@@ -1,0 +1,29 @@
+"""corrosion_tpu — a TPU-native framework with the capabilities of Corrosion.
+
+Corrosion (the reference, superfly/corrosion) is a gossip-based, eventually
+consistent distributed SQLite for service discovery: SWIM membership (foca),
+CRDT changeset broadcast over QUIC, periodic anti-entropy sync, LWW register
+merge via the CR-SQLite extension.
+
+This package rebuilds those capabilities TPU-first, in two halves:
+
+- ``corrosion_tpu.sim``: the TPU cluster simulator. Nodes are rows of
+  struct-of-arrays state; SWIM probe/ack/suspect/disseminate, changeset
+  fanout, and anti-entropy sync are fused, jittable message-passing steps;
+  CR-SQLite's LWW merge is an elementwise lexicographic max over
+  ``(col_version, value, site_id)`` clocks. State shards across a
+  ``jax.sharding.Mesh`` so 10k-100k node clusters simulate on a TPU pod
+  slice (neighbor exchange rides ICI collectives).
+
+- ``corrosion_tpu.runtime``: the host-side agent runtime — a real,
+  networked eventually-consistent SQLite node (asyncio + stdlib sqlite3)
+  with the same protocol semantics, used both standalone (the product
+  surface: HTTP API, schema management, subscriptions, CLI, admin) and as
+  the small-cluster oracle the simulator is parity-checked against.
+
+Shared pieces live in ``ops`` (jittable kernels), ``parallel`` (mesh and
+sharding helpers), and ``utils`` (tripwire/backoff/spawn/metrics — the
+reference's lifecycle crates, reimagined for asyncio).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,23 @@
+"""Jittable kernels shared by the simulator: LWW merge, version
+bookkeeping, lexicographic segment reductions."""
+
+from corrosion_tpu.ops.lww import (  # noqa: F401
+    INT32_MIN,
+    STATE_ALIVE,
+    STATE_DOWN,
+    STATE_SUSPECT,
+    apply_changes_to_store,
+    lex_max,
+    lex_segment_argmax,
+    lex_wins,
+    merge_store,
+    pack_inc_state,
+    unpack_inc_state,
+)
+from corrosion_tpu.ops.versions import (  # noqa: F401
+    NO_ORIGIN,
+    Book,
+    advance_heads,
+    needs_count,
+    record_versions,
+)

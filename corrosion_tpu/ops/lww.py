@@ -1,0 +1,156 @@
+"""Last-write-wins merge kernels — the TPU-native equivalent of CR-SQLite.
+
+The reference ships the CRDT engine as a prebuilt native SQLite extension
+(``crates/corro-types/crsqlite-linux-x86_64.so``, loaded at
+``crates/corro-types/src/sqlite.rs:121-139``). Its per-column LWW merge rule
+(reference ``doc/crdts.md:14-16`` and ``doc/crdts.md:237``) is:
+
+1. biggest ``col_version`` wins;
+2. tie -> biggest ``value`` wins (SQLite ``max()`` ordering);
+3. tie -> biggest ``site_id`` wins.
+
+Here that rule is an elementwise lexicographic max over three int32 key
+planes ``(col_version, value, site_id)``; each cell also carries the
+origin's ``db_version`` as a payload plane (cr-sqlite clock rows keep
+``db_version`` alongside, which is what anti-entropy sync ranges over).
+A whole-store merge of two replicas is one fused elementwise op; merging a
+batch of in-flight changes addressed at arbitrary cells is a lexicographic
+segment-argmax followed by one scatter. Everything is int32 to stay on the
+TPU's native integer path (no x64 emulation).
+
+SWIM membership views use the same trick with a *packed* single-word key:
+``incarnation * 4 + state_precedence`` so that "higher incarnation wins;
+same incarnation: Down > Suspect > Alive" (foca's invariants; the reference
+uses ``foca = 0.16``, ``Cargo.toml:28``) becomes plain ``maximum`` /
+``segment_max`` / ``.at[].max`` on one int32 plane.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+INT32_MIN = jnp.int32(-2147483648)
+
+# SWIM member states, ordered by same-incarnation precedence
+# (Down > Suspect > Alive), matching foca's update semantics.
+STATE_ALIVE = 0
+STATE_SUSPECT = 1
+STATE_DOWN = 2
+
+
+def lex_wins(a: Sequence[jax.Array], b: Sequence[jax.Array]) -> jax.Array:
+    """Elementwise: does tuple ``a`` win (>=) against ``b`` lexicographically?
+
+    With keys ``(col_version, value, site_id)`` this is exactly the LWW rule
+    of ``doc/crdts.md:237``. Full ties keep ``a`` (the incumbent) — a full
+    tie means an identical change, so it is immaterial.
+    """
+    assert len(a) == len(b) and len(a) >= 1
+    # Build from the last key up: wins_k = a_k > b_k | (a_k == b_k & wins_{k+1})
+    wins = a[-1] >= b[-1]
+    for ak, bk in zip(reversed(a[:-1]), reversed(b[:-1])):
+        wins = (ak > bk) | ((ak == bk) & wins)
+    return wins
+
+
+def lex_max(
+    a: Sequence[jax.Array], b: Sequence[jax.Array], *payloads
+) -> Tuple[jax.Array, ...]:
+    """Elementwise lexicographic max over key tuples, carrying payloads.
+
+    ``payloads`` are ``(pa, pb)`` pairs selected by the same winner mask.
+    Returns ``(*keys, *selected_payloads)``.
+    """
+    wins = lex_wins(a, b)
+    keys = tuple(jnp.where(wins, ak, bk) for ak, bk in zip(a, b))
+    extra = tuple(jnp.where(wins, pa, pb) for pa, pb in payloads)
+    return keys + extra
+
+
+def lex_segment_argmax(
+    keys: Sequence[jax.Array], segment_ids: jax.Array, num_segments: int
+) -> Tuple[jax.Array, jax.Array]:
+    """Index of the lexicographically-largest key tuple per segment.
+
+    One ``segment_max`` pass per key, masking losers with ``INT32_MIN``
+    between passes — no int64 packing needed. Returns ``(argmax, nonempty)``
+    where ``argmax`` is a global index into the batch (arbitrary member of
+    the winner class for exact ties) and ``nonempty`` marks segments that
+    received at least one live entry. Entries the caller wants ignored must
+    be routed to a scratch segment beforehand.
+    """
+    alive = None
+    for k in keys:
+        kk = k if alive is None else jnp.where(alive, k, INT32_MIN)
+        m = jax.ops.segment_max(kk, segment_ids, num_segments=num_segments)
+        this = kk == m[segment_ids]
+        alive = this if alive is None else (alive & this)
+    idxs = jnp.arange(segment_ids.shape[0], dtype=jnp.int32)
+    winner = jax.ops.segment_max(
+        jnp.where(alive, idxs, jnp.int32(-1)), segment_ids, num_segments=num_segments
+    )
+    return jnp.maximum(winner, 0), winner >= 0
+
+
+def merge_store(store, incoming):
+    """Merge two whole LWW stores.
+
+    A store is ``(ver, val, site, dbv)`` — three lex-key planes plus the
+    origin-db_version payload plane, all int32 of identical shape. This is
+    the array analog of replaying every row of a remote ``crsql_changes``
+    into the local db (``INSERT INTO crsql_changes``, reference
+    ``crates/corro-agent/src/agent/util.rs:1233``): each cell resolves
+    independently by the LWW rule.
+    """
+    a, b = store, incoming
+    return lex_max(a[:3], b[:3], (a[3], b[3]))
+
+
+def apply_changes_to_store(store, flat_idx, ver, val, site, dbv, valid):
+    """Apply a batch of addressed changes to a flattened LWW store.
+
+    ``store``: ``(ver, val, site, dbv)`` planes flattened to 1-D size S.
+    ``flat_idx`` int32 [M] target cell per change; ``valid`` bool [M]
+    (invalid changes route to scratch segment S and vanish).
+
+    Matches applying a batch of remote changes in one SQLite tx
+    (``process_multiple_changes``, reference
+    ``crates/corro-agent/src/agent/util.rs:699``): order within the batch is
+    irrelevant because the LWW join is commutative and associative — that is
+    what makes it a CRDT and what lets the simulator apply a whole gossip
+    round's message soup in one fused op.
+    """
+    s_ver, s_val, s_site, s_dbv = store
+    size = s_ver.shape[0]
+    seg = jnp.where(valid, flat_idx, size).astype(jnp.int32)
+    win, nonempty = lex_segment_argmax((ver, val, site), seg, num_segments=size + 1)
+    win, nonempty = win[:size], nonempty[:size]
+    b = (ver[win], val[win], site[win], dbv[win])
+    m_ver, m_val, m_site, m_dbv = lex_max(
+        (s_ver, s_val, s_site), b[:3], (s_dbv, b[3])
+    )
+    return (
+        jnp.where(nonempty, m_ver, s_ver),
+        jnp.where(nonempty, m_val, s_val),
+        jnp.where(nonempty, m_site, s_site),
+        jnp.where(nonempty, m_dbv, s_dbv),
+    )
+
+
+def pack_inc_state(incarnation, state):
+    """Pack (incarnation, member-state) into one comparable int32.
+
+    ``incarnation * 4 + state`` — so ordinary ``max`` implements foca's
+    update precedence: higher incarnation always wins; within an
+    incarnation Down(2) > Suspect(1) > Alive(0). Incarnations stay well
+    below 2**29 (they bump only on refute/rejoin, reference
+    ``crates/corro-types/src/actor.rs:199-210``).
+    """
+    return incarnation * 4 + state
+
+
+def unpack_inc_state(packed):
+    return packed >> 2, packed & 3

@@ -1,0 +1,221 @@
+"""Per-origin version bookkeeping — the array analog of ``BookedVersions``.
+
+The reference tracks, per (node, origin-actor): applied versions, partially
+applied versions, and *gaps* (needed ranges) in a rangemap mirrored into
+``__corro_bookkeeping_gaps`` (``crates/corro-types/src/agent.rs:1270-1604``,
+gap algebra ``compute_gaps_change`` at ``agent.rs:1179-1244``). Gaps drive
+anti-entropy sync need computation (``crates/corro-types/src/sync.rs:127``),
+and the seen-check dedupes re-broadcasts
+(``crates/corro-agent/src/agent/handlers.rs:548-786``).
+
+Array re-design (no dynamic rangemaps): because the LWW join is commutative
+and associative, a change can be *applied* to the store the moment it
+arrives, in any order; bookkeeping only needs to know WHICH origin-versions
+have been seen. Per (node, origin) we keep
+
+- ``head``      int32 [N, O]: all origin-versions ``1..head`` seen
+  (contiguous prefix — the complement of the reference's gap set),
+- ``known_max`` int32 [N, O]: highest origin-version heard of (gossiped
+  alongside changes; bounds need computation),
+
+plus a bounded per-node out-of-order buffer of seen versions beyond the
+head — ``buf_origin``/``buf_ver`` int32 [N, K], free slots marked -1 —
+the analog of the reference's partials/gap bookkeeping with the queue-cap
+drop policy of ``handle_changes`` (overflow drops; sync repairs later).
+
+Head advance ("gaps closing") is a sort + segmented boolean scan, fully
+jittable and batched over all nodes at once.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+NO_ORIGIN = jnp.int32(-1)  # free buffer slot marker
+
+
+class Book(NamedTuple):
+    """Version bookkeeping for all N simulated nodes over O origins."""
+
+    head: jax.Array  # int32 [N, O]
+    known_max: jax.Array  # int32 [N, O]
+    buf_origin: jax.Array  # int32 [N, K], -1 = free
+    buf_ver: jax.Array  # int32 [N, K]
+
+    @staticmethod
+    def create(n_nodes: int, n_origins: int, buf_slots: int) -> "Book":
+        return Book(
+            head=jnp.zeros((n_nodes, n_origins), jnp.int32),
+            known_max=jnp.zeros((n_nodes, n_origins), jnp.int32),
+            buf_origin=jnp.full((n_nodes, buf_slots), NO_ORIGIN, jnp.int32),
+            buf_ver=jnp.zeros((n_nodes, buf_slots), jnp.int32),
+        )
+
+
+def record_versions(book: Book, origin, ver, valid):
+    """Record a per-node batch of incoming (origin, version) pairs.
+
+    ``origin``/``ver``: int32 [N, M] — up to M messages per node this round;
+    ``valid``: bool [N, M]. Returns ``(book, fresh)`` where ``fresh`` [N, M]
+    marks messages not seen before by that node (the seen-cache check of
+    ``handle_changes``, reference ``handlers.rs:548-786`` — fresh changes
+    get applied and re-broadcast, stale ones dropped).
+
+    Fresh messages are placed into free buffer slots (overflow → dropped,
+    like the bounded processing queue, ``config.rs:15-27``; sync repairs),
+    then heads advance over any newly-closed gaps.
+    """
+    n_nodes, n_slots = book.buf_origin.shape
+
+    # --- seen-checks -----------------------------------------------------
+    behind_head = ver <= jnp.take_along_axis(book.head, origin, axis=1)
+    in_buffer = jnp.any(
+        (book.buf_origin[:, None, :] == origin[:, :, None])
+        & (book.buf_ver[:, None, :] == ver[:, :, None]),
+        axis=2,
+    )
+    # dedupe within the batch: keep only the first of identical (o, v) pairs
+    same = (
+        (origin[:, :, None] == origin[:, None, :])
+        & (ver[:, :, None] == ver[:, None, :])
+        & valid[:, None, :]
+    )
+    m = origin.shape[1]
+    earlier = jnp.tril(jnp.ones((m, m), bool), k=-1)
+    dup_in_batch = jnp.any(same & earlier[None, :, :], axis=2)
+
+    fresh = valid & ~behind_head & ~in_buffer & ~dup_in_batch
+
+    # --- slot allocation (per node, vectorized) --------------------------
+    free = book.buf_origin == NO_ORIGIN
+    # free slots first, in order
+    slot_order = jnp.argsort(~free, axis=1, stable=True).astype(jnp.int32)
+    n_free = jnp.sum(free, axis=1).astype(jnp.int32)
+    rank = (jnp.cumsum(fresh, axis=1) - 1).astype(jnp.int32)
+    placed = fresh & (rank < n_free[:, None])
+    slot = jnp.take_along_axis(slot_order, jnp.clip(rank, 0, n_slots - 1), axis=1)
+    rows = jnp.broadcast_to(
+        jnp.arange(n_nodes, dtype=jnp.int32)[:, None], slot.shape
+    )
+    flat = jnp.where(placed, rows * n_slots + slot, n_nodes * n_slots)
+    buf_origin = (
+        book.buf_origin.reshape(-1)
+        .at[flat.reshape(-1)]
+        .set(origin.reshape(-1), mode="drop")
+        .reshape(book.buf_origin.shape)
+    )
+    buf_ver = (
+        book.buf_ver.reshape(-1)
+        .at[flat.reshape(-1)]
+        .set(ver.reshape(-1), mode="drop")
+        .reshape(book.buf_ver.shape)
+    )
+
+    # --- known_max scatter-max ------------------------------------------
+    n_origins = book.head.shape[1]
+    flat_ko = jnp.where(valid, rows * n_origins + origin, n_nodes * n_origins)
+    known_max = (
+        book.known_max.reshape(-1)
+        .at[flat_ko.reshape(-1)]
+        .max(ver.reshape(-1), mode="drop")
+        .reshape(book.known_max.shape)
+    )
+
+    book = Book(book.head, known_max, buf_origin, buf_ver)
+    return advance_heads(book), fresh
+
+
+def advance_heads(book: Book) -> Book:
+    """Advance per-(node, origin) heads over buffered contiguous runs.
+
+    The jittable replacement for the reference's gap-merge
+    (``compute_gaps_change``, ``agent.rs:1179-1244``): sort each node's
+    buffer by (origin, version), then a segmented boolean affine scan marks
+    every entry reachable from its origin's head by a contiguous chain;
+    reachable entries advance the head and free their slots. One pass
+    suffices because the sort groups each origin's chain contiguously.
+    """
+    n_nodes, n_slots = book.buf_origin.shape
+    n_origins = book.head.shape[1]
+
+    free = book.buf_origin == NO_ORIGIN
+    o_key = jnp.where(free, jnp.int32(n_origins), book.buf_origin)
+
+    def sort_one(o, v):
+        order = jnp.lexsort((v, o)).astype(jnp.int32)
+        return o[order], v[order]
+
+    o_s, v_s = jax.vmap(sort_one)(o_key, book.buf_ver)
+
+    head_at = jnp.take_along_axis(
+        book.head, jnp.clip(o_s, 0, n_origins - 1), axis=1
+    )
+    live = o_s < n_origins
+    start = live & (v_s == head_at + 1)
+    chain = (
+        live
+        & (o_s == jnp.roll(o_s, 1, axis=1))
+        & (v_s == jnp.roll(v_s, 1, axis=1) + 1)
+    )
+    chain = chain.at[:, 0].set(False)
+
+    # consumable[i] = start[i] | (chain[i] & consumable[i-1]) — an affine
+    # boolean recurrence; solve with an associative scan over map
+    # composition (c, s) ∘ (c', s') = (c & c', s | (c & s')).
+    def compose(g1, g2):
+        c1, s1 = g1
+        c2, s2 = g2
+        return c1 & c2, s2 | (c2 & s1)
+
+    _, consumable = jax.lax.associative_scan(compose, (chain, start), axis=1)
+
+    rows = jnp.broadcast_to(
+        jnp.arange(n_nodes, dtype=jnp.int32)[:, None], o_s.shape
+    )
+    flat = jnp.where(
+        consumable, rows * n_origins + o_s, jnp.int32(n_nodes * n_origins)
+    )
+    head = (
+        book.head.reshape(-1)
+        .at[flat.reshape(-1)]
+        .max(v_s.reshape(-1), mode="drop")
+        .reshape(book.head.shape)
+    )
+
+    # free consumed slots and any slot at/below the (possibly jumped) head
+    head_after = jnp.take_along_axis(head, jnp.clip(o_s, 0, n_origins - 1), axis=1)
+    drop = consumable | (live & (v_s <= head_after))
+    o_out = jnp.where(drop, NO_ORIGIN, jnp.where(live, o_s, NO_ORIGIN))
+    v_out = jnp.where(drop | ~live, 0, v_s)
+    return Book(head, jnp.maximum(book.known_max, head), o_out, v_out)
+
+
+def needs_count(book: Book) -> jax.Array:
+    """Outstanding need per (node, origin): versions heard of but not seen.
+
+    ``known_max - head - |buffered in (head, known_max]|`` — the scalar
+    magnitude of the reference's gap set, used both for sync peer choice
+    ("most needed versions first", ``handlers.rs:808-863``) and as the
+    convergence predicate (no needs + equal heads — the same check as the
+    reference's ``check_bookkeeping.py`` Antithesis driver).
+    """
+    live = book.buf_origin != NO_ORIGIN
+    n_origins = book.head.shape[1]
+    o = jnp.clip(book.buf_origin, 0, n_origins - 1)
+    above_head = book.buf_ver > jnp.take_along_axis(book.head, o, axis=1)
+    counted = live & above_head
+    n_nodes = book.head.shape[0]
+    rows = jnp.broadcast_to(
+        jnp.arange(n_nodes, dtype=jnp.int32)[:, None], o.shape
+    )
+    flat = jnp.where(counted, rows * n_origins + o, n_nodes * n_origins)
+    buffered = (
+        jnp.zeros(n_nodes * n_origins, jnp.int32)
+        .at[flat.reshape(-1)]
+        .add(1, mode="drop")
+        .reshape(book.head.shape)
+    )
+    return jnp.maximum(book.known_max - book.head, 0) - buffered

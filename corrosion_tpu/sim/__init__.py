@@ -1,0 +1,3 @@
+"""The TPU cluster simulator: Corrosion's distributed protocols (SWIM
+membership, CRDT changeset broadcast, anti-entropy sync) as fused, jittable
+message-passing steps over struct-of-arrays node state."""

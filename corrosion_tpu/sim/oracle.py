@@ -1,0 +1,83 @@
+"""Pure-Python host oracle for the simulator — the small-N ground truth.
+
+Mirrors the semantics the array kernels must reproduce, in plain dicts and
+sets: LWW cell merge (``doc/crdts.md:14-16,237``), per-origin version
+bookkeeping (seen-set / contiguous head — ``BookedVersions``, reference
+``crates/corro-types/src/agent.rs:1270-1604``), and the convergence
+predicate ("no needs, equal heads", as the reference's Antithesis
+``check_bookkeeping.py`` driver checks).
+
+Deliberately slow and obvious; property tests drive both this and the
+jitted kernels with the same random traffic and demand identical states.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Set, Tuple
+
+Change = Tuple[int, int, int, int, int, int]  # (cell, ver, val, site, origin, dbv)
+
+
+def lww_wins(a: Tuple[int, int, int], b: Tuple[int, int, int]) -> bool:
+    """Does clock ``a`` = (col_version, value, site_id) beat ``b``?
+
+    Ties keep the incumbent ``a`` (identical change)."""
+    return a >= b  # Python tuple comparison IS the lexicographic rule
+
+
+@dataclass
+class OracleNode:
+    """One simulated node: LWW store + per-origin version bookkeeping."""
+
+    n_origins: int
+    # cell -> (col_version, value, site, origin_db_version)
+    store: Dict[int, Tuple[int, int, int, int]] = field(default_factory=dict)
+    seen: Dict[int, Set[int]] = field(default_factory=dict)  # origin -> versions
+    known_max: Dict[int, int] = field(default_factory=dict)
+
+    def head(self, origin: int) -> int:
+        s = self.seen.get(origin, set())
+        h = 0
+        while (h + 1) in s:
+            h += 1
+        return h
+
+    def merge_cell(self, cell: int, ver: int, val: int, site: int, dbv: int):
+        cur = self.store.get(cell)
+        if cur is None or not lww_wins(cur[:3], (ver, val, site)):
+            self.store[cell] = (ver, val, site, dbv)
+
+    def record(self, origin: int, version: int) -> bool:
+        """Record an origin-version; returns True when fresh (unseen)."""
+        s = self.seen.setdefault(origin, set())
+        self.known_max[origin] = max(self.known_max.get(origin, 0), version)
+        if version in s:
+            return False
+        s.add(version)
+        return True
+
+    def apply(self, change: Change) -> bool:
+        cell, ver, val, site, origin, dbv = change
+        fresh = self.record(origin, dbv)
+        if fresh:
+            self.merge_cell(cell, ver, val, site, dbv)
+        return fresh
+
+    def needs(self, origin: int) -> int:
+        s = self.seen.get(origin, set())
+        km = self.known_max.get(origin, 0)
+        return sum(1 for v in range(1, km + 1) if v not in s)
+
+
+def converged(nodes) -> bool:
+    """The reference's convergence check: no needs + equal heads
+    (``check_bookkeeping.py``), plus (stronger) identical LWW stores."""
+    first = nodes[0]
+    for n in nodes[1:]:
+        if n.store != first.store:
+            return False
+        for o in range(first.n_origins):
+            if n.head(o) != first.head(o) or n.needs(o) or first.needs(o):
+                return False
+    return True

@@ -1,0 +1,26 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+Multi-chip TPU hardware is not available in CI; per the build plan, all
+sharding logic is validated on ``--xla_force_host_platform_device_count=8``
+host devices (the driver separately dry-runs the multi-chip path).
+
+NOTE: this environment's ``sitecustomize`` registers an ``axon`` TPU-tunnel
+PJRT plugin at interpreter start and forces ``jax_platforms`` via
+``config.update`` — which takes precedence over the ``JAX_PLATFORMS`` env
+var. An explicit ``config.update("jax_platforms", "cpu")`` is therefore
+required, or every ``jax.devices()`` call tries (and may hang) to init the
+TPU tunnel.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,98 @@
+"""Property tests: Book (version bookkeeping) against the oracle seen-sets.
+
+The array Book must match the oracle's contiguous head, freshness
+decisions, and needs counts for any arrival order — the same contracts the
+reference's gap-algebra unit tests pin down
+(``crates/corro-types/src/agent.rs:1606-1841``)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from corrosion_tpu.ops import NO_ORIGIN, Book, needs_count, record_versions
+from corrosion_tpu.sim.oracle import OracleNode
+
+
+def run_rounds(rng, n_nodes, n_origins, slots, batch, rounds, max_ver=20):
+    book = Book.create(n_nodes, n_origins, slots)
+    oracles = [OracleNode(n_origins) for _ in range(n_nodes)]
+    fresh_match = True
+    for _ in range(rounds):
+        origin = rng.integers(0, n_origins, (n_nodes, batch))
+        ver = rng.integers(1, max_ver, (n_nodes, batch))
+        valid = rng.random((n_nodes, batch)) < 0.7
+        book, fresh = record_versions(
+            book,
+            jnp.asarray(origin, jnp.int32),
+            jnp.asarray(ver, jnp.int32),
+            jnp.asarray(valid),
+        )
+        fresh = np.asarray(fresh)
+        for n in range(n_nodes):
+            batch_seen = set()
+            for j in range(batch):
+                if not valid[n, j]:
+                    continue
+                o, v = int(origin[n, j]), int(ver[n, j])
+                want = oracles[n].record(o, v) and (o, v) not in batch_seen
+                batch_seen.add((o, v))
+                if bool(fresh[n, j]) != want:
+                    fresh_match = False
+    return book, oracles, fresh_match
+
+
+def test_heads_and_freshness_match_oracle_when_buffer_ample():
+    rng = np.random.default_rng(3)
+    # slots ample: every out-of-order version fits, so heads must be exact
+    book, oracles, fresh_ok = run_rounds(
+        rng, n_nodes=5, n_origins=3, slots=64, batch=8, rounds=12, max_ver=15
+    )
+    assert fresh_ok
+    heads = np.asarray(book.head)
+    needs = np.asarray(needs_count(book))
+    for n, o in np.ndindex(heads.shape):
+        assert heads[n, o] == oracles[n].head(o), (n, o)
+        assert needs[n, o] == oracles[n].needs(o), (n, o)
+
+
+def test_contiguous_delivery_keeps_buffer_empty():
+    n_nodes, n_origins = 4, 2
+    book = Book.create(n_nodes, n_origins, 8)
+    for v in range(1, 6):
+        origin = jnp.zeros((n_nodes, 2), jnp.int32)
+        ver = jnp.full((n_nodes, 2), v, jnp.int32)
+        valid = jnp.asarray([[True, True]] * n_nodes)  # duplicate in batch
+        book, fresh = record_versions(book, origin, ver, valid)
+        assert np.asarray(fresh)[:, 0].all() and not np.asarray(fresh)[:, 1].any()
+    assert (np.asarray(book.head)[:, 0] == 5).all()
+    assert (np.asarray(book.buf_origin) == NO_ORIGIN).all()
+
+
+def test_gap_then_close_advances_head_in_one_pass():
+    book = Book.create(1, 1, 8)
+    o = jnp.zeros((1, 4), jnp.int32)
+    # versions 2,3,5 arrive first: head stays 0, needs = 3 (1,2,3 missing? no:
+    # known_max=5, seen={2,3,5} → missing {1,4} → needs 2)
+    book, _ = record_versions(
+        book, o[:, :3], jnp.asarray([[2, 3, 5]], jnp.int32), jnp.ones((1, 3), bool)
+    )
+    assert int(book.head[0, 0]) == 0
+    assert int(needs_count(book)[0, 0]) == 2
+    # 1 and 4 arrive: whole chain 1..5 must collapse in one record call
+    book, _ = record_versions(
+        book, o[:, :2], jnp.asarray([[4, 1]], jnp.int32), jnp.ones((1, 2), bool)
+    )
+    assert int(book.head[0, 0]) == 5
+    assert int(needs_count(book)[0, 0]) == 0
+    assert (np.asarray(book.buf_origin) == NO_ORIGIN).all()
+
+
+def test_buffer_overflow_drops_but_keeps_correct_heads():
+    rng = np.random.default_rng(4)
+    # slots tiny: drops will happen; heads must still be a *lower bound* of
+    # the oracle's and never exceed it (dropped = not seen)
+    book, oracles, _ = run_rounds(
+        rng, n_nodes=4, n_origins=2, slots=3, batch=6, rounds=10, max_ver=30
+    )
+    heads = np.asarray(book.head)
+    for n, o in np.ndindex(heads.shape):
+        assert heads[n, o] <= oracles[n].head(o), (n, o)
