@@ -1,0 +1,88 @@
+"""Fixed-capacity slot machinery — bounded queues/mailboxes under jit.
+
+The reference is full of bounded queues with drop policies (change
+processing queue cap 20k, broadcast queue with drop-oldest,
+``crates/corro-types/src/config.rs:15-60``,
+``crates/corro-agent/src/broadcast/mod.rs:410-812``). Under XLA every
+shape is static, so those become fixed-width slot arrays plus two
+primitives:
+
+- ``alloc_slots``: place a batch of candidate items into free slots of
+  per-row pools (overflow -> dropped, the drop policy);
+- ``mailbox_pack``: regroup a flat, arbitrarily-addressed message batch
+  into dense per-receiver rows (the "one channel per node" illusion),
+  bounded per-receiver capacity, overflow dropped.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def alloc_slots(free, want):
+    """Assign free slots of each row to wanting items of the same row.
+
+    ``free``: bool [N, K] — free slots per row. ``want``: bool [N, M] —
+    items wanting a slot. Returns ``(slot, placed)``: int32 [N, M] slot
+    index per item (clipped garbage when not placed) and bool [N, M].
+    Items beyond the free-slot supply are not placed (drop policy).
+    """
+    n, k = free.shape
+    slot_order = jnp.argsort(~free, axis=1, stable=True).astype(jnp.int32)
+    n_free = jnp.sum(free, axis=1).astype(jnp.int32)
+    rank = (jnp.cumsum(want, axis=1) - 1).astype(jnp.int32)
+    placed = want & (rank < n_free[:, None])
+    slot = jnp.take_along_axis(slot_order, jnp.clip(rank, 0, k - 1), axis=1)
+    return slot, placed
+
+
+def scatter_rows(dest, slot, placed, values):
+    """``dest[i, slot[i,j]] = values[i,j]`` where ``placed`` — flat scatter."""
+    n, k = dest.shape
+    rows = jnp.broadcast_to(
+        jnp.arange(n, dtype=jnp.int32)[:, None], slot.shape
+    )
+    flat = jnp.where(placed, rows * k + slot, n * k)
+    return (
+        dest.reshape(-1)
+        .at[flat.reshape(-1)]
+        .set(values.reshape(-1), mode="drop")
+        .reshape(n, k)
+    )
+
+
+def mailbox_pack(recv, valid, n_rows: int, capacity: int, fields):
+    """Regroup flat messages into dense per-receiver mailboxes.
+
+    ``recv`` int32 [M], ``valid`` bool [M], ``fields``: tuple of int32 [M]
+    payload arrays. Returns ``(live, packed_fields)`` with shapes
+    [n_rows, capacity]; messages past a receiver's capacity are dropped
+    (bounded-queue semantics). Implemented as one sort by receiver plus a
+    segmented rank — no per-receiver loops.
+    """
+    m = recv.shape[0]
+    sort_key = jnp.where(valid, recv, jnp.int32(n_rows))
+    order = jnp.argsort(sort_key, stable=True).astype(jnp.int32)
+    r_s = sort_key[order]
+    idx = jnp.arange(m, dtype=jnp.int32)
+    is_start = jnp.concatenate([jnp.ones(1, bool), r_s[1:] != r_s[:-1]])
+    run_start = jax.lax.cummax(jnp.where(is_start, idx, 0))
+    rank = idx - run_start
+    ok = (r_s < n_rows) & (rank < capacity)
+    flat = jnp.where(ok, r_s * capacity + rank, n_rows * capacity)
+
+    live = (
+        jnp.zeros(n_rows * capacity, bool)
+        .at[flat]
+        .set(True, mode="drop")
+        .reshape(n_rows, capacity)
+    )
+    packed = tuple(
+        jnp.zeros(n_rows * capacity, f.dtype)
+        .at[flat]
+        .set(f[order], mode="drop")
+        .reshape(n_rows, capacity)
+        for f in fields
+    )
+    return live, packed

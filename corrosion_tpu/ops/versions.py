@@ -34,6 +34,8 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from corrosion_tpu.ops.slots import alloc_slots, scatter_rows
+
 NO_ORIGIN = jnp.int32(-1)  # free buffer slot marker
 
 
@@ -91,31 +93,15 @@ def record_versions(book: Book, origin, ver, valid):
 
     # --- slot allocation (per node, vectorized) --------------------------
     free = book.buf_origin == NO_ORIGIN
-    # free slots first, in order
-    slot_order = jnp.argsort(~free, axis=1, stable=True).astype(jnp.int32)
-    n_free = jnp.sum(free, axis=1).astype(jnp.int32)
-    rank = (jnp.cumsum(fresh, axis=1) - 1).astype(jnp.int32)
-    placed = fresh & (rank < n_free[:, None])
-    slot = jnp.take_along_axis(slot_order, jnp.clip(rank, 0, n_slots - 1), axis=1)
-    rows = jnp.broadcast_to(
-        jnp.arange(n_nodes, dtype=jnp.int32)[:, None], slot.shape
-    )
-    flat = jnp.where(placed, rows * n_slots + slot, n_nodes * n_slots)
-    buf_origin = (
-        book.buf_origin.reshape(-1)
-        .at[flat.reshape(-1)]
-        .set(origin.reshape(-1), mode="drop")
-        .reshape(book.buf_origin.shape)
-    )
-    buf_ver = (
-        book.buf_ver.reshape(-1)
-        .at[flat.reshape(-1)]
-        .set(ver.reshape(-1), mode="drop")
-        .reshape(book.buf_ver.shape)
-    )
+    slot, placed = alloc_slots(free, fresh)
+    buf_origin = scatter_rows(book.buf_origin, slot, placed, origin)
+    buf_ver = scatter_rows(book.buf_ver, slot, placed, ver)
 
     # --- known_max scatter-max ------------------------------------------
     n_origins = book.head.shape[1]
+    rows = jnp.broadcast_to(
+        jnp.arange(n_nodes, dtype=jnp.int32)[:, None], origin.shape
+    )
     flat_ko = jnp.where(valid, rows * n_origins + origin, n_nodes * n_origins)
     known_max = (
         book.known_max.reshape(-1)

@@ -1,0 +1,237 @@
+"""CRDT changeset broadcast + apply as one fused round.
+
+Reference pipeline (SURVEY §3.2/§3.3): a local write commits, its
+changeset rows are chunked and pushed onto the broadcast queue
+(``make_broadcastable_changes`` ->
+``crates/corro-types/src/broadcast.rs:506-574``); ``handle_broadcasts``
+flushes the queue to ring0 + a random sample of members, re-sending each
+changeset up to ``max_transmissions`` times
+(``crates/corro-agent/src/broadcast/mod.rs:410-812``); receivers dedupe
+against the seen-cache/bookie, apply in batched transactions, and
+*re-broadcast* fresh changes with a decremented budget
+(``agent/handlers.rs:548-786``).
+
+Array re-design: every node carries a fixed-width outgoing queue of
+pending changesets (free slot = origin -1). One round =
+
+1. writers commit new cells (``local_write``),
+2. every node with queued changes picks ``bcast_fanout`` believed-alive
+   targets and fires its sendable slots over the lossy uni channel,
+3. the flat message soup is packed into per-receiver mailboxes
+   (bounded; overflow = the reference's queue-cap drop, repaired by sync),
+4. receivers dedupe via ``Book`` (fresh = unseen origin-version), apply
+   fresh cells to the LWW store in one ``apply_changes_to_store``, and
+   enqueue fresh changes for re-broadcast with budget-1.
+
+Ordering is irrelevant to correctness (LWW join is commutative), which is
+what lets a whole round apply as one scatter — the reference needs
+newest-first wire order only as a latency optimization
+(``test_broadcast_order``, ``broadcast/mod.rs:1104-1202``).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+
+from corrosion_tpu.ops.lww import apply_changes_to_store
+from corrosion_tpu.ops.slots import alloc_slots, mailbox_pack, scatter_rows
+from corrosion_tpu.ops.versions import Book, record_versions
+from corrosion_tpu.sim.config import SimConfig
+from corrosion_tpu.sim.transport import NetModel, uni_ok
+
+NO_Q = jnp.int32(-1)
+
+
+class CrdtState(NamedTuple):
+    """LWW store + bookkeeping + broadcast queues for all N nodes."""
+
+    store: Tuple[jax.Array, jax.Array, jax.Array, jax.Array]  # 4x int32 [N, R*C]
+    book: Book
+    next_dbv: jax.Array  # int32 [N] — origin's next db_version (1-based)
+    q_origin: jax.Array  # int32 [N, Q] — -1 = free slot
+    q_dbv: jax.Array  # int32 [N, Q]
+    q_cell: jax.Array  # int32 [N, Q]
+    q_ver: jax.Array  # int32 [N, Q]
+    q_val: jax.Array  # int32 [N, Q]
+    q_site: jax.Array  # int32 [N, Q]
+    q_tx: jax.Array  # int32 [N, Q] — remaining transmissions
+
+    @staticmethod
+    def create(cfg: SimConfig) -> "CrdtState":
+        n, q, c = cfg.n_nodes, cfg.bcast_queue, cfg.n_cells
+        z = lambda *s: jnp.zeros(s, jnp.int32)  # noqa: E731
+        return CrdtState(
+            store=(z(n, c), z(n, c), z(n, c), z(n, c)),
+            book=Book.create(n, cfg.n_origins, cfg.buf_slots),
+            next_dbv=jnp.ones(n, jnp.int32),
+            q_origin=jnp.full((n, q), NO_Q, jnp.int32),
+            q_dbv=z(n, q),
+            q_cell=z(n, q),
+            q_ver=z(n, q),
+            q_val=z(n, q),
+            q_site=z(n, q),
+            q_tx=z(n, q),
+        )
+
+
+def _enqueue(cst: CrdtState, want, origin, dbv, cell, ver, val, site, tx):
+    """Place per-node batches of changes into free queue slots."""
+    free = cst.q_origin == NO_Q
+    slot, placed = alloc_slots(free, want)
+    return cst._replace(
+        q_origin=scatter_rows(cst.q_origin, slot, placed, origin),
+        q_dbv=scatter_rows(cst.q_dbv, slot, placed, dbv),
+        q_cell=scatter_rows(cst.q_cell, slot, placed, cell),
+        q_ver=scatter_rows(cst.q_ver, slot, placed, ver),
+        q_val=scatter_rows(cst.q_val, slot, placed, val),
+        q_site=scatter_rows(cst.q_site, slot, placed, site),
+        q_tx=scatter_rows(cst.q_tx, slot, placed, tx),
+    )
+
+
+def local_write(cfg: SimConfig, cst: CrdtState, write_mask, cell, val):
+    """Commit one-cell write transactions at the writer nodes.
+
+    ``write_mask`` bool [N] (only indices < n_origins may be set),
+    ``cell``/``val`` int32 [N]. Mirrors ``POST /v1/transactions``
+    (SURVEY §3.2): assign db_version, bump the cell's col_version from
+    the *current* clock (cr-sqlite increments the clock row it sees,
+    merged or local), apply locally, queue the changeset for broadcast.
+    """
+    n = cfg.n_nodes
+    iarr = jnp.arange(n, dtype=jnp.int32)
+    is_origin = iarr < cfg.n_origins
+    w = write_mask & is_origin
+
+    dbv = cst.next_dbv
+    cur_ver = cst.store[0][iarr, cell]
+    ver = cur_ver + 1
+    site = iarr
+
+    # apply to own store
+    flat_idx = iarr * cfg.n_cells + cell
+    store = apply_changes_to_store(
+        tuple(p.reshape(-1) for p in cst.store), flat_idx, ver, val, site, dbv, w
+    )
+    store = tuple(p.reshape(n, cfg.n_cells) for p in store)
+
+    # record own version in own bookkeeping (a writer has trivially seen
+    # its own db_versions; its head over itself == next_dbv - 1)
+    book, _ = record_versions(
+        cst.book, site[:, None], dbv[:, None], w[:, None]
+    )
+
+    cst = cst._replace(
+        store=store, book=book, next_dbv=jnp.where(w, dbv + 1, cst.next_dbv)
+    )
+    return _enqueue(
+        cst,
+        w[:, None],
+        site[:, None],
+        dbv[:, None],
+        cell[:, None],
+        ver[:, None],
+        val[:, None],
+        site[:, None],
+        jnp.full((n, 1), cfg.bcast_max_transmissions, jnp.int32),
+    )
+
+
+def bcast_step(
+    cfg: SimConfig,
+    cst: CrdtState,
+    believed_alive,  # bool [N, N] from the SWIM view (fanout candidates)
+    alive,  # bool [N] ground truth
+    net: NetModel,
+    key: jax.Array,
+):
+    """One broadcast flush + ingest round. Returns (state, info)."""
+    n, q, f = cfg.n_nodes, cfg.bcast_queue, cfg.bcast_fanout
+    iarr = jnp.arange(n, dtype=jnp.int32)
+    k_tgt, k_drop = jr.split(key)
+
+    # --- fanout targets: f random believed-alive members ----------------
+    cand = believed_alive & ~jnp.eye(n, dtype=bool) & alive[:, None]
+    scores = jnp.where(cand, jr.uniform(k_tgt, (n, n)), -1.0)
+    t_val, targets = jax.lax.top_k(scores, f)  # [N, F]
+    t_ok = t_val >= 0
+
+    # --- sendable slots: anything queued with budget left ---------------
+    live_slot = (cst.q_origin != NO_Q) & (cst.q_tx > 0)  # [N, Q]
+
+    # messages: sender x slot x target
+    src = jnp.broadcast_to(iarr[:, None, None], (n, q, f))
+    dst = jnp.broadcast_to(targets[:, None, :], (n, q, f))
+    m_ok = (
+        live_slot[:, :, None]
+        & t_ok[:, None, :]
+        & uni_ok(net, k_drop, alive, src, dst)
+    )
+
+    flat = lambda a: jnp.broadcast_to(a[:, :, None], (n, q, f)).reshape(-1)  # noqa: E731
+    live, (m_origin, m_dbv, m_cell, m_ver, m_val, m_site) = mailbox_pack(
+        dst.reshape(-1),
+        m_ok.reshape(-1),
+        n_rows=n,
+        capacity=cfg.recv_slots,
+        fields=(
+            flat(cst.q_origin),
+            flat(cst.q_dbv),
+            flat(cst.q_cell),
+            flat(cst.q_ver),
+            flat(cst.q_val),
+            flat(cst.q_site),
+        ),
+    )
+
+    # --- sender-side budget decrement, free exhausted slots -------------
+    # one "transmission" = one flush to the fanout set; decrement on the
+    # attempt (the sender cannot observe datagram loss)
+    attempted = (live_slot & jnp.any(t_ok, axis=1)[:, None]).astype(jnp.int32)
+    q_tx = jnp.where(live_slot, cst.q_tx - attempted, cst.q_tx)
+    exhausted = (cst.q_origin != NO_Q) & (q_tx <= 0)
+    cst = cst._replace(
+        q_tx=jnp.maximum(q_tx, 0),
+        q_origin=jnp.where(exhausted, NO_Q, cst.q_origin),
+    )
+
+    # --- receiver ingest: dedupe, apply, re-broadcast -------------------
+    book, fresh = record_versions(cst.book, m_origin, m_dbv, live)
+
+    flat_idx = (
+        jnp.broadcast_to(iarr[:, None], m_cell.shape) * cfg.n_cells + m_cell
+    )
+    store = apply_changes_to_store(
+        tuple(p.reshape(-1) for p in cst.store),
+        flat_idx.reshape(-1),
+        m_ver.reshape(-1),
+        m_val.reshape(-1),
+        m_site.reshape(-1),
+        m_dbv.reshape(-1),
+        fresh.reshape(-1),
+    )
+    store = tuple(p.reshape(n, cfg.n_cells) for p in store)
+
+    # fresh changes re-broadcast with a smaller budget (handlers.rs:768-779)
+    cst = _enqueue(
+        cst._replace(store=store, book=book),
+        fresh,
+        m_origin,
+        m_dbv,
+        m_cell,
+        m_ver,
+        m_val,
+        m_site,
+        jnp.full(m_origin.shape, max(1, cfg.bcast_max_transmissions - 1), jnp.int32),
+    )
+    info = {
+        "sent": jnp.sum(m_ok),
+        "delivered": jnp.sum(live),
+        "fresh": jnp.sum(fresh),
+        "queued": jnp.sum(cst.q_origin != NO_Q),
+    }
+    return cst, info

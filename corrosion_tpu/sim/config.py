@@ -1,0 +1,71 @@
+"""Static simulator configuration.
+
+Mirrors the knobs the reference centralizes in ``GossipConfig`` /
+``PerfConfig`` (``crates/corro-types/src/config.rs:200-257``) and the
+cluster-size-adaptive foca config (``make_foca_config``,
+``crates/corro-agent/src/broadcast/mod.rs:951-960``), re-expressed in
+simulator units: one *round* is one fused message-passing step, roughly a
+SWIM probe period.
+
+Everything here is static (hashable) so the config can be a jit
+static-arg; per-run dynamic knobs (drop probability, partitions) live in
+``NetModel`` (``transport.py``) as traced arrays instead.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+
+@dataclasses.dataclass(frozen=True)
+class SimConfig:
+    """Shapes and protocol constants for the simulated cluster."""
+
+    n_nodes: int
+    # --- SWIM membership (foca analog) -----------------------------------
+    n_indirect: int = 3  # foca num_indirect_probes (new_wan keeps 3)
+    suspicion_rounds: int = 6  # probe periods before suspect -> down
+    piggyback: int = 8  # membership updates per message (1178 B packet analog)
+    max_transmissions: int = 10  # per-update re-send budget before it goes quiet
+    announce_interval: int = 16  # mean rounds between announces (ANNOUNCE_INTERVAL)
+    # --- CRDT store ------------------------------------------------------
+    n_origins: int = 4  # writer nodes (nodes 0..n_origins-1 may write)
+    n_rows: int = 16  # LWW rows per table
+    n_cols: int = 4  # LWW columns per row
+    buf_slots: int = 64  # out-of-order version buffer per node
+    # --- broadcast dissemination (handle_broadcasts analog) --------------
+    bcast_fanout: int = 5  # random member fanout per flush
+    bcast_queue: int = 64  # pending-broadcast slots per node
+    bcast_max_transmissions: int = 3  # re-send budget per changeset
+    recv_slots: int = 96  # max applied messages per node per round
+    # --- anti-entropy sync (parallel_sync analog) -------------------------
+    sync_interval: int = 8  # rounds between sync attempts per node
+    sync_peers: int = 2  # peers per sync round (clamp(members/100, 3, 10) analog)
+    sync_chunk: int = 32  # max versions pulled per (peer, origin) per round
+
+    @property
+    def n_cells(self) -> int:
+        return self.n_rows * self.n_cols
+
+    def validate(self) -> "SimConfig":
+        assert self.n_origins <= self.n_nodes
+        assert self.piggyback >= 1 and self.n_indirect >= 0
+        return self
+
+
+def wan_config(n_nodes: int, **overrides) -> SimConfig:
+    """Cluster-size-adaptive defaults, following the shape of the
+    reference's ``make_foca_config`` (``broadcast/mod.rs:951-960``): WAN
+    tuning, 3 indirect probes, dissemination budget growing with log N so
+    rumors survive long enough to cover the cluster."""
+    log_n = max(1, math.ceil(math.log2(max(2, n_nodes))))
+    defaults = dict(
+        n_indirect=3,
+        max_transmissions=log_n + 4,
+        suspicion_rounds=max(4, log_n),
+        piggyback=8,
+        bcast_fanout=max(3, min(10, n_nodes // 100 + 3)),
+    )
+    defaults.update(overrides)
+    return SimConfig(n_nodes=n_nodes, **defaults).validate()

@@ -1,0 +1,92 @@
+"""Workload scenarios — the BASELINE.json benchmark configs as input
+streams.
+
+Each scenario builds a stacked ``RoundInput`` (leading axis = rounds)
+plus a ``NetModel``, mirroring the reference's test drivers: single-writer
+inserts (config 1/3), membership churn (config 2), conflict-heavy
+multi-writer LWW (config 4), and the full mix with partitions (config 5)
+— the same shapes as ``configurable_stress_test``
+(``crates/corro-agent/src/agent/tests.rs:286-600``) and the Antithesis
+workload scripts.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+
+from corrosion_tpu.sim.config import SimConfig
+from corrosion_tpu.sim.step import RoundInput
+from corrosion_tpu.sim.transport import NetModel
+
+
+def quiet(cfg: SimConfig, rounds: int) -> RoundInput:
+    """Membership-only (BASELINE config 2 without churn)."""
+    z = RoundInput.quiet(cfg)
+    return jax.tree.map(lambda a: jnp.broadcast_to(a, (rounds,) + a.shape), z)
+
+
+def churn(cfg: SimConfig, rounds: int, key, rate: float = 0.01) -> RoundInput:
+    """Random failure churn: each round a node dies or rejoins with
+    prob ``rate`` (BASELINE config 2)."""
+    n = cfg.n_nodes
+    k1, k2 = jr.split(key)
+    kill = jr.uniform(k1, (rounds, n)) < rate
+    revive = jr.uniform(k2, (rounds, n)) < rate
+    base = quiet(cfg, rounds)
+    return base._replace(kill=kill, revive=revive & ~kill)
+
+
+def single_writer(cfg: SimConfig, rounds: int, key, writes_per_round: int = 1):
+    """One writer streams inserts (BASELINE config 3: fanout latency)."""
+    n = cfg.n_nodes
+    k1, k2 = jr.split(key)
+    base = quiet(cfg, rounds)
+    w = jnp.zeros((rounds, n), bool).at[:, 0].set(True)
+    cell = jnp.zeros((rounds, n), jnp.int32).at[:, 0].set(
+        jr.randint(k1, (rounds,), 0, cfg.n_cells)
+    )
+    val = jnp.zeros((rounds, n), jnp.int32).at[:, 0].set(
+        jr.randint(k2, (rounds,), 0, 1 << 20)
+    )
+    return base._replace(write_mask=w, write_cell=cell, write_val=val)
+
+
+def conflict_heavy(
+    cfg: SimConfig, rounds: int, key, write_prob: float = 0.5, hot_cells: int = 2
+):
+    """All origins hammer a few hot cells concurrently — the LWW
+    conflict workload (BASELINE config 4)."""
+    n = cfg.n_nodes
+    k1, k2, k3 = jr.split(key, 3)
+    base = quiet(cfg, rounds)
+    w = (jr.uniform(k1, (rounds, n)) < write_prob) & (
+        jnp.arange(n)[None, :] < cfg.n_origins
+    )
+    cell = jr.randint(k2, (rounds, n), 0, max(1, hot_cells)).astype(jnp.int32)
+    val = jr.randint(k3, (rounds, n), 0, 1 << 20).astype(jnp.int32)
+    return base._replace(write_mask=w, write_cell=cell, write_val=val)
+
+
+def full_mix(
+    cfg: SimConfig,
+    rounds: int,
+    key,
+    churn_rate: float = 0.005,
+    write_prob: float = 0.3,
+    partition_rounds: tuple = (),
+):
+    """Churn + multi-writer + (optional) partition/heal windows
+    (BASELINE config 5). Returns (inputs, net_for_partition_phase)."""
+    k1, k2 = jr.split(key)
+    inp = conflict_heavy(cfg, rounds, k1, write_prob=write_prob, hot_cells=cfg.n_cells)
+    ch = churn(cfg, rounds, k2, rate=churn_rate)
+    return inp._replace(kill=ch.kill, revive=ch.revive)
+
+
+def partitioned_net(cfg: SimConfig, groups: int = 2, drop_prob: float = 0.0) -> NetModel:
+    return NetModel(
+        partition=(jnp.arange(cfg.n_nodes) % groups).astype(jnp.int32),
+        drop_prob=jnp.float32(drop_prob),
+    )

@@ -1,0 +1,112 @@
+"""The fused whole-cluster step: SWIM + writes + broadcast + sync.
+
+This is the simulator's "training step": one call advances every
+simulated node through one protocol round — the analog of every
+corro-agent loop (``runtime_loop``, ``handle_changes``, ``sync_loop``)
+ticking once across the whole cluster. It is pure, jittable, and
+scannable (``lax.scan`` over rounds), which is what the benchmark
+measures (rounds/sec) and what shards over the device mesh.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+
+from corrosion_tpu.ops.lww import STATE_ALIVE
+from corrosion_tpu.ops.versions import needs_count
+from corrosion_tpu.sim.broadcast import CrdtState, bcast_step, local_write
+from corrosion_tpu.sim.config import SimConfig
+from corrosion_tpu.sim.swim import SwimState, swim_metrics, swim_step
+from corrosion_tpu.sim.transport import NetModel
+
+
+class SimState(NamedTuple):
+    swim: SwimState
+    crdt: CrdtState
+
+    @staticmethod
+    def create(cfg: SimConfig, n_seeds: int = 4) -> "SimState":
+        return SimState(SwimState.create(cfg, n_seeds), CrdtState.create(cfg))
+
+
+class RoundInput(NamedTuple):
+    """External events for one round (fault + workload injection)."""
+
+    kill: jax.Array  # bool [N]
+    revive: jax.Array  # bool [N]
+    write_mask: jax.Array  # bool [N] (effective only for nodes < n_origins)
+    write_cell: jax.Array  # int32 [N]
+    write_val: jax.Array  # int32 [N]
+
+    @staticmethod
+    def quiet(cfg: SimConfig) -> "RoundInput":
+        n = cfg.n_nodes
+        return RoundInput(
+            kill=jnp.zeros(n, bool),
+            revive=jnp.zeros(n, bool),
+            write_mask=jnp.zeros(n, bool),
+            write_cell=jnp.zeros(n, jnp.int32),
+            write_val=jnp.zeros(n, jnp.int32),
+        )
+
+
+def sim_step(cfg: SimConfig, st: SimState, net: NetModel, key, inp: RoundInput):
+    """One full protocol round for the whole cluster."""
+    from corrosion_tpu.sim.sync import sync_step  # local: avoid import cycle
+
+    k_swim, k_bcast, k_sync = jr.split(key, 3)
+    swim, swim_info = swim_step(
+        cfg, st.swim, net, k_swim, kill=inp.kill, revive=inp.revive
+    )
+    believed = (swim.view >= 0) & ((swim.view & 3) == STATE_ALIVE)
+
+    cst = local_write(cfg, st.crdt, inp.write_mask, inp.write_cell, inp.write_val)
+    cst, b_info = bcast_step(cfg, cst, believed, swim.alive, net, k_bcast)
+    cst, s_info = sync_step(cfg, cst, believed, swim.alive, net, k_sync)
+
+    info = {**swim_info, **b_info, **s_info}
+    return SimState(swim, cst), info
+
+
+def run_rounds(cfg: SimConfig, st: SimState, net: NetModel, key, inputs: RoundInput):
+    """``lax.scan`` over stacked per-round inputs (leading axis = rounds).
+
+    The whole simulation compiles to one XLA program — the form the
+    benchmark runs and the mesh shards.
+    """
+
+    def body(carry, inp):
+        st, key = carry
+        key, sub = jr.split(key)
+        st, info = sim_step(cfg, st, net, sub, inp)
+        return (st, key), info
+
+    (st, key), infos = jax.lax.scan(body, (st, key), inputs)
+    return st, infos
+
+
+def crdt_metrics(cfg: SimConfig, st: SimState):
+    """The reference's convergence predicate, vectorized: equal LWW
+    stores, equal heads, and no outstanding needs across all alive nodes
+    (``check_bookkeeping.py``: fails if any node still needs versions or
+    heads mismatch)."""
+    alive = st.swim.alive
+    ref = jnp.argmax(alive)  # some alive node as the comparison anchor
+    same_store = jnp.stack(
+        [jnp.all(p == p[ref], axis=1) for p in st.crdt.store]
+    ).all(axis=0)
+    same_head = jnp.all(st.crdt.book.head == st.crdt.book.head[ref], axis=1)
+    needs = needs_count(st.crdt.book)
+    no_needs = jnp.all(needs <= 0, axis=1)
+    ok = (~alive) | (same_store & same_head & no_needs)
+    swim_m = {f"swim_{k}": v for k, v in swim_metrics(st.swim).items()}
+    return {
+        "converged": jnp.all(ok),
+        "n_diverged": jnp.sum(~ok),
+        "total_needs": jnp.sum(jnp.where(alive[:, None], jnp.maximum(needs, 0), 0)),
+        **swim_m,
+    }

@@ -1,0 +1,292 @@
+"""SWIM membership as one fused, jittable message-passing round.
+
+The reference runs foca (SWIM) as an event loop: probe a member each
+period, wait for ack, fall back to ``num_indirect_probes`` helpers,
+suspect on silence, declare down when the suspicion timer lapses, refute
+by bumping our incarnation when we hear ourselves suspected, and
+piggyback a bounded batch of freshest membership updates on every packet
+(``runtime_loop``, ``crates/corro-agent/src/broadcast/mod.rs:122-376``;
+identity renew/rejoin ``crates/corro-types/src/actor.rs:184-210``).
+
+Array re-design: all N nodes execute one probe period simultaneously.
+
+- A node's *view* of every other node is one packed int32
+  (``incarnation * 4 + state``; -1 = unknown), so "apply a received
+  membership update" is ``scatter-max`` — foca's precedence rules
+  (higher incarnation wins; same incarnation Down > Suspect > Alive)
+  collapse into integer ordering (see ``ops/lww.py``).
+- Probe targets / indirect helpers / piggyback subjects are chosen by
+  masked random scores + ``argmax``/``top_k`` (distributionally matching
+  foca's shuffled round-robin; parity is distributional by design —
+  SURVEY §7 hard-part (d)).
+- Suspicion timers are countdown planes; expiry is an elementwise
+  rewrite to Down.
+- Per-(viewer, subject) remaining-transmission budgets (``tx_left``)
+  vectorize foca's update queue: any cell whose view changed this round
+  gets a fresh budget and is eligible for piggybacking until it drains.
+
+One call = one probe period for the whole cluster; wall-clock per round
+is the benchmark metric (BASELINE config 2: N-node join + churn).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+
+from corrosion_tpu.ops.lww import STATE_ALIVE, STATE_DOWN, STATE_SUSPECT, pack_inc_state
+from corrosion_tpu.sim.config import SimConfig
+from corrosion_tpu.sim.transport import NetModel, datagram_ok
+
+UNKNOWN = jnp.int32(-1)
+
+
+class SwimState(NamedTuple):
+    alive: jax.Array  # bool  [N] — ground-truth process liveness
+    incarnation: jax.Array  # int32 [N] — own incarnation counter
+    view: jax.Array  # int32 [N, N] — packed (inc, state); -1 unknown
+    suspect_timer: jax.Array  # int32 [N, N] — rounds until suspect -> down
+    tx_left: jax.Array  # int32 [N, N] — piggyback budget per belief
+
+    @staticmethod
+    def create(cfg: SimConfig, n_seeds: int = 4) -> "SwimState":
+        """Fresh cluster: everyone up; each node knows itself and the
+        first ``n_seeds`` nodes (the bootstrap list — the reference
+        resolves a configured seed set at startup,
+        ``crates/corro-agent/src/agent/bootstrap.rs:14-150``)."""
+        n = cfg.n_nodes
+        view = jnp.full((n, n), UNKNOWN, jnp.int32)
+        seed_key = pack_inc_state(jnp.int32(0), jnp.int32(STATE_ALIVE))
+        view = view.at[:, : max(1, n_seeds)].set(seed_key)
+        view = view.at[jnp.arange(n), jnp.arange(n)].set(seed_key)
+        return SwimState(
+            alive=jnp.ones(n, bool),
+            incarnation=jnp.zeros(n, jnp.int32),
+            view=view,
+            suspect_timer=jnp.zeros((n, n), jnp.int32),
+            tx_left=jnp.full((n, n), cfg.max_transmissions, jnp.int32),
+        )
+
+
+def swim_step(
+    cfg: SimConfig,
+    st: SwimState,
+    net: NetModel,
+    key: jax.Array,
+    kill=None,
+    revive=None,
+):
+    """One SWIM probe period for all nodes. Returns (state, info)."""
+    n = cfg.n_nodes
+    iarr = jnp.arange(n, dtype=jnp.int32)
+    k_tgt, k_p1, k_p2, k_help, k_ind, k_pri, k_announce = jr.split(key, 7)
+
+    # --- churn (external fault injection, BASELINE config 2/5) ----------
+    kill = jnp.zeros(n, bool) if kill is None else kill
+    revive = jnp.zeros(n, bool) if revive is None else revive
+    alive = (st.alive & ~kill) | revive
+    # rejoin = identity renew: bump incarnation so the old Down loses
+    # (actor.rs:199-210 `renew()` + auto-rejoin)
+    inc = st.incarnation + revive.astype(jnp.int32)
+
+    old_view = st.view
+    self_key = pack_inc_state(inc, jnp.int32(STATE_ALIVE))
+    view = old_view.at[iarr, iarr].max(jnp.where(alive, self_key, UNKNOWN))
+
+    # --- probe target: one believed-alive member, uniformly ------------
+    believed_alive = (view >= 0) & ((view & 3) == STATE_ALIVE)
+    believed_alive = believed_alive & ~jnp.eye(n, dtype=bool)
+    t_scores = jnp.where(believed_alive, jr.uniform(k_tgt, (n, n)), -1.0)
+    tgt = jnp.argmax(t_scores, axis=1).astype(jnp.int32)
+    has_tgt = alive & jnp.any(believed_alive, axis=1)
+
+    # --- direct probe + ack (datagram channel, lossy) -------------------
+    leg_out = datagram_ok(net, k_p1, alive, iarr, tgt)  # probe reaches tgt
+    leg_back = datagram_ok(net, k_p2, alive, tgt, iarr)  # ack reaches us
+    probe_ok = has_tgt & leg_out & leg_back
+
+    # --- indirect probes through n_indirect helpers ---------------------
+    h_scores = jnp.where(
+        believed_alive & (iarr[None, :] != tgt[:, None]),
+        jr.uniform(k_help, (n, n)),
+        -1.0,
+    )
+    h_val, helpers = jax.lax.top_k(h_scores, max(1, cfg.n_indirect))
+    h_valid = h_val >= 0
+    k1, k2, k3, k4 = jr.split(k_ind, 4)
+    src = jnp.broadcast_to(iarr[:, None], helpers.shape)
+    tgt_b = jnp.broadcast_to(tgt[:, None], helpers.shape)
+    ind_leg = (
+        datagram_ok(net, k1, alive, src, helpers)
+        & datagram_ok(net, k2, alive, helpers, tgt_b)
+        & datagram_ok(net, k3, alive, tgt_b, helpers)
+        & datagram_ok(net, k4, alive, helpers, src)
+    )
+    ind_ok = jnp.any(h_valid & ind_leg, axis=1) & has_tgt
+    acked = probe_ok | ind_ok
+    failed = has_tgt & ~acked
+
+    # --- suspicion start: probe failed => suspect at the known inc ------
+    cur_tgt = view[iarr, tgt]
+    suspect_key = (cur_tgt >> 2) * 4 + STATE_SUSPECT
+    view = view.at[iarr, tgt].max(jnp.where(failed, suspect_key, UNKNOWN))
+    # the suspicion also travels toward the target itself (gossip fanout
+    # reaches the subject quickly in practice; foca's refutation depends
+    # on it) — if it lands, the target's self-cell merge triggers the
+    # incarnation bump below
+    k_notify = jr.fold_in(k_p1, 1)
+    notify_ok = failed & datagram_ok(net, k_notify, alive, iarr, tgt)
+    view = view.at[tgt, tgt].max(jnp.where(notify_ok, suspect_key, UNKNOWN))
+
+    # --- periodic announce (spawn_swim_announcer analog) ----------------
+    # Each round a node announces with prob 1/announce_interval to a
+    # uniformly random *ever-known* member — NOT just believed-alive ones.
+    # This is the partition-heal / rejoin path: the reference announces to
+    # DB-known members on a jittered timer
+    # (``agent/handlers.rs:193-244``, ``ANNOUNCE_INTERVAL`` agent/mod.rs:33).
+    k_ann, k_annt, k_ann1, k_ann2 = jr.split(k_announce, 4)
+    announcing = alive & (
+        jr.uniform(k_ann, (n,)) < 1.0 / max(1, cfg.announce_interval)
+    )
+    known = (view >= 0) & ~jnp.eye(n, dtype=bool)
+    a_scores = jnp.where(known, jr.uniform(k_annt, (n, n)), -1.0)
+    ann_tgt = jnp.argmax(a_scores, axis=1).astype(jnp.int32)
+    announcing = announcing & jnp.any(known, axis=1)
+    ann_out = announcing & datagram_ok(net, k_ann1, alive, iarr, ann_tgt)
+    ann_back = ann_out & datagram_ok(net, k_ann2, alive, ann_tgt, iarr)
+
+    # the announce asserts the sender is alive at its current incarnation
+    view = view.at[ann_tgt, iarr].max(jnp.where(ann_out, self_key, UNKNOWN))
+    # down-notice: if the receiver believed the sender suspect/down, it
+    # tells the sender, whose self-cell merge triggers refutation below
+    # (the reference's "declared down -> renew + rejoin", actor.rs:199-210)
+    bel = old_view[ann_tgt, iarr]
+    notice = ann_back & (bel >= 0) & ((bel & 3) != STATE_ALIVE)
+    view = view.at[iarr, iarr].max(jnp.where(notice, bel, UNKNOWN))
+
+    # --- piggyback gossip on probe + ack + announce packets -------------
+    # each sender picks up to `piggyback` subjects with budget left
+    pri = jnp.where(st.tx_left > 0, jr.uniform(k_pri, (n, n)), -1.0)
+    sel_val, subj = jax.lax.top_k(pri, cfg.piggyback)  # [N, U]
+    sel_ok = sel_val >= 0
+    payload = view[iarr[:, None], subj]  # [N, U]
+
+    def edges(sender_rows, receiver, ok):
+        return (
+            jnp.broadcast_to(receiver[:, None], subj.shape),
+            subj[sender_rows],
+            payload[sender_rows],
+            ok[:, None] & sel_ok[sender_rows],
+        )
+
+    # probe i->tgt (iff leg_out), ack tgt->i (iff probe_ok),
+    # announce i->ann_tgt (iff ann_out), announce-reply ann_tgt->i
+    parts = [
+        edges(iarr, tgt, has_tgt & leg_out),
+        (jnp.broadcast_to(iarr[:, None], subj.shape), subj[tgt], payload[tgt], probe_ok[:, None] & sel_ok[tgt]),
+        edges(iarr, ann_tgt, ann_out),
+        (jnp.broadcast_to(iarr[:, None], subj.shape), subj[ann_tgt], payload[ann_tgt], ann_back[:, None] & sel_ok[ann_tgt]),
+    ]
+    recv = jnp.concatenate([p[0] for p in parts])
+    subjects = jnp.concatenate([p[1] for p in parts])
+    keys_m = jnp.concatenate([p[2] for p in parts])
+    valid_m = jnp.concatenate([p[3] for p in parts])
+
+    # every delivered packet also asserts its sender is alive at the
+    # sender's current incarnation (receiving data from a peer IS
+    # liveness evidence; this is what re-knits views after rejoin when
+    # the dedicated rumor budget has already drained)
+    sender_assert = [
+        (tgt, iarr, self_key, has_tgt & leg_out),  # probe: tgt hears i
+        (iarr, tgt, self_key[tgt], probe_ok),  # ack: i hears tgt
+        (ann_tgt, iarr, self_key, ann_out),
+        (iarr, ann_tgt, self_key[ann_tgt], ann_back),
+    ]
+    recv = jnp.concatenate([recv.reshape(-1)] + [r for r, *_ in sender_assert])
+    subjects = jnp.concatenate(
+        [subjects.reshape(-1)] + [s for _, s, *_ in sender_assert]
+    )
+    keys_m = jnp.concatenate([keys_m.reshape(-1)] + [k for *_, k, _ in sender_assert])
+    valid_m = jnp.concatenate([valid_m.reshape(-1)] + [v for *_, v in sender_assert])
+
+    flat_cell = jnp.where(valid_m, recv * n + subjects, n * n).reshape(-1)
+    view = (
+        view.reshape(-1)
+        .at[flat_cell]
+        .max(keys_m.reshape(-1), mode="drop")
+        .reshape(n, n)
+    )
+
+    # --- decrement piggyback budgets for attempted sends ----------------
+    sends = (
+        has_tgt.astype(jnp.int32)
+        + announcing.astype(jnp.int32)
+        + jnp.zeros(n, jnp.int32).at[tgt].add((leg_out & alive[tgt]).astype(jnp.int32))
+        + jnp.zeros(n, jnp.int32).at[ann_tgt].add(ann_back.astype(jnp.int32))
+    )
+    dec_cell = jnp.where(sel_ok, iarr[:, None] * n + subj, n * n).reshape(-1)
+    tx_left = (
+        st.tx_left.reshape(-1)
+        .at[dec_cell]
+        .add(-jnp.broadcast_to(sends[:, None], subj.shape).reshape(-1), mode="drop")
+        .reshape(n, n)
+    )
+    tx_left = jnp.maximum(tx_left, 0)
+
+    # --- suspicion timers: arm on fresh suspicion, tick, expire to Down -
+    changed = view != old_view
+    is_suspect = (view >= 0) & ((view & 3) == STATE_SUSPECT)
+    newly = changed & is_suspect
+    timer = jnp.where(newly, cfg.suspicion_rounds, st.suspect_timer)
+    ticking = is_suspect & ~newly & alive[:, None]
+    timer = jnp.where(ticking, timer - 1, timer)
+    expired = is_suspect & (timer <= 0) & alive[:, None]
+    view = jnp.where(expired, (view >> 2) * 4 + STATE_DOWN, view)
+
+    # --- refutation: I hear I'm suspected/down => bump my incarnation ---
+    selfv = view[iarr, iarr]
+    refute = alive & (selfv >= 0) & ((selfv & 3) != STATE_ALIVE)
+    inc = jnp.where(refute, (selfv >> 2) + 1, inc)
+    view = view.at[iarr, iarr].set(
+        jnp.where(alive, pack_inc_state(inc, jnp.int32(STATE_ALIVE)), selfv)
+    )
+
+    # --- fresh news gets a fresh dissemination budget -------------------
+    changed = view != old_view
+    tx_left = jnp.where(changed, cfg.max_transmissions, tx_left)
+
+    st2 = SwimState(alive, inc, view, timer, tx_left)
+    info = {
+        "acked": jnp.sum(acked),
+        "failed_probes": jnp.sum(failed),
+        "refutes": jnp.sum(refute),
+    }
+    return st2, info
+
+
+def swim_metrics(st: SwimState):
+    """Convergence metrics — the assertion of the reference's stress tests
+    (``configurable_stress_test``, ``crates/corro-agent/src/agent/tests.rs``)
+    transplanted to membership: every alive node's view matches ground
+    truth (alive subjects seen Alive; dead subjects seen Down or never
+    known)."""
+    state = st.view & 3
+    known = st.view >= 0
+    subj_alive = st.alive[None, :]
+    ok = jnp.where(
+        subj_alive,
+        known & (state == STATE_ALIVE),
+        ~known | (state == STATE_DOWN),
+    )
+    viewer = st.alive[:, None]
+    correct = jnp.sum(ok & viewer)
+    total = jnp.maximum(jnp.sum(viewer) * st.alive.shape[0], 1)
+    accuracy = correct / total
+    return {
+        "accuracy": accuracy,
+        "converged": correct == jnp.sum(viewer) * st.alive.shape[0],
+        "n_alive": jnp.sum(st.alive),
+    }

@@ -1,0 +1,90 @@
+"""End-to-end cluster convergence: broadcast + sync + SWIM together.
+
+The sim analog of the reference's ``configurable_stress_test``
+(``crates/corro-agent/src/agent/tests.rs:286-600``): fire interleaved
+writes at the cluster, then poll until every node's store/heads/needs
+agree — convergence IS the assertion."""
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+import numpy as np
+import pytest
+
+from corrosion_tpu.sim import scenario
+from corrosion_tpu.sim.config import wan_config
+from corrosion_tpu.sim.step import RoundInput, SimState, crdt_metrics, run_rounds
+from corrosion_tpu.sim.transport import NetModel
+
+N = 24
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return wan_config(
+        N, n_origins=4, n_rows=4, n_cols=2, sync_interval=4, announce_interval=8
+    )
+
+
+def settle(cfg, st, net, key, rounds):
+    inp = scenario.quiet(cfg, rounds)
+    return run_rounds(cfg, st, net, key, inp)
+
+
+def test_single_writer_propagates_to_all(cfg):
+    st = SimState.create(cfg)
+    net = NetModel.create(N)
+    key = jr.key(10)
+    inp = scenario.single_writer(cfg, 20, jr.key(11), writes_per_round=1)
+    st, _ = run_rounds(cfg, st, net, key, inp)
+    st, _ = settle(cfg, st, net, jr.key(12), 60)
+    m = crdt_metrics(cfg, st)
+    assert bool(m["converged"]), (
+        int(m["n_diverged"]),
+        int(m["total_needs"]),
+    )
+    # writer's 20 versions reached everyone: heads[*, 0] == 20
+    heads = np.asarray(st.crdt.book.head)
+    assert (heads[:, 0] == 20).all(), heads[:, 0]
+    # and the winning cells are identical everywhere
+    assert len(np.unique(np.asarray(st.crdt.store[1]), axis=0)) == 1
+
+
+def test_conflict_heavy_multi_writer_converges(cfg):
+    st = SimState.create(cfg)
+    net = NetModel.create(N, drop_prob=0.05)
+    inp = scenario.conflict_heavy(cfg, 30, jr.key(21), write_prob=0.5, hot_cells=2)
+    st, _ = run_rounds(cfg, st, net, jr.key(20), inp)
+    st, _ = settle(cfg, st, NetModel.create(N), jr.key(22), 100)
+    m = crdt_metrics(cfg, st)
+    assert bool(m["converged"]), (int(m["n_diverged"]), int(m["total_needs"]))
+
+
+def test_sync_repairs_partition(cfg):
+    # writes happen while the cluster is partitioned; after healing,
+    # anti-entropy must reconcile both sides
+    st = SimState.create(cfg)
+    part = scenario.partitioned_net(cfg, groups=2)
+    inp = scenario.conflict_heavy(cfg, 20, jr.key(31), write_prob=0.4, hot_cells=2)
+    st, _ = run_rounds(cfg, st, part, jr.key(30), inp)
+
+    healed = NetModel.create(N)
+    st, _ = settle(cfg, st, healed, jr.key(32), 150)
+    m = crdt_metrics(cfg, st)
+    assert bool(m["converged"]), (int(m["n_diverged"]), int(m["total_needs"]))
+
+
+def test_churn_mix_converges_after_quiesce(cfg):
+    st = SimState.create(cfg)
+    net = NetModel.create(N, drop_prob=0.02)
+    inp = scenario.full_mix(cfg, 40, jr.key(41), churn_rate=0.01, write_prob=0.3)
+    st, _ = run_rounds(cfg, st, net, jr.key(40), inp)
+    # revive everyone, stop writing, let it settle
+    n = cfg.n_nodes
+    wake = scenario.quiet(cfg, 1)._replace(
+        revive=(~st.swim.alive)[None, :]
+    )
+    st, _ = run_rounds(cfg, st, net, jr.key(42), wake)
+    st, _ = settle(cfg, st, NetModel.create(N), jr.key(43), 150)
+    m = crdt_metrics(cfg, st)
+    assert bool(m["converged"]), (int(m["n_diverged"]), int(m["total_needs"]))

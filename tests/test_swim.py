@@ -1,0 +1,106 @@
+"""SWIM membership step: join / failure-detection / rejoin / partition.
+
+These mirror the reference's in-process cluster tests (real agents on
+loopback asserting convergence, ``crates/corro-agent/src/agent/tests.rs``)
+— here the "cluster" is the vectorized state and the assertion is
+``swim_metrics``'s ground-truth accuracy (BASELINE config 2)."""
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+import numpy as np
+import pytest
+
+from corrosion_tpu.sim.config import SimConfig, wan_config
+from corrosion_tpu.sim.swim import SwimState, swim_metrics, swim_step
+from corrosion_tpu.sim.transport import NetModel
+
+N = 32
+
+
+@pytest.fixture(scope="module")
+def stepper():
+    cfg = wan_config(N, announce_interval=8)
+    step = jax.jit(
+        lambda st, net, key, kill, revive: swim_step(
+            cfg, st, net, key, kill=kill, revive=revive
+        )[0]
+    )
+    return cfg, step
+
+
+def run(step, st, net, key, rounds, kill=None, revive=None):
+    none = jnp.zeros(N, bool)
+    for r in range(rounds):
+        key, sub = jr.split(key)
+        k = kill if (kill is not None and r == 0) else none
+        v = revive if (revive is not None and r == 0) else none
+        st = step(st, net, sub, k, v)
+    return st, key
+
+
+def test_join_converges_from_seeds(stepper):
+    cfg, step = stepper
+    st = SwimState.create(cfg, n_seeds=3)
+    net = NetModel.create(N)
+    st, _ = run(step, st, net, jr.key(0), 40)
+    m = swim_metrics(st)
+    assert bool(m["converged"]), float(m["accuracy"])
+
+
+def test_failure_detected_then_rejoin(stepper):
+    cfg, step = stepper
+    st = SwimState.create(cfg, n_seeds=3)
+    net = NetModel.create(N)
+    st, key = run(step, st, net, jr.key(1), 40)
+
+    kill = jnp.zeros(N, bool).at[7].set(True)
+    st, key = run(step, st, net, key, 60, kill=kill)
+    m = swim_metrics(st)
+    assert int(m["n_alive"]) == N - 1
+    assert bool(m["converged"]), float(m["accuracy"])
+    # every alive node sees 7 as Down (it was known before the kill)
+    states = np.asarray(st.view) & 3
+    known = np.asarray(st.view) >= 0
+    viewers = np.asarray(st.alive)
+    assert all(known[i, 7] and states[i, 7] == 2 for i in range(N) if viewers[i])
+
+    # rejoin: identity renew bumps incarnation and spreads
+    revive = jnp.zeros(N, bool).at[7].set(True)
+    st, key = run(step, st, net, key, 80, revive=revive)
+    m = swim_metrics(st)
+    assert int(m["n_alive"]) == N
+    assert bool(m["converged"]), float(m["accuracy"])
+    assert int(st.incarnation[7]) >= 1
+
+
+def test_converges_under_heavy_loss(stepper):
+    cfg, step = stepper
+    st = SwimState.create(cfg, n_seeds=3)
+    net = NetModel.create(N, drop_prob=0.15)
+    st, _ = run(step, st, net, jr.key(2), 120)
+    m = swim_metrics(st)
+    assert float(m["accuracy"]) > 0.95, float(m["accuracy"])
+
+
+def test_partition_then_heal(stepper):
+    cfg, step = stepper
+    st = SwimState.create(cfg, n_seeds=3)
+    net = NetModel.create(N)
+    st, key = run(step, st, net, jr.key(3), 40)
+
+    # split 2:1; each side should declare the other Down
+    part = NetModel(
+        partition=(jnp.arange(N) % 3 == 0).astype(jnp.int32),
+        drop_prob=jnp.float32(0.0),
+    )
+    st, key = run(step, st, part, key, 60)
+    states = np.asarray(st.view) & 3
+    pa = np.asarray(part.partition)
+    cross = pa[:, None] != pa[None, :]
+    assert (states[cross] == 2).mean() > 0.95  # almost all cross-views Down
+
+    # heal: announces + down-notices + incarnation renewal re-knit the mesh
+    st, key = run(step, st, net, key, 200)
+    m = swim_metrics(st)
+    assert bool(m["converged"]), float(m["accuracy"])
