@@ -1,0 +1,70 @@
+"""Benchmark: gossip-simulator round throughput on one chip.
+
+Prints one JSON line ``{"metric", "value", "unit", "vs_baseline"}``.
+
+North-star (BASELINE.md): >=10,000 simulated gossip rounds/sec at 100k
+nodes on a v5e-8. This bench runs the fused whole-cluster round
+(SWIM + changeset broadcast + anti-entropy sync) under ``lax.scan`` on
+whatever single chip is available and reports steady-state rounds/sec;
+``vs_baseline`` is the fraction of the 10k rounds/sec target.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import time
+
+import jax
+
+# this environment's sitecustomize forces a platform via config.update,
+# which outranks the JAX_PLATFORMS env var — re-honor the env var
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import jax.random as jr
+
+
+def main() -> None:
+    from corrosion_tpu.sim.config import wan_config
+    from corrosion_tpu.sim.scenario import conflict_heavy
+    from corrosion_tpu.sim.step import SimState, run_rounds
+    from corrosion_tpu.sim.transport import NetModel
+
+    platform = jax.devices()[0].platform
+    n_nodes = int(os.environ.get("BENCH_NODES", 4096 if platform == "tpu" else 64))
+    rounds = int(os.environ.get("BENCH_ROUNDS", 64 if platform == "tpu" else 4))
+    reps = int(os.environ.get("BENCH_REPS", 5 if platform == "tpu" else 2))
+
+    cfg = wan_config(n_nodes)
+    key = jr.key(0)
+    st = SimState.create(cfg)
+    net = NetModel.create(n_nodes, drop_prob=0.01)
+    inputs = conflict_heavy(cfg, rounds, jr.key(1), write_prob=0.25)
+
+    run = jax.jit(functools.partial(run_rounds, cfg), donate_argnums=(0,))
+    st, _ = jax.block_until_ready(run(st, net, key, inputs))  # compile + warm
+
+    t0 = time.perf_counter()
+    for i in range(reps):
+        st, infos = run(st, net, jr.fold_in(key, i), inputs)
+    jax.block_until_ready(st)
+    dt = time.perf_counter() - t0
+
+    rps = reps * rounds / dt
+    target = 10_000.0
+    print(
+        json.dumps(
+            {
+                "metric": f"sim_rounds_per_sec_n{n_nodes}_{platform}",
+                "value": round(rps, 2),
+                "unit": "rounds/s",
+                "vs_baseline": round(rps / target, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

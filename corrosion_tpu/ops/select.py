@@ -1,0 +1,36 @@
+"""Random-candidate selection primitives.
+
+The reference picks gossip fanout sets, sync peers, and probe subjects
+by sampling from its member list (``choose_broadcast_members``,
+``crates/corro-agent/src/broadcast/mod.rs:653-713``; sync peer sampling
+``agent/handlers.rs:808-863``). Vectorized: score every candidate with a
+uniform draw, mask out non-candidates, take ``top_k`` / ``argmax`` —
+a uniform random sample without replacement per row.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+
+
+def sample_k(mask: jax.Array, k: int, key: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Per-row uniform sample of ``k`` distinct columns where ``mask``.
+
+    ``mask`` bool [N, C]. Returns ``(cols, ok)``: int32 [N, k] column
+    indices and bool [N, k] validity (rows with fewer than ``k``
+    candidates return fewer valid picks).
+    """
+    scores = jnp.where(mask, jr.uniform(key, mask.shape), -1.0)
+    val, cols = jax.lax.top_k(scores, k)
+    return cols.astype(jnp.int32), val >= 0
+
+
+def sample_one(mask: jax.Array, key: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Per-row uniform sample of one column where ``mask``; (col, ok)."""
+    scores = jnp.where(mask, jr.uniform(key, mask.shape), -1.0)
+    col = jnp.argmax(scores, axis=1).astype(jnp.int32)
+    return col, jnp.any(mask, axis=1)

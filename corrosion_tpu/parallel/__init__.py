@@ -1,0 +1,17 @@
+"""Mesh parallelism for the cluster simulator.
+
+The reference scales by adding gossiping processes connected over
+QUIC/NCCL-less sockets (SURVEY §2.3 "Distributed comm backend"); the
+TPU-native analog shards the *simulated nodes* axis across a
+``jax.sharding.Mesh`` and lets XLA insert the collectives (all_gather /
+reduce_scatter / ppermute over ICI) implied by cross-node message
+traffic. See ``mesh.py``.
+"""
+
+from corrosion_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    node_sharding,
+    shard_state,
+    sharded_step,
+    sharded_run,
+)

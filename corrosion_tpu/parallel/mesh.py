@@ -1,0 +1,102 @@
+"""Shard the simulator over a device mesh along the node axis.
+
+Design (SURVEY §5 "long-context"): the simulator's "long axis" is N
+simulated nodes. Every piece of ``SimState`` is a struct-of-arrays with
+leading dimension N, so the whole state shards with one
+``NamedSharding(mesh, P("node"))`` annotation and the fused round step
+runs under ``jit`` unchanged — XLA turns the cross-node traffic
+(piggyback scatters, fanout gathers, peer store reads) into ICI
+collectives. This is the pjit recipe: pick a mesh, annotate shardings,
+let XLA insert collectives, profile, iterate.
+
+The reference reaches the same scale with one OS process per node and
+QUIC between them (``Transport``, ``crates/corro-agent/src/transport.rs``);
+here a "process" is a row of the state arrays and the transport is the
+mesh interconnect.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from corrosion_tpu.sim.config import SimConfig
+from corrosion_tpu.sim.step import RoundInput, SimState, sim_step
+from corrosion_tpu.sim.transport import NetModel
+
+NODE_AXIS = "node"
+
+
+def make_mesh(devices=None) -> Mesh:
+    """A 1-D mesh over the node axis; all devices simulate node shards."""
+    if devices is None:
+        devices = jax.devices()
+    import numpy as np
+
+    return Mesh(np.asarray(devices), (NODE_AXIS,))
+
+
+def node_sharding(mesh: Mesh, n_nodes: int):
+    """Pytree-of-shardings: shard leading axis when it is the node axis.
+
+    Per-node arrays ([N], [N, ...]) shard over ``node``; scalars and
+    small broadcast tables replicate. Works for ``SimState``,
+    ``NetModel``, ``RoundInput`` and stacked round inputs ([rounds, N,
+    ...], where axis 1 is the node axis).
+    """
+
+    def spec(x) -> NamedSharding:
+        shape = jnp.shape(x)
+        if len(shape) >= 1 and shape[0] == n_nodes:
+            return NamedSharding(mesh, P(NODE_AXIS, *([None] * (len(shape) - 1))))
+        if len(shape) >= 2 and shape[1] == n_nodes:  # stacked rounds
+            return NamedSharding(mesh, P(None, NODE_AXIS, *([None] * (len(shape) - 2))))
+        return NamedSharding(mesh, P())
+
+    return spec
+
+
+def shard_state(mesh: Mesh, n_nodes: int, tree: Any) -> Any:
+    """Device-put a state pytree with node-axis sharding."""
+    spec = node_sharding(mesh, n_nodes)
+    return jax.tree.map(lambda x: jax.device_put(x, spec(x)), tree)
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def _step(cfg: SimConfig, st: SimState, net: NetModel, key, inp: RoundInput):
+    return sim_step(cfg, st, net, key, inp)
+
+
+def sharded_step(cfg: SimConfig, mesh: Mesh, st, net, key, inp):
+    """One fused round with node-sharded state.
+
+    The state/net/inputs must already be placed via ``shard_state``;
+    jit infers shardings from the arguments (no mesh context needed) and
+    XLA propagates them through the scatters/gathers, inserting
+    collectives where messages cross shard boundaries.
+    """
+    del mesh  # sharding travels on the arguments
+    return _step(cfg, st, net, key, inp)
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def _run(cfg: SimConfig, st: SimState, net: NetModel, key, inputs: RoundInput):
+    def body(carry, inp):
+        st, key = carry
+        key, sub = jax.random.split(key)
+        st, info = sim_step(cfg, st, net, sub, inp)
+        return (st, key), info
+
+    (st, _), infos = jax.lax.scan(body, (st, key), inputs)
+    return st, infos
+
+
+def sharded_run(cfg: SimConfig, mesh: Mesh, st, net, key, inputs):
+    """``lax.scan`` over stacked rounds with node-sharded state — the
+    whole simulation compiles to one XLA program spanning the mesh."""
+    del mesh  # sharding travels on the arguments
+    return _run(cfg, st, net, key, inputs)

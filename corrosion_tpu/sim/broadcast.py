@@ -144,21 +144,23 @@ def local_write(cfg: SimConfig, cst: CrdtState, write_mask, cell, val):
 def bcast_step(
     cfg: SimConfig,
     cst: CrdtState,
-    believed_alive,  # bool [N, N] from the SWIM view (fanout candidates)
+    targets,  # int32 [N, F] fanout target ids (chosen from the SWIM view)
+    t_ok,  # bool [N, F] target validity
     alive,  # bool [N] ground truth
     net: NetModel,
     key: jax.Array,
 ):
-    """One broadcast flush + ingest round. Returns (state, info)."""
+    """One broadcast flush + ingest round. Returns (state, info).
+
+    Target choice is the caller's (full-view sim samples the [N, N]
+    believed-alive matrix; the scale sim samples its bounded member
+    table) — mirroring how ``handle_broadcasts`` asks the ``Members``
+    registry for its fanout set (``broadcast/mod.rs:653-713``).
+    """
     n, q, f = cfg.n_nodes, cfg.bcast_queue, cfg.bcast_fanout
     iarr = jnp.arange(n, dtype=jnp.int32)
-    k_tgt, k_drop = jr.split(key)
-
-    # --- fanout targets: f random believed-alive members ----------------
-    cand = believed_alive & ~jnp.eye(n, dtype=bool) & alive[:, None]
-    scores = jnp.where(cand, jr.uniform(k_tgt, (n, n)), -1.0)
-    t_val, targets = jax.lax.top_k(scores, f)  # [N, F]
-    t_ok = t_val >= 0
+    k_drop = key
+    assert targets.shape == (n, f)
 
     # --- sendable slots: anything queued with budget left ---------------
     live_slot = (cst.q_origin != NO_Q) & (cst.q_tx > 0)  # [N, Q]

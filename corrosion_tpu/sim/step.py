@@ -56,17 +56,22 @@ class RoundInput(NamedTuple):
 
 def sim_step(cfg: SimConfig, st: SimState, net: NetModel, key, inp: RoundInput):
     """One full protocol round for the whole cluster."""
-    from corrosion_tpu.sim.sync import sync_step  # local: avoid import cycle
+    from corrosion_tpu.ops.select import sample_k  # local: avoid import cycle
+    from corrosion_tpu.sim.sync import sync_step
 
-    k_swim, k_bcast, k_sync = jr.split(key, 3)
+    n = cfg.n_nodes
+    k_swim, k_bcast, k_sync, k_bt, k_sp = jr.split(key, 5)
     swim, swim_info = swim_step(
         cfg, st.swim, net, k_swim, kill=inp.kill, revive=inp.revive
     )
     believed = (swim.view >= 0) & ((swim.view & 3) == STATE_ALIVE)
+    cand = believed & ~jnp.eye(n, dtype=bool)
 
     cst = local_write(cfg, st.crdt, inp.write_mask, inp.write_cell, inp.write_val)
-    cst, b_info = bcast_step(cfg, cst, believed, swim.alive, net, k_bcast)
-    cst, s_info = sync_step(cfg, cst, believed, swim.alive, net, k_sync)
+    targets, t_ok = sample_k(cand & swim.alive[:, None], cfg.bcast_fanout, k_bt)
+    cst, b_info = bcast_step(cfg, cst, targets, t_ok, swim.alive, net, k_bcast)
+    peers, p_ok = sample_k(cand, cfg.sync_peers, k_sp)
+    cst, s_info = sync_step(cfg, cst, peers, p_ok, swim.alive, net, k_sync)
 
     info = {**swim_info, **b_info, **s_info}
     return SimState(swim, cst), info

@@ -42,7 +42,8 @@ from corrosion_tpu.sim.transport import NetModel, bi_ok
 def sync_step(
     cfg: SimConfig,
     cst: CrdtState,
-    believed_alive,  # bool [N, N]
+    peers,  # int32 [N, P] chosen sync peers (caller-sampled, see bcast_step)
+    p_ok,  # bool [N, P] peer validity
     alive,  # bool [N]
     net: NetModel,
     key: jax.Array,
@@ -51,18 +52,12 @@ def sync_step(
     ``sync_peers`` peers. Returns (state, info)."""
     n, p_cnt, n_org = cfg.n_nodes, cfg.sync_peers, cfg.n_origins
     iarr = jnp.arange(n, dtype=jnp.int32)
-    k_go, k_peer, k_bi = jr.split(key, 3)
+    k_go, k_bi = jr.split(key)
+    assert peers.shape == (n, p_cnt)
 
     syncing = alive & (jr.uniform(k_go, (n,)) < 1.0 / max(1, cfg.sync_interval))
-    cand = believed_alive & ~jnp.eye(n, dtype=bool)
-    scores = jnp.where(cand, jr.uniform(k_peer, (n, n)), -1.0)
-    s_val, peers = jax.lax.top_k(scores, p_cnt)  # [N, P]
     src = jnp.broadcast_to(iarr[:, None], peers.shape)
-    ok = (
-        syncing[:, None]
-        & (s_val >= 0)
-        & bi_ok(net, k_bi, alive, src, peers)
-    )
+    ok = syncing[:, None] & p_ok & bi_ok(net, k_bi, alive, src, peers)
 
     head_i = cst.book.head  # [N, O]
     head_p = cst.book.head[peers]  # [N, P, O]
