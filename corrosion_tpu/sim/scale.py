@@ -1,0 +1,398 @@
+"""Large-cluster SWIM with bounded O(N*M) member tables — the 100k-node path.
+
+The full-view simulator (``sim/swim.py``) keeps every node's belief about
+every other node: an [N, N] plane. That is the faithful small-N model, but
+at the north-star scale (100k nodes, BASELINE.md) an [N, N] int32 plane is
+40 GB — far beyond HBM, and one round would touch all of it, capping
+throughput near 100 rounds/s. The reference has the same wall in spirit:
+foca bounds its *updates backlog* and packet size (<=1178 B,
+``crates/corro-agent/src/broadcast/mod.rs:951-960``) so per-node work stays
+bounded no matter the cluster size; a member list is cheap on a CPU heap
+but a dense plane is not cheap on a TPU.
+
+Scale re-design (SURVEY §7 step 1: "membership table [N, M_slots]"): each
+node tracks at most M members in a **globally hash-slotted table** — the
+entry for subject ``s`` may only ever live in slot ``h(s) = s mod M``.
+The payoff is that slot indices agree across all nodes, so a gossip packet
+is simply the sender's *aligned row*: receiving a packet is a gather of
+the sender's row plus one elementwise insert-or-merge — no scatters over
+the big planes, no sorts; the whole round is dense [N, M] arithmetic plus
+O(N) bookkeeping. The cost is that each node tracks at most one subject
+per hash class (a random-eviction partial view, in the HyParView spirit);
+membership knowledge becomes probabilistic but SWIM's detection and
+refutation semantics are preserved exactly per-entry.
+
+Channels per round (each per-receiver unique, so merges stay dense):
+
+1. probe     prober -> target   (one prober chosen per target per round;
+                                 surplus probers' packets drop — the
+                                 datagram channel is lossy anyway)
+2. ack       target -> prober
+3. announce  announcer -> ever-known member (heal/rejoin path, like the
+             reference's DB-known announces, ``agent/handlers.rs:193-244``)
+4. announce-reply (carries the down-notice that triggers refutation)
+
+Piggyback = the sender's row masked by per-entry remaining-transmission
+budgets (``mem_tx``), the array analog of foca's bounded updates backlog;
+fresh news refills the budget, so rumors spread epidemically then quiesce.
+
+Suspicion timers, Down conversion, incarnation refutation and the
+down-purge (48 h analog, ``broadcast/mod.rs:953``) all run as elementwise
+updates on the [N, M] planes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+
+from corrosion_tpu.ops.lww import (
+    STATE_ALIVE,
+    STATE_DOWN,
+    STATE_SUSPECT,
+    pack_inc_state,
+)
+from corrosion_tpu.ops.select import sample_k, sample_one
+from corrosion_tpu.sim.transport import NetModel, datagram_ok
+
+FREE = jnp.int32(-1)
+
+
+@dataclasses.dataclass(frozen=True)
+class ScaleConfig:
+    """Static shapes/constants for the bounded-table simulator."""
+
+    n_nodes: int
+    m_slots: int = 64  # member-table slots per node (hash classes)
+    n_seeds: int = 4  # bootstrap: everyone initially knows nodes 0..n_seeds-1
+    n_indirect: int = 3  # foca num_indirect_probes
+    suspicion_rounds: int = 6
+    max_transmissions: int = 10
+    announce_interval: int = 16
+    down_purge_rounds: int = 64  # rounds a Down entry lingers (48 h analog)
+
+    def validate(self) -> "ScaleConfig":
+        assert self.m_slots > 0 and self.n_seeds >= 1
+        return self
+
+
+def scale_config(n_nodes: int, **overrides) -> ScaleConfig:
+    """Cluster-size-adaptive defaults (``make_foca_config`` shape,
+    ``broadcast/mod.rs:951-960``): dissemination budget grows with log N."""
+    log_n = max(1, math.ceil(math.log2(max(2, n_nodes))))
+    defaults = dict(
+        m_slots=min(64, max(8, n_nodes // 2)),
+        max_transmissions=log_n + 4,
+        suspicion_rounds=max(4, log_n),
+        down_purge_rounds=8 * max(4, log_n),
+    )
+    defaults.update(overrides)
+    return ScaleConfig(n_nodes=n_nodes, **defaults).validate()
+
+
+class ScaleSwimState(NamedTuple):
+    alive: jax.Array  # bool  [N] — ground-truth process liveness
+    inc: jax.Array  # int32 [N] — own incarnation
+    mem_id: jax.Array  # int32 [N, M] — subject id per slot, -1 free
+    mem_view: jax.Array  # int32 [N, M] — packed (inc, state), -1 on free
+    mem_timer: jax.Array  # int32 [N, M] — suspicion / down-purge countdown
+    mem_tx: jax.Array  # int32 [N, M] — piggyback budget per entry
+
+    @staticmethod
+    def create(cfg: ScaleConfig) -> "ScaleSwimState":
+        n, m = cfg.n_nodes, cfg.m_slots
+        iarr = jnp.arange(n, dtype=jnp.int32)
+        mem_id = jnp.full((n, m), FREE, jnp.int32)
+        mem_view = jnp.full((n, m), FREE, jnp.int32)
+        alive_key = pack_inc_state(jnp.int32(0), jnp.int32(STATE_ALIVE))
+        for s in range(min(cfg.n_seeds, n)):
+            mem_id = mem_id.at[:, s % m].set(s)
+            mem_view = mem_view.at[:, s % m].set(alive_key)
+        # self entry (always wins its hash class)
+        mem_id = mem_id.at[iarr, iarr % m].set(iarr)
+        mem_view = mem_view.at[iarr, iarr % m].set(alive_key)
+        return ScaleSwimState(
+            alive=jnp.ones(n, bool),
+            inc=jnp.zeros(n, jnp.int32),
+            mem_id=mem_id,
+            mem_view=mem_view,
+            mem_timer=jnp.zeros((n, m), jnp.int32),
+            mem_tx=jnp.full((n, m), cfg.max_transmissions, jnp.int32),
+        )
+
+
+def _one_sender_per_receiver(n, src_valid, tgt, key):
+    """Pick one sender per receiver from competing (sender -> tgt) edges.
+
+    Packs a random priority above the sender id so a single O(N) scatter-max
+    resolves contention; surplus senders' packets drop (the datagram
+    channel is lossy anyway). Returns (sender_of [N], has_sender [N])."""
+    bits = max(1, n - 1).bit_length()
+    pri = jr.randint(key, (n,), 0, 1 << 12, dtype=jnp.int32)
+    packed = jnp.where(
+        src_valid, (pri << bits) | jnp.arange(n, dtype=jnp.int32), -1
+    )
+    best = jnp.full(n, -1, jnp.int32).at[tgt].max(packed, mode="drop")
+    return best & ((1 << bits) - 1), best >= 0
+
+
+def _merge_packet(mem_id, mem_view, sender_id, sender_view, src, valid, sendable):
+    """Fold one dense gossip packet into the receivers' member tables.
+
+    ``src`` int32 [N]: sender per receiver; ``valid`` bool [N]. The packet
+    is the sender's (start-of-round) row masked by its budget — hash-slot
+    alignment makes incoming entry k target exactly slot k. Insert-or-merge
+    per slot: same subject -> packed max (foca precedence); free slot ->
+    insert; collision -> keep, unless the incumbent is Down and the
+    incoming subject is Alive (fresh members displace corpses)."""
+    in_id = sender_id[src]
+    in_view = sender_view[src]
+    ok = valid[:, None] & (in_id >= 0) & sendable[src]
+    same = ok & (mem_id == in_id)
+    ins = ok & (mem_id < 0)
+    take = (
+        ok
+        & (mem_id >= 0)
+        & (mem_id != in_id)
+        & ((mem_view & 3) == STATE_DOWN)
+        & ((in_view & 3) == STATE_ALIVE)
+    )
+    view = jnp.where(same, jnp.maximum(mem_view, in_view), mem_view)
+    view = jnp.where(ins | take, in_view, view)
+    new_id = jnp.where(ins | take, in_id, mem_id)
+    return new_id, view
+
+
+def _assert_sender_alive(n, m, mem_id, mem_view, snd, valid, s_key):
+    """A delivered packet is liveness evidence: merge (sender, Alive@inc)
+    into each receiver's table at the sender's hash slot (O(N) scatter)."""
+    iarr = jnp.arange(n, dtype=jnp.int32)
+    slot = snd % m
+    cell = iarr * m + slot
+    cur_id = mem_id[iarr, slot]
+    same = cur_id == snd
+    free = cur_id < 0
+    upd = jnp.where(valid & (same | free), cell, n * m)
+    mem_view = (
+        mem_view.reshape(-1).at[upd].max(s_key, mode="drop").reshape(n, m)
+    )
+    mem_id = (
+        mem_id.reshape(-1)
+        .at[jnp.where(valid & free, cell, n * m)]
+        .set(snd, mode="drop")
+        .reshape(n, m)
+    )
+    return mem_id, mem_view
+
+
+def scale_swim_step(
+    cfg: ScaleConfig,
+    st: ScaleSwimState,
+    net: NetModel,
+    key: jax.Array,
+    kill=None,
+    revive=None,
+):
+    """One SWIM probe period for the whole cluster, O(N*M) work."""
+    n, m = cfg.n_nodes, cfg.m_slots
+    iarr = jnp.arange(n, dtype=jnp.int32)
+    (k_tgt, k_p1, k_p2, k_help, k_ind, k_ann, k_annt, k_ann1, k_ann2,
+     k_cp, k_ca) = jr.split(key, 11)
+
+    # --- churn ----------------------------------------------------------
+    kill = jnp.zeros(n, bool) if kill is None else kill
+    revive = jnp.zeros(n, bool) if revive is None else revive
+    alive = (st.alive & ~kill) | revive
+    inc = st.inc + revive.astype(jnp.int32)  # rejoin = renew (actor.rs:199-210)
+
+    old_id, old_view = st.mem_id, st.mem_view
+    mem_id, mem_view = old_id, old_view
+
+    # refresh self entry: an alive node always occupies its own hash slot
+    self_slot = iarr % m
+    self_key = pack_inc_state(inc, jnp.int32(STATE_ALIVE))
+    mem_id = mem_id.at[iarr, self_slot].set(
+        jnp.where(alive, iarr, mem_id[iarr, self_slot])
+    )
+    mem_view = mem_view.at[iarr, self_slot].set(
+        jnp.where(alive, self_key, mem_view[iarr, self_slot])
+    )
+
+    occupied = mem_id >= 0
+    not_self = mem_id != iarr[:, None]
+    bel_alive = occupied & not_self & (mem_view >= 0) & ((mem_view & 3) == STATE_ALIVE)
+
+    # --- probe target: one believed-alive table entry -------------------
+    probe_slot, has_slot = sample_one(bel_alive, k_tgt)
+    tgt = jnp.clip(mem_id[iarr, probe_slot], 0)
+    has_tgt = alive & has_slot
+
+    leg_out = has_tgt & datagram_ok(net, k_p1, alive, iarr, tgt)
+    leg_back = datagram_ok(net, k_p2, alive, tgt, iarr)
+    probe_ok = leg_out & leg_back
+
+    # --- indirect probes through helper entries -------------------------
+    h_mask = bel_alive & (mem_id != tgt[:, None])
+    h_slots, h_valid = sample_k(h_mask, max(1, cfg.n_indirect), k_help)
+    helpers = jnp.clip(jnp.take_along_axis(mem_id, h_slots, axis=1), 0)
+    k1, k2, k3, k4 = jr.split(k_ind, 4)
+    src_b = jnp.broadcast_to(iarr[:, None], helpers.shape)
+    tgt_b = jnp.broadcast_to(tgt[:, None], helpers.shape)
+    ind_leg = (
+        datagram_ok(net, k1, alive, src_b, helpers)
+        & datagram_ok(net, k2, alive, helpers, tgt_b)
+        & datagram_ok(net, k3, alive, tgt_b, helpers)
+        & datagram_ok(net, k4, alive, helpers, src_b)
+    )
+    ind_ok = jnp.any(h_valid & ind_leg, axis=1) & has_tgt
+    acked = probe_ok | ind_ok
+    failed = has_tgt & ~acked
+
+    # --- failed probe: suspect the entry, notify the subject -------------
+    cur = mem_view[iarr, probe_slot]
+    suspect_key = (cur >> 2) * 4 + STATE_SUSPECT
+    mem_view = mem_view.at[iarr, probe_slot].max(
+        jnp.where(failed, suspect_key, FREE)
+    )
+    notify_ok = failed & datagram_ok(net, jr.fold_in(k_p1, 1), alive, iarr, tgt)
+    sus_heard = (
+        jnp.full(n, -1, jnp.int32)
+        .at[tgt]
+        .max(jnp.where(notify_ok, suspect_key, -1), mode="drop")
+    )
+
+    # --- announce to a random ever-known member (heal/rejoin path) ------
+    announcing = alive & (
+        jr.uniform(k_ann, (n,)) < 1.0 / max(1, cfg.announce_interval)
+    )
+    known = occupied & not_self
+    ann_slot, has_known = sample_one(known, k_annt)
+    ann_tgt = jnp.clip(mem_id[iarr, ann_slot], 0)
+    announcing = announcing & has_known
+    ann_out = announcing & datagram_ok(net, k_ann1, alive, iarr, ann_tgt)
+    ann_back = ann_out & datagram_ok(net, k_ann2, alive, ann_tgt, iarr)
+
+    # down-notice: the announce receiver's (possibly stale) belief about
+    # the announcer rides the reply; a non-alive belief at >= our
+    # incarnation triggers refutation below
+    bel = old_view[ann_tgt, self_slot]
+    bel_is_me = old_id[ann_tgt, self_slot] == iarr
+    notice = jnp.where(ann_back & bel_is_me, bel, -1)
+    sus_heard = jnp.maximum(sus_heard, notice)
+
+    # --- choose one prober / announcer per receiver ----------------------
+    prober_of, has_prober = _one_sender_per_receiver(n, leg_out, tgt, k_cp)
+    announcer_of, has_announcer = _one_sender_per_receiver(
+        n, ann_out, ann_tgt, k_ca
+    )
+
+    # --- four dense packet merges ----------------------------------------
+    sendable = st.mem_tx > 0
+    for src, valid in (
+        (prober_of, has_prober),
+        (tgt, probe_ok),
+        (announcer_of, has_announcer),
+        (ann_tgt, ann_back),
+    ):
+        mem_id, mem_view = _merge_packet(
+            mem_id, mem_view, old_id, old_view, jnp.clip(src, 0), valid, sendable
+        )
+
+    # every delivered packet also asserts its sender alive at current inc
+    for snd, valid in (
+        (prober_of, has_prober),
+        (tgt, probe_ok),
+        (announcer_of, has_announcer),
+        (ann_tgt, ann_back),
+    ):
+        snd = jnp.clip(snd, 0)
+        mem_id, mem_view = _assert_sender_alive(
+            n, m, mem_id, mem_view, snd, valid, pack_inc_state(inc[snd], jnp.int32(STATE_ALIVE))
+        )
+
+    # --- budget decrement for attempted sends ---------------------------
+    sends = (
+        has_tgt.astype(jnp.int32)  # probe we sent
+        + announcing.astype(jnp.int32)  # announce we sent
+        + has_prober.astype(jnp.int32)  # ack we sent back to our prober
+        + ann_back.astype(jnp.int32)  # announce-reply we received => they sent
+    )
+    mem_tx = jnp.maximum(
+        jnp.where(sendable, st.mem_tx - sends[:, None], st.mem_tx), 0
+    )
+
+    # --- suspicion timers / down conversion / purge ----------------------
+    occupied = mem_id >= 0
+    changed = (mem_view != old_view) | (mem_id != old_id)
+    is_suspect = occupied & (mem_view >= 0) & ((mem_view & 3) == STATE_SUSPECT)
+    newly = changed & is_suspect
+    timer = jnp.where(newly, cfg.suspicion_rounds, st.mem_timer)
+    ticking = is_suspect & ~newly & alive[:, None]
+    timer = jnp.where(ticking, timer - 1, timer)
+    expired = is_suspect & (timer <= 0) & alive[:, None]
+    mem_view = jnp.where(expired, (mem_view >> 2) * 4 + STATE_DOWN, mem_view)
+
+    # down entries linger for down_purge_rounds, then free the slot
+    is_down = occupied & (mem_view >= 0) & ((mem_view & 3) == STATE_DOWN)
+    newly_down = expired | (changed & is_down)
+    timer = jnp.where(is_down & newly_down, cfg.down_purge_rounds, timer)
+    timer = jnp.where(is_down & ~newly_down & alive[:, None], timer - 1, timer)
+    purge = is_down & (timer <= 0) & alive[:, None]
+    mem_id = jnp.where(purge, FREE, mem_id)
+    mem_view = jnp.where(purge, FREE, mem_view)
+
+    # --- refutation: suspicion about me reached me => bump my incarnation
+    # (via direct notify, down-notice, or gossip that landed in my own
+    # self slot during the merges)
+    self_gossip = jnp.where(
+        mem_id[iarr, self_slot] == iarr, mem_view[iarr, self_slot], -1
+    )
+    heard = jnp.maximum(sus_heard, self_gossip)
+    refute = alive & (heard >= inc * 4 + STATE_SUSPECT)
+    inc = jnp.where(refute, (heard >> 2) + 1, inc)
+    self_key = pack_inc_state(inc, jnp.int32(STATE_ALIVE))
+    mem_view = mem_view.at[iarr, self_slot].set(
+        jnp.where(alive, self_key, mem_view[iarr, self_slot])
+    )
+    mem_id = mem_id.at[iarr, self_slot].set(
+        jnp.where(alive, iarr, mem_id[iarr, self_slot])
+    )
+
+    # --- fresh news refills the dissemination budget ---------------------
+    changed = (mem_view != old_view) | (mem_id != old_id)
+    mem_tx = jnp.where(changed, cfg.max_transmissions, mem_tx)
+
+    st2 = ScaleSwimState(alive, inc, mem_id, mem_view, timer, mem_tx)
+    info = {
+        "acked": jnp.sum(acked),
+        "failed_probes": jnp.sum(failed),
+        "refutes": jnp.sum(refute),
+    }
+    return st2, info
+
+
+def scale_swim_metrics(st: ScaleSwimState):
+    """Belief accuracy over occupied entries of alive viewers: alive
+    subjects believed Alive, dead subjects believed Down (or purged —
+    purged entries simply stop counting). The bounded-view analog of the
+    reference's stress-test convergence assertion."""
+    n = st.alive.shape[0]
+    occ = (st.mem_id >= 0) & (st.mem_view >= 0)
+    not_self = st.mem_id != jnp.arange(n, dtype=jnp.int32)[:, None]
+    subj = jnp.clip(st.mem_id, 0)
+    subj_alive = st.alive[subj]
+    state = st.mem_view & 3
+    entry_ok = jnp.where(subj_alive, state == STATE_ALIVE, state == STATE_DOWN)
+    counted = occ & not_self & st.alive[:, None]
+    correct = jnp.sum(entry_ok & counted)
+    total = jnp.maximum(jnp.sum(counted), 1)
+    return {
+        "accuracy": correct / total,
+        "mean_tracked": jnp.sum(counted) / jnp.maximum(jnp.sum(st.alive), 1),
+        "n_alive": jnp.sum(st.alive),
+    }
