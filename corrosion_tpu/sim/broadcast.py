@@ -141,6 +141,52 @@ def local_write(cfg: SimConfig, cst: CrdtState, write_mask, cell, val):
     )
 
 
+def ingest_changes(cfg, cst: CrdtState, live, m_origin, m_dbv, m_cell, m_ver, m_val, m_site):
+    """Receiver ingest shared by every dissemination carrier: dedupe via
+    the Book, apply fresh cells to the LWW store, re-enqueue fresh changes
+    for re-broadcast with a decremented budget (``handlers.rs:548-786``,
+    rebroadcast ``handlers.rs:768-779``).
+
+    Message fields are [N, M] per-receiver batches; ``live`` masks real
+    messages. Returns ``(cst, info)``.
+    """
+    n = cfg.n_nodes
+    iarr = jnp.arange(n, dtype=jnp.int32)
+    book, fresh = record_versions(cst.book, m_origin, m_dbv, live)
+
+    flat_idx = (
+        jnp.broadcast_to(iarr[:, None], m_cell.shape) * cfg.n_cells + m_cell
+    )
+    store = apply_changes_to_store(
+        tuple(p.reshape(-1) for p in cst.store),
+        flat_idx.reshape(-1),
+        m_ver.reshape(-1),
+        m_val.reshape(-1),
+        m_site.reshape(-1),
+        m_dbv.reshape(-1),
+        fresh.reshape(-1),
+    )
+    store = tuple(p.reshape(n, cfg.n_cells) for p in store)
+
+    cst = _enqueue(
+        cst._replace(store=store, book=book),
+        fresh,
+        m_origin,
+        m_dbv,
+        m_cell,
+        m_ver,
+        m_val,
+        m_site,
+        jnp.full(m_origin.shape, max(1, cfg.bcast_max_transmissions - 1), jnp.int32),
+    )
+    info = {
+        "delivered": jnp.sum(live),
+        "fresh": jnp.sum(fresh),
+        "queued": jnp.sum(cst.q_origin != NO_Q),
+    }
+    return cst, info
+
+
 def bcast_step(
     cfg: SimConfig,
     cst: CrdtState,
@@ -202,38 +248,7 @@ def bcast_step(
     )
 
     # --- receiver ingest: dedupe, apply, re-broadcast -------------------
-    book, fresh = record_versions(cst.book, m_origin, m_dbv, live)
-
-    flat_idx = (
-        jnp.broadcast_to(iarr[:, None], m_cell.shape) * cfg.n_cells + m_cell
+    cst, info = ingest_changes(
+        cfg, cst, live, m_origin, m_dbv, m_cell, m_ver, m_val, m_site
     )
-    store = apply_changes_to_store(
-        tuple(p.reshape(-1) for p in cst.store),
-        flat_idx.reshape(-1),
-        m_ver.reshape(-1),
-        m_val.reshape(-1),
-        m_site.reshape(-1),
-        m_dbv.reshape(-1),
-        fresh.reshape(-1),
-    )
-    store = tuple(p.reshape(n, cfg.n_cells) for p in store)
-
-    # fresh changes re-broadcast with a smaller budget (handlers.rs:768-779)
-    cst = _enqueue(
-        cst._replace(store=store, book=book),
-        fresh,
-        m_origin,
-        m_dbv,
-        m_cell,
-        m_ver,
-        m_val,
-        m_site,
-        jnp.full(m_origin.shape, max(1, cfg.bcast_max_transmissions - 1), jnp.int32),
-    )
-    info = {
-        "sent": jnp.sum(m_ok),
-        "delivered": jnp.sum(live),
-        "fresh": jnp.sum(fresh),
-        "queued": jnp.sum(cst.q_origin != NO_Q),
-    }
-    return cst, info
+    return cst, {**info, "sent": jnp.sum(m_ok)}

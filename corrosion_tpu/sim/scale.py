@@ -78,6 +78,9 @@ class ScaleConfig:
 
     def validate(self) -> "ScaleConfig":
         assert self.m_slots > 0 and self.n_seeds >= 1
+        # sender-election packs a 12-bit priority above the node id in one
+        # int32 (_one_sender_per_receiver); larger clusters would overflow
+        assert self.n_nodes <= 1 << 19, "max 2^19 nodes per sender-election word"
         return self
 
 
@@ -320,7 +323,7 @@ def scale_swim_step(
         has_tgt.astype(jnp.int32)  # probe we sent
         + announcing.astype(jnp.int32)  # announce we sent
         + has_prober.astype(jnp.int32)  # ack we sent back to our prober
-        + ann_back.astype(jnp.int32)  # announce-reply we received => they sent
+        + has_announcer.astype(jnp.int32)  # reply we sent to our announcer
     )
     mem_tx = jnp.maximum(
         jnp.where(sendable, st.mem_tx - sends[:, None], st.mem_tx), 0
@@ -373,7 +376,15 @@ def scale_swim_step(
         "failed_probes": jnp.sum(failed),
         "refutes": jnp.sum(refute),
     }
-    return st2, info
+    # the four delivered-packet channels, (sender, valid) per receiver —
+    # higher layers piggyback changesets on exactly these packets
+    channels = [
+        (jnp.clip(prober_of, 0), has_prober),
+        (tgt, probe_ok),
+        (jnp.clip(announcer_of, 0), has_announcer),
+        (ann_tgt, ann_back),
+    ]
+    return st2, info, channels
 
 
 def scale_swim_metrics(st: ScaleSwimState):

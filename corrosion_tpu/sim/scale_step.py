@@ -1,0 +1,269 @@
+"""The fused whole-cluster round at scale: bounded-table SWIM + CRDT.
+
+This is the 100k-node counterpart of ``sim/step.py``. The full-view round
+routes changeset broadcast through an explicit fanout + mailbox sort
+(``sim/broadcast.py``), which costs O(N*Q*F log) per round — fine at
+small N, fatal at 100k. Here dissemination is re-designed the way epidemic
+broadcast systems actually ride at scale (plumtree/scuttlebutt style):
+**changesets piggyback on the membership channels**. Every SWIM packet
+(probe / ack / announce / announce-reply — each per-receiver unique, see
+``sim/scale.py``) carries up to ``pig_changes`` queued changesets from the
+sender's broadcast queue; receiving stays a dense gather + the usual
+dedupe/apply (``record_versions`` + ``apply_changes_to_store``). The
+reference's equivalents: broadcast fanout with re-send budgets
+(``crates/corro-agent/src/broadcast/mod.rs:410-812``) and rebroadcast of
+fresh changes (``agent/handlers.rs:768-779``) — same budgets, same
+dedupe, different carrier.
+
+Anti-entropy sync is unchanged from the full sim (``sim/sync.py`` is
+already O(N*P*C) dense); peers are sampled from the bounded member table
+instead of the full view.
+
+The per-origin version bookkeeping (``Book``) is [N, O]: at scale the
+writer set is a bounded pool of ``n_origins`` nodes — the array analog of
+"any node may write, but per-actor bookkeeping is per *observed* actor";
+a dense [N, N] head matrix would be the same 40 GB wall the member table
+avoids (SURVEY §7 hard-part (e)).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+
+from corrosion_tpu.ops.lww import STATE_ALIVE
+from corrosion_tpu.ops.select import sample_k
+from corrosion_tpu.ops.versions import needs_count
+from corrosion_tpu.sim.broadcast import NO_Q, CrdtState, ingest_changes, local_write
+from corrosion_tpu.sim.scale import (
+    ScaleSwimState,
+    scale_config,
+    scale_swim_metrics,
+    scale_swim_step,
+)
+from corrosion_tpu.sim.transport import NetModel
+
+
+@dataclasses.dataclass(frozen=True)
+class ScaleSimConfig:
+    """Static shapes for the scale round (SWIM knobs mirror ScaleConfig)."""
+
+    n_nodes: int
+    # --- SWIM (see ScaleConfig) -----------------------------------------
+    m_slots: int = 64
+    n_seeds: int = 4
+    n_indirect: int = 3
+    suspicion_rounds: int = 6
+    max_transmissions: int = 10
+    announce_interval: int = 16
+    down_purge_rounds: int = 64
+    # --- CRDT store ------------------------------------------------------
+    n_origins: int = 16
+    n_rows: int = 16
+    n_cols: int = 4
+    buf_slots: int = 32
+    # --- dissemination ---------------------------------------------------
+    bcast_queue: int = 32
+    bcast_max_transmissions: int = 4
+    pig_changes: int = 4  # changesets per SWIM packet
+    # --- anti-entropy sync -----------------------------------------------
+    sync_interval: int = 8
+    sync_peers: int = 2
+    sync_chunk: int = 32
+
+    @property
+    def n_cells(self) -> int:
+        return self.n_rows * self.n_cols
+
+    def validate(self) -> "ScaleSimConfig":
+        assert self.n_origins <= self.n_nodes and self.m_slots > 0
+        # shares the sender-election int32 packing (see ScaleConfig.validate)
+        assert self.n_nodes <= 1 << 19, "max 2^19 nodes per sender-election word"
+        return self
+
+
+def scale_sim_config(n_nodes: int, **overrides) -> ScaleSimConfig:
+    """Cluster-size-adaptive defaults.
+
+    The SWIM portion is derived from ``scale_config`` (single source of
+    truth for the membership tuning); only the CRDT-layer knobs are set
+    here."""
+    swim = scale_config(n_nodes)
+    log_n = max(1, math.ceil(math.log2(max(2, n_nodes))))
+    defaults = dict(
+        m_slots=swim.m_slots,
+        n_seeds=swim.n_seeds,
+        n_indirect=swim.n_indirect,
+        suspicion_rounds=swim.suspicion_rounds,
+        max_transmissions=swim.max_transmissions,
+        announce_interval=swim.announce_interval,
+        down_purge_rounds=swim.down_purge_rounds,
+        bcast_max_transmissions=max(3, log_n // 2),
+    )
+    defaults.update(overrides)
+    return ScaleSimConfig(n_nodes=n_nodes, **defaults).validate()
+
+
+class ScaleSimState(NamedTuple):
+    swim: ScaleSwimState
+    crdt: CrdtState
+
+    @staticmethod
+    def create(cfg: ScaleSimConfig) -> "ScaleSimState":
+        return ScaleSimState(ScaleSwimState.create(cfg), CrdtState.create(cfg))
+
+
+class ScaleRoundInput(NamedTuple):
+    """External events for one round (same shape as the full sim's)."""
+
+    kill: jax.Array  # bool [N]
+    revive: jax.Array  # bool [N]
+    write_mask: jax.Array  # bool [N]
+    write_cell: jax.Array  # int32 [N]
+    write_val: jax.Array  # int32 [N]
+
+    @staticmethod
+    def quiet(cfg: ScaleSimConfig) -> "ScaleRoundInput":
+        n = cfg.n_nodes
+        return ScaleRoundInput(
+            kill=jnp.zeros(n, bool),
+            revive=jnp.zeros(n, bool),
+            write_mask=jnp.zeros(n, bool),
+            write_cell=jnp.zeros(n, jnp.int32),
+            write_val=jnp.zeros(n, jnp.int32),
+        )
+
+
+def piggyback_bcast_step(cfg: ScaleSimConfig, cst: CrdtState, channels, key):
+    """Disseminate queued changesets over the SWIM packet channels.
+
+    ``channels``: list of ``(src, valid)`` pairs — per-receiver-unique
+    senders from the membership round. Each delivered packet carries the
+    sender's ``pig_changes`` highest-priority live queue slots; the
+    receiver dedupes via the Book, applies fresh cells, and re-enqueues
+    fresh changes with a decremented budget (``handlers.rs:768-779``).
+    """
+    n, q, r = cfg.n_nodes, cfg.bcast_queue, cfg.pig_changes
+    iarr = jnp.arange(n, dtype=jnp.int32)
+
+    live_slot = (cst.q_origin != NO_Q) & (cst.q_tx > 0)  # [N, Q]
+    sel_slots, sel_ok = sample_k(live_slot, r, key)  # [N, R] per sender
+
+    def sender_fields(src):
+        g = lambda a: jnp.take_along_axis(a[src], sel_slots[src], axis=1)  # noqa: E731
+        return (
+            g(cst.q_origin),
+            g(cst.q_dbv),
+            g(cst.q_cell),
+            g(cst.q_ver),
+            g(cst.q_val),
+            g(cst.q_site),
+        )
+
+    # --- gather each channel's payload; [N, n_channels*R] messages ------
+    parts, valids = [], []
+    for src, valid in channels:
+        src = jnp.clip(src, 0)
+        parts.append(sender_fields(src))
+        valids.append(valid[:, None] & sel_ok[src])
+    m_origin, m_dbv, m_cell, m_ver, m_val, m_site = (
+        jnp.concatenate([p[i] for p in parts], axis=1) for i in range(6)
+    )
+    live = jnp.concatenate(valids, axis=1)
+
+    # --- sender budget decrement: one per delivered packet ---------------
+    carried = jnp.zeros(n, jnp.int32)
+    for src, valid in channels:
+        carried = carried.at[jnp.clip(src, 0)].add(
+            valid.astype(jnp.int32), mode="drop"
+        )
+    dec = jnp.zeros((n, q), jnp.int32)
+    rows = jnp.broadcast_to(iarr[:, None], sel_slots.shape)
+    flat = jnp.where(sel_ok, rows * q + sel_slots, n * q)
+    dec = (
+        dec.reshape(-1)
+        .at[flat.reshape(-1)]
+        .add(jnp.broadcast_to(carried[:, None], sel_slots.shape).reshape(-1), mode="drop")
+        .reshape(n, q)
+    )
+    q_tx = jnp.maximum(cst.q_tx - dec, 0)
+    exhausted = (cst.q_origin != NO_Q) & (q_tx <= 0)
+    cst = cst._replace(
+        q_tx=q_tx, q_origin=jnp.where(exhausted, NO_Q, cst.q_origin)
+    )
+
+    # --- receiver ingest: dedupe, apply, re-broadcast --------------------
+    return ingest_changes(
+        cfg, cst, live, m_origin, m_dbv, m_cell, m_ver, m_val, m_site
+    )
+
+
+def scale_sim_step(
+    cfg: ScaleSimConfig,
+    st: ScaleSimState,
+    net: NetModel,
+    key,
+    inp: ScaleRoundInput,
+):
+    """One full protocol round at scale. Returns (state, info)."""
+    from corrosion_tpu.sim.sync import sync_step
+
+    k_swim, k_pig, k_sp, k_sync = jr.split(key, 4)
+    swim, swim_info, channels = scale_swim_step(
+        cfg, st.swim, net, k_swim, kill=inp.kill, revive=inp.revive
+    )
+
+    cst = local_write(cfg, st.crdt, inp.write_mask, inp.write_cell, inp.write_val)
+    cst, b_info = piggyback_bcast_step(cfg, cst, channels, k_pig)
+
+    # sync peers from the bounded member table (believed-alive entries)
+    bel_alive = (
+        (swim.mem_id >= 0)
+        & (swim.mem_id != jnp.arange(cfg.n_nodes, dtype=jnp.int32)[:, None])
+        & (swim.mem_view >= 0)
+        & ((swim.mem_view & 3) == STATE_ALIVE)
+    )
+    p_slots, p_ok = sample_k(bel_alive, cfg.sync_peers, k_sp)
+    peers = jnp.clip(jnp.take_along_axis(swim.mem_id, p_slots, axis=1), 0)
+    cst, s_info = sync_step(cfg, cst, peers, p_ok, swim.alive, net, k_sync)
+
+    info = {**swim_info, **b_info, **s_info}
+    return ScaleSimState(swim, cst), info
+
+
+def scale_run_rounds(cfg: ScaleSimConfig, st, net: NetModel, key, inputs):
+    """``lax.scan`` over stacked per-round inputs — one XLA program."""
+
+    def body(carry, inp):
+        st, key = carry
+        key, sub = jr.split(key)
+        st, info = scale_sim_step(cfg, st, net, sub, inp)
+        return (st, key), info
+
+    (st, key), infos = jax.lax.scan(body, (st, key), inputs)
+    return st, infos
+
+
+def scale_crdt_metrics(cfg: ScaleSimConfig, st: ScaleSimState):
+    """Convergence predicate at scale (same as ``crdt_metrics``)."""
+    alive = st.swim.alive
+    ref = jnp.argmax(alive)
+    same_store = jnp.stack(
+        [jnp.all(p == p[ref], axis=1) for p in st.crdt.store]
+    ).all(axis=0)
+    same_head = jnp.all(st.crdt.book.head == st.crdt.book.head[ref], axis=1)
+    needs = needs_count(st.crdt.book)
+    no_needs = jnp.all(needs <= 0, axis=1)
+    ok = (~alive) | (same_store & same_head & no_needs)
+    swim_m = {f"swim_{k}": v for k, v in scale_swim_metrics(st.swim).items()}
+    return {
+        "converged": jnp.all(ok),
+        "n_diverged": jnp.sum(~ok),
+        "total_needs": jnp.sum(jnp.where(alive[:, None], jnp.maximum(needs, 0), 0)),
+        **swim_m,
+    }

@@ -1,0 +1,84 @@
+"""Scale round (piggyback dissemination + sync): convergence tests.
+
+The assertion mirrors the reference's stress tests and Antithesis
+``check_bookkeeping.py``: after writes stop, every alive node reaches the
+same LWW store, equal heads, and no outstanding needs.
+"""
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+import pytest
+
+from corrosion_tpu.sim.scale_step import (
+    ScaleRoundInput,
+    ScaleSimState,
+    scale_crdt_metrics,
+    scale_run_rounds,
+    scale_sim_config,
+)
+from corrosion_tpu.sim.transport import NetModel
+
+
+def quiet_inputs(cfg, rounds):
+    z = ScaleRoundInput.quiet(cfg)
+    return jax.tree.map(lambda a: jnp.broadcast_to(a, (rounds,) + a.shape), z)
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return scale_sim_config(
+        48, m_slots=16, n_origins=4, n_rows=4, n_cols=2, sync_interval=4
+    )
+
+
+def run(cfg, st, net, key, inputs):
+    return jax.jit(lambda s, i: scale_run_rounds(cfg, s, net, key, i))(st, inputs)
+
+
+def test_single_writer_converges(cfg):
+    net = NetModel.create(cfg.n_nodes, drop_prob=0.02)
+    st = ScaleSimState.create(cfg)
+    # warm membership so the piggyback carrier has live channels
+    st, _ = run(cfg, st, net, jr.key(0), quiet_inputs(cfg, 40))
+
+    rounds = 30
+    inp = quiet_inputs(cfg, rounds)
+    n = cfg.n_nodes
+    w = jnp.zeros((rounds, n), bool).at[:8, 0].set(True)
+    cell = jnp.zeros((rounds, n), jnp.int32).at[:8, 0].set(
+        jnp.arange(8, dtype=jnp.int32) % cfg.n_cells
+    )
+    val = jnp.zeros((rounds, n), jnp.int32).at[:8, 0].set(100 + jnp.arange(8))
+    inp = inp._replace(write_mask=w, write_cell=cell, write_val=val)
+    st, _ = run(cfg, st, net, jr.key(1), inp)
+    # drain: no new writes, let broadcast + sync finish
+    st, _ = run(cfg, st, net, jr.key(2), quiet_inputs(cfg, 150))
+
+    m = scale_crdt_metrics(cfg, st)
+    assert bool(m["converged"]), f"diverged: {int(m['n_diverged'])} nodes"
+    # writer's values actually landed everywhere
+    assert int(st.crdt.store[1][-1, 0]) >= 100
+
+
+def test_conflict_heavy_converges(cfg):
+    net = NetModel.create(cfg.n_nodes, drop_prob=0.02)
+    st = ScaleSimState.create(cfg)
+    st, _ = run(cfg, st, net, jr.key(3), quiet_inputs(cfg, 40))
+
+    rounds = 24
+    n = cfg.n_nodes
+    k1, k2, k3 = jr.split(jr.key(4), 3)
+    inp = quiet_inputs(cfg, rounds)
+    w = (jr.uniform(k1, (rounds, n)) < 0.5) & (
+        jnp.arange(n)[None, :] < cfg.n_origins
+    )
+    cell = jr.randint(k2, (rounds, n), 0, 2).astype(jnp.int32)
+    val = jr.randint(k3, (rounds, n), 0, 1 << 20).astype(jnp.int32)
+    inp = inp._replace(write_mask=w, write_cell=cell, write_val=val)
+    st, _ = run(cfg, st, net, jr.key(5), inp)
+    st, _ = run(cfg, st, net, jr.key(6), quiet_inputs(cfg, 200))
+
+    m = scale_crdt_metrics(cfg, st)
+    assert bool(m["converged"]), f"diverged: {int(m['n_diverged'])} nodes"
+    assert int(m["total_needs"]) == 0
