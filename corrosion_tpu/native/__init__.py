@@ -1,0 +1,135 @@
+"""ctypes bindings for the native host components (``native/corro_host.cpp``).
+
+The reference loads its native CRDT engine at runtime
+(``crates/corro-types/src/sqlite.rs:121-139``); here the shared library is
+built on demand with ``make`` the first time it is needed. If no C++
+toolchain is available the callers fall back to the pure-Python oracle
+(``sim/oracle.py``) — same semantics, slower.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import pathlib
+import subprocess
+import threading
+
+import numpy as np
+
+_NATIVE_DIR = pathlib.Path(__file__).resolve().parents[2] / "native"
+_LIB_PATH = _NATIVE_DIR / "libcorro_host.so"
+_lock = threading.Lock()
+_lib = None
+
+
+def load(build: bool = True):
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if not _LIB_PATH.exists() and build:
+            try:
+                subprocess.run(
+                    ["make", "-s"], cwd=_NATIVE_DIR, check=True, capture_output=True
+                )
+            except (OSError, subprocess.CalledProcessError):
+                return None
+        if not _LIB_PATH.exists():
+            return None
+        lib = ctypes.CDLL(str(_LIB_PATH))
+        i32, i64, p = ctypes.c_int32, ctypes.c_int64, ctypes.c_void_p
+        ip = ctypes.POINTER(ctypes.c_int32)
+        lib.corro_lww_new.restype = p
+        lib.corro_lww_new.argtypes = [i32]
+        lib.corro_lww_free.argtypes = [p]
+        lib.corro_lww_merge.restype = i32
+        lib.corro_lww_merge.argtypes = [p, i32, i32, i32, i32, i32]
+        lib.corro_lww_get.argtypes = [p, i32, ip]
+        lib.corro_lww_dump.argtypes = [p, ip, ip, ip, ip]
+        lib.corro_book_new.restype = p
+        lib.corro_book_new.argtypes = [i32]
+        lib.corro_book_free.argtypes = [p]
+        lib.corro_book_record.restype = i32
+        lib.corro_book_record.argtypes = [p, i32, i32]
+        lib.corro_book_head.restype = i32
+        lib.corro_book_head.argtypes = [p, i32]
+        lib.corro_book_known_max.restype = i32
+        lib.corro_book_known_max.argtypes = [p, i32]
+        lib.corro_book_needs.restype = i64
+        lib.corro_book_needs.argtypes = [p, i32]
+        lib.corro_book_n_gaps.restype = i64
+        lib.corro_book_n_gaps.argtypes = [p, i32]
+        lib.corro_apply_batch.restype = i32
+        lib.corro_apply_batch.argtypes = [p, p, ip, i32, ip]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+class NativeNode:
+    """One simulated node backed by the C++ engine: LWW store + bookie.
+
+    Mirrors ``sim/oracle.OracleNode`` exactly — the devcluster parity
+    harness uses this for big host clusters where Python dicts are slow.
+    """
+
+    def __init__(self, n_cells: int, n_origins: int):
+        self._lib = load()
+        if self._lib is None:
+            raise RuntimeError("native library unavailable (no C++ toolchain?)")
+        self.n_cells = n_cells
+        self.n_origins = n_origins
+        self._lww = self._lib.corro_lww_new(n_cells)
+        self._book = self._lib.corro_book_new(n_origins)
+
+    def __del__(self):
+        lib = getattr(self, "_lib", None)
+        if lib is not None:
+            if getattr(self, "_lww", None):
+                lib.corro_lww_free(self._lww)
+            if getattr(self, "_book", None):
+                lib.corro_book_free(self._book)
+
+    def apply(self, changes) -> np.ndarray:
+        """Apply [n, 6] int32 rows (cell, ver, val, site, origin, dbv);
+        returns per-change freshness flags."""
+        arr = np.ascontiguousarray(changes, dtype=np.int32).reshape(-1, 6)
+        fresh = np.zeros(arr.shape[0], dtype=np.int32)
+        self._lib.corro_apply_batch(
+            self._book,
+            self._lww,
+            arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            arr.shape[0],
+            fresh.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
+        return fresh.astype(bool)
+
+    def record(self, origin: int, version: int) -> bool:
+        return bool(self._lib.corro_book_record(self._book, origin, version))
+
+    def head(self, origin: int) -> int:
+        return self._lib.corro_book_head(self._book, origin)
+
+    def known_max(self, origin: int) -> int:
+        return self._lib.corro_book_known_max(self._book, origin)
+
+    def needs(self, origin: int) -> int:
+        return self._lib.corro_book_needs(self._book, origin)
+
+    def n_gaps(self, origin: int) -> int:
+        return self._lib.corro_book_n_gaps(self._book, origin)
+
+    def store(self):
+        """The four store planes as [n_cells] int32 arrays."""
+        planes = tuple(
+            np.zeros(self.n_cells, dtype=np.int32) for _ in range(4)
+        )
+        ptrs = [
+            pl.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)) for pl in planes
+        ]
+        self._lib.corro_lww_dump(self._lww, *ptrs)
+        return planes

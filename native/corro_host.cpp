@@ -1,0 +1,197 @@
+// Host-side CRDT engine + version bookkeeping — the native component.
+//
+// The reference ships its CRDT engine as a prebuilt native SQLite
+// extension (crates/corro-types/crsqlite-linux-x86_64.so, loaded at
+// crates/corro-types/src/sqlite.rs:121-139) and keeps version/gap
+// bookkeeping in Rust rangemaps (BookedVersions,
+// crates/corro-types/src/agent.rs:1270-1604; gap algebra
+// compute_gaps_change at agent.rs:1179-1244). This library is the
+// TPU framework's host-side equivalent: an exact, interval-based
+// implementation of the LWW merge rule (doc/crdts.md:14-16,237) and the
+// gap bookkeeping, used as the ground-truth parity checker the
+// devcluster harness runs against the TPU simulator's array state —
+// fast enough for 256+-node host clusters where the pure-Python oracle
+// is not.
+//
+// C ABI (ctypes-friendly): opaque handles + flat int32 batches.
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------
+// LWW store: cell -> (col_version, value, site, dbv); merge rule:
+// biggest col_version wins, tie -> biggest value, tie -> biggest site.
+struct Cell {
+  int32_t ver = 0, val = 0, site = 0, dbv = 0;
+};
+
+struct Lww {
+  std::vector<Cell> cells;
+};
+
+inline bool incoming_wins(const Cell& cur, int32_t ver, int32_t val,
+                          int32_t site) {
+  if (ver != cur.ver) return ver > cur.ver;
+  if (val != cur.val) return val > cur.val;
+  return site > cur.site;
+}
+
+// ---------------------------------------------------------------------
+// Per-origin interval set of seen versions — the rangemap analog.
+// Invariant: disjoint, non-adjacent [lo, hi] runs keyed by lo.
+struct OriginBook {
+  std::map<int32_t, int32_t> runs;  // lo -> hi
+  int32_t known_max = 0;
+
+  // Returns true when `v` was unseen (fresh). Merges adjacent runs —
+  // the same interval algebra as compute_gaps_change.
+  bool record(int32_t v) {
+    if (v > known_max) known_max = v;
+    auto it = runs.upper_bound(v);
+    if (it != runs.begin()) {
+      auto prev = std::prev(it);
+      if (prev->second >= v) return false;      // already inside a run
+      if (prev->second + 1 == v) {              // extend prev upward
+        prev->second = v;
+        if (it != runs.end() && it->first == v + 1) {  // bridge gap
+          prev->second = it->second;
+          runs.erase(it);
+        }
+        return true;
+      }
+    }
+    if (it != runs.end() && it->first == v + 1) {  // extend next downward
+      int32_t hi = it->second;
+      runs.erase(it);
+      runs[v] = hi;
+      return true;
+    }
+    runs[v] = v;
+    return true;
+  }
+
+  int32_t head() const {
+    auto it = runs.find(1);
+    return it == runs.end() ? 0 : it->second;
+  }
+
+  // Versions heard of but not seen (the gap set's total size).
+  int64_t needs() const {
+    int64_t seen = 0;
+    for (auto& [lo, hi] : runs)
+      if (lo <= known_max) seen += std::min(hi, known_max) - lo + 1;
+    return (int64_t)known_max - seen;
+  }
+
+  int64_t n_gaps() const {
+    // gaps strictly below known_max, matching __corro_bookkeeping_gaps
+    int64_t gaps = 0;
+    int32_t cursor = 0;
+    for (auto& [lo, hi] : runs) {
+      if (lo > known_max) break;
+      if (lo > cursor + 1) gaps++;
+      cursor = std::max(cursor, hi);
+    }
+    if (cursor < known_max) gaps++;
+    return gaps;
+  }
+};
+
+struct Book {
+  std::vector<OriginBook> origins;
+};
+
+}  // namespace
+
+extern "C" {
+
+// --- LWW store --------------------------------------------------------
+void* corro_lww_new(int32_t n_cells) {
+  auto* l = new Lww();
+  l->cells.resize(n_cells);
+  return l;
+}
+void corro_lww_free(void* h) { delete static_cast<Lww*>(h); }
+
+// Returns 1 when the incoming change won the cell.
+int32_t corro_lww_merge(void* h, int32_t cell, int32_t ver, int32_t val,
+                        int32_t site, int32_t dbv) {
+  auto* l = static_cast<Lww*>(h);
+  Cell& c = l->cells[cell];
+  if (c.ver == 0 || incoming_wins(c, ver, val, site)) {
+    c = Cell{ver, val, site, dbv};
+    return 1;
+  }
+  return 0;
+}
+
+// Writes (ver, val, site, dbv) for `cell` into out[0..3].
+void corro_lww_get(void* h, int32_t cell, int32_t* out) {
+  const Cell& c = static_cast<Lww*>(h)->cells[cell];
+  out[0] = c.ver; out[1] = c.val; out[2] = c.site; out[3] = c.dbv;
+}
+
+// Dump the whole store as 4 planes of n_cells int32 each.
+void corro_lww_dump(void* h, int32_t* ver, int32_t* val, int32_t* site,
+                    int32_t* dbv) {
+  auto* l = static_cast<Lww*>(h);
+  for (size_t i = 0; i < l->cells.size(); i++) {
+    ver[i] = l->cells[i].ver; val[i] = l->cells[i].val;
+    site[i] = l->cells[i].site; dbv[i] = l->cells[i].dbv;
+  }
+}
+
+// --- version bookkeeping ---------------------------------------------
+void* corro_book_new(int32_t n_origins) {
+  auto* b = new Book();
+  b->origins.resize(n_origins);
+  return b;
+}
+void corro_book_free(void* h) { delete static_cast<Book*>(h); }
+
+int32_t corro_book_record(void* h, int32_t origin, int32_t version) {
+  return static_cast<Book*>(h)->origins[origin].record(version) ? 1 : 0;
+}
+int32_t corro_book_head(void* h, int32_t origin) {
+  return static_cast<Book*>(h)->origins[origin].head();
+}
+int32_t corro_book_known_max(void* h, int32_t origin) {
+  return static_cast<Book*>(h)->origins[origin].known_max;
+}
+int64_t corro_book_needs(void* h, int32_t origin) {
+  return static_cast<Book*>(h)->origins[origin].needs();
+}
+int64_t corro_book_n_gaps(void* h, int32_t origin) {
+  return static_cast<Book*>(h)->origins[origin].n_gaps();
+}
+
+// --- batched node: Book + Lww behind one apply ------------------------
+// changes: flat [n, 6] int32 rows (cell, ver, val, site, origin, dbv).
+// fresh_out (optional, may be null): per-change freshness flags.
+// Returns number of fresh changes. Fresh changes merge into the store;
+// stale ones are dropped — exactly process_multiple_changes'
+// seen-check-then-apply (util.rs:699).
+int32_t corro_apply_batch(void* book_h, void* lww_h, const int32_t* changes,
+                          int32_t n, int32_t* fresh_out) {
+  auto* b = static_cast<Book*>(book_h);
+  auto* l = static_cast<Lww*>(lww_h);
+  int32_t n_fresh = 0;
+  for (int32_t i = 0; i < n; i++) {
+    const int32_t* c = changes + 6 * i;
+    bool fresh = b->origins[c[4]].record(c[5]);
+    if (fresh) {
+      n_fresh++;
+      Cell& cell = l->cells[c[0]];
+      if (cell.ver == 0 || incoming_wins(cell, c[1], c[2], c[3]))
+        cell = Cell{c[1], c[2], c[3], c[5]};
+    }
+    if (fresh_out) fresh_out[i] = fresh ? 1 : 0;
+  }
+  return n_fresh;
+}
+
+}  // extern "C"

@@ -1,0 +1,85 @@
+"""Native (C++) host engine: parity against the Python oracle and the
+JAX kernels under identical random traffic."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from corrosion_tpu.sim.oracle import OracleNode
+
+native = pytest.importorskip("corrosion_tpu.native")
+if not native.available():
+    pytest.skip("no C++ toolchain", allow_module_level=True)
+
+
+def random_changes(rng, n, n_cells, n_origins, max_ver=6):
+    cell = rng.integers(0, n_cells, n)
+    ver = rng.integers(1, max_ver, n)
+    val = rng.integers(0, 1000, n)
+    site = rng.integers(0, n_origins, n)
+    origin = rng.integers(0, n_origins, n)
+    dbv = rng.integers(1, 40, n)
+    return np.stack([cell, ver, val, site, origin, dbv], axis=1).astype(np.int32)
+
+
+def test_native_matches_python_oracle():
+    rng = np.random.default_rng(0)
+    n_cells, n_origins = 8, 3
+    nat = native.NativeNode(n_cells, n_origins)
+    orc = OracleNode(n_origins)
+    for _ in range(50):
+        batch = random_changes(rng, 20, n_cells, n_origins)
+        fresh_nat = nat.apply(batch)
+        fresh_orc = np.array([orc.apply(tuple(row)) for row in batch])
+        np.testing.assert_array_equal(fresh_nat, fresh_orc)
+    for o in range(n_origins):
+        assert nat.head(o) == orc.head(o)
+        assert nat.needs(o) == orc.needs(o)
+        assert nat.known_max(o) == orc.known_max.get(o, 0)
+    ver, val, site, dbv = nat.store()
+    for c in range(n_cells):
+        got = (int(ver[c]), int(val[c]), int(site[c]), int(dbv[c]))
+        want = orc.store.get(c, (0, 0, 0, 0))
+        assert got == want, f"cell {c}: {got} != {want}"
+
+
+def test_native_matches_jax_book():
+    from corrosion_tpu.ops.versions import Book, needs_count, record_versions
+
+    rng = np.random.default_rng(1)
+    n_origins = 4
+    # buffer big enough that nothing is dropped (native book is unbounded;
+    # the JAX buffer's drop-on-overflow is by design and tested elsewhere)
+    nat = native.NativeNode(1, n_origins)
+    book = Book.create(1, n_origins, buf_slots=256)
+    for _ in range(30):
+        origin = rng.integers(0, n_origins, 8).astype(np.int32)
+        ver = rng.integers(1, 30, 8).astype(np.int32)
+        for o, v in zip(origin, ver):
+            nat.record(int(o), int(v))
+        book, _ = record_versions(
+            book, jnp.asarray(origin)[None, :], jnp.asarray(ver)[None, :],
+            jnp.ones((1, 8), bool),
+        )
+    needs = needs_count(book)
+    for o in range(n_origins):
+        assert int(book.head[0, o]) == nat.head(o)
+        assert int(book.known_max[0, o]) == nat.known_max(o)
+        assert int(needs[0, o]) == nat.needs(o)
+
+
+def test_gap_interval_algebra():
+    """Directed gap-merge cases from the reference's gap algebra tests
+    (``agent.rs:1606-1841`` shape): extend-up, extend-down, bridge."""
+    nat = native.NativeNode(1, 1)
+    assert nat.record(0, 2) and nat.record(0, 4)
+    assert nat.head(0) == 0 and nat.n_gaps(0) == 2  # [1] and [3]
+    assert nat.record(0, 3)  # bridge 2-4
+    assert nat.n_gaps(0) == 1
+    assert nat.record(0, 1)  # close the head gap
+    assert nat.head(0) == 4 and nat.needs(0) == 0 and nat.n_gaps(0) == 0
+    assert not nat.record(0, 3)  # duplicate is stale
+    nat2 = native.NativeNode(1, 1)
+    assert nat2.record(0, 10)
+    assert nat2.needs(0) == 9 and nat2.n_gaps(0) == 1
