@@ -1,0 +1,253 @@
+"""Configuration: TOML file + environment overlay.
+
+Mirrors the reference's config system (``crates/corro-types/src/config.rs``):
+a TOML file with sections ``db / api / gossip / perf / admin / telemetry /
+log / consul`` (``config.rs:63-81``), an environment-variable overlay using
+the ``__`` separator (``config.rs:326-332``), a ``PerfConfig`` section that
+centralizes every queue length / pool size (``config.rs:200-257``), and a
+builder for tests (``config.rs:335-456``).
+
+TPU reframing: the ``[sim]`` section (no reference analog) selects the
+simulator model and cluster scale; ``[perf]`` holds the bounded-pool
+shapes that the reference expresses as channel capacities; ``[gossip]``
+carries the protocol knobs plus the network-condition model that the
+reference gets implicitly from real sockets.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tomllib
+from typing import Any, Optional
+
+ENV_PREFIX = "CORRO_TPU"
+
+
+@dataclasses.dataclass
+class DbConfig:
+    """Where state lives on the host (``config.rs`` ``db.path`` etc.).
+
+    The SQLite file's role — the durable checkpoint — is played by
+    checkpoint directories (see ``checkpoint.py``)."""
+
+    path: str = "./corro_tpu_state"
+    schema_paths: tuple = ()
+
+
+@dataclasses.dataclass
+class ApiConfig:
+    """HTTP API listener (``config.rs`` ``api.bind_addr``)."""
+
+    addr: str = "127.0.0.1"
+    port: int = 8787
+
+
+@dataclasses.dataclass
+class GossipConfig:
+    """Protocol + network-model knobs (``config.rs`` ``gossip``)."""
+
+    bootstrap: tuple = ()  # seed node ids (DNS list analog)
+    cluster_id: int = 0
+    drop_prob: float = 0.01
+    idle_rounds: int = 16  # announce interval analog
+    plaintext: bool = True  # no TLS in the simulator
+
+
+@dataclasses.dataclass
+class PerfConfig:
+    """Bounded-pool shapes (``PerfConfig``, ``config.rs:200-257``)."""
+
+    buf_slots: int = 32  # out-of-order version buffer (processing queue cap)
+    bcast_queue: int = 32  # pending-broadcast slots
+    recv_slots: int = 96  # per-round apply mailbox (full sim)
+    pig_changes: int = 4  # changesets per packet (scale sim)
+    sync_chunk: int = 32  # versions per (peer, origin) sync pull
+    sync_interval: int = 8
+    sync_peers: int = 2
+    bcast_fanout: int = 5
+    bcast_max_transmissions: int = 4
+
+
+@dataclasses.dataclass
+class SimConfigSection:
+    """Simulator model + scale (TPU-specific section)."""
+
+    mode: str = "scale"  # "full" (O(N^2) faithful) | "scale" (bounded tables)
+    n_nodes: int = 256
+    m_slots: int = 64
+    n_origins: int = 16
+    n_rows: int = 16
+    n_cols: int = 4
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class AdminConfig:
+    """UDS admin socket (``config.rs`` ``admin.uds_path``)."""
+
+    uds_path: str = "./admin.sock"
+
+
+@dataclasses.dataclass
+class TelemetryConfig:
+    """Prometheus exposition (``config.rs`` ``telemetry``)."""
+
+    prometheus_addr: Optional[str] = None  # "host:port" or None = disabled
+
+
+@dataclasses.dataclass
+class LogConfig:
+    colors: bool = False
+    format: str = "plaintext"  # or "json"
+    level: str = "info"
+
+
+@dataclasses.dataclass
+class ConsulConfig:
+    enabled: bool = False
+    addr: str = "127.0.0.1:8500"
+    poll_seconds: float = 1.0
+
+
+@dataclasses.dataclass
+class Config:
+    db: DbConfig = dataclasses.field(default_factory=DbConfig)
+    api: ApiConfig = dataclasses.field(default_factory=ApiConfig)
+    gossip: GossipConfig = dataclasses.field(default_factory=GossipConfig)
+    perf: PerfConfig = dataclasses.field(default_factory=PerfConfig)
+    sim: SimConfigSection = dataclasses.field(default_factory=SimConfigSection)
+    admin: AdminConfig = dataclasses.field(default_factory=AdminConfig)
+    telemetry: TelemetryConfig = dataclasses.field(default_factory=TelemetryConfig)
+    log: LogConfig = dataclasses.field(default_factory=LogConfig)
+    consul: ConsulConfig = dataclasses.field(default_factory=ConsulConfig)
+
+    # --- simulator-config bridges ---------------------------------------
+    def to_scale_config(self):
+        from corrosion_tpu.sim.scale_step import scale_sim_config
+
+        return scale_sim_config(
+            self.sim.n_nodes,
+            m_slots=self.sim.m_slots,
+            n_origins=self.sim.n_origins,
+            n_rows=self.sim.n_rows,
+            n_cols=self.sim.n_cols,
+            buf_slots=self.perf.buf_slots,
+            bcast_queue=self.perf.bcast_queue,
+            pig_changes=self.perf.pig_changes,
+            sync_chunk=self.perf.sync_chunk,
+            sync_interval=self.perf.sync_interval,
+            sync_peers=self.perf.sync_peers,
+            bcast_max_transmissions=self.perf.bcast_max_transmissions,
+            announce_interval=self.gossip.idle_rounds,
+        )
+
+    def to_full_config(self):
+        from corrosion_tpu.sim.config import wan_config
+
+        return wan_config(
+            self.sim.n_nodes,
+            n_origins=self.sim.n_origins,
+            n_rows=self.sim.n_rows,
+            n_cols=self.sim.n_cols,
+            buf_slots=self.perf.buf_slots,
+            bcast_queue=self.perf.bcast_queue,
+            recv_slots=self.perf.recv_slots,
+            sync_chunk=self.perf.sync_chunk,
+            sync_interval=self.perf.sync_interval,
+            sync_peers=self.perf.sync_peers,
+            bcast_fanout=self.perf.bcast_fanout,
+            bcast_max_transmissions=self.perf.bcast_max_transmissions,
+            announce_interval=self.gossip.idle_rounds,
+        )
+
+    def sim_config(self):
+        if self.sim.mode == "scale":
+            return self.to_scale_config()
+        if self.sim.mode == "full":
+            return self.to_full_config()
+        raise ValueError(f"unknown sim.mode {self.sim.mode!r}")
+
+
+_SECTIONS = {f.name: f.type for f in dataclasses.fields(Config)}
+
+
+def _coerce(cur: Any, raw: str) -> Any:
+    """Coerce an env-var string to the type of the current value."""
+    if isinstance(cur, bool):
+        return raw.lower() in ("1", "true", "yes", "on")
+    if isinstance(cur, int):
+        return int(raw)
+    if isinstance(cur, float):
+        return float(raw)
+    if isinstance(cur, tuple):
+        return tuple(x.strip() for x in raw.split(",") if x.strip())
+    return raw
+
+
+def _apply_dict(cfg: Config, data: dict) -> Config:
+    for section, values in data.items():
+        if section not in _SECTIONS:
+            raise ValueError(f"unknown config section [{section}]")
+        sec = getattr(cfg, section)
+        if not isinstance(values, dict):
+            raise ValueError(f"section [{section}] must be a table")
+        for k, v in values.items():
+            if not hasattr(sec, k):
+                raise ValueError(f"unknown key {k!r} in section [{section}]")
+            if isinstance(v, list):
+                v = tuple(v)
+            setattr(sec, k, v)
+    return cfg
+
+
+def _apply_env(cfg: Config, environ=None) -> Config:
+    """Overlay ``CORRO_TPU__SECTION__KEY=value`` env vars (the reference's
+    ``__``-separator overlay, ``config.rs:326-332``)."""
+    environ = os.environ if environ is None else environ
+    prefix = ENV_PREFIX + "__"
+    for name, raw in environ.items():
+        if not name.startswith(prefix):
+            continue
+        parts = name[len(prefix):].lower().split("__")
+        if len(parts) != 2:
+            raise ValueError(f"bad config env var {name} (want SECTION__KEY)")
+        section, key = parts
+        if section not in _SECTIONS:
+            raise ValueError(f"unknown config section {section!r} from {name}")
+        sec = getattr(cfg, section)
+        if not hasattr(sec, key):
+            raise ValueError(f"unknown key {key!r} from {name}")
+        setattr(sec, key, _coerce(getattr(sec, key), raw))
+    return cfg
+
+
+def load_config(path: Optional[str] = None, environ=None) -> Config:
+    """TOML file (optional) + env overlay -> validated Config."""
+    cfg = Config()
+    if path is not None:
+        with open(path, "rb") as f:
+            _apply_dict(cfg, tomllib.load(f))
+    return _apply_env(cfg, environ)
+
+
+def default_toml() -> str:
+    """An example config file (``config.example.toml`` analog)."""
+    lines = []
+    for f in dataclasses.fields(Config):
+        lines.append(f"[{f.name}]")
+        sec = getattr(Config(), f.name)
+        for sf in dataclasses.fields(sec):
+            v = getattr(sec, sf.name)
+            if v is None:
+                lines.append(f"# {sf.name} = <unset>")
+            elif isinstance(v, bool):
+                lines.append(f"{sf.name} = {str(v).lower()}")
+            elif isinstance(v, (int, float)):
+                lines.append(f"{sf.name} = {v}")
+            elif isinstance(v, tuple):
+                lines.append(f"{sf.name} = {list(v)!r}")
+            else:
+                lines.append(f'{sf.name} = "{v}"')
+        lines.append("")
+    return "\n".join(lines)

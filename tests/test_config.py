@@ -1,0 +1,64 @@
+"""Config system: TOML load, env overlay, sim-config bridges."""
+
+import pytest
+
+from corrosion_tpu.config import Config, default_toml, load_config
+
+
+def test_defaults_roundtrip(tmp_path):
+    # the generated example file parses back to the defaults
+    p = tmp_path / "config.toml"
+    p.write_text(default_toml())
+    cfg = load_config(str(p), environ={})
+    assert cfg == Config()
+
+
+def test_toml_and_env_overlay(tmp_path):
+    p = tmp_path / "config.toml"
+    p.write_text(
+        """
+[sim]
+mode = "scale"
+n_nodes = 512
+
+[gossip]
+drop_prob = 0.05
+bootstrap = ["0", "1", "2"]
+
+[perf]
+sync_peers = 3
+"""
+    )
+    env = {
+        "CORRO_TPU__SIM__N_NODES": "1024",  # env beats file
+        "CORRO_TPU__GOSSIP__CLUSTER_ID": "7",
+        "CORRO_TPU__CONSUL__ENABLED": "true",
+    }
+    cfg = load_config(str(p), environ=env)
+    assert cfg.sim.n_nodes == 1024
+    assert cfg.gossip.drop_prob == 0.05
+    assert cfg.gossip.bootstrap == ("0", "1", "2")
+    assert cfg.perf.sync_peers == 3
+    assert cfg.gossip.cluster_id == 7
+    assert cfg.consul.enabled is True
+
+
+def test_unknown_keys_rejected(tmp_path):
+    p = tmp_path / "config.toml"
+    p.write_text("[gossip]\nnot_a_knob = 1\n")
+    with pytest.raises(ValueError, match="unknown key"):
+        load_config(str(p), environ={})
+    with pytest.raises(ValueError, match="unknown config section"):
+        load_config(None, environ={"CORRO_TPU__NOPE__X": "1"})
+
+
+def test_sim_config_bridges():
+    cfg = load_config(None, environ={"CORRO_TPU__SIM__N_NODES": "128"})
+    sc = cfg.to_scale_config()
+    assert sc.n_nodes == 128 and sc.sync_peers == cfg.perf.sync_peers
+    cfg.sim.mode = "full"
+    fc = cfg.sim_config()
+    assert fc.n_nodes == 128 and fc.bcast_fanout == cfg.perf.bcast_fanout
+    cfg.sim.mode = "bogus"
+    with pytest.raises(ValueError):
+        cfg.sim_config()
