@@ -1,0 +1,1 @@
+"""Host-side utilities: metrics, tracing, lock registry, lifecycle."""

@@ -1,0 +1,92 @@
+"""Lifecycle utilities: graceful shutdown, counted spawns, backoff.
+
+The reference's layer-9 crates (SURVEY §1): ``tripwire`` — a shutdown
+future tripped by SIGTERM/SIGINT or programmatically
+(``crates/tripwire/src/tripwire.rs:21``); ``spawn`` — ``spawn_counted``
+tracks pending tasks so shutdown can wait for all of them
+(``crates/spawn/src/lib.rs:14-28``); ``backoff`` — a jittered exponential
+backoff iterator (``crates/backoff/src/lib.rs:7-50``). Threads play the
+role of tokio tasks in the host agent.
+"""
+
+from __future__ import annotations
+
+import random
+import signal
+import threading
+from typing import Iterator, Optional
+
+
+class Tripwire:
+    """Shutdown signal: ``tripped`` flips once; waiters unblock."""
+
+    def __init__(self):
+        self._event = threading.Event()
+
+    def trip(self):
+        self._event.set()
+
+    @property
+    def tripped(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._event.wait(timeout)
+
+    def hook_signals(self):
+        """SIGTERM/SIGINT -> trip (main thread only)."""
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(sig, lambda *_: self.trip())
+        return self
+
+
+_pending = 0
+_pending_mu = threading.Lock()
+_pending_zero = threading.Condition(_pending_mu)
+
+
+def spawn_counted(target, *args, name: Optional[str] = None, **kwargs) -> threading.Thread:
+    """Spawn a thread counted toward ``wait_for_all_pending``."""
+    global _pending
+    with _pending_mu:
+        _pending += 1
+
+    def run():
+        global _pending
+        try:
+            target(*args, **kwargs)
+        finally:
+            with _pending_mu:
+                _pending -= 1
+                if _pending == 0:
+                    _pending_zero.notify_all()
+
+    t = threading.Thread(target=run, daemon=True, name=name)
+    t.start()
+    return t
+
+
+def pending_count() -> int:
+    with _pending_mu:
+        return _pending
+
+
+def wait_for_all_pending(timeout: Optional[float] = None) -> bool:
+    """Block until every counted spawn finished (shutdown barrier)."""
+    with _pending_mu:
+        return _pending_zero.wait_for(lambda: _pending == 0, timeout)
+
+
+def backoff(
+    base: float = 0.1,
+    factor: float = 2.0,
+    max_delay: float = 60.0,
+    jitter: float = 0.25,
+    rng: Optional[random.Random] = None,
+) -> Iterator[float]:
+    """Jittered exponential backoff delays (``backoff`` crate analog)."""
+    rng = rng or random.Random()
+    delay = base
+    while True:
+        yield min(max_delay, delay) * (1.0 + jitter * (2 * rng.random() - 1))
+        delay = min(max_delay, delay * factor)

@@ -1,0 +1,174 @@
+"""Metrics registry with Prometheus text exposition.
+
+The reference records ~100 series through the ``metrics`` crate facade and
+exposes them via a Prometheus HTTP exporter with curated buckets
+(``crates/corrosion/src/command/agent.rs:114-139``; series documented in
+``doc/telemetry/prometheus.md``). Here the same facade: counters, gauges,
+and histograms keyed by name + sorted labels, a global registry, and a
+text-format renderer; the host agent serves it at ``/metrics``.
+
+The simulator's round ``info`` dicts map onto ``corro.*`` names via
+``record_round_info`` — the analog of the metrics calls sprinkled through
+the reference's loops (gossip ``broadcast/mod.rs:296-312``, changes-queue
+``handlers.rs:636-638``, sync ``api/peer/mod.rs:975-987``).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+_DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0
+)
+
+
+def _key(name: str, labels: Optional[dict]) -> Tuple[str, Tuple[Tuple[str, str], ...]]:
+    return name, tuple(sorted((labels or {}).items()))
+
+
+class Registry:
+    """Thread-safe metrics store (one per agent; a global default)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict = {}
+        self._gauges: Dict = {}
+        self._histograms: Dict = {}
+
+    def counter(self, name: str, value: float = 1.0, labels: Optional[dict] = None):
+        k = _key(name, labels)
+        with self._lock:
+            self._counters[k] = self._counters.get(k, 0.0) + value
+
+    def gauge(self, name: str, value: float, labels: Optional[dict] = None):
+        with self._lock:
+            self._gauges[_key(name, labels)] = float(value)
+
+    def histogram(
+        self,
+        name: str,
+        value: float,
+        labels: Optional[dict] = None,
+        buckets: Tuple[float, ...] = _DEFAULT_BUCKETS,
+    ):
+        k = _key(name, labels)
+        with self._lock:
+            h = self._histograms.get(k)
+            if h is None:
+                h = {"buckets": buckets, "counts": [0] * (len(buckets) + 1),
+                     "sum": 0.0, "count": 0}
+                self._histograms[k] = h
+            h["counts"][bisect.bisect_left(h["buckets"], value)] += 1
+            h["sum"] += value
+            h["count"] += 1
+
+    def get_counter(self, name: str, labels: Optional[dict] = None) -> float:
+        return self._counters.get(_key(name, labels), 0.0)
+
+    def get_gauge(self, name: str, labels: Optional[dict] = None) -> Optional[float]:
+        return self._gauges.get(_key(name, labels))
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "histograms": {
+                    k: dict(v, counts=list(v["counts"]))
+                    for k, v in self._histograms.items()
+                },
+            }
+
+    # --- Prometheus text format v0.0.4 ----------------------------------
+    def render(self) -> str:
+        def fmt_labels(lab, extra=()):
+            items = list(lab) + list(extra)
+            if not items:
+                return ""
+            inner = ",".join(f'{k}="{v}"' for k, v in items)
+            return "{" + inner + "}"
+
+        out = []
+        snap = self.snapshot()
+        for (name, lab), v in sorted(snap["counters"].items()):
+            pname = name.replace(".", "_")
+            out.append(f"# TYPE {pname} counter")
+            out.append(f"{pname}{fmt_labels(lab)} {v}")
+        for (name, lab), v in sorted(snap["gauges"].items()):
+            pname = name.replace(".", "_")
+            out.append(f"# TYPE {pname} gauge")
+            out.append(f"{pname}{fmt_labels(lab)} {v}")
+        for (name, lab), h in sorted(snap["histograms"].items()):
+            pname = name.replace(".", "_")
+            out.append(f"# TYPE {pname} histogram")
+            acc = 0
+            for b, c in zip(h["buckets"], h["counts"]):
+                acc += c
+                out.append(f"{pname}_bucket{fmt_labels(lab, [('le', b)])} {acc}")
+            out.append(f"{pname}_bucket{fmt_labels(lab, [('le', '+Inf')])} {h['count']}")
+            out.append(f"{pname}_sum{fmt_labels(lab)} {h['sum']}")
+            out.append(f"{pname}_count{fmt_labels(lab)} {h['count']}")
+        return "\n".join(out) + "\n"
+
+
+REGISTRY = Registry()
+
+# round-info key -> corro.* series (reference names where one exists)
+_INFO_MAP = {
+    "acked": ("corro.gossip.probe.acked", "counter"),
+    "failed_probes": ("corro.gossip.probe.failed", "counter"),
+    "refutes": ("corro.gossip.refutes", "counter"),
+    "sent": ("corro.broadcast.sent", "counter"),
+    "delivered": ("corro.broadcast.recv.count", "counter"),
+    "fresh": ("corro.broadcast.processed.count", "counter"),
+    "queued": ("corro.broadcast.pending.count", "gauge"),
+    "syncs": ("corro.sync.client.count", "counter"),
+    "cells_pulled": ("corro.sync.changes.recv", "counter"),
+    "versions_granted": ("corro.sync.chunk.sent.versions", "counter"),
+}
+
+
+def record_round_info(info: dict, registry: Registry = REGISTRY):
+    """Map one round's info dict onto the corro.* series."""
+    for k, v in info.items():
+        mapped = _INFO_MAP.get(k)
+        if mapped is None:
+            continue
+        name, kind = mapped
+        v = float(v)
+        if kind == "counter":
+            registry.counter(name, v)
+        else:
+            registry.gauge(name, v)
+
+
+class RoundTimer:
+    """Slow-turn watchdog: the reference warns when a runtime-loop turn
+    exceeds 1 s (``broadcast/mod.rs:319-323``) and profiles statements
+    slower than 1 s (``sqlite.rs:51-61``). Use as a context manager around
+    host-side round dispatch."""
+
+    def __init__(self, name: str, warn_seconds: float = 1.0,
+                 registry: Registry = REGISTRY, logger=None):
+        self.name = name
+        self.warn_seconds = warn_seconds
+        self.registry = registry
+        self.logger = logger
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dt = time.perf_counter() - self._t0
+        self.registry.histogram(f"corro.{self.name}.seconds", dt)
+        if dt > self.warn_seconds:
+            self.registry.counter(f"corro.{self.name}.slow", 1)
+            if self.logger is not None:
+                self.logger.warning(
+                    "%s turn took %.3fs (> %.1fs)", self.name, dt, self.warn_seconds
+                )
+        return False

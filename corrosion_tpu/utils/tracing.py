@@ -1,0 +1,93 @@
+"""Tracing: spans, slow-span warnings, W3C trace-context propagation.
+
+The reference runs ``tracing`` everywhere with an optional OpenTelemetry
+OTLP pipeline (``crates/corrosion/src/main.rs:57-150``) and propagates
+trace context **across nodes inside the sync protocol** —
+``SyncTraceContextV1 {traceparent, tracestate}`` implements the otel
+Injector/Extractor (``crates/corro-types/src/sync.rs:33-67``), injected by
+the sync client (``api/peer/mod.rs:1017-1020``) and extracted by the
+server (``peer/mod.rs:1414-1416``).
+
+Here: a dependency-free span implementation logging through ``logging``,
+a W3C ``traceparent`` codec for the same cross-agent propagation (the
+host sync harness passes it peer to peer), and a dynamic level filter
+reloadable at runtime through the admin socket (the reference's
+``LogCommand``, ``corro-admin/src/lib.rs:129-132``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import logging
+import secrets
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+logger = logging.getLogger("corrosion_tpu")
+
+_current_span: contextvars.ContextVar = contextvars.ContextVar(
+    "corro_span", default=None
+)
+
+
+@dataclass
+class SpanContext:
+    """W3C trace-context ids (``SyncTraceContextV1`` analog)."""
+
+    trace_id: str  # 32 hex chars
+    span_id: str  # 16 hex chars
+
+    def to_traceparent(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-01"
+
+    @staticmethod
+    def from_traceparent(tp: Optional[str]) -> Optional["SpanContext"]:
+        if not tp:
+            return None
+        parts = tp.split("-")
+        if len(parts) != 4 or len(parts[1]) != 32 or len(parts[2]) != 16:
+            return None
+        return SpanContext(trace_id=parts[1], span_id=parts[2])
+
+
+def current_span() -> Optional[SpanContext]:
+    return _current_span.get()
+
+
+def inject_traceparent() -> Optional[str]:
+    """For the sync client: current context -> wire field."""
+    ctx = current_span()
+    return ctx.to_traceparent() if ctx else None
+
+
+@contextlib.contextmanager
+def span(name: str, traceparent: Optional[str] = None, warn_seconds: float = 1.0,
+         **attrs):
+    """A timed span; nests under the current one or under an extracted
+    remote parent (the sync server path)."""
+    parent = SpanContext.from_traceparent(traceparent) or current_span()
+    ctx = SpanContext(
+        trace_id=parent.trace_id if parent else secrets.token_hex(16),
+        span_id=secrets.token_hex(8),
+    )
+    token = _current_span.set(ctx)
+    t0 = time.perf_counter()
+    try:
+        yield ctx
+    finally:
+        dt = time.perf_counter() - t0
+        _current_span.reset(token)
+        level = logging.WARNING if dt > warn_seconds else logging.DEBUG
+        logger.log(
+            level,
+            "span %s took %.3fs trace=%s span=%s %s",
+            name, dt, ctx.trace_id[:8], ctx.span_id,
+            " ".join(f"{k}={v}" for k, v in attrs.items()),
+        )
+
+
+def set_level(level: str):
+    """Dynamic log filter reload (admin ``LogCommand`` analog)."""
+    logger.setLevel(getattr(logging, level.upper()))

@@ -1,0 +1,96 @@
+"""Host utilities: metrics registry, tracing, lock registry, lifecycle."""
+
+import logging
+import time
+
+from corrosion_tpu.utils.lifecycle import (
+    Tripwire,
+    backoff,
+    pending_count,
+    spawn_counted,
+    wait_for_all_pending,
+)
+from corrosion_tpu.utils.locks import LockRegistry
+from corrosion_tpu.utils.metrics import Registry, RoundTimer, record_round_info
+from corrosion_tpu.utils.tracing import SpanContext, inject_traceparent, span
+
+
+def test_metrics_registry_and_prometheus():
+    r = Registry()
+    r.counter("corro.broadcast.sent", 3)
+    r.counter("corro.broadcast.sent", 2)
+    r.gauge("corro.members.count", 42, labels={"state": "alive"})
+    r.histogram("corro.sync.seconds", 0.03)
+    r.histogram("corro.sync.seconds", 4.2)
+    assert r.get_counter("corro.broadcast.sent") == 5
+    text = r.render()
+    assert "corro_broadcast_sent 5" in text
+    assert 'corro_members_count{state="alive"} 42' in text
+    assert "corro_sync_seconds_count 2" in text
+    assert 'le="+Inf"} 2' in text
+
+
+def test_record_round_info():
+    r = Registry()
+    record_round_info({"acked": 7, "queued": 3, "unknown_key": 9}, registry=r)
+    record_round_info({"acked": 1}, registry=r)
+    assert r.get_counter("corro.gossip.probe.acked") == 8
+    assert r.get_gauge("corro.broadcast.pending.count") == 3
+
+
+def test_round_timer_slow_warn():
+    r = Registry()
+    with RoundTimer("round", warn_seconds=0.0, registry=r):
+        time.sleep(0.01)
+    assert r.get_counter("corro.round.slow") == 1
+
+
+def test_span_propagation():
+    with span("sync.client") as parent:
+        tp = inject_traceparent()
+        assert tp is not None and parent.trace_id in tp
+    # server side extracts the context and continues the same trace
+    with span("sync.server", traceparent=tp) as server_ctx:
+        assert server_ctx.trace_id == parent.trace_id
+    assert SpanContext.from_traceparent("garbage") is None
+
+
+def test_lock_registry_watchdog():
+    logs = []
+
+    class L:
+        def warning(self, msg, *a):
+            logs.append(msg % a)
+
+    reg = LockRegistry(warn_seconds=0.0, logger=L())
+    lk = reg.lock("bookie.write")
+    with lk:
+        time.sleep(0.01)
+        slow = reg.check()
+        assert slow and slow[0]["label"] == "bookie.write"
+    assert reg.check() == []  # released -> clean
+    assert logs and "bookie.write" in logs[0]
+
+
+def test_lifecycle_spawn_and_tripwire():
+    tw = Tripwire()
+    results = []
+
+    def worker():
+        tw.wait(5)
+        results.append(1)
+
+    spawn_counted(worker)
+    spawn_counted(worker)
+    assert pending_count() >= 2
+    tw.trip()
+    assert wait_for_all_pending(timeout=5)
+    assert results == [1, 1] and tw.tripped
+
+
+def test_backoff_grows_and_caps():
+    delays = []
+    for i, d in zip(range(8), backoff(base=0.1, factor=2, max_delay=1.0, jitter=0)):
+        delays.append(d)
+    assert delays[0] == 0.1 and delays[1] == 0.2
+    assert max(delays) == 1.0 and delays[-1] == 1.0
