@@ -1,0 +1,315 @@
+"""Agent core: the round loop + write/read surface over the simulator.
+
+Maps the reference's node runtime (SURVEY §3.1 ``start_with_config`` ->
+``run``) onto the TPU model:
+
+- the **round loop** thread is every corro-agent loop fused: each tick
+  advances the whole cluster one protocol round (SWIM + broadcast + sync)
+  through one jitted step — ``runtime_loop``/``handle_changes``/
+  ``sync_loop`` in one dispatch;
+- the **write path** mirrors ``POST /v1/transactions``
+  (``api_v1_transactions``, ``crates/corro-agent/src/api/public/mod.rs:177``):
+  statements execute against a node's pending-write slot and are
+  disseminated by the next round's broadcast step;
+- the **read path** mirrors ``/v1/queries``: reads observe one node's
+  local replica only (eventually consistent by construction);
+- **churn/partition controls** are the admin/fault-injection surface
+  (Antithesis drivers, SURVEY §4).
+
+Thread-safety: API threads only touch the pending-input buffers and the
+latest host snapshot, both under tracked locks; the round thread owns the
+device state exclusively.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+import numpy as np
+
+from corrosion_tpu.config import Config
+from corrosion_tpu.utils.lifecycle import Tripwire, spawn_counted
+from corrosion_tpu.utils.locks import LockRegistry
+from corrosion_tpu.utils.metrics import Registry, RoundTimer, record_round_info
+from corrosion_tpu.utils.tracing import logger
+
+
+class Agent:
+    """The node runtime. ``Agent(config).start()`` -> round loop running.
+
+    Use :meth:`execute` / :meth:`query` / :meth:`snapshot` from any
+    thread; :meth:`shutdown` is the tripwire.
+    """
+
+    def __init__(self, config: Optional[Config] = None):
+        self.config = config or Config()
+        sim = self.config.sim
+        self.mode = sim.mode
+        self.cfg = self.config.sim_config()
+        self.n_nodes = self.cfg.n_nodes
+        self.n_origins = self.cfg.n_origins
+        self.n_cells = self.cfg.n_cells
+
+        if self.mode == "scale":
+            from corrosion_tpu.sim.scale_step import (
+                ScaleRoundInput,
+                ScaleSimState,
+                scale_sim_step,
+            )
+
+            self._state = ScaleSimState.create(self.cfg)
+            self._quiet = ScaleRoundInput.quiet(self.cfg)
+            self._step = jax.jit(
+                lambda st, net, key, inp: scale_sim_step(self.cfg, st, net, key, inp)
+            )
+        else:
+            from corrosion_tpu.sim.step import RoundInput, SimState, sim_step
+
+            self._state = SimState.create(self.cfg)
+            self._quiet = RoundInput.quiet(self.cfg)
+            self._step = jax.jit(
+                lambda st, net, key, inp: sim_step(self.cfg, st, net, key, inp)
+            )
+
+        from corrosion_tpu.sim.transport import NetModel
+
+        self._net = NetModel.create(self.n_nodes, drop_prob=self.config.gossip.drop_prob)
+        self._key = jr.key(sim.seed)
+
+        self.metrics = Registry()
+        self.locks = LockRegistry(logger=logger)
+        self.tripwire = Tripwire()
+        self._input_lock = self.locks.lock("agent.pending_inputs")
+        self._snap_lock = self.locks.lock("agent.snapshot")
+
+        # pending per-node inputs for the next round (host-side staging)
+        n = self.n_nodes
+        self._pend_write = np.zeros(n, bool)
+        self._pend_cell = np.zeros(n, np.int32)
+        self._pend_val = np.zeros(n, np.int32)
+        self._pend_kill = np.zeros(n, bool)
+        self._pend_revive = np.zeros(n, bool)
+        self._pend_partition: Optional[np.ndarray] = None
+        self._write_waiters: list = []
+
+        self.round_no = 0
+        self._round_cv = threading.Condition()
+        self._snapshot_host = None  # (round_no, store planes, heads, alive)
+        self._thread = None
+        self._listeners = []  # subscription manager hooks
+
+    # --- lifecycle ------------------------------------------------------
+    def start(self, pace_seconds: float = 0.0):
+        assert self._thread is None, "already started"
+        self._thread = spawn_counted(
+            self._run_loop, pace_seconds, name="agent-round-loop"
+        )
+        return self
+
+    def shutdown(self):
+        self.tripwire.trip()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False
+
+    # --- the round loop -------------------------------------------------
+    def _run_loop(self, pace_seconds: float):
+        while not self.tripwire.tripped:
+            t0 = time.perf_counter()
+            self._one_round()
+            if pace_seconds > 0:
+                left = pace_seconds - (time.perf_counter() - t0)
+                if left > 0 and self.tripwire.wait(left):
+                    break
+
+    def _one_round(self):
+        with self._input_lock:
+            # np.array copies: jnp.asarray may alias the staging buffers
+            # (zero-copy on the CPU backend) which we zero right below
+            inp = self._quiet._replace(
+                write_mask=jnp.asarray(np.array(self._pend_write)),
+                write_cell=jnp.asarray(np.array(self._pend_cell)),
+                write_val=jnp.asarray(np.array(self._pend_val)),
+                kill=jnp.asarray(np.array(self._pend_kill)),
+                revive=jnp.asarray(np.array(self._pend_revive)),
+            )
+            net = self._net
+            if self._pend_partition is not None:
+                net = net._replace(partition=jnp.asarray(self._pend_partition))
+                self._net = net
+                self._pend_partition = None
+            waiters = self._write_waiters
+            self._write_waiters = []
+            self._pend_write[:] = False
+            self._pend_kill[:] = False
+            self._pend_revive[:] = False
+
+        with RoundTimer("round", warn_seconds=1.0, registry=self.metrics,
+                        logger=logger):
+            self._key, sub = jr.split(self._key)
+            self._state, info = self._step(self._state, net, sub, inp)
+            jax.block_until_ready(self._state)
+
+        record_round_info(
+            {k: v for k, v in info.items()}, registry=self.metrics
+        )
+        with self._round_cv:
+            self.round_no += 1
+            self._round_cv.notify_all()
+        with self._snap_lock:
+            self._snapshot_host = None  # invalidate lazily
+        for ev in waiters:
+            ev.set()
+        for hook in list(self._listeners):
+            try:
+                hook(self.round_no)
+            except Exception:  # noqa: BLE001 — a bad subscriber must not kill the loop
+                logger.exception("round listener failed")
+
+    def wait_rounds(self, k: int = 1, timeout: float = 30.0) -> bool:
+        """Block until ``k`` more rounds completed."""
+        with self._round_cv:
+            target = self.round_no + k
+            return self._round_cv.wait_for(
+                lambda: self.round_no >= target, timeout
+            )
+
+    def add_round_listener(self, hook):
+        self._listeners.append(hook)
+
+    # --- write path (transactions) --------------------------------------
+    def write(self, node: int, cell: int, value: int, wait: bool = True,
+              timeout: float = 30.0) -> dict:
+        """One-cell write transaction at ``node`` (must be an origin).
+
+        Returns ``{rows_affected, round}`` after the write entered a round
+        (the reference returns once committed locally; dissemination is
+        async, ``public/mod.rs:177-256``)."""
+        if not (0 <= node < self.n_origins):
+            raise ValueError(
+                f"node {node} is not a writer (origins are 0..{self.n_origins - 1})"
+            )
+        if not (0 <= cell < self.n_cells):
+            raise ValueError(f"cell {cell} out of range (n_cells={self.n_cells})")
+        ev = threading.Event()
+        with self._input_lock:
+            if self._pend_write[node]:
+                # one write per node per round: wait for the next round
+                pass
+            self._pend_write[node] = True
+            self._pend_cell[node] = cell
+            self._pend_val[node] = value
+            self._write_waiters.append(ev)
+        if wait and not ev.wait(timeout):
+            raise TimeoutError("write did not enter a round in time")
+        return {"rows_affected": 1, "round": self.round_no}
+
+    # --- fault injection (admin surface) --------------------------------
+    def kill_node(self, node: int):
+        with self._input_lock:
+            self._pend_kill[node] = True
+
+    def revive_node(self, node: int):
+        with self._input_lock:
+            self._pend_revive[node] = True
+
+    def set_partition(self, groups: np.ndarray):
+        """Assign partition group per node (same group = connected)."""
+        groups = np.asarray(groups, np.int32)
+        assert groups.shape == (self.n_nodes,)
+        with self._input_lock:
+            self._pend_partition = groups
+
+    def heal_partition(self):
+        self.set_partition(np.zeros(self.n_nodes, np.int32))
+
+    # --- read path ------------------------------------------------------
+    def snapshot(self) -> dict:
+        """Host copy of cluster state: store planes, heads, liveness.
+
+        Device->host transfer happens at most once per round (lazy)."""
+        with self._snap_lock:
+            if self._snapshot_host is not None:
+                return self._snapshot_host
+            st = self._state
+            store = tuple(np.asarray(p) for p in st.crdt.store)
+            snap = {
+                "round": self.round_no,
+                "store": store,  # (ver, val, site, dbv) planes [N, n_cells]
+                "head": np.asarray(st.crdt.book.head),
+                "known_max": np.asarray(st.crdt.book.known_max),
+                "alive": np.asarray(st.swim.alive),
+                "incarnation": np.asarray(
+                    getattr(st.swim, "inc", getattr(st.swim, "incarnation", None))
+                ),
+            }
+            self._snapshot_host = snap
+            return snap
+
+    def read_cell(self, node: int, cell: int) -> dict:
+        snap = self.snapshot()
+        return {
+            "value": int(snap["store"][1][node, cell]),
+            "col_version": int(snap["store"][0][node, cell]),
+            "site": int(snap["store"][2][node, cell]),
+            "db_version": int(snap["store"][3][node, cell]),
+        }
+
+    def node_rows(self, node: int) -> np.ndarray:
+        """One node's replica as [n_rows, n_cols] values."""
+        snap = self.snapshot()
+        return snap["store"][1][node].reshape(self.cfg.n_rows, self.cfg.n_cols)
+
+    # --- cluster introspection (admin sync state dump) -------------------
+    def sync_state(self, node: int) -> dict:
+        """``corrosion sync generate`` analog: heads + needs per origin."""
+        from corrosion_tpu.ops.versions import needs_count
+
+        snap = self.snapshot()
+        needs = np.maximum(
+            snap["known_max"][node] - snap["head"][node], 0
+        )
+        return {
+            "actor_id": node,
+            "heads": {str(o): int(h) for o, h in enumerate(snap["head"][node])},
+            "need": {
+                str(o): int(v) for o, v in enumerate(needs) if v > 0
+            },
+        }
+
+    def members(self) -> list:
+        snap = self.snapshot()
+        return [
+            {"id": i, "state": "Alive" if bool(a) else "Down",
+             "incarnation": int(inc)}
+            for i, (a, inc) in enumerate(
+                zip(snap["alive"], snap["incarnation"])
+            )
+        ]
+
+    def converged(self) -> bool:
+        """The check_bookkeeping predicate on the current snapshot."""
+        snap = self.snapshot()
+        alive = snap["alive"]
+        if not alive.any():
+            return True
+        ref = int(np.argmax(alive))
+        same = np.all(
+            [np.all(p[alive] == p[ref], axis=1) for p in snap["store"]]
+        )
+        heads_eq = np.all(snap["head"][alive] == snap["head"][ref])
+        no_needs = np.all(
+            (snap["known_max"][alive] - snap["head"][alive]) <= 0
+        )
+        return bool(same and heads_eq and no_needs)

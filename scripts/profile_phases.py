@@ -1,0 +1,106 @@
+"""Per-phase profiling of the scale round on the current backend.
+
+Times each protocol phase in isolation under lax.scan to find the slow
+one. Usage: python scripts/profile_phases.py [n_nodes rounds]
+"""
+
+import functools
+import os
+import sys
+import time
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import jax.numpy as jnp
+import jax.random as jr
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from corrosion_tpu.ops.lww import STATE_ALIVE
+from corrosion_tpu.ops.select import sample_k
+from corrosion_tpu.sim.broadcast import local_write
+from corrosion_tpu.sim.scale import scale_swim_step
+from corrosion_tpu.sim.scale_step import (
+    ScaleRoundInput,
+    ScaleSimState,
+    piggyback_bcast_step,
+    scale_sim_config,
+    scale_sim_step,
+)
+from corrosion_tpu.sim.sync import sync_step
+from corrosion_tpu.sim.transport import NetModel
+
+
+def timed(name, fn, *args):
+    fn = jax.jit(fn)
+    out = jax.block_until_ready(fn(*args))  # compile
+    t0 = time.perf_counter()
+    reps = 3
+    for _ in range(reps):
+        out = jax.block_until_ready(fn(*args))
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{name:30s} {dt*1000:10.2f} ms")
+    return out
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 16384
+    rounds = int(sys.argv[2]) if len(sys.argv) > 2 else 10
+    cfg = scale_sim_config(n, n_origins=min(16, n))
+    net = NetModel.create(n, drop_prob=0.01)
+    st = ScaleSimState.create(cfg)
+    key = jr.key(0)
+    inp = ScaleRoundInput.quiet(cfg)
+    print(f"n={n} m={cfg.m_slots} rounds={rounds} platform={jax.devices()[0].platform}")
+
+    def scan_over(step):
+        def run(st, key):
+            def body(carry, _):
+                st, key = carry
+                key, sub = jr.split(key)
+                st = step(st, sub)
+                return (st, key), ()
+            (st, _), _ = jax.lax.scan(body, (st, key), None, length=rounds)
+            return st
+
+        return run
+
+    # full round
+    timed("full round", scan_over(lambda s, k: scale_sim_step(cfg, s, net, k, inp)[0]), st, key)
+
+    # swim only
+    def swim_only(s, k):
+        swim, _, _ = scale_swim_step(cfg, s.swim, net, k)
+        return s._replace(swim=swim)
+    timed("swim only", scan_over(swim_only), st, key)
+
+    # bcast only (fixed channels)
+    iarr = jnp.arange(n, dtype=jnp.int32)
+    channels = [((iarr + 1) % n, jnp.ones(n, bool))]
+    def bcast_only(s, k):
+        cst = local_write(cfg, s.crdt, inp.write_mask, inp.write_cell, inp.write_val)
+        cst, _ = piggyback_bcast_step(cfg, cst, channels, k)
+        return s._replace(crdt=cst)
+    timed("bcast only", scan_over(bcast_only), st, key)
+
+    # sync only (fixed peers)
+    peers = jnp.stack([(iarr + 1) % n, (iarr + 2) % n], axis=1)
+    p_ok = jnp.ones((n, 2), bool)
+    def sync_only(s, k):
+        cst, _ = sync_step(cfg, s.crdt, peers, p_ok, s.swim.alive, net, k)
+        return s._replace(crdt=cst)
+    timed("sync only", scan_over(sync_only), st, key)
+
+    # swim sub-phases: probe+merge without record/apply
+    def swim_sample(s, k):
+        bel = (s.swim.mem_id >= 0) & ((s.swim.mem_view & 3) == STATE_ALIVE)
+        cols, ok = sample_k(bel, 3, k)
+        return s._replace(swim=s.swim._replace(inc=s.swim.inc + cols[:, 0] * 0 + ok[:, 0]))
+    timed("sample_k only", scan_over(swim_sample), st, key)
+
+
+if __name__ == "__main__":
+    main()

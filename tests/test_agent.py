@@ -1,0 +1,76 @@
+"""Host agent: write at A, poll B for convergence — the in-process analog
+of the reference's ``insert_rows_and_gossip`` integration tests
+(``crates/corro-agent/src/agent/tests.rs:52``)."""
+
+import numpy as np
+import pytest
+
+from corrosion_tpu.agent import Agent
+from corrosion_tpu.config import Config
+
+
+def small_config(**sim_over):
+    cfg = Config()
+    cfg.sim.mode = sim_over.pop("mode", "scale")
+    cfg.sim.n_nodes = 32
+    cfg.sim.m_slots = 16
+    cfg.sim.n_origins = 4
+    cfg.sim.n_rows = 4
+    cfg.sim.n_cols = 2
+    cfg.perf.sync_interval = 4
+    cfg.gossip.drop_prob = 0.01
+    for k, v in sim_over.items():
+        setattr(cfg.sim, k, v)
+    return cfg
+
+
+@pytest.fixture(scope="module")
+def agent():
+    with Agent(small_config()) as a:
+        # warm membership before the tests write
+        assert a.wait_rounds(30, timeout=120)
+        yield a
+
+
+def test_write_and_gossip(agent):
+    agent.write(node=0, cell=3, value=777)
+    deadline = 400
+    reader = agent.n_nodes - 1
+    while deadline:
+        if agent.read_cell(reader, 3)["value"] == 777:
+            break
+        agent.wait_rounds(5, timeout=60)
+        deadline -= 5
+    assert agent.read_cell(reader, 3)["value"] == 777
+    assert agent.read_cell(reader, 3)["site"] == 0
+
+
+def test_members_and_sync_state(agent):
+    ms = agent.members()
+    assert len(ms) == agent.n_nodes
+    assert all(m["state"] == "Alive" for m in ms)
+    ss = agent.sync_state(1)
+    assert "heads" in ss and ss["actor_id"] == 1
+
+
+def test_kill_revive_and_convergence(agent):
+    victim = agent.n_nodes - 2
+    agent.kill_node(victim)
+    assert agent.wait_rounds(2, timeout=60)
+    assert not bool(agent.snapshot()["alive"][victim])
+    agent.revive_node(victim)
+    assert agent.wait_rounds(2, timeout=60)
+    assert bool(agent.snapshot()["alive"][victim])
+    # drain until converged (bounded)
+    for _ in range(100):
+        if agent.converged():
+            break
+        agent.wait_rounds(5, timeout=60)
+    assert agent.converged()
+
+
+def test_writer_validation(agent):
+    with pytest.raises(ValueError):
+        agent.write(node=agent.n_nodes - 1, cell=0, value=1)
+    with pytest.raises(ValueError):
+        agent.write(node=0, cell=10_000, value=1)
