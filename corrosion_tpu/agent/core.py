@@ -87,15 +87,15 @@ class Agent:
         self._input_lock = self.locks.lock("agent.pending_inputs")
         self._snap_lock = self.locks.lock("agent.snapshot")
 
-        # pending per-node inputs for the next round (host-side staging)
+        # pending per-node inputs for the next round (host-side staging).
+        # Writes queue in per-node FIFOs — one cell enters the round per
+        # node per tick, the array analog of the reference's broadcast
+        # batching queue (``broadcast/mod.rs:395-408``).
         n = self.n_nodes
-        self._pend_write = np.zeros(n, bool)
-        self._pend_cell = np.zeros(n, np.int32)
-        self._pend_val = np.zeros(n, np.int32)
+        self._write_queues: dict = {}  # node -> list of (cell, val, event|None)
         self._pend_kill = np.zeros(n, bool)
         self._pend_revive = np.zeros(n, bool)
         self._pend_partition: Optional[np.ndarray] = None
-        self._write_waiters: list = []
 
         self.round_no = 0
         self._round_cv = threading.Condition()
@@ -125,22 +125,53 @@ class Agent:
 
     # --- the round loop -------------------------------------------------
     def _run_loop(self, pace_seconds: float):
-        while not self.tripwire.tripped:
-            t0 = time.perf_counter()
-            self._one_round()
-            if pace_seconds > 0:
-                left = pace_seconds - (time.perf_counter() - t0)
-                if left > 0 and self.tripwire.wait(left):
-                    break
+        try:
+            while not self.tripwire.tripped:
+                t0 = time.perf_counter()
+                self._one_round()
+                if pace_seconds > 0:
+                    left = pace_seconds - (time.perf_counter() - t0)
+                    if left > 0 and self.tripwire.wait(left):
+                        break
+        except Exception:  # noqa: BLE001 — a dead loop must not look alive
+            logger.exception("round loop crashed; tripping shutdown")
+        finally:
+            self.tripwire.trip()
+            # wake everything parked on us: queued writers + round waiters
+            with self._input_lock:
+                for q in self._write_queues.values():
+                    for _, _, ev in q:
+                        if ev is not None:
+                            ev.set()
+                self._write_queues.clear()
+            with self._round_cv:
+                self._round_cv.notify_all()
 
     def _one_round(self):
         with self._input_lock:
+            n = self.n_nodes
+            write_mask = np.zeros(n, bool)
+            write_cell = np.zeros(n, np.int32)
+            write_val = np.zeros(n, np.int32)
+            waiters = []
+            drained = []
+            for node, q in self._write_queues.items():
+                cell, val, ev = q.pop(0)
+                write_mask[node] = True
+                write_cell[node] = cell
+                write_val[node] = val
+                if ev is not None:
+                    waiters.append(ev)
+                if not q:
+                    drained.append(node)
+            for node in drained:
+                del self._write_queues[node]
             # np.array copies: jnp.asarray may alias the staging buffers
             # (zero-copy on the CPU backend) which we zero right below
             inp = self._quiet._replace(
-                write_mask=jnp.asarray(np.array(self._pend_write)),
-                write_cell=jnp.asarray(np.array(self._pend_cell)),
-                write_val=jnp.asarray(np.array(self._pend_val)),
+                write_mask=jnp.asarray(write_mask),
+                write_cell=jnp.asarray(write_cell),
+                write_val=jnp.asarray(write_val),
                 kill=jnp.asarray(np.array(self._pend_kill)),
                 revive=jnp.asarray(np.array(self._pend_revive)),
             )
@@ -149,9 +180,6 @@ class Agent:
                 net = net._replace(partition=jnp.asarray(self._pend_partition))
                 self._net = net
                 self._pend_partition = None
-            waiters = self._write_waiters
-            self._write_waiters = []
-            self._pend_write[:] = False
             self._pend_kill[:] = False
             self._pend_revive[:] = False
 
@@ -164,11 +192,13 @@ class Agent:
         record_round_info(
             {k: v for k, v in info.items()}, registry=self.metrics
         )
+        # invalidate the cached snapshot BEFORE waking round waiters, so a
+        # woken wait_rounds() caller never reads pre-round state
+        with self._snap_lock:
+            self._snapshot_host = None
         with self._round_cv:
             self.round_no += 1
             self._round_cv.notify_all()
-        with self._snap_lock:
-            self._snapshot_host = None  # invalidate lazily
         for ev in waiters:
             ev.set()
         for hook in list(self._listeners):
@@ -178,12 +208,14 @@ class Agent:
                 logger.exception("round listener failed")
 
     def wait_rounds(self, k: int = 1, timeout: float = 30.0) -> bool:
-        """Block until ``k`` more rounds completed."""
+        """Block until ``k`` more rounds completed (False on timeout or
+        shutdown)."""
         with self._round_cv:
             target = self.round_no + k
             return self._round_cv.wait_for(
-                lambda: self.round_no >= target, timeout
-            )
+                lambda: self.round_no >= target or self.tripwire.tripped,
+                timeout,
+            ) and self.round_no >= target
 
     def add_round_listener(self, hook):
         self._listeners.append(hook)
@@ -196,24 +228,38 @@ class Agent:
         Returns ``{rows_affected, round}`` after the write entered a round
         (the reference returns once committed locally; dissemination is
         async, ``public/mod.rs:177-256``)."""
+        return self.write_many(node, [(cell, value)], wait=wait, timeout=timeout)
+
+    def write_many(self, node: int, cells, wait: bool = True,
+                   timeout: float = 30.0) -> dict:
+        """Multi-cell transaction at ``node``: a list of ``(cell, value)``.
+
+        Cells enter rounds in order, one per round (FIFO staging — the
+        broadcast-batching analog). With ``wait`` the call returns once
+        the *last* cell entered a round, i.e. the whole transaction is
+        committed locally and queued for dissemination."""
         if not (0 <= node < self.n_origins):
             raise ValueError(
                 f"node {node} is not a writer (origins are 0..{self.n_origins - 1})"
             )
-        if not (0 <= cell < self.n_cells):
-            raise ValueError(f"cell {cell} out of range (n_cells={self.n_cells})")
+        cells = list(cells)
+        if not cells:
+            return {"rows_affected": 0, "round": self.round_no}
+        for cell, _ in cells:
+            if not (0 <= cell < self.n_cells):
+                raise ValueError(f"cell {cell} out of range (n_cells={self.n_cells})")
+        if self.tripwire.tripped:
+            raise RuntimeError("agent is shut down")
         ev = threading.Event()
         with self._input_lock:
-            if self._pend_write[node]:
-                # one write per node per round: wait for the next round
-                pass
-            self._pend_write[node] = True
-            self._pend_cell[node] = cell
-            self._pend_val[node] = value
-            self._write_waiters.append(ev)
+            q = self._write_queues.setdefault(node, [])
+            for cell, value in cells[:-1]:
+                q.append((int(cell), int(value), None))
+            last_cell, last_val = cells[-1]
+            q.append((int(last_cell), int(last_val), ev))
         if wait and not ev.wait(timeout):
             raise TimeoutError("write did not enter a round in time")
-        return {"rows_affected": 1, "round": self.round_no}
+        return {"rows_affected": len(cells), "round": self.round_no}
 
     # --- fault injection (admin surface) --------------------------------
     def kill_node(self, node: int):
@@ -243,18 +289,23 @@ class Agent:
             if self._snapshot_host is not None:
                 return self._snapshot_host
             st = self._state
-            store = tuple(np.asarray(p) for p in st.crdt.store)
-            snap = {
-                "round": self.round_no,
-                "store": store,  # (ver, val, site, dbv) planes [N, n_cells]
-                "head": np.asarray(st.crdt.book.head),
-                "known_max": np.asarray(st.crdt.book.known_max),
-                "alive": np.asarray(st.swim.alive),
-                "incarnation": np.asarray(
-                    getattr(st.swim, "inc", getattr(st.swim, "incarnation", None))
-                ),
-            }
-            self._snapshot_host = snap
+            round_no = self.round_no
+        # device->host transfer happens OUTSIDE the lock so the round
+        # thread's invalidation never stalls behind a large copy
+        store = tuple(np.asarray(p) for p in st.crdt.store)
+        snap = {
+            "round": round_no,
+            "store": store,  # (ver, val, site, dbv) planes [N, n_cells]
+            "head": np.asarray(st.crdt.book.head),
+            "known_max": np.asarray(st.crdt.book.known_max),
+            "alive": np.asarray(st.swim.alive),
+            "incarnation": np.asarray(
+                getattr(st.swim, "inc", getattr(st.swim, "incarnation", None))
+            ),
+        }
+        with self._snap_lock:
+            if self._snapshot_host is None and self.round_no == round_no:
+                self._snapshot_host = snap
             return snap
 
     def read_cell(self, node: int, cell: int) -> dict:
@@ -273,9 +324,12 @@ class Agent:
 
     # --- cluster introspection (admin sync state dump) -------------------
     def sync_state(self, node: int) -> dict:
-        """``corrosion sync generate`` analog: heads + needs per origin."""
-        from corrosion_tpu.ops.versions import needs_count
+        """``corrosion sync generate`` analog: heads + needs per origin.
 
+        Need = known_max - head, an upper bound: versions already sitting
+        in the node's out-of-order buffer still count as needed until
+        applied (the precise count is ``ops.versions.needs_count``, which
+        requires the buffer planes; the snapshot deliberately omits them)."""
         snap = self.snapshot()
         needs = np.maximum(
             snap["known_max"][node] - snap["head"][node], 0

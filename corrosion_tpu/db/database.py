@@ -1,0 +1,481 @@
+"""Database: SQL statement execution over the TPU-resident LWW store.
+
+The write path mirrors ``execute_statement`` /
+``make_broadcastable_changes`` (``crates/corro-agent/src/api/public/
+mod.rs:53-174``): statements in one transaction are translated into cell
+writes on the writer node's replica and staged into the round loop
+together, after which dissemination is asynchronous. The read path
+mirrors ``/v1/queries``: reads observe one node's local replica only.
+
+Supported dialect (the write/read surface the reference's API exercises):
+``INSERT [OR IGNORE] INTO t (cols) VALUES (...)`` (upsert semantics, as
+cr-sqlite rewrites inserts), ``UPDATE t SET c=? WHERE pk=?``,
+``DELETE FROM t WHERE pk=?`` (causal-length tombstone), and
+``SELECT cols FROM t [WHERE simple-conjunction] [LIMIT n]`` with the
+``corro_json_contains`` function from ``sqlite-functions``.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from corrosion_tpu.db.schema import (
+    CL_COL,
+    RowMap,
+    Schema,
+    SchemaError,
+    diff_schemas,
+    parse_schema_sql,
+)
+from corrosion_tpu.db.values import NULL_ID, ValueHeap, corro_json_contains
+
+
+class SqlError(ValueError):
+    pass
+
+
+_INSERT_RE = re.compile(
+    r"INSERT\s+(?:OR\s+(?P<or>IGNORE|REPLACE)\s+)?INTO\s+(?P<table>[\w\"]+)\s*"
+    r"\((?P<cols>[^)]*)\)\s*VALUES\s*\((?P<vals>.*)\)\s*"
+    r"(?P<conflict>ON\s+CONFLICT.*)?$",
+    re.IGNORECASE | re.DOTALL,
+)
+_UPDATE_RE = re.compile(
+    r"UPDATE\s+(?P<table>[\w\"]+)\s+SET\s+(?P<sets>.*?)\s+WHERE\s+(?P<where>.*)$",
+    re.IGNORECASE | re.DOTALL,
+)
+_DELETE_RE = re.compile(
+    r"DELETE\s+FROM\s+(?P<table>[\w\"]+)\s+WHERE\s+(?P<where>.*)$",
+    re.IGNORECASE | re.DOTALL,
+)
+_SELECT_RE = re.compile(
+    r"SELECT\s+(?P<cols>.*?)\s+FROM\s+(?P<table>[\w\"]+)"
+    r"(?:\s+WHERE\s+(?P<where>.*?))?(?:\s+LIMIT\s+(?P<limit>\d+))?\s*$",
+    re.IGNORECASE | re.DOTALL,
+)
+_COND_RE = re.compile(
+    r"^(?P<col>[\w\"]+)\s*(?P<op>=|!=|<>|<=|>=|<|>)\s*(?P<val>.+)$", re.DOTALL
+)
+_FUNC_RE = re.compile(
+    r"^corro_json_contains\s*\(\s*(?P<a>[^,]+)\s*,\s*(?P<b>.+)\s*\)$",
+    re.IGNORECASE | re.DOTALL,
+)
+
+
+def _unquote(ident: str) -> str:
+    return ident.strip().strip('"').strip("`")
+
+
+class _Params:
+    """Positional ``?`` and named ``:name``/``$name`` parameter source."""
+
+    def __init__(self, params: Any):
+        self.named: Dict[str, Any] = {}
+        self.positional: List[Any] = []
+        if isinstance(params, dict):
+            self.named = params
+        elif params is not None:
+            self.positional = list(params)
+        self._pos = 0
+
+    def next_positional(self) -> Any:
+        if self._pos >= len(self.positional):
+            raise SqlError("not enough positional parameters")
+        v = self.positional[self._pos]
+        self._pos += 1
+        return v
+
+    def get_named(self, name: str) -> Any:
+        if name not in self.named:
+            raise SqlError(f"missing named parameter :{name}")
+        return self.named[name]
+
+
+def _parse_literal(tok: str, params: _Params) -> Any:
+    tok = tok.strip()
+    if tok == "?":
+        return params.next_positional()
+    if tok.startswith((":", "$", "@")):
+        return params.get_named(tok[1:])
+    up = tok.upper()
+    if up == "NULL":
+        return None
+    if up == "TRUE":
+        return 1
+    if up == "FALSE":
+        return 0
+    if tok.startswith("'") and tok.endswith("'") and len(tok) >= 2:
+        return tok[1:-1].replace("''", "'")
+    if (tok.startswith("x'") or tok.startswith("X'")) and tok.endswith("'"):
+        return bytes.fromhex(tok[2:-1])
+    try:
+        return int(tok)
+    except ValueError:
+        try:
+            return float(tok)
+        except ValueError:
+            raise SqlError(f"unsupported literal: {tok!r}")
+
+
+def _split_top_commas(s: str) -> List[str]:
+    parts, depth, start = [], 0, 0
+    in_str = False
+    for i, ch in enumerate(s):
+        if in_str:
+            if ch == "'":
+                in_str = False
+        elif ch == "'":
+            in_str = True
+        elif ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            parts.append(s[start:i])
+            start = i + 1
+    parts.append(s[start:])
+    return [p.strip() for p in parts if p.strip()]
+
+
+class ExecResult(dict):
+    """``{rows_affected, time}`` — corro-api-types ``ExecResult``."""
+
+
+class Database:
+    """Schema + heap + row map bound to one :class:`Agent` cluster."""
+
+    def __init__(self, agent):
+        self.agent = agent
+        self.schema = Schema()
+        self.heap = ValueHeap()
+        self.rows = RowMap(agent.cfg.n_rows)
+        self.n_cols = agent.cfg.n_cols
+        self._mu = threading.Lock()
+        self._write_hooks: List = []  # pubsub/updates change hooks
+
+    # --- schema ----------------------------------------------------------
+    def apply_schema_sql(self, sql: str) -> List[Tuple[str, str]]:
+        """Parse + diff + apply (``/v1/migrations`` and startup schema
+        files, ``public/mod.rs:540-593``)."""
+        new = parse_schema_sql(sql)
+        with self._mu:
+            merged = Schema(dict(self.schema.tables))
+            for name, t in new.tables.items():
+                merged.tables[name] = t
+            changes = diff_schemas(self.schema, merged)
+            for t in merged.tables.values():
+                if len(t.value_columns) > self.n_cols - 1:
+                    raise SchemaError(
+                        f"table {t.name} has {len(t.value_columns)} value "
+                        f"columns; grid supports {self.n_cols - 1} "
+                        f"(raise [sim].n_cols)"
+                    )
+            self.schema = merged
+        return changes
+
+    def add_write_hook(self, hook) -> None:
+        """hook(node, table, pk, {col: value}, deleted: bool) after a
+        local write enters the round loop — the ``match_changes`` seam
+        (``util.rs:1034-1037``)."""
+        self._write_hooks.append(hook)
+
+    # --- cell helpers ----------------------------------------------------
+    def _cell(self, row: int, col: int) -> int:
+        return row * self.n_cols + col
+
+    def _read_plane(self, node: int, row: int, col: int) -> int:
+        snap = self.agent.snapshot()
+        return int(snap["store"][1][node, self._cell(row, col)])
+
+    def _row_live(self, node: int, row: int) -> bool:
+        return self._read_plane(node, row, CL_COL) % 2 == 1
+
+    # --- writes ----------------------------------------------------------
+    def execute(self, node: int, statements: Sequence,
+                wait: bool = True, timeout: float = 30.0) -> List[ExecResult]:
+        """Run a transaction of statements at ``node``
+        (``/v1/transactions``). Each statement is ``sql`` or
+        ``(sql, params)``; returns one ``ExecResult`` per statement."""
+        t0 = time.perf_counter()
+        results: List[ExecResult] = []
+        cells: List[Tuple[int, int]] = []
+        notifications = []
+        for stmt in statements:
+            sql, params = (stmt, None) if isinstance(stmt, str) else (
+                stmt[0], stmt[1] if len(stmt) > 1 else None
+            )
+            affected, stmt_cells, notes = self._plan_write(node, sql, params)
+            cells.extend(stmt_cells)
+            notifications.extend(notes)
+            results.append(
+                ExecResult(rows_affected=affected,
+                           time=time.perf_counter() - t0)
+            )
+        if cells:
+            self.agent.write_many(node, cells, wait=wait, timeout=timeout)
+        for note in notifications:
+            for hook in self._write_hooks:
+                hook(node, *note)
+        return results
+
+    def _plan_write(self, node: int, sql: str, params: Any):
+        """-> (rows_affected, [(cell, interned_val)], [notifications])."""
+        sql = sql.strip().rstrip(";").strip()
+        p = _Params(params)
+        m = _INSERT_RE.match(sql)
+        if m:
+            return self._plan_insert(node, m, p)
+        m = _UPDATE_RE.match(sql)
+        if m:
+            return self._plan_update(node, m, p)
+        m = _DELETE_RE.match(sql)
+        if m:
+            return self._plan_delete(node, m, p)
+        if _SELECT_RE.match(sql):
+            raise SqlError("SELECT not allowed in /v1/transactions (read-only "
+                           "statements go to /v1/queries)")
+        raise SqlError(f"unsupported statement: {sql[:80]!r}")
+
+    def _plan_insert(self, node: int, m, p: _Params):
+        table = self.schema.table(_unquote(m.group("table")))
+        col_names = [_unquote(c) for c in m.group("cols").split(",")]
+        vals = [_parse_literal(v, p) for v in _split_top_commas(m.group("vals"))]
+        if len(col_names) != len(vals):
+            raise SqlError(f"{len(col_names)} columns but {len(vals)} values")
+        by_col = dict(zip(col_names, vals))
+        pk_name = table.pk.name
+        if pk_name not in by_col:
+            raise SqlError(f"INSERT into {table.name} must set pk {pk_name}")
+        pk = by_col.pop(pk_name)
+        if pk is None:
+            raise SqlError(f"pk {table.name}.{pk_name} cannot be NULL")
+        for c in table.value_columns:
+            if c.name not in by_col:
+                by_col[c.name] = c.default
+            elif by_col[c.name] is None and c.not_null:
+                raise SqlError(f"NOT NULL violation: {table.name}.{c.name}")
+        for name in by_col:
+            table.column(name)  # raises on unknown column
+
+        row = self.rows.get_or_alloc(table.name, pk)
+        cl = self._read_plane(node, row, CL_COL)
+        live = cl % 2 == 1
+        or_clause = (m.group("or") or "").upper()
+        conflict = (m.group("conflict") or "").upper().strip()
+        if live and (or_clause == "IGNORE" or "DO NOTHING" in conflict):
+            return 0, [], []
+        cells: List[Tuple[int, int]] = []
+        if not live:
+            cells.append((self._cell(row, CL_COL), cl + 1))
+        for name, value in by_col.items():
+            cells.append(
+                (self._cell(row, table.col_index(name)), self.heap.intern(value))
+            )
+        return 1, cells, [(table.name, pk, dict(by_col), False)]
+
+    def _split_where_pk(self, table, where: str, p: _Params):
+        cond = _COND_RE.match(where.strip())
+        if not cond or cond.group("op") != "=":
+            raise SqlError(
+                f"writes require `WHERE {table.pk.name} = <value>` "
+                f"(got {where!r})"
+            )
+        col = _unquote(cond.group("col"))
+        if col != table.pk.name:
+            raise SqlError(f"writes must filter on the pk ({table.pk.name})")
+        return _parse_literal(cond.group("val"), p)
+
+    def _plan_update(self, node: int, m, p: _Params):
+        table = self.schema.table(_unquote(m.group("table")))
+        sets: Dict[str, Any] = {}
+        set_parts = _split_top_commas(m.group("sets"))
+        for part in set_parts:
+            if "=" not in part:
+                raise SqlError(f"bad SET clause: {part!r}")
+            name, _, raw = part.partition("=")
+            name = _unquote(name)
+            col = table.column(name)
+            if col.primary_key:
+                raise SqlError("cannot UPDATE the primary key")
+            sets[name] = _parse_literal(raw, p)
+        pk = self._split_where_pk(table, m.group("where"), p)
+        row = self.rows.get(table.name, pk)
+        if row is None or not self._row_live(node, row):
+            return 0, [], []
+        for name, value in sets.items():
+            if value is None and table.column(name).not_null:
+                raise SqlError(f"NOT NULL violation: {table.name}.{name}")
+        cells = [
+            (self._cell(row, table.col_index(name)), self.heap.intern(value))
+            for name, value in sets.items()
+        ]
+        return 1, cells, [(table.name, pk, dict(sets), False)]
+
+    def _plan_delete(self, node: int, m, p: _Params):
+        table = self.schema.table(_unquote(m.group("table")))
+        pk = self._split_where_pk(table, m.group("where"), p)
+        row = self.rows.get(table.name, pk)
+        if row is None:
+            return 0, [], []
+        cl = self._read_plane(node, row, CL_COL)
+        if cl % 2 == 0:
+            return 0, [], []
+        cells = [(self._cell(row, CL_COL), cl + 1)]
+        return 1, cells, [(table.name, pk, {}, True)]
+
+    # --- reads -----------------------------------------------------------
+    def query(self, node: int, sql: str, params: Any = None
+              ) -> Tuple[List[str], Iterable[List[Any]]]:
+        """Read-only query against ``node``'s replica (``/v1/queries``).
+        Returns ``(column_names, row_iterator)``."""
+        sql = sql.strip().rstrip(";").strip()
+        m = _SELECT_RE.match(sql)
+        if m is None:
+            raise SqlError(f"only SELECT is allowed on the query path: "
+                           f"{sql[:80]!r}")
+        p = _Params(params)
+        table = self.schema.table(_unquote(m.group("table")))
+        raw_cols = m.group("cols").strip()
+        if raw_cols == "*":
+            names = [c.name for c in table.columns]
+        else:
+            names = [_unquote(c) for c in raw_cols.split(",")]
+            for n in names:
+                table.column(n)
+        conds = self._parse_where(table, m.group("where"), p)
+        limit = int(m.group("limit")) if m.group("limit") else None
+        return names, self._scan(node, table, names, conds, limit)
+
+    def _parse_where(self, table, where: Optional[str], p: _Params):
+        if not where:
+            return []
+        conds = []
+        for clause in re.split(r"\s+AND\s+", where.strip(), flags=re.IGNORECASE):
+            clause = clause.strip()
+            fm = _FUNC_RE.match(clause)
+            if fm:
+                col = _unquote(fm.group("a"))
+                table.column(col)
+                needle = _parse_literal(fm.group("b"), p)
+                conds.append(("json_contains", col, needle))
+                continue
+            cm = _COND_RE.match(clause)
+            if cm is None:
+                raise SqlError(f"unsupported WHERE clause: {clause!r}")
+            col = _unquote(cm.group("col"))
+            table.column(col)
+            conds.append(
+                (cm.group("op"), col, _parse_literal(cm.group("val"), p))
+            )
+        return conds
+
+    def _scan(self, node: int, table, names, conds, limit):
+        snap = self.agent.snapshot()
+        vals = snap["store"][1][node]
+        emitted = 0
+        for pk, row in self.rows.rows_of(table.name):
+            if int(vals[self._cell(row, CL_COL)]) % 2 == 0:
+                continue
+            rec = self._materialize(table, pk, vals, row)
+            if all(self._eval(c, rec) for c in conds):
+                yield [rec[n] for n in names]
+                emitted += 1
+                if limit is not None and emitted >= limit:
+                    return
+
+    def _materialize(self, table, pk, vals, row) -> Dict[str, Any]:
+        rec = {table.pk.name: pk}
+        for c in table.value_columns:
+            vid = int(vals[self._cell(row, table.col_index(c.name))])
+            rec[c.name] = self.heap.lookup(vid)
+        return rec
+
+    def read_row(self, node: int, table_name: str, pk: Any
+                 ) -> Optional[Dict[str, Any]]:
+        """One row of ``node``'s replica, or None if absent/deleted."""
+        table = self.schema.table(table_name)
+        row = self.rows.get(table_name, pk)
+        if row is None:
+            return None
+        snap = self.agent.snapshot()
+        vals = snap["store"][1][node]
+        if int(vals[self._cell(row, CL_COL)]) % 2 == 0:
+            return None
+        return self._materialize(table, pk, vals, row)
+
+    @staticmethod
+    def _eval(cond, rec) -> bool:
+        op, col, ref = cond
+        v = rec.get(col)
+        if op == "json_contains":
+            try:
+                return corro_json_contains(v, ref)
+            except (TypeError, ValueError):
+                return False
+        if v is None or ref is None:
+            return False
+        try:
+            if op == "=":
+                return v == ref
+            if op in ("!=", "<>"):
+                return v != ref
+            if op == "<":
+                return v < ref
+            if op == "<=":
+                return v <= ref
+            if op == ">":
+                return v > ref
+            if op == ">=":
+                return v >= ref
+        except TypeError:
+            return False
+        raise SqlError(f"unsupported operator {op!r}")
+
+    # --- stats & checkpoint ----------------------------------------------
+    def table_stats(self, node: int = 0) -> Dict[str, Dict[str, int]]:
+        """``/v1/table_stats`` analog: row counts per table on ``node``."""
+        snap = self.agent.snapshot()
+        vals = snap["store"][1][node]
+        out: Dict[str, Dict[str, int]] = {}
+        for name in self.schema.tables:
+            rows = self.rows.rows_of(name)
+            live = sum(
+                1 for _, r in rows
+                if int(vals[self._cell(r, CL_COL)]) % 2 == 1
+            )
+            out[name] = {"allocated": len(rows), "live": live}
+        return out
+
+    def schema_sql(self) -> str:
+        parts = []
+        for t in self.schema.tables.values():
+            cols = []
+            for c in t.columns:
+                bits = [c.name, c.sql_type]
+                if c.primary_key:
+                    bits.append("PRIMARY KEY")
+                elif c.not_null:
+                    bits.append("NOT NULL")
+                if c.default is not None:
+                    d = (f"'{c.default}'" if isinstance(c.default, str)
+                         else str(c.default))
+                    bits.append(f"DEFAULT {d}")
+                cols.append(" ".join(bits))
+            parts.append(f"CREATE TABLE {t.name} ({', '.join(cols)});")
+        return "\n".join(parts)
+
+    def state_dict(self) -> dict:
+        return {
+            "schema_sql": self.schema_sql(),
+            "heap": self.heap.state_dict(),
+            "rows": self.rows.state_dict(),
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        with self._mu:
+            self.schema = parse_schema_sql(state["schema_sql"])
+            self.heap = ValueHeap.from_state_dict(state["heap"])
+            self.rows = RowMap.from_state_dict(state["rows"])

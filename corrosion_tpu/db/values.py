@@ -1,0 +1,124 @@
+"""Interned value heap: SQL values <-> compact int32 ids.
+
+The reference's CRDT cells hold arbitrary SQL values (``SqliteValue``,
+``crates/corro-api-types/src/lib.rs:422-433``). The TPU store holds int32
+planes — so the host keeps an append-only interning heap mapping every
+distinct value (NULL, integer, real, text, blob) to a stable id, and the
+device gossips ids. The heap is process-global state shared by all
+simulated nodes (one process hosts the whole cluster), so id assignment
+is trivially consistent across replicas.
+
+Deviation from the reference, by design: the LWW tie-break on equal
+``col_version`` orders by *intern id* (assignment order) rather than by
+serialized value bytes (``doc/crdts.md:14-16``). Both are deterministic
+total orders; parity checks against the CPU oracle use the same heap, so
+convergence results are unaffected.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Optional
+
+NULL_ID = 0
+
+# type tags for serialization
+_T_NULL, _T_INT, _T_REAL, _T_TEXT, _T_BLOB = "n", "i", "r", "t", "b"
+
+
+def _key(value: Any):
+    """Hashable identity key: 1 and 1.0 intern separately (SQL types)."""
+    if value is None:
+        return (_T_NULL,)
+    if isinstance(value, bool):  # bools are ints in SQLite
+        return (_T_INT, int(value))
+    if isinstance(value, int):
+        return (_T_INT, value)
+    if isinstance(value, float):
+        return (_T_REAL, value)
+    if isinstance(value, str):
+        return (_T_TEXT, value)
+    if isinstance(value, (bytes, bytearray)):
+        return (_T_BLOB, bytes(value))
+    raise TypeError(f"unsupported SQL value type: {type(value).__name__}")
+
+
+class ValueHeap:
+    """Thread-safe append-only value interning table. Id 0 is NULL."""
+
+    def __init__(self):
+        self._values: list = [None]
+        self._ids: dict = {(_T_NULL,): NULL_ID}
+        self._mu = threading.Lock()
+
+    def intern(self, value: Any) -> int:
+        k = _key(value)
+        with self._mu:
+            vid = self._ids.get(k)
+            if vid is None:
+                vid = len(self._values)
+                if vid >= (1 << 31):
+                    raise OverflowError("value heap exceeded int32 id space")
+                self._values.append(
+                    bytes(value) if isinstance(value, bytearray) else value
+                )
+                self._ids[k] = vid
+            return vid
+
+    def lookup(self, vid: int) -> Any:
+        if vid == NULL_ID:
+            return None
+        return self._values[vid]
+
+    def __len__(self) -> int:
+        return len(self._values)
+
+    # --- checkpoint support ----------------------------------------------
+    def state_dict(self) -> dict:
+        out = []
+        for v in self._values[1:]:
+            if isinstance(v, bytes):
+                out.append([_T_BLOB, v.hex()])
+            elif isinstance(v, str):
+                out.append([_T_TEXT, v])
+            elif isinstance(v, float):
+                out.append([_T_REAL, v])
+            else:
+                out.append([_T_INT, v])
+        return {"values": out}
+
+    @classmethod
+    def from_state_dict(cls, state: dict) -> "ValueHeap":
+        heap = cls()
+        for tag, raw in state["values"]:
+            if tag == _T_BLOB:
+                heap.intern(bytes.fromhex(raw))
+            elif tag == _T_REAL:
+                heap.intern(float(raw))
+            elif tag == _T_INT:
+                heap.intern(int(raw))
+            else:
+                heap.intern(raw)
+        return heap
+
+
+def corro_json_contains(outer: Any, inner: Any) -> bool:
+    """The custom SQL function from ``sqlite-functions``
+    (``crates/sqlite-functions/src/lib.rs:5-30``): true when ``inner``'s
+    JSON object/array is recursively contained in ``outer``'s."""
+    a = json.loads(outer) if isinstance(outer, (str, bytes)) else outer
+    b = json.loads(inner) if isinstance(inner, (str, bytes)) else inner
+    return _contains(a, b)
+
+
+def _contains(outer: Any, inner: Any) -> bool:
+    if isinstance(inner, dict):
+        return isinstance(outer, dict) and all(
+            k in outer and _contains(outer[k], v) for k, v in inner.items()
+        )
+    if isinstance(inner, list):
+        return isinstance(outer, list) and all(
+            any(_contains(o, v) for o in outer) for v in inner
+        )
+    return outer == inner

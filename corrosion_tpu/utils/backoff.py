@@ -1,0 +1,63 @@
+"""Jittered exponential backoff iterator.
+
+Mirrors the reference's ``backoff`` crate (``crates/backoff/src/lib.rs:7-50``):
+an iterator of sleep durations that grows exponentially from ``min`` to
+``max`` with multiplicative ``factor``, each step jittered by a random
+fraction so a fleet of nodes does not thunder-herd. Used by the sync loop
+(1 s -> 15 s, ``agent/util.rs:352-398``) and bootstrap announcements
+(5 s -> 120 s, ``agent/bootstrap.rs``).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Iterator, Optional
+
+
+class Backoff:
+    """``iter(Backoff(...))`` yields jittered, exponentially growing delays.
+
+    The iterator is infinite unless ``max_retries`` is set; after the cap
+    it keeps yielding ``max_wait`` (like the reference's saturating
+    iterator).
+    """
+
+    def __init__(
+        self,
+        min_wait: float = 1.0,
+        max_wait: float = 15.0,
+        factor: float = 2.0,
+        jitter: float = 0.5,
+        max_retries: Optional[int] = None,
+        rng: Optional[random.Random] = None,
+    ):
+        assert min_wait > 0 and max_wait >= min_wait and factor >= 1.0
+        assert 0.0 <= jitter <= 1.0
+        self.min_wait = min_wait
+        self.max_wait = max_wait
+        self.factor = factor
+        self.jitter = jitter
+        self.max_retries = max_retries
+        self._rng = rng or random.Random()
+
+    def __iter__(self) -> Iterator[float]:
+        base = self.min_wait
+        n = 0
+        while True:
+            if self.max_retries is not None and n >= self.max_retries:
+                return
+            # jitter scales the delay in [1-j, 1+j], clamped to [min, max]
+            scale = 1.0 + self.jitter * (2.0 * self._rng.random() - 1.0)
+            yield max(self.min_wait, min(self.max_wait, base * scale))
+            base = min(self.max_wait, base * self.factor)
+            n += 1
+
+    def iter_no_jitter(self) -> Iterator[float]:
+        base = self.min_wait
+        n = 0
+        while True:
+            if self.max_retries is not None and n >= self.max_retries:
+                return
+            yield base
+            base = min(self.max_wait, base * self.factor)
+            n += 1

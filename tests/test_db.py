@@ -1,0 +1,173 @@
+"""DB layer: schema parse/diff/apply constraints + SQL execution over the
+cluster — the analog of the reference's schema tests (``schema.rs``) and
+the HTTP write/read path tests (``api/public/mod.rs``)."""
+
+import pytest
+
+from corrosion_tpu.agent import Agent
+from corrosion_tpu.config import Config
+from corrosion_tpu.db import Database, SchemaError, parse_schema_sql
+from corrosion_tpu.db.values import ValueHeap, corro_json_contains
+
+SCHEMA = """
+CREATE TABLE users (
+    id INTEGER PRIMARY KEY,
+    name TEXT NOT NULL DEFAULT 'anon',
+    score INTEGER,
+    bio TEXT
+);
+"""
+
+
+def db_config():
+    cfg = Config()
+    cfg.sim.mode = "scale"
+    cfg.sim.n_nodes = 16
+    cfg.sim.m_slots = 8
+    cfg.sim.n_origins = 4
+    cfg.sim.n_rows = 8
+    cfg.sim.n_cols = 4
+    cfg.perf.sync_interval = 4
+    cfg.gossip.drop_prob = 0.0
+    return cfg
+
+
+@pytest.fixture(scope="module")
+def db():
+    with Agent(db_config()) as agent:
+        agent.wait_rounds(10, timeout=120)
+        d = Database(agent)
+        d.apply_schema_sql(SCHEMA)
+        yield d
+
+
+# --- schema parsing ------------------------------------------------------
+
+def test_parse_schema():
+    s = parse_schema_sql(SCHEMA)
+    t = s.table("users")
+    assert t.pk.name == "id"
+    assert [c.name for c in t.value_columns] == ["name", "score", "bio"]
+    assert t.column("name").default == "anon"
+    assert t.col_index("name") == 1 and t.col_index("bio") == 3
+
+
+def test_schema_constraints():
+    with pytest.raises(SchemaError):  # no pk
+        parse_schema_sql("CREATE TABLE t (a INTEGER, b TEXT);")
+    with pytest.raises(SchemaError):  # unique forbidden
+        parse_schema_sql("CREATE TABLE t (a INTEGER PRIMARY KEY, b TEXT UNIQUE);")
+    with pytest.raises(SchemaError):  # unique index forbidden
+        parse_schema_sql(
+            "CREATE TABLE t (a INTEGER PRIMARY KEY);"
+            "CREATE UNIQUE INDEX i ON t (a);"
+        )
+    # table-level pk works
+    s = parse_schema_sql("CREATE TABLE t (a INTEGER, b TEXT, PRIMARY KEY (a));")
+    assert s.table("t").pk.name == "a"
+
+
+def test_schema_diff_rejects_destructive(db):
+    with pytest.raises(SchemaError):  # dropping a column
+        db.apply_schema_sql("CREATE TABLE users (id INTEGER PRIMARY KEY);")
+    # adding a table and appending a column are fine
+    changes = db.apply_schema_sql(
+        "CREATE TABLE users (id INTEGER PRIMARY KEY, name TEXT NOT NULL "
+        "DEFAULT 'anon', score INTEGER, bio TEXT);\n"
+        "CREATE TABLE deploys (node TEXT PRIMARY KEY, version INTEGER);"
+    )
+    assert ("create_table", "deploys") in changes
+
+
+# --- write / read path ---------------------------------------------------
+
+def test_insert_select_roundtrip(db):
+    db.execute(0, [
+        ("INSERT INTO users (id, name, score) VALUES (?, ?, ?)", [1, "ada", 10]),
+        ("INSERT INTO users (id, name, score) VALUES (?, ?, ?)", [2, "bob", 5]),
+    ])
+    cols, rows = db.query(0, "SELECT id, name, score FROM users")
+    got = sorted(rows)
+    assert cols == ["id", "name", "score"]
+    assert got == [[1, "ada", 10], [2, "bob", 5]]
+    # default applied
+    assert db.read_row(0, "users", 1)["bio"] is None
+
+
+def test_update_delete(db):
+    db.execute(0, [("UPDATE users SET score = ? WHERE id = ?", [99, 1])])
+    assert db.read_row(0, "users", 1)["score"] == 99
+    db.execute(0, [("DELETE FROM users WHERE id = ?", [2])])
+    assert db.read_row(0, "users", 2) is None
+    # delete is idempotent; re-insert revives via causal length
+    (res,) = db.execute(0, [("DELETE FROM users WHERE id = ?", [2])])
+    assert res["rows_affected"] == 0
+    db.execute(0, [("INSERT INTO users (id, name) VALUES (?, ?)", [2, "bob2"])])
+    assert db.read_row(0, "users", 2)["name"] == "bob2"
+
+
+def test_where_and_limit(db):
+    _, rows = db.query(0, "SELECT id FROM users WHERE score >= ?", [50])
+    assert [1] in list(rows)
+    _, rows = db.query(0, "SELECT id FROM users LIMIT 1")
+    assert len(list(rows)) == 1
+
+
+def test_replication_to_reader_node(db):
+    agent = db.agent
+    db.execute(1, [("INSERT INTO users (id, name, score) VALUES (3, 'eve', 7)",)])
+    reader = agent.n_nodes - 1
+    # cells replicate independently (column-level LWW) — wait for the
+    # whole row, not just the first cell that lands
+    for _ in range(100):
+        row = db.read_row(reader, "users", 3)
+        if row is not None and row["name"] == "eve" and row["score"] == 7:
+            break
+        agent.wait_rounds(4, timeout=60)
+    assert db.read_row(reader, "users", 3)["score"] == 7
+
+
+def test_sql_errors(db):
+    from corrosion_tpu.db.database import SqlError
+
+    with pytest.raises(SqlError):
+        db.execute(0, ["SELECT * FROM users"])  # read on write path
+    with pytest.raises(SqlError):
+        db.query(0, "DELETE FROM users WHERE id = 1")  # write on read path
+    with pytest.raises(SqlError):
+        db.execute(0, [("INSERT INTO users (name) VALUES ('x')",)])  # no pk
+    with pytest.raises(SqlError):
+        db.execute(0, [("UPDATE users SET name = NULL WHERE id = 1",)])
+
+
+def test_table_stats(db):
+    stats = db.table_stats(0)
+    assert stats["users"]["live"] >= 1
+
+
+def test_state_dict_roundtrip(db):
+    state = db.state_dict()
+    with Agent(db_config()) as a2:
+        d2 = Database(a2)
+        d2.load_state_dict(state)
+        assert d2.schema.table("users").pk.name == "id"
+        assert d2.rows.get("users", 1) == db.rows.get("users", 1)
+        assert len(d2.heap) == len(db.heap)
+
+
+# --- value heap ----------------------------------------------------------
+
+def test_value_heap():
+    h = ValueHeap()
+    assert h.intern(None) == 0
+    a = h.intern("x")
+    assert h.intern("x") == a
+    assert h.intern(1) != h.intern(1.0)  # SQL type identity
+    assert h.lookup(h.intern(b"\x01")) == b"\x01"
+    h2 = ValueHeap.from_state_dict(h.state_dict())
+    assert h2.lookup(a) == "x" and len(h2) == len(h)
+
+
+def test_json_contains():
+    assert corro_json_contains('{"a": 1, "b": [1, 2]}', '{"b": [2]}')
+    assert not corro_json_contains('{"a": 1}', '{"b": 1}')

@@ -1,0 +1,52 @@
+"""HLC clock + backoff iterator (reference ``uhlc`` usage and
+``crates/backoff``)."""
+
+import random
+
+import pytest
+
+from corrosion_tpu.utils.backoff import Backoff
+from corrosion_tpu.utils.hlc import ClockDriftError, HLClock, Timestamp
+
+
+def test_hlc_monotonic():
+    clk = HLClock(actor=7)
+    stamps = [clk.new_timestamp() for _ in range(100)]
+    assert all(a < b for a, b in zip(stamps, stamps[1:]))
+    assert stamps[0].actor == 7
+
+
+def test_hlc_update_from_remote():
+    t = [1_000_000]
+    clk = HLClock(actor=1, now_us=lambda: t[0])
+    remote = Timestamp(((t[0] + 1000) << 16) | 5, 2)
+    clk.update_with_timestamp(remote)
+    local = clk.new_timestamp()
+    assert local.ntp > remote.ntp  # stays ahead of everything observed
+
+
+def test_hlc_drift_rejection():
+    t = [1_000_000]
+    clk = HLClock(actor=1, max_delta_ms=300, now_us=lambda: t[0])
+    too_far = Timestamp((t[0] + 400_000) << 16, 2)  # 400 ms ahead
+    with pytest.raises(ClockDriftError):
+        clk.update_with_timestamp(too_far)
+
+
+def test_backoff_growth_and_caps():
+    b = Backoff(min_wait=1, max_wait=8, factor=2, jitter=0.0,
+                rng=random.Random(0))
+    it = iter(b)
+    vals = [next(it) for _ in range(6)]
+    assert vals == [1, 2, 4, 8, 8, 8]
+
+
+def test_backoff_jitter_bounds():
+    b = Backoff(min_wait=1, max_wait=15, jitter=0.5, rng=random.Random(1))
+    for i, d in zip(range(50), b):
+        assert 1 <= d <= 15
+
+
+def test_backoff_max_retries():
+    b = Backoff(min_wait=1, max_wait=4, jitter=0.0, max_retries=3)
+    assert len(list(b)) == 3
