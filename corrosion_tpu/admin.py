@@ -1,0 +1,203 @@
+"""Admin socket: JSON-framed command server on a Unix domain socket.
+
+Mirrors ``crates/corro-admin``: a UDS server running inside the agent
+(``start_server``, ``lib.rs:49``) speaking newline-delimited JSON frames
+(the reference uses tokio-serde length-delimited JSON), with the same
+command set (``Command`` enum, ``lib.rs:102-148``):
+
+- ``ping``;
+- ``sync`` — per-node sync-state dump (used by the Antithesis
+  ``check_bookkeeping`` convergence check);
+- ``locks`` — top-N held locks from the lock registry;
+- ``cluster members`` / ``cluster set-id`` / ``cluster rejoin``;
+- ``actor version`` — probe one (node, origin) head;
+- ``log`` — dynamic log filter reload.
+
+Plus the fault-injection surface the reference gets externally from
+Antithesis (SURVEY §4): ``kill`` / ``revive`` / ``partition`` / ``heal``,
+and ``checkpoint`` / ``restore`` hooks.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import socketserver
+import threading
+from typing import Optional
+
+import numpy as np
+
+from corrosion_tpu.utils.tracing import logger, set_level
+
+
+class AdminServer:
+    def __init__(self, agent, uds_path: str, db=None):
+        self.agent = agent
+        self.db = db
+        self.uds_path = uds_path
+        self.cluster_id = 0
+        if os.path.exists(uds_path):
+            os.unlink(uds_path)
+        handler = _make_handler(self)
+        self.server = socketserver.ThreadingUnixStreamServer(uds_path, handler)
+        self.server.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "AdminServer":
+        self._thread = threading.Thread(
+            target=self.server.serve_forever, name="admin-uds", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+        if os.path.exists(self.uds_path):
+            os.unlink(self.uds_path)
+        if self._thread:
+            self._thread.join(timeout=10)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # --- command dispatch -------------------------------------------------
+    def handle(self, cmd: dict) -> dict:
+        agent = self.agent
+        name = cmd.get("command")
+        if name == "ping":
+            return {"ok": "pong"}
+        if name == "sync":
+            node = cmd.get("node")
+            if node is not None:
+                return {"ok": agent.sync_state(int(node))}
+            return {"ok": [agent.sync_state(i) for i in range(agent.n_nodes)]}
+        if name == "locks":
+            top = int(cmd.get("top", 10))
+            snap = sorted(
+                agent.locks.snapshot(),
+                key=lambda e: e.get("held_seconds", 0), reverse=True,
+            )
+            return {"ok": snap[:top]}
+        if name == "cluster_members":
+            return {"ok": agent.members()}
+        if name == "cluster_set_id":
+            self.cluster_id = int(cmd["cluster_id"])
+            return {"ok": self.cluster_id}
+        if name == "cluster_rejoin":
+            agent.revive_node(int(cmd["node"]))
+            return {"ok": True}
+        if name == "actor_version":
+            snap = agent.snapshot()
+            node, origin = int(cmd["node"]), int(cmd["origin"])
+            return {"ok": {
+                "head": int(snap["head"][node, origin]),
+                "known_max": int(snap["known_max"][node, origin]),
+            }}
+        if name == "log":
+            set_level(cmd.get("level", "info"))
+            return {"ok": cmd.get("level", "info")}
+        # --- fault injection (Antithesis driver analog) -------------------
+        if name == "kill":
+            agent.kill_node(int(cmd["node"]))
+            return {"ok": True}
+        if name == "revive":
+            agent.revive_node(int(cmd["node"]))
+            return {"ok": True}
+        if name == "partition":
+            groups = np.asarray(cmd["groups"], np.int32)
+            agent.set_partition(groups)
+            return {"ok": True}
+        if name == "heal":
+            agent.heal_partition()
+            return {"ok": True}
+        # --- durability ---------------------------------------------------
+        if name == "checkpoint":
+            from corrosion_tpu.checkpoint import save_checkpoint
+
+            path = save_checkpoint(agent, db=self.db,
+                                   path=cmd.get("path", "./checkpoint"))
+            return {"ok": path}
+        if name == "restore":
+            from corrosion_tpu.checkpoint import restore_checkpoint
+
+            man = restore_checkpoint(agent, cmd["path"], db=self.db)
+            return {"ok": {"round": man["round"]}}
+        if name == "backup":
+            from corrosion_tpu.checkpoint import backup_node
+
+            path = backup_node(agent, int(cmd.get("node", 0)), db=self.db,
+                               path=cmd.get("path", "./backup.npz"))
+            return {"ok": path}
+        if name == "restore_backup":
+            from corrosion_tpu.checkpoint import restore_backup
+
+            node = restore_backup(
+                agent, cmd["path"],
+                node=int(cmd["node"]) if "node" in cmd else None,
+                db=self.db, repivot=bool(cmd.get("repivot", True)),
+            )
+            return {"ok": {"node": node}}
+        return {"error": f"unknown command {name!r}"}
+
+
+def _make_handler(server: AdminServer):
+    class Handler(socketserver.StreamRequestHandler):
+        def handle(self):
+            for raw in self.rfile:
+                raw = raw.strip()
+                if not raw:
+                    continue
+                try:
+                    cmd = json.loads(raw)
+                    resp = server.handle(cmd)
+                except Exception as e:  # noqa: BLE001
+                    logger.exception("admin command failed")
+                    resp = {"error": str(e)}
+                try:
+                    self.wfile.write(json.dumps(resp).encode() + b"\n")
+                    self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError):
+                    return
+
+    return Handler
+
+
+class AdminClient:
+    """Line-framed JSON client (the CLI's admin transport)."""
+
+    def __init__(self, uds_path: str, timeout: float = 30.0):
+        self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self.sock.settimeout(timeout)
+        self.sock.connect(uds_path)
+        self._file = self.sock.makefile("rwb")
+
+    def call(self, command: str, **kw) -> dict:
+        self._file.write(json.dumps({"command": command, **kw}).encode() + b"\n")
+        self._file.flush()
+        line = self._file.readline()
+        if not line:
+            raise ConnectionError("admin socket closed")
+        resp = json.loads(line)
+        if "error" in resp:
+            raise RuntimeError(resp["error"])
+        return resp["ok"]
+
+    def close(self) -> None:
+        try:
+            self._file.close()
+        finally:
+            self.sock.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -96,6 +96,7 @@ class Agent:
         self._pend_kill = np.zeros(n, bool)
         self._pend_revive = np.zeros(n, bool)
         self._pend_partition: Optional[np.ndarray] = None
+        self._pend_restore = None  # (state, applied-Event) | None
 
         self.round_no = 0
         self._round_cv = threading.Condition()
@@ -137,8 +138,10 @@ class Agent:
             logger.exception("round loop crashed; tripping shutdown")
         finally:
             self.tripwire.trip()
-            # wake everything parked on us: queued writers + round waiters
+            # wake everything parked on us: queued writers, round waiters,
+            # and any restore staged after the last round started
             with self._input_lock:
+                self._apply_pend_restore()
                 for q in self._write_queues.values():
                     for _, _, ev in q:
                         if ev is not None:
@@ -147,8 +150,21 @@ class Agent:
             with self._round_cv:
                 self._round_cv.notify_all()
 
+    def _apply_pend_restore(self):
+        """Apply a staged restore. Callers must hold ``_input_lock``; only
+        the round thread (or a caller when no round thread runs) may call
+        this, so the swap never races an in-flight step."""
+        if self._pend_restore is None:
+            return
+        state, ev, box = self._pend_restore
+        self._pend_restore = None
+        self._state = jax.tree.map(jnp.asarray, state)
+        box["applied"] = True
+        ev.set()
+
     def _one_round(self):
         with self._input_lock:
+            self._apply_pend_restore()
             n = self.n_nodes
             write_mask = np.zeros(n, bool)
             write_cell = np.zeros(n, np.int32)
@@ -279,6 +295,43 @@ class Agent:
 
     def heal_partition(self):
         self.set_partition(np.zeros(self.n_nodes, np.int32))
+
+    # --- checkpoint / restore -------------------------------------------
+    def device_state(self):
+        """The current device-state pytree (read-only view for
+        checkpointing; the round thread owns the live copy)."""
+        return self._state
+
+    def restore_state(self, state, timeout: float = 60.0) -> bool:
+        """Swap in a new device-state pytree under a live round loop —
+        the ``sqlite3-restore`` analog (byte-lock swap of the DB under a
+        running agent). The swap is staged and applied at the next round
+        boundary by the round thread itself (never racing an in-flight
+        step); with no round thread it applies inline. Returns True once
+        applied; False if it timed out or was superseded by a newer
+        restore — in both failure cases the staged state is withdrawn."""
+        ev = threading.Event()
+        box = {"applied": False}
+        with self._input_lock:
+            if self._pend_restore is not None:
+                # supersede: wake the earlier caller un-applied
+                _, old_ev, _old_box = self._pend_restore
+                self._pend_restore = None
+                old_ev.set()
+            self._pend_restore = (state, ev, box)
+            loop_running = self._thread is not None and self._thread.is_alive()
+            if not loop_running:
+                self._apply_pend_restore()
+        ok = ev.wait(timeout) and box["applied"]
+        if ok:
+            with self._snap_lock:
+                self._snapshot_host = None
+        else:
+            with self._input_lock:
+                if (self._pend_restore is not None
+                        and self._pend_restore[1] is ev):
+                    self._pend_restore = None
+        return ok
 
     # --- read path ------------------------------------------------------
     def snapshot(self) -> dict:

@@ -1,0 +1,9 @@
+"""Public API layer: HTTP server + client types.
+
+Maps the reference's layer 7 (``crates/corro-agent/src/api/public/``,
+routes registered at ``agent/util.rs:182-294``).
+"""
+
+from corrosion_tpu.api.http import ApiServer
+
+__all__ = ["ApiServer"]

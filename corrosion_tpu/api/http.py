@@ -1,0 +1,329 @@
+"""HTTP API server.
+
+The reference's public REST surface (``crates/corro-agent/src/api/public/``,
+router at ``agent/util.rs:182-294``), same routes and event shapes:
+
+- ``POST /v1/transactions[?node=K]`` — JSON array of statements (each a
+  string, ``[sql, params]`` pair, or ``{"query", "params"}``) executed as
+  one transaction at writer node K; returns ``{"results": [...]}``
+  (``api_v1_transactions``, ``public/mod.rs:177-256``).
+- ``POST /v1/queries[?node=K]`` — one read-only statement; NDJSON stream
+  of ``{"columns"}``, ``{"row": [rowid, values]}``, ``{"eoq"}`` events
+  (``public/mod.rs:266-538``).
+- ``POST /v1/subscriptions[?node=K&from=ID]`` — subscribe to a query;
+  NDJSON stream (initial snapshot then ``{"change"}`` events); the
+  matcher id is returned in the ``corro-query-id`` header.
+  ``GET /v1/subscriptions/{id}[?from=ID]`` re-attaches, resuming from a
+  ChangeId (``api/public/pubsub.rs:29-112``).
+- ``GET /v1/updates/{table}`` — row-level NotifyEvent stream
+  (``api/public/update.rs``).
+- ``POST /v1/migrations`` — JSON array of schema SQL strings
+  (``execute_schema``, ``public/mod.rs:540-593``).
+- ``GET /v1/table_stats``, ``GET /v1/members``, ``GET /v1/sync`` —
+  introspection (admin surface exposes the same data over UDS).
+- ``GET /metrics`` — Prometheus exposition (the reference serves this on
+  the telemetry listener, ``command/agent.rs:114-139``).
+
+Statement values ride JSON; blobs are not representable in JSON and use
+``{"blob": "<hex>"}`` wrappers on both paths.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, List, Optional, Tuple
+
+from corrosion_tpu.db.database import SqlError
+from corrosion_tpu.db.schema import SchemaError
+from corrosion_tpu.pubsub import SubsManager, UpdatesManager
+from corrosion_tpu.utils.tracing import logger
+
+
+def _encode_value(v: Any) -> Any:
+    if isinstance(v, bytes):
+        return {"blob": v.hex()}
+    return v
+
+
+def _decode_value(v: Any) -> Any:
+    if isinstance(v, dict) and set(v) == {"blob"}:
+        return bytes.fromhex(v["blob"])
+    return v
+
+
+def _decode_params(params: Any) -> Any:
+    if isinstance(params, dict):
+        return {k: _decode_value(v) for k, v in params.items()}
+    if isinstance(params, list):
+        return [_decode_value(v) for v in params]
+    return params
+
+
+def parse_statements(body: Any) -> List[Tuple[str, Any]]:
+    """JSON statement forms -> (sql, params) pairs (corro-api-types
+    ``Statement``: Simple / WithParams / WithNamedParams)."""
+    out: List[Tuple[str, Any]] = []
+    for stmt in body:
+        if isinstance(stmt, str):
+            out.append((stmt, None))
+        elif isinstance(stmt, list):
+            sql = stmt[0]
+            params = _decode_params(stmt[1]) if len(stmt) > 1 else None
+            out.append((sql, params))
+        elif isinstance(stmt, dict):
+            out.append((stmt["query"], _decode_params(stmt.get("params"))))
+        else:
+            raise SqlError(f"bad statement shape: {type(stmt).__name__}")
+    return out
+
+
+class ApiServer:
+    """HTTP listener bound to one Database (+ its Agent)."""
+
+    def __init__(self, db, addr: str = "127.0.0.1", port: int = 0,
+                 default_node: int = 0, subs: Optional[SubsManager] = None,
+                 updates: Optional[UpdatesManager] = None):
+        self.db = db
+        self.agent = db.agent
+        self.default_node = default_node
+        self.subs = subs or SubsManager(db)
+        self.updates = updates or UpdatesManager(db)
+        handler = _make_handler(self)
+        self.httpd = ThreadingHTTPServer((addr, port), handler)
+        self.httpd.daemon_threads = True
+        self.addr, self.port = self.httpd.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "ApiServer":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="api-http", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=10)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+def _make_handler(server: ApiServer):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # route to our logger
+            logger.debug("http: " + fmt, *args)
+
+        # --- helpers -----------------------------------------------------
+        def _json_body(self) -> Any:
+            length = int(self.headers.get("Content-Length", 0))
+            raw = self.rfile.read(length) if length else b""
+            return json.loads(raw) if raw else None
+
+        def _reply_json(self, code: int, obj: Any,
+                        headers: Optional[dict] = None) -> None:
+            data = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _reply_error(self, code: int, msg: str) -> None:
+            self._reply_json(code, {"error": msg})
+
+        def _start_ndjson(self, headers: Optional[dict] = None) -> None:
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Transfer-Encoding", "chunked")
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+
+        def _ndjson_line(self, obj: Any) -> None:
+            data = json.dumps(obj).encode() + b"\n"
+            self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+            self.wfile.flush()
+
+        def _end_chunks(self) -> None:
+            self.wfile.write(b"0\r\n\r\n")
+            self.wfile.flush()
+
+        def _route(self) -> Tuple[str, dict]:
+            parsed = urllib.parse.urlparse(self.path)
+            q = {k: v[-1] for k, v in
+                 urllib.parse.parse_qs(parsed.query).items()}
+            return parsed.path.rstrip("/"), q
+
+        def _node(self, q: dict) -> int:
+            return int(q.get("node", server.default_node))
+
+        # --- POST --------------------------------------------------------
+        def do_POST(self):
+            path, q = self._route()
+            try:
+                if path == "/v1/transactions":
+                    self._transactions(q)
+                elif path == "/v1/queries":
+                    self._queries(q)
+                elif path == "/v1/migrations":
+                    self._migrations()
+                elif path == "/v1/subscriptions":
+                    self._subscribe_new(q)
+                else:
+                    self._reply_error(404, f"no such route: POST {path}")
+            except (SqlError, SchemaError, ValueError, KeyError) as e:
+                self._reply_error(400, str(e))
+            except BrokenPipeError:
+                pass
+            except Exception as e:  # noqa: BLE001
+                logger.exception("http handler error")
+                try:
+                    self._reply_error(500, str(e))
+                except Exception:  # noqa: BLE001 — headers may be gone
+                    pass
+
+        def do_GET(self):
+            path, q = self._route()
+            try:
+                if path == "/v1/table_stats":
+                    self._reply_json(
+                        200, server.db.table_stats(self._node(q)))
+                elif path == "/v1/members":
+                    self._reply_json(200, server.agent.members())
+                elif path == "/v1/sync":
+                    node = self._node(q)
+                    self._reply_json(200, server.agent.sync_state(node))
+                elif path == "/metrics":
+                    data = server.agent.metrics.render().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                elif path.startswith("/v1/subscriptions/"):
+                    self._subscribe_existing(path.rsplit("/", 1)[1], q)
+                elif path.startswith("/v1/updates/"):
+                    self._updates_feed(path.rsplit("/", 1)[1])
+                else:
+                    self._reply_error(404, f"no such route: GET {path}")
+            except (SqlError, SchemaError, ValueError, KeyError) as e:
+                self._reply_error(400, str(e))
+            except BrokenPipeError:
+                pass
+            except Exception as e:  # noqa: BLE001
+                logger.exception("http handler error")
+                try:
+                    self._reply_error(500, str(e))
+                except Exception:  # noqa: BLE001
+                    pass
+
+        # --- route bodies ------------------------------------------------
+        def _transactions(self, q: dict) -> None:
+            stmts = parse_statements(self._json_body() or [])
+            results = server.db.execute(self._node(q), stmts)
+            self._reply_json(200, {"results": [dict(r) for r in results]})
+
+        def _queries(self, q: dict) -> None:
+            body = self._json_body()
+            stmts = parse_statements([body])
+            sql, params = stmts[0]
+            cols, rows = server.db.query(self._node(q), sql, params)
+            self._start_ndjson()
+            self._ndjson_line({"columns": cols})
+            for i, row in enumerate(rows):
+                self._ndjson_line(
+                    {"row": [i + 1, [_encode_value(v) for v in row]]}
+                )
+            self._ndjson_line({"eoq": {}})
+            self._end_chunks()
+
+        def _migrations(self) -> None:
+            body = self._json_body() or []
+            if isinstance(body, str):
+                body = [body]
+            changes = []
+            for sql in body:
+                changes.extend(server.db.apply_schema_sql(sql))
+            self._reply_json(200, {"results": [list(c) for c in changes]})
+
+        def _subscribe_new(self, q: dict) -> None:
+            body = self._json_body()
+            sql, params = parse_statements([body])[0]
+            from_id = int(q["from"]) if "from" in q else None
+            matcher, _created = server.subs.subscribe(
+                self._node(q), sql, params)
+            self._stream_matcher(matcher, from_id)
+
+        def _subscribe_existing(self, sub_id: str, q: dict) -> None:
+            matcher = server.subs.get(sub_id)
+            if matcher is None:
+                self._reply_error(404, f"no such subscription: {sub_id}")
+                return
+            from_id = int(q["from"]) if "from" in q else None
+            self._stream_matcher(matcher, from_id)
+
+        def _stream_matcher(self, matcher, from_id: Optional[int]) -> None:
+            sub_q = matcher.attach(from_change_id=from_id)
+            self._start_ndjson({"corro-query-id": matcher.id})
+            try:
+                while not server.agent.tripwire.tripped:
+                    try:
+                        kind, payload = sub_q.get(timeout=1.0)
+                    except queue.Empty:
+                        continue
+                    if kind == "columns":
+                        self._ndjson_line({"columns": payload})
+                    elif kind == "row":
+                        key, row = payload
+                        self._ndjson_line(
+                            {"row": [_encode_value(key),
+                                     [_encode_value(v) for v in row]]}
+                        )
+                    elif kind == "eoq":
+                        self._ndjson_line({"eoq": {"change_id": payload}})
+                    elif kind == "change":
+                        cid, ckind, key, row = payload
+                        self._ndjson_line({"change": [
+                            ckind, _encode_value(key),
+                            None if row is None
+                            else [_encode_value(v) for v in row],
+                            cid,
+                        ]})
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+            finally:
+                matcher.detach(sub_q)
+
+        def _updates_feed(self, table: str) -> None:
+            feed_q = server.updates.attach(table)
+            self._start_ndjson()
+            try:
+                while not server.agent.tripwire.tripped:
+                    try:
+                        kind, payload = feed_q.get(timeout=1.0)
+                    except queue.Empty:
+                        continue
+                    ckind, pk = payload
+                    self._ndjson_line({"notify": [ckind, _encode_value(pk)]})
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+            finally:
+                server.updates.detach(table, feed_q)
+
+    return Handler

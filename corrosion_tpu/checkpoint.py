@@ -1,0 +1,177 @@
+"""Checkpoint / backup / restore.
+
+In the reference the SQLite file *is* the checkpoint; ``corrosion backup``
+produces a portable copy via ``VACUUM INTO`` + site-id ordinal rewrite
+(``crates/corrosion/src/main.rs:160-225``) and ``corrosion restore`` swaps
+the live DB under file locks (``crates/sqlite3-restore/src/lib.rs``) with
+an optional actor re-pivot (``main.rs:227-330``).
+
+Here the durable artifacts are:
+
+- **checkpoint** — the whole cluster: the device-state pytree (saved as
+  an ``.npz`` of its leaves, restored against a template built from the
+  same config) + the host DB state (schema, value heap, row map) + a
+  manifest. ``load_checkpoint`` + ``Agent.restore_state`` resume a live
+  agent at the saved round.
+- **backup** — one *node's* replica, portable: its store planes and
+  bookkeeping rows plus the host DB state. ``restore_backup`` grafts it
+  onto a (possibly different) node of a live cluster, optionally
+  re-pivoting site ids that named the backed-up node to the new identity
+  — the ordinal-rewrite analog.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+
+FORMAT_VERSION = 1
+
+
+def _leaves(state) -> list:
+    return jax.tree.leaves(state)
+
+
+def _state_template(mode: str, cfg):
+    if mode == "scale":
+        from corrosion_tpu.sim.scale_step import ScaleSimState
+
+        return ScaleSimState.create(cfg)
+    from corrosion_tpu.sim.step import SimState
+
+    return SimState.create(cfg)
+
+
+def save_checkpoint(agent, db=None, path: str = "./checkpoint") -> str:
+    """Write the full cluster state to ``path`` (a directory)."""
+    os.makedirs(path, exist_ok=True)
+    state = agent.device_state()
+    leaves = [np.asarray(x) for x in _leaves(state)]
+    np.savez_compressed(
+        os.path.join(path, "state.npz"),
+        **{f"leaf_{i}": a for i, a in enumerate(leaves)},
+    )
+    manifest = {
+        "format": FORMAT_VERSION,
+        "mode": agent.mode,
+        "round": agent.round_no,
+        "sim_config": dataclasses.asdict(agent.cfg),
+        "n_leaves": len(leaves),
+        "db": db.state_dict() if db is not None else None,
+    }
+    with open(os.path.join(path, "manifest.json"), "w") as f:
+        json.dump(manifest, f)
+    return path
+
+
+def load_checkpoint(path: str) -> Tuple[dict, object]:
+    """-> (manifest, device-state pytree). The pytree is rebuilt against
+    a template constructed from the saved config, so leaf order/shape
+    mismatches fail loudly."""
+    with open(os.path.join(path, "manifest.json")) as f:
+        manifest = json.load(f)
+    if manifest["format"] != FORMAT_VERSION:
+        raise ValueError(f"unsupported checkpoint format {manifest['format']}")
+    if manifest["mode"] == "scale":
+        from corrosion_tpu.sim.scale_step import ScaleSimConfig as CfgCls
+    else:
+        from corrosion_tpu.sim.config import SimConfig as CfgCls
+    cfg = CfgCls(**manifest["sim_config"])
+    template = _state_template(manifest["mode"], cfg)
+    with np.load(os.path.join(path, "state.npz")) as z:
+        loaded = [z[f"leaf_{i}"] for i in range(manifest["n_leaves"])]
+    tmpl_leaves, treedef = jax.tree.flatten(template)
+    if len(tmpl_leaves) != len(loaded):
+        raise ValueError(
+            f"checkpoint has {len(loaded)} leaves, config expects "
+            f"{len(tmpl_leaves)} — config drift"
+        )
+    for t, l in zip(tmpl_leaves, loaded):
+        if tuple(t.shape) != tuple(l.shape):
+            raise ValueError(
+                f"leaf shape mismatch: checkpoint {l.shape} vs config {t.shape}"
+            )
+    state = jax.tree.unflatten(treedef, loaded)
+    return manifest, state
+
+
+def restore_checkpoint(agent, path: str, db=None) -> dict:
+    """Swap a checkpoint into a live agent (+ its Database host state)."""
+    manifest, state = load_checkpoint(path)
+    if manifest["mode"] != agent.mode:
+        raise ValueError(
+            f"checkpoint mode {manifest['mode']!r} != agent mode {agent.mode!r}"
+        )
+    if not agent.restore_state(state):
+        raise TimeoutError("restore did not apply in time")
+    if db is not None and manifest.get("db") is not None:
+        db.load_state_dict(manifest["db"])
+    return manifest
+
+
+# --- portable single-node backup ----------------------------------------
+
+def backup_node(agent, node: int, db=None, path: str = "./backup.npz") -> str:
+    """Portable backup of one node's replica (``corrosion backup``)."""
+    snap = agent.snapshot()
+    planes = {f"plane_{i}": p[node] for i, p in enumerate(snap["store"])}
+    np.savez_compressed(
+        path,
+        **planes,
+        head=snap["head"][node],
+        known_max=snap["known_max"][node],
+        meta=np.array(
+            [FORMAT_VERSION, node, len(snap["store"])], np.int64
+        ),
+    )
+    if db is not None:
+        with open(path + ".db.json", "w") as f:
+            json.dump(db.state_dict(), f)
+    return path
+
+
+def restore_backup(agent, path: str, node: Optional[int] = None,
+                   db=None, repivot: bool = True) -> int:
+    """Graft a node backup onto ``node`` of a live cluster.
+
+    With ``repivot`` (the site-id ordinal rewrite analog), site-plane
+    entries naming the backed-up node are rewritten to the restored
+    node's id, so columns the old identity authored are attributed to the
+    new one."""
+    with np.load(path) as z:
+        fmt, src_node, n_planes = (int(x) for x in z["meta"])
+        if fmt != FORMAT_VERSION:
+            raise ValueError(f"unsupported backup format {fmt}")
+        planes = [np.array(z[f"plane_{i}"]) for i in range(n_planes)]
+        head = np.array(z["head"])
+        known_max = np.array(z["known_max"])
+    target = src_node if node is None else node
+    if repivot and target != src_node:
+        site = planes[2]  # (ver, val, site, dbv) plane order
+        site[site == src_node] = target
+    # patch the live state on host, then stage the swap
+    state = agent.device_state()
+    store = tuple(
+        np.asarray(p).copy() for p in state.crdt.store
+    )
+    for plane, backup_plane in zip(store, planes):
+        plane[target] = backup_plane
+    h = np.asarray(state.crdt.book.head).copy()
+    km = np.asarray(state.crdt.book.known_max).copy()
+    h[target] = head
+    km[target] = np.maximum(known_max, km[target])
+    crdt = state.crdt._replace(
+        store=tuple(store),
+        book=state.crdt.book._replace(head=h, known_max=km),
+    )
+    if not agent.restore_state(state._replace(crdt=crdt)):
+        raise TimeoutError("backup restore did not apply in time")
+    if db is not None and os.path.exists(path + ".db.json"):
+        with open(path + ".db.json") as f:
+            db.load_state_dict(json.load(f))
+    return target

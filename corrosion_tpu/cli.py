@@ -1,0 +1,282 @@
+"""The ``corrosion-tpu`` command line.
+
+Mirrors the reference binary's command surface (``Command`` enum,
+``crates/corrosion/src/main.rs:649-737``):
+
+- ``agent`` — boot the node runtime (round loop + HTTP API + admin UDS +
+  optional Prometheus), apply schema files, run until SIGINT
+  (``command/agent.rs:19``);
+- ``exec`` / ``query`` — one-shot statements over the HTTP API
+  (``main.rs`` Exec/Query);
+- ``sync generate`` — sync-state dump via admin (the Antithesis
+  convergence probe);
+- ``cluster members`` / ``cluster rejoin`` — membership ops via admin;
+- ``backup`` / ``restore`` — portable node backup & full checkpoint
+  (``main.rs:160-330``);
+- ``locks`` — lock-registry dump;
+- ``template`` — render templates that re-render on subscription change;
+- ``consul sync`` — Consul bridge loop.
+
+Run as ``python -m corrosion_tpu <command>``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from corrosion_tpu.config import Config, default_toml, load_config
+
+
+def _client(args):
+    from corrosion_tpu.client import CorrosionApiClient
+
+    return CorrosionApiClient(args.api_addr, args.api_port)
+
+
+def _admin(args):
+    from corrosion_tpu.admin import AdminClient
+
+    return AdminClient(args.admin_path)
+
+
+def _params(raw):
+    """CLI params: JSON literals when they parse, raw strings otherwise
+    (so ``--param 10.0.0.2`` stays a string but ``--param 80`` is an int)."""
+    out = []
+    for p in raw:
+        try:
+            out.append(json.loads(p))
+        except json.JSONDecodeError:
+            out.append(p)
+    return out
+
+
+def cmd_agent(args) -> int:
+    from corrosion_tpu.admin import AdminServer
+    from corrosion_tpu.agent import Agent
+    from corrosion_tpu.api import ApiServer
+    from corrosion_tpu.db import Database
+
+    cfg = load_config(args.config) if args.config else Config()
+    agent = Agent(cfg).start(pace_seconds=args.pace)
+    agent.tripwire.hook_signals()
+    db = Database(agent)
+    for path in cfg.db.schema_paths:
+        with open(path) as f:
+            db.apply_schema_sql(f.read())
+    api = ApiServer(db, addr=cfg.api.addr, port=cfg.api.port).start()
+    admin = AdminServer(agent, cfg.admin.uds_path, db=db).start()
+    print(f"agent up: api http://{api.addr}:{api.port} "
+          f"admin {cfg.admin.uds_path} nodes={agent.n_nodes}", flush=True)
+    try:
+        while not agent.tripwire.tripped:
+            agent.tripwire.wait(0.5)
+    finally:
+        admin.stop()
+        api.stop()
+        agent.shutdown()
+    return 0
+
+
+def cmd_exec(args) -> int:
+    with_params = [(args.sql, _params(args.param))] if args.param else [args.sql]
+    results = _client(args).execute(with_params, node=args.node)
+    for r in results:
+        print(json.dumps(r))
+    return 0
+
+
+def cmd_query(args) -> int:
+    client = _client(args)
+    stmt = (args.sql, _params(args.param)) if args.param else (args.sql, None)
+    if args.follow:
+        stream = client.subscribe(stmt[0], stmt[1], node=args.node)
+        try:
+            for event in stream:
+                print(json.dumps(event), flush=True)
+        except KeyboardInterrupt:
+            stream.close()
+        return 0
+    cols, rows = client.query(stmt[0], stmt[1], node=args.node)
+    if args.columns:
+        print("\t".join(cols))
+    for row in rows:
+        print("\t".join(json.dumps(v) if not isinstance(v, str) else v
+                        for v in row))
+    return 0
+
+
+def cmd_sync(args) -> int:
+    with _admin(args) as admin:
+        out = admin.call("sync", **({"node": args.node}
+                                    if args.node is not None else {}))
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+def cmd_cluster(args) -> int:
+    with _admin(args) as admin:
+        if args.cluster_cmd == "members":
+            print(json.dumps(admin.call("cluster_members"), indent=2))
+        elif args.cluster_cmd == "rejoin":
+            admin.call("cluster_rejoin", node=args.node)
+            print("ok")
+        elif args.cluster_cmd == "set-id":
+            print(json.dumps(admin.call("cluster_set_id",
+                                        cluster_id=args.cluster_id)))
+    return 0
+
+
+def cmd_locks(args) -> int:
+    with _admin(args) as admin:
+        print(json.dumps(admin.call("locks", top=args.top), indent=2))
+    return 0
+
+
+def cmd_backup(args) -> int:
+    with _admin(args) as admin:
+        path = admin.call("backup", path=args.path, node=args.node)
+    print(path)
+    return 0
+
+
+def cmd_restore(args) -> int:
+    with _admin(args) as admin:
+        if args.full:
+            out = admin.call("restore", path=args.path)
+        else:
+            out = admin.call(
+                "restore_backup", path=args.path,
+                **({"node": args.node} if args.node is not None else {}),
+            )
+    print(json.dumps(out))
+    return 0
+
+
+def cmd_checkpoint(args) -> int:
+    with _admin(args) as admin:
+        print(admin.call("checkpoint", path=args.path))
+    return 0
+
+
+def cmd_template(args) -> int:
+    from corrosion_tpu.tpl import render_template_cli
+
+    return render_template_cli(args)
+
+
+def cmd_consul(args) -> int:
+    from corrosion_tpu.consul import consul_sync_cli
+
+    return consul_sync_cli(args)
+
+
+def cmd_default_config(args) -> int:
+    print(default_toml())
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="corrosion-tpu",
+        description="TPU-native gossip/CRDT cluster simulator",
+    )
+    p.add_argument("--api-addr", default="127.0.0.1")
+    p.add_argument("--api-port", type=int, default=8787)
+    p.add_argument("--admin-path", default="./admin.sock")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    a = sub.add_parser("agent", help="run the agent")
+    a.add_argument("-c", "--config", default=None)
+    a.add_argument("--pace", type=float, default=0.05,
+                   help="seconds per round (0 = flat out)")
+    a.set_defaults(fn=cmd_agent)
+
+    e = sub.add_parser("exec", help="execute write statements")
+    e.add_argument("sql")
+    e.add_argument("--param", action="append", default=[])
+    e.add_argument("--node", type=int, default=0)
+    e.set_defaults(fn=cmd_exec)
+
+    q = sub.add_parser("query", help="run a read-only query")
+    q.add_argument("sql")
+    q.add_argument("--param", action="append", default=[])
+    q.add_argument("--node", type=int, default=0)
+    q.add_argument("--columns", action="store_true")
+    q.add_argument("--follow", action="store_true",
+                   help="subscribe and stream changes")
+    q.set_defaults(fn=cmd_query)
+
+    s = sub.add_parser("sync", help="sync state introspection")
+    ssub = s.add_subparsers(dest="sync_cmd", required=True)
+    sg = ssub.add_parser("generate")
+    sg.add_argument("--node", type=int, default=None)
+    sg.set_defaults(fn=cmd_sync)
+
+    c = sub.add_parser("cluster", help="cluster membership ops")
+    csub = c.add_subparsers(dest="cluster_cmd", required=True)
+    csub.add_parser("members").set_defaults(fn=cmd_cluster)
+    cr = csub.add_parser("rejoin")
+    cr.add_argument("--node", type=int, required=True)
+    cr.set_defaults(fn=cmd_cluster)
+    ci = csub.add_parser("set-id")
+    ci.add_argument("cluster_id", type=int)
+    ci.set_defaults(fn=cmd_cluster)
+
+    lk = sub.add_parser("locks", help="lock registry dump")
+    lk.add_argument("--top", type=int, default=10)
+    lk.set_defaults(fn=cmd_locks)
+
+    b = sub.add_parser("backup", help="portable single-node backup")
+    b.add_argument("path")
+    b.add_argument("--node", type=int, default=0)
+    b.set_defaults(fn=cmd_backup)
+
+    r = sub.add_parser("restore", help="restore a backup or checkpoint")
+    r.add_argument("path")
+    r.add_argument("--node", type=int, default=None)
+    r.add_argument("--full", action="store_true",
+                   help="path is a full checkpoint directory")
+    r.set_defaults(fn=cmd_restore)
+
+    ck = sub.add_parser("checkpoint", help="write a full cluster checkpoint")
+    ck.add_argument("path")
+    ck.set_defaults(fn=cmd_checkpoint)
+
+    t = sub.add_parser("template", help="render templates (re-render on change)")
+    t.add_argument("spec", nargs="+", help="template.py:output pairs")
+    t.add_argument("--once", action="store_true")
+    t.add_argument("--node", type=int, default=0)
+    t.set_defaults(fn=cmd_template)
+
+    co = sub.add_parser("consul", help="consul bridge")
+    cosub = co.add_subparsers(dest="consul_cmd", required=True)
+    cs = cosub.add_parser("sync")
+    cs.add_argument("--consul-addr", default="127.0.0.1:8500")
+    cs.add_argument("--once", action="store_true")
+    cs.add_argument("--node", type=int, default=0)
+    cs.set_defaults(fn=cmd_consul)
+
+    d = sub.add_parser("default-config", help="print an example config file")
+    d.set_defaults(fn=cmd_default_config)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except BrokenPipeError:
+        # downstream pager/head closed the pipe — normal unix behavior
+        try:
+            sys.stdout.close()
+        except Exception:  # noqa: BLE001
+            pass
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,303 @@
+"""Subscriptions (pubsub) + table update feeds.
+
+Mirrors the reference's subscription engine (``crates/corro-types/src/
+pubsub.rs:527-1100``) and its lighter sibling, the table updates feed
+(``crates/corro-types/src/updates.rs``):
+
+- a **Matcher** owns one SQL query against one observer node's replica,
+  keeps the last materialized result keyed by pk (the reference keeps it
+  in a dedicated per-subscription SQLite db), and on every round diffs
+  the fresh result against it, emitting ``QueryEvent::Change`` rows with
+  a **monotonic ChangeId** per matcher;
+- subscribers attach live via per-subscriber queues (the tokio broadcast
+  channel analog) and can **catch up from a ChangeId** through the
+  matcher's retained change log (``pubsub.rs:842-878``);
+- the **UpdatesManager** streams row-level ``NotifyEvent``s per table
+  without a query (``/v1/updates/:table``).
+
+Matchers re-poll on the agent's round listener — the seam where the
+reference calls ``match_changes`` on every applied changeset
+(``util.rs:1034-1037``, ``broadcast.rs:539-540``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+from corrosion_tpu.utils.tracing import logger
+
+# change kinds (reference ChangeType)
+UPSERT = "update"
+INSERT = "insert"
+DELETE = "delete"
+
+
+class Matcher:
+    """One subscription query: materialized result + change log."""
+
+    def __init__(self, db, node: int, sql: str, params: Any = None,
+                 sub_id: Optional[str] = None, max_log: int = 4096):
+        self.id = sub_id or uuid.uuid4().hex
+        self.db = db
+        self.node = node
+        self.sql = sql
+        self.params = params
+        self.max_log = max_log
+        # validate the query + capture column names up front
+        cols, _ = db.query(node, sql, params)
+        self.columns: List[str] = list(cols)
+        self._table = self._target_table(sql)
+        self._pk_name = db.schema.table(self._table).pk.name
+        self._state: Dict[Any, Tuple] = {}
+        self._log: List[Tuple[int, str, Any, Optional[List[Any]]]] = []
+        self._log_base = 1  # change id of _log[0]
+        self.last_change_id = 0
+        self._subs: List[queue.Queue] = []
+        self._mu = threading.Lock()
+        self._prime()
+
+    def _target_table(self, sql: str) -> str:
+        import re
+
+        m = re.search(r"\bFROM\s+([\w\"]+)", sql, re.IGNORECASE)
+        assert m, "query must have a FROM clause"
+        return m.group(1).strip('"')
+
+    def _current(self) -> Dict[Any, Tuple]:
+        cols, rows = self.db.query(self.node, self.sql, self.params)
+        pk_idx = cols.index(self._pk_name) if self._pk_name in cols else None
+        out: Dict[Any, Tuple] = {}
+        for i, row in enumerate(rows):
+            key = row[pk_idx] if pk_idx is not None else i
+            out[key] = tuple(row)
+        return out
+
+    def _prime(self) -> None:
+        self._state = self._current()
+
+    # --- diffing ---------------------------------------------------------
+    def poll(self) -> int:
+        """Diff the node's replica against the materialized state; emit
+        change events. Returns the number of events emitted."""
+        fresh = self._current()
+        events = []
+        with self._mu:
+            for key, row in fresh.items():
+                old = self._state.get(key)
+                if old is None:
+                    events.append((INSERT, key, list(row)))
+                elif old != row:
+                    events.append((UPSERT, key, list(row)))
+            for key in self._state:
+                if key not in fresh:
+                    events.append((DELETE, key, None))
+            self._state = fresh
+            out = []
+            for kind, key, row in events:
+                self.last_change_id += 1
+                rec = (self.last_change_id, kind, key, row)
+                self._log.append(rec)
+                out.append(rec)
+            if len(self._log) > self.max_log:
+                drop = len(self._log) - self.max_log
+                self._log = self._log[drop:]
+                self._log_base += drop
+            subs = list(self._subs)
+        for rec in out:
+            for q in subs:
+                q.put(("change", rec))
+        return len(out)
+
+    # --- subscriber attach/detach ---------------------------------------
+    def attach(self, from_change_id: Optional[int] = None) -> queue.Queue:
+        """A live event queue, optionally preloaded with the catch-up
+        backlog from ``from_change_id`` (exclusive). If the backlog has
+        been GC'd past that id, the subscriber gets a full re-dump
+        (columns + rows), like the reference's query restart."""
+        q: queue.Queue = queue.Queue(maxsize=65536)
+        with self._mu:
+            q.put(("columns", self.columns))
+            if from_change_id is None:
+                for key, row in self._state.items():
+                    q.put(("row", (key, list(row))))
+                q.put(("eoq", self.last_change_id))
+            elif from_change_id + 1 >= self._log_base:
+                for rec in self._log[from_change_id + 1 - self._log_base:]:
+                    q.put(("change", rec))
+            else:
+                # backlog GC'd: full resync
+                for key, row in self._state.items():
+                    q.put(("row", (key, list(row))))
+                q.put(("eoq", self.last_change_id))
+            self._subs.append(q)
+        return q
+
+    def detach(self, q: queue.Queue) -> None:
+        with self._mu:
+            if q in self._subs:
+                self._subs.remove(q)
+
+    @property
+    def n_subscribers(self) -> int:
+        return len(self._subs)
+
+    # --- persistence (pubsub.rs stores matcher SQL + state on disk) ------
+    def manifest(self) -> dict:
+        return {"id": self.id, "node": self.node, "sql": self.sql,
+                "params": self.params, "last_change_id": self.last_change_id}
+
+
+class SubsManager:
+    """All matchers of one agent; re-polls them after every round."""
+
+    def __init__(self, db, persist_dir: Optional[str] = None):
+        self.db = db
+        self.persist_dir = persist_dir
+        self._matchers: Dict[str, Matcher] = {}
+        self._by_query: Dict[Tuple, str] = {}
+        self._mu = threading.Lock()
+        db.agent.add_round_listener(self._on_round)
+        if persist_dir:
+            os.makedirs(persist_dir, exist_ok=True)
+
+    def _on_round(self, round_no: int) -> None:
+        for m in list(self._matchers.values()):
+            try:
+                m.poll()
+            except Exception:  # noqa: BLE001 — a bad matcher must not stall rounds
+                logger.exception("matcher %s poll failed", m.id)
+
+    def subscribe(self, node: int, sql: str, params: Any = None
+                  ) -> Tuple[Matcher, bool]:
+        """Get-or-create a matcher (the reference dedupes identical query
+        subs onto one matcher). Returns (matcher, created)."""
+        key = (node, sql, json.dumps(params, sort_keys=True, default=str))
+        with self._mu:
+            mid = self._by_query.get(key)
+            if mid is not None:
+                return self._matchers[mid], False
+            m = Matcher(self.db, node, sql, params)
+            self._matchers[m.id] = m
+            self._by_query[key] = m.id
+            self._persist(m)
+            return m, True
+
+    def get(self, sub_id: str) -> Optional[Matcher]:
+        return self._matchers.get(sub_id)
+
+    def unsubscribe(self, sub_id: str) -> bool:
+        with self._mu:
+            m = self._matchers.pop(sub_id, None)
+            if m is None:
+                return False
+            self._by_query = {k: v for k, v in self._by_query.items()
+                              if v != sub_id}
+            if self.persist_dir:
+                path = os.path.join(self.persist_dir, f"{sub_id}.json")
+                if os.path.exists(path):
+                    os.unlink(path)
+            return True
+
+    def ids(self) -> List[str]:
+        return list(self._matchers)
+
+    def _persist(self, m: Matcher) -> None:
+        if not self.persist_dir:
+            return
+        with open(os.path.join(self.persist_dir, f"{m.id}.json"), "w") as f:
+            json.dump(m.manifest(), f)
+
+    def restore(self) -> int:
+        """Recreate persisted matchers (boot hook, ``setup.rs:291-344``)."""
+        if not self.persist_dir or not os.path.isdir(self.persist_dir):
+            return 0
+        n = 0
+        for name in sorted(os.listdir(self.persist_dir)):
+            if not name.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(self.persist_dir, name)) as f:
+                    man = json.load(f)
+                m = Matcher(self.db, man["node"], man["sql"], man["params"],
+                            sub_id=man["id"])
+                with self._mu:
+                    self._matchers[m.id] = m
+                    key = (m.node, m.sql,
+                           json.dumps(m.params, sort_keys=True, default=str))
+                    self._by_query[key] = m.id
+                n += 1
+            except Exception:  # noqa: BLE001
+                logger.exception("failed to restore subscription %s", name)
+        return n
+
+
+class UpdatesManager:
+    """Row-level per-table feeds (``updates.rs:61-250``): each table feed
+    diffs pk liveness + row content every round and emits
+    ``NotifyEvent {kind, pk}``."""
+
+    def __init__(self, db, node: int = 0):
+        self.db = db
+        self.node = node
+        self._feeds: Dict[str, List[queue.Queue]] = {}
+        self._state: Dict[str, Dict[Any, Tuple]] = {}
+        self._mu = threading.Lock()
+        db.agent.add_round_listener(self._on_round)
+
+    def attach(self, table: str) -> queue.Queue:
+        self.db.schema.table(table)  # raises on unknown table
+        q: queue.Queue = queue.Queue(maxsize=65536)
+        with self._mu:
+            if table not in self._feeds:
+                self._state[table] = self._snapshot_table(table)
+            self._feeds.setdefault(table, []).append(q)
+        return q
+
+    def detach(self, table: str, q: queue.Queue) -> None:
+        with self._mu:
+            if table in self._feeds and q in self._feeds[table]:
+                self._feeds[table].remove(q)
+                if not self._feeds[table]:
+                    del self._feeds[table]
+                    del self._state[table]
+
+    def _snapshot_table(self, table: str) -> Dict[Any, Tuple]:
+        t = self.db.schema.table(table)
+        cols = [c.name for c in t.columns]
+        sql = f"SELECT {', '.join(cols)} FROM {table}"
+        _, rows = self.db.query(self.node, sql)
+        pk_idx = cols.index(t.pk.name)
+        return {row[pk_idx]: tuple(row) for row in rows}
+
+    def _on_round(self, round_no: int) -> None:
+        with self._mu:
+            tables = list(self._feeds)
+        for table in tables:
+            try:
+                fresh = self._snapshot_table(table)
+            except Exception:  # noqa: BLE001
+                logger.exception("updates feed poll failed for %s", table)
+                continue
+            with self._mu:
+                old = self._state.get(table)
+                if old is None:
+                    continue
+                events = []
+                for pk, row in fresh.items():
+                    if pk not in old:
+                        events.append((INSERT, pk))
+                    elif old[pk] != row:
+                        events.append((UPSERT, pk))
+                for pk in old:
+                    if pk not in fresh:
+                        events.append((DELETE, pk))
+                self._state[table] = fresh
+                subs = list(self._feeds.get(table, ()))
+            for ev in events:
+                for q in subs:
+                    q.put(("notify", ev))

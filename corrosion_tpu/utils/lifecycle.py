@@ -84,9 +84,11 @@ def backoff(
     jitter: float = 0.25,
     rng: Optional[random.Random] = None,
 ) -> Iterator[float]:
-    """Jittered exponential backoff delays (``backoff`` crate analog)."""
-    rng = rng or random.Random()
-    delay = base
-    while True:
-        yield min(max_delay, delay) * (1.0 + jitter * (2 * rng.random() - 1))
-        delay = min(max_delay, delay * factor)
+    """Jittered exponential backoff delays (``backoff`` crate analog).
+
+    Thin generator facade over :class:`corrosion_tpu.utils.backoff.Backoff`
+    for call sites that just want delays."""
+    from corrosion_tpu.utils.backoff import Backoff
+
+    yield from Backoff(min_wait=base, max_wait=max_delay, factor=factor,
+                       jitter=jitter, rng=rng)

@@ -1,0 +1,137 @@
+"""Admin UDS socket + CLI surface (``corro-admin`` + the ``corrosion``
+binary's command enum)."""
+
+import json
+
+import pytest
+
+from corrosion_tpu import cli
+from corrosion_tpu.admin import AdminClient, AdminServer
+from corrosion_tpu.agent import Agent
+from corrosion_tpu.api import ApiServer
+from corrosion_tpu.config import Config
+from corrosion_tpu.db import Database
+
+SCHEMA = "CREATE TABLE kv (k TEXT PRIMARY KEY, v INTEGER);"
+
+
+def rig_config():
+    cfg = Config()
+    cfg.sim.mode = "scale"
+    cfg.sim.n_nodes = 16
+    cfg.sim.m_slots = 8
+    cfg.sim.n_origins = 4
+    cfg.sim.n_rows = 8
+    cfg.sim.n_cols = 4
+    cfg.perf.sync_interval = 4
+    cfg.gossip.drop_prob = 0.0
+    return cfg
+
+
+@pytest.fixture(scope="module")
+def rig(tmp_path_factory):
+    uds = str(tmp_path_factory.mktemp("adm") / "admin.sock")
+    with Agent(rig_config()) as agent:
+        agent.wait_rounds(10, timeout=120)
+        db = Database(agent)
+        db.apply_schema_sql(SCHEMA)
+        db.execute(0, [("INSERT INTO kv (k, v) VALUES ('a', 1)",)])
+        with ApiServer(db, port=0) as api, AdminServer(agent, uds, db=db):
+            yield agent, db, api, uds
+
+
+def test_admin_ping_and_members(rig):
+    _, _, _, uds = rig
+    with AdminClient(uds) as admin:
+        assert admin.call("ping") == "pong"
+        members = admin.call("cluster_members")
+        assert len(members) == 16
+        assert admin.call("cluster_set_id", cluster_id=3) == 3
+
+
+def test_admin_sync_and_actor_version(rig):
+    agent, _, _, uds = rig
+    with AdminClient(uds) as admin:
+        state = admin.call("sync", node=0)
+        assert state["actor_id"] == 0
+        ver = admin.call("actor_version", node=0, origin=0)
+        assert ver["head"] >= 1  # we wrote at node 0
+        all_states = admin.call("sync")
+        assert len(all_states) == agent.n_nodes
+
+
+def test_admin_locks_and_log(rig):
+    _, _, _, uds = rig
+    with AdminClient(uds) as admin:
+        locks = admin.call("locks", top=5)
+        assert isinstance(locks, list)
+        assert admin.call("log", level="debug") == "debug"
+        admin.call("log", level="info")
+
+
+def test_admin_fault_injection(rig):
+    agent, _, _, uds = rig
+    victim = agent.n_nodes - 1
+    with AdminClient(uds) as admin:
+        admin.call("kill", node=victim)
+        agent.wait_rounds(2, timeout=60)
+        assert not bool(agent.snapshot()["alive"][victim])
+        admin.call("cluster_rejoin", node=victim)
+        agent.wait_rounds(2, timeout=60)
+        assert bool(agent.snapshot()["alive"][victim])
+        admin.call("partition", groups=[i % 2 for i in range(agent.n_nodes)])
+        admin.call("heal")
+    with AdminClient(uds) as admin:
+        with pytest.raises(RuntimeError):
+            admin.call("no_such_command")
+
+
+def test_admin_checkpoint_backup(tmp_path, rig):
+    _, _, _, uds = rig
+    with AdminClient(uds) as admin:
+        ck = admin.call("checkpoint", path=str(tmp_path / "ck"))
+        assert ck.endswith("ck")
+        b = admin.call("backup", path=str(tmp_path / "b.npz"), node=0)
+        out = admin.call("restore_backup", path=b, node=2)
+        assert out["node"] == 2
+        restored = admin.call("restore", path=ck)
+        assert "round" in restored
+
+
+def test_cli_exec_query_sync(rig, capsys):
+    _, _, api, uds = rig
+    base = ["--api-addr", api.addr, "--api-port", str(api.port),
+            "--admin-path", uds]
+    assert cli.main(base + ["exec", "INSERT INTO kv (k, v) VALUES ('c', 3)"]) == 0
+    out = capsys.readouterr().out
+    assert json.loads(out.splitlines()[-1])["rows_affected"] == 1
+
+    assert cli.main(base + ["query", "SELECT k, v FROM kv", "--columns"]) == 0
+    out = capsys.readouterr().out
+    assert out.splitlines()[0] == "k\tv"
+    assert any("c\t3" in line for line in out.splitlines())
+
+    assert cli.main(base + ["sync", "generate", "--node", "0"]) == 0
+    assert json.loads(capsys.readouterr().out)["actor_id"] == 0
+
+    assert cli.main(base + ["cluster", "members"]) == 0
+    assert len(json.loads(capsys.readouterr().out)) == 16
+
+    assert cli.main(base + ["locks", "--top", "3"]) == 0
+    capsys.readouterr()
+
+    assert cli.main(base + ["default-config"]) == 0
+    assert "[gossip]" in capsys.readouterr().out
+
+
+def test_cli_backup_restore(tmp_path, rig, capsys):
+    _, _, api, uds = rig
+    base = ["--api-addr", api.addr, "--api-port", str(api.port),
+            "--admin-path", uds]
+    assert cli.main(base + ["backup", str(tmp_path / "cli_b.npz")]) == 0
+    path = capsys.readouterr().out.strip()
+    assert cli.main(base + ["restore", path, "--node", "1"]) == 0
+    assert json.loads(capsys.readouterr().out)["node"] == 1
+    assert cli.main(base + ["checkpoint", str(tmp_path / "cli_ck")]) == 0
+    ck = capsys.readouterr().out.strip()
+    assert cli.main(base + ["restore", ck, "--full"]) == 0

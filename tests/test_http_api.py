@@ -1,0 +1,195 @@
+"""HTTP API + client + pubsub: the reference's public REST surface tests
+(``api/public/mod.rs`` + ``api/public/pubsub.rs`` + ``corro-client``)."""
+
+import threading
+
+import pytest
+
+from corrosion_tpu.agent import Agent
+from corrosion_tpu.api import ApiServer
+from corrosion_tpu.client import ApiError, CorrosionApiClient
+from corrosion_tpu.config import Config
+from corrosion_tpu.db import Database
+from corrosion_tpu.pubsub import SubsManager, UpdatesManager
+
+SCHEMA = """
+CREATE TABLE svc (
+    name TEXT PRIMARY KEY,
+    addr TEXT,
+    port INTEGER
+);
+"""
+
+
+def api_config():
+    cfg = Config()
+    cfg.sim.mode = "scale"
+    cfg.sim.n_nodes = 16
+    cfg.sim.m_slots = 8
+    cfg.sim.n_origins = 4
+    cfg.sim.n_rows = 8
+    cfg.sim.n_cols = 4
+    cfg.perf.sync_interval = 4
+    cfg.gossip.drop_prob = 0.0
+    return cfg
+
+
+@pytest.fixture(scope="module")
+def rig():
+    with Agent(api_config()) as agent:
+        agent.wait_rounds(10, timeout=120)
+        db = Database(agent)
+        server = ApiServer(db, port=0)
+        with server:
+            client = CorrosionApiClient(server.addr, server.port)
+            client.schema([SCHEMA])
+            yield agent, db, server, client
+
+
+def test_migrations_and_transactions(rig):
+    _, _, _, client = rig
+    results = client.execute([
+        ("INSERT INTO svc (name, addr, port) VALUES (?, ?, ?)",
+         ["web", "10.0.0.1", 80]),
+        "INSERT INTO svc (name, addr, port) VALUES ('api', '10.0.0.2', 443)",
+    ])
+    assert [r["rows_affected"] for r in results] == [1, 1]
+
+
+def test_query_roundtrip(rig):
+    _, _, _, client = rig
+    cols, rows = client.query("SELECT name, port FROM svc WHERE port >= ?", [80])
+    assert cols == ["name", "port"]
+    assert sorted(rows) == [["api", 443], ["web", 80]]
+
+
+def test_query_errors(rig):
+    _, _, _, client = rig
+    with pytest.raises(ApiError) as e:
+        client.query("DELETE FROM svc WHERE name = 'web'")
+    assert e.value.status == 400
+    with pytest.raises(ApiError):
+        client.execute(["SELECT * FROM svc"])
+    with pytest.raises(ApiError):
+        client.query("SELECT * FROM nope")
+
+
+def test_subscription_snapshot_and_changes(rig):
+    agent, _, _, client = rig
+    stream = client.subscribe("SELECT name, port FROM svc")
+    assert stream.id
+    events = iter(stream)
+    # initial snapshot: columns, rows..., eoq
+    first = next(events)
+    assert first == {"columns": ["name", "port"]}
+    seen_rows = []
+    for ev in events:
+        if "eoq" in ev:
+            break
+        seen_rows.append(ev["row"][1])
+    assert ["web", 80] in seen_rows
+    # live change arrives after a write + a round
+    done = threading.Event()
+    got = {}
+
+    def reader():
+        for ev in events:
+            if "change" in ev:
+                got["change"] = ev["change"]
+                done.set()
+                return
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    client.execute([("UPDATE svc SET port = ? WHERE name = ?", [8080, "web"])])
+    agent.wait_rounds(3, timeout=60)
+    assert done.wait(30), "no change event received"
+    kind, key, row, change_id = got["change"]
+    assert key == "web" and row == ["web", 8080] and change_id >= 1
+    assert stream.last_change_id == change_id
+    stream.close()
+
+
+def test_subscription_resume(rig):
+    agent, _, server, client = rig
+    s1 = client.subscribe("SELECT name, port FROM svc")
+    for ev in s1:
+        if "eoq" in ev:
+            break
+    s1.last_change_id = s1.last_change_id or 0
+    s1.close()
+    # write while detached, then resume from the last seen id
+    client.execute([("UPDATE svc SET port = ? WHERE name = ?", [9999, "api"])])
+    assert agent.wait_rounds(3, timeout=60)
+    matcher = server.subs.get(s1.id)
+    assert matcher is not None
+    deadline = 50
+    while matcher.last_change_id <= (s1.last_change_id or 0) and deadline:
+        agent.wait_rounds(1, timeout=30)
+        deadline -= 1
+    s2 = client.resubscribe(s1)
+    got_change = False
+    for ev in s2:
+        if "change" in ev and ev["change"][1] == "api":
+            got_change = True
+            break
+        if "eoq" in ev:
+            break  # backlog was GC'd -> full resync path
+    s2.close()
+    assert got_change or matcher.last_change_id > 0
+
+
+def test_updates_feed(rig):
+    agent, _, _, client = rig
+    stream = client.updates("svc")
+    got = {}
+    done = threading.Event()
+
+    def reader():
+        for ev in stream:
+            if "notify" in ev:
+                got["ev"] = ev["notify"]
+                done.set()
+                return
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    client.execute([
+        ("INSERT INTO svc (name, addr, port) VALUES ('cache', 'x', 11211)",)
+    ])
+    agent.wait_rounds(3, timeout=60)
+    assert done.wait(30), "no notify event received"
+    kind, pk = got["ev"]
+    assert pk == "cache" and kind in ("insert", "update")
+    stream.close()
+
+
+def test_updates_unknown_table(rig):
+    _, _, _, client = rig
+    with pytest.raises(ApiError):
+        next(iter(client.updates("nope")))
+
+
+def test_introspection_endpoints(rig):
+    _, _, _, client = rig
+    stats = client.table_stats()
+    assert stats["svc"]["live"] >= 2
+    members = client.members()
+    assert len(members) == 16
+    sync = client.sync_state(3)
+    assert sync["actor_id"] == 3
+    assert "corro_tpu" in client.metrics() or "round" in client.metrics()
+
+
+def test_subs_manager_dedupe_and_persistence(tmp_path, rig):
+    agent, db, _, _ = rig
+    mgr = SubsManager(db, persist_dir=str(tmp_path))
+    m1, created1 = mgr.subscribe(0, "SELECT name FROM svc")
+    m2, created2 = mgr.subscribe(0, "SELECT name FROM svc")
+    assert created1 and not created2 and m1.id == m2.id
+    # restore into a fresh manager
+    mgr2 = SubsManager(db, persist_dir=str(tmp_path))
+    assert mgr2.restore() == 1
+    assert mgr2.get(m1.id) is not None
+    assert mgr.unsubscribe(m1.id)
+    assert not mgr.unsubscribe(m1.id)

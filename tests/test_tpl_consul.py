@@ -1,0 +1,141 @@
+"""Template engine + Consul bridge (``corro-tpl`` / ``corrosion consul
+sync``)."""
+
+import http.server
+import json
+import threading
+
+import pytest
+
+from corrosion_tpu.agent import Agent
+from corrosion_tpu.api import ApiServer
+from corrosion_tpu.client import CorrosionApiClient
+from corrosion_tpu.config import Config
+from corrosion_tpu.consul import CONSUL_SCHEMA, ConsulClient, ConsulSync
+from corrosion_tpu.db import Database
+from corrosion_tpu.tpl import TemplateRunner, render_template
+
+SCHEMA = "CREATE TABLE svc (name TEXT PRIMARY KEY, addr TEXT, port INTEGER);"
+
+
+def rig_config():
+    cfg = Config()
+    cfg.sim.mode = "scale"
+    cfg.sim.n_nodes = 16
+    cfg.sim.m_slots = 8
+    cfg.sim.n_origins = 4
+    cfg.sim.n_rows = 8
+    cfg.sim.n_cols = 4
+    cfg.perf.sync_interval = 4
+    cfg.gossip.drop_prob = 0.0
+    return cfg
+
+
+@pytest.fixture(scope="module")
+def rig():
+    with Agent(rig_config()) as agent:
+        agent.wait_rounds(10, timeout=120)
+        db = Database(agent)
+        db.apply_schema_sql(SCHEMA)
+        db.execute(0, [
+            ("INSERT INTO svc (name, addr, port) VALUES ('web', '10.0.0.1', 80)",),
+        ])
+        with ApiServer(db, port=0) as api:
+            client = CorrosionApiClient(api.addr, api.port)
+            yield agent, db, client
+
+
+TEMPLATE = """
+rows = sql("SELECT name, addr, port FROM svc")
+for r in sorted(rows, key=lambda r: r["name"]):
+    write(f"upstream {r['name']} {{ server {r['addr']}:{r['port']}; }}\\n")
+write("# host: " + hostname() + "\\n")
+"""
+
+
+def test_render_template(rig):
+    _, db, _ = rig
+    out, queries = render_template(
+        TEMPLATE, lambda q, p: db.query(0, q, p)
+    )
+    assert "upstream web { server 10.0.0.1:80; }" in out
+    assert len(queries) == 1
+
+
+def test_template_runner_rerender(tmp_path, rig):
+    agent, db, client = rig
+    src = tmp_path / "t.py"
+    dst = tmp_path / "out.conf"
+    src.write_text(TEMPLATE)
+    runner = TemplateRunner(client, [f"{src}:{dst}"])
+    runner.render_all()
+    first = dst.read_text()
+    assert "web" in first
+    # change the data; a re-render pass must pick it up
+    client.execute([
+        ("INSERT INTO svc (name, addr, port) VALUES ('api', '10.0.0.9', 443)",)
+    ])
+    agent.wait_rounds(3, timeout=60)
+    runner.render_all()
+    assert "api" in dst.read_text()
+
+
+def test_template_bad_spec(rig):
+    _, _, client = rig
+    with pytest.raises(ValueError):
+        TemplateRunner(client, ["no-colon-spec"])
+
+
+# --- consul bridge --------------------------------------------------------
+
+class FakeConsul(http.server.BaseHTTPRequestHandler):
+    services = {"web-1": {"Service": "web", "Port": 80}}
+    checks = {"web-1-check": {"Status": "passing"}}
+
+    def do_GET(self):
+        if self.path == "/v1/agent/services":
+            body = json.dumps(self.services).encode()
+        elif self.path == "/v1/agent/checks":
+            body = json.dumps(self.checks).encode()
+        else:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture()
+def fake_consul():
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), FakeConsul)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield f"127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+    httpd.server_close()
+
+
+def test_consul_sync(rig, fake_consul):
+    agent, db, client = rig
+    client.schema([CONSUL_SCHEMA])
+    sync = ConsulSync(
+        ConsulClient(fake_consul),
+        execute=lambda stmts, node: client.execute(stmts, node=node),
+    )
+    n_svc, n_chk = sync.sync_once()
+    assert (n_svc, n_chk) == (1, 1)
+    row = db.read_row(0, "consul_services", "web-1")
+    assert row is not None and json.loads(row["data"])["Port"] == 80
+    # unchanged poll -> no writes
+    assert sync.sync_once() == (0, 0)
+    # removal -> delete
+    FakeConsul.services = {}
+    n_svc, _ = sync.sync_once()
+    assert n_svc == 1
+    agent.wait_rounds(2, timeout=60)
+    assert db.read_row(0, "consul_services", "web-1") is None
