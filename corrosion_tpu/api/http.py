@@ -286,6 +286,9 @@ def _make_handler(server: ApiServer):
                     try:
                         kind, payload = sub_q.get(timeout=1.0)
                     except queue.Empty:
+                        if sub_q.lagged:
+                            # slow consumer was disconnected by the matcher
+                            break
                         continue
                     if kind == "columns":
                         self._ndjson_line({"columns": payload})
@@ -318,6 +321,8 @@ def _make_handler(server: ApiServer):
                     try:
                         kind, payload = feed_q.get(timeout=1.0)
                     except queue.Empty:
+                        if feed_q.lagged:
+                            break
                         continue
                     ckind, pk = payload
                     self._ndjson_line({"notify": [ckind, _encode_value(pk)]})

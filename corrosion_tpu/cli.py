@@ -61,6 +61,17 @@ def cmd_agent(args) -> int:
     from corrosion_tpu.db import Database
 
     cfg = load_config(args.config) if args.config else Config()
+    # validate listener addresses BEFORE anything starts, so a config typo
+    # cannot strand half-booted servers
+    prom_hostport = None
+    if cfg.telemetry.prometheus_addr:
+        host, sep, port = cfg.telemetry.prometheus_addr.rpartition(":")
+        if not sep or not port.isdigit():
+            raise SystemExit(
+                f"telemetry.prometheus_addr must be host:port "
+                f"(got {cfg.telemetry.prometheus_addr!r})"
+            )
+        prom_hostport = (host or "127.0.0.1", int(port))
     agent = Agent(cfg).start(pace_seconds=args.pace)
     agent.tripwire.hook_signals()
     db = Database(agent)
@@ -69,14 +80,31 @@ def cmd_agent(args) -> int:
             db.apply_schema_sql(f.read())
     api = ApiServer(db, addr=cfg.api.addr, port=cfg.api.port).start()
     admin = AdminServer(agent, cfg.admin.uds_path, db=db).start()
+    pg = None
+    if cfg.pg.enabled:
+        from corrosion_tpu.pg import PgServer
+
+        pg = PgServer(db, addr=cfg.pg.addr, port=cfg.pg.port).start()
+    prom = None
+    if prom_hostport:
+        from corrosion_tpu.utils.metrics import start_prometheus_listener
+
+        prom = start_prometheus_listener(agent.metrics, *prom_hostport)
+    extras = (f" pg {pg.addr}:{pg.port}" if pg else "") + (
+        f" prometheus {cfg.telemetry.prometheus_addr}" if prom else "")
     print(f"agent up: api http://{api.addr}:{api.port} "
-          f"admin {cfg.admin.uds_path} nodes={agent.n_nodes}", flush=True)
+          f"admin {cfg.admin.uds_path}{extras} nodes={agent.n_nodes}",
+          flush=True)
     try:
         while not agent.tripwire.tripped:
             agent.tripwire.wait(0.5)
     finally:
         admin.stop()
         api.stop()
+        if pg:
+            pg.stop()
+        if prom:
+            prom.shutdown()
         agent.shutdown()
     return 0
 
@@ -104,9 +132,16 @@ def cmd_query(args) -> int:
     if args.columns:
         print("\t".join(cols))
     for row in rows:
-        print("\t".join(json.dumps(v) if not isinstance(v, str) else v
-                        for v in row))
+        print("\t".join(_fmt_cell(v) for v in row))
     return 0
+
+
+def _fmt_cell(v) -> str:
+    if isinstance(v, str):
+        return v
+    if isinstance(v, bytes):
+        return "x'" + v.hex() + "'"
+    return json.dumps(v)
 
 
 def cmd_sync(args) -> int:

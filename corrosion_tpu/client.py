@@ -92,11 +92,12 @@ class CorrosionApiClient:
         self.timeout = timeout
 
     # --- plumbing --------------------------------------------------------
-    def _connect(self, timeout: Optional[float] = None
-                 ) -> http.client.HTTPConnection:
+    _UNSET = object()  # sentinel: None must mean "no timeout" (endless streams)
+
+    def _connect(self, timeout=_UNSET) -> http.client.HTTPConnection:
         return http.client.HTTPConnection(
             self.addr, self.port,
-            timeout=self.timeout if timeout is None else timeout,
+            timeout=self.timeout if timeout is self._UNSET else timeout,
         )
 
     def _request_json(self, method: str, path: str, body: Any = None) -> Any:
@@ -117,7 +118,7 @@ class CorrosionApiClient:
             conn.close()
 
     def _request_stream(self, method: str, path: str, body: Any = None,
-                        stream_timeout: Optional[float] = None):
+                        stream_timeout=_UNSET):
         conn = self._connect(timeout=stream_timeout)
         payload = None if body is None else json.dumps(body)
         conn.request(method, path, body=payload,

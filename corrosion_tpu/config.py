@@ -83,6 +83,15 @@ class SimConfigSection:
 
 
 @dataclasses.dataclass
+class PgConfig:
+    """PostgreSQL wire listener (``config.rs`` ``api.pg``)."""
+
+    enabled: bool = False
+    addr: str = "127.0.0.1"
+    port: int = 5432
+
+
+@dataclasses.dataclass
 class AdminConfig:
     """UDS admin socket (``config.rs`` ``admin.uds_path``)."""
 
@@ -117,6 +126,7 @@ class Config:
     gossip: GossipConfig = dataclasses.field(default_factory=GossipConfig)
     perf: PerfConfig = dataclasses.field(default_factory=PerfConfig)
     sim: SimConfigSection = dataclasses.field(default_factory=SimConfigSection)
+    pg: PgConfig = dataclasses.field(default_factory=PgConfig)
     admin: AdminConfig = dataclasses.field(default_factory=AdminConfig)
     telemetry: TelemetryConfig = dataclasses.field(default_factory=TelemetryConfig)
     log: LogConfig = dataclasses.field(default_factory=LogConfig)

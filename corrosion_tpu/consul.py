@@ -72,19 +72,32 @@ class ConsulSync:
         self._stop = threading.Event()
 
     def sync_once(self) -> Tuple[int, int]:
-        """One poll: returns (services_changed, checks_changed)."""
+        """One poll: returns (services_changed, checks_changed). The
+        applied-hash caches only advance after the write succeeds, so a
+        failed transaction is retried on the next poll."""
         services = self.consul.agent_services()
         checks = self.consul.agent_checks()
-        stmts = []
-        n_svc = self._diff("consul_services", services, self._svc_hashes, stmts)
-        n_chk = self._diff("consul_checks", checks, self._chk_hashes, stmts)
+        stmts: list = []
+        svc_updates = self._diff("consul_services", services,
+                                 self._svc_hashes, stmts)
+        chk_updates = self._diff("consul_checks", checks,
+                                 self._chk_hashes, stmts)
         if stmts:
             self.execute(stmts, self.node)
-        return n_svc, n_chk
+        for cache, updates in ((self._svc_hashes, svc_updates),
+                               (self._chk_hashes, chk_updates)):
+            for cid, h in updates.items():
+                if h is None:
+                    cache.pop(cid, None)
+                else:
+                    cache[cid] = h
+        return len(svc_updates), len(chk_updates)
 
     def _diff(self, table: str, fresh: Dict[str, dict],
-              cache: Dict[str, str], stmts: list) -> int:
-        n = 0
+              cache: Dict[str, str], stmts: list) -> Dict[str, Optional[str]]:
+        """-> proposed cache updates (id -> hash, None = removal); applied
+        by the caller only after the statements commit."""
+        updates: Dict[str, Optional[str]] = {}
         for cid, obj in fresh.items():
             h = _hash(obj)
             if cache.get(cid) == h:
@@ -93,14 +106,12 @@ class ConsulSync:
                 f"INSERT INTO {table} (id, data, hash) VALUES (?, ?, ?)",
                 [cid, json.dumps(obj, sort_keys=True), h],
             ))
-            cache[cid] = h
-            n += 1
-        for cid in list(cache):
+            updates[cid] = h
+        for cid in cache:
             if cid not in fresh:
                 stmts.append((f"DELETE FROM {table} WHERE id = ?", [cid]))
-                del cache[cid]
-                n += 1
-        return n
+                updates[cid] = None
+        return updates
 
     def run(self, poll_seconds: float = 1.0) -> None:
         """Poll forever with backoff on consul errors (the reference

@@ -1,0 +1,486 @@
+"""PostgreSQL wire-protocol (v3) server.
+
+Mirrors ``crates/corro-pg`` (``corro-pg/src/lib.rs``, ~4k LoC): optional
+``api.pg`` listeners speak the PostgreSQL frontend/backend protocol —
+startup (incl. SSLRequest refusal), simple query, and the extended
+protocol (Parse/Bind/Describe/Execute/Sync/Close with prepared
+statements + portals) — translating PG SQL onto the local store, so any
+PG client can read and write the cluster. Writes ride the same statement
+path as the HTTP API (the reference routes them through
+``insert_local_changes``/``broadcast_changes``); reads observe one
+node's replica.
+
+Simplifications vs the reference: values are returned in text format
+with a minimal OID mapping (int8/float8/text/bytea); the ``pg_catalog``
+virtual tables are answered as empty result sets (the reference fakes
+``pg_type``/``pg_class``/... with vtabs, ``src/vtab/pg_*.rs``);
+transactions are statement-local (``BEGIN``/``COMMIT``/``ROLLBACK`` are
+accepted no-ops), matching the eventual-consistency write model.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from corrosion_tpu.db.database import SqlError
+from corrosion_tpu.db.schema import SchemaError
+from corrosion_tpu.utils.tracing import logger
+
+PROTO_V3 = 196608
+SSL_REQUEST = 80877103
+CANCEL_REQUEST = 80877102
+
+# minimal OID map (values always travel in text format)
+OID_INT8 = 20
+OID_FLOAT8 = 701
+OID_TEXT = 25
+OID_BYTEA = 17
+
+# SQLSTATE codes (corro-pg ships a full table, sql_state.rs)
+SQLSTATE_SYNTAX = "42601"
+SQLSTATE_UNDEFINED_TABLE = "42P01"
+SQLSTATE_INTERNAL = "XX000"
+
+
+def _col_oid(sql_type: str) -> int:
+    return {
+        "INTEGER": OID_INT8,
+        "REAL": OID_FLOAT8,
+        "BLOB": OID_BYTEA,
+    }.get(sql_type, OID_TEXT)
+
+
+def _text_value(v: Any) -> Optional[bytes]:
+    if v is None:
+        return None
+    if isinstance(v, bool):
+        return b"t" if v else b"f"
+    if isinstance(v, bytes):
+        return b"\\x" + v.hex().encode()
+    return str(v).encode()
+
+
+def _translate_sql(sql: str) -> str:
+    """Light PG -> local dialect cleanup: strip casts and quote styles the
+    parser does not need (the reference runs a full sqlparser -> SQLite
+    translation)."""
+    import re
+
+    out = re.sub(r"::\w+", "", sql)  # $1::text style casts
+    return out.strip()
+
+
+class _Msg:
+    """Backend message writer."""
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self._buf = bytearray()
+
+    def add(self, kind: bytes, payload: bytes = b"") -> "_Msg":
+        self._buf += kind + struct.pack("!I", len(payload) + 4) + payload
+        return self
+
+    def flush(self) -> None:
+        if self._buf:
+            self.sock.sendall(bytes(self._buf))
+            self._buf.clear()
+
+
+def _cstr(s: str) -> bytes:
+    return s.encode() + b"\x00"
+
+
+class _PreparedStatement:
+    def __init__(self, sql: str, param_oids: List[int]):
+        self.sql = sql
+        self.param_oids = param_oids
+
+
+class _Portal:
+    def __init__(self, stmt: _PreparedStatement, params: List[Any]):
+        self.stmt = stmt
+        self.params = params
+
+
+class PgServer:
+    """PG v3 listener bound to one Database."""
+
+    def __init__(self, db, addr: str = "127.0.0.1", port: int = 0,
+                 default_node: int = 0):
+        self.db = db
+        self.default_node = default_node
+        handler = _make_handler(self)
+        self.server = socketserver.ThreadingTCPServer(
+            (addr, port), handler, bind_and_activate=False
+        )
+        self.server.allow_reuse_address = True
+        self.server.daemon_threads = True
+        self.server.server_bind()
+        self.server.server_activate()
+        self.addr, self.port = self.server.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "PgServer":
+        self._thread = threading.Thread(
+            target=self.server.serve_forever, name="pg-wire", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+        if self._thread:
+            self._thread.join(timeout=10)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+def _make_handler(server: PgServer):
+    class Handler(socketserver.BaseRequestHandler):
+        def setup(self):
+            self.sock: socket.socket = self.request
+            self.out = _Msg(self.sock)
+            self.stmts: Dict[str, _PreparedStatement] = {}
+            self.portals: Dict[str, _Portal] = {}
+            self.node = server.default_node
+
+        # --- low-level reads ---------------------------------------------
+        def _read_exact(self, n: int) -> bytes:
+            data = b""
+            while len(data) < n:
+                chunk = self.sock.recv(n - len(data))
+                if not chunk:
+                    raise ConnectionResetError
+                data += chunk
+            return data
+
+        def _read_startup(self) -> Optional[dict]:
+            (length,) = struct.unpack("!I", self._read_exact(4))
+            payload = self._read_exact(length - 4)
+            (code,) = struct.unpack("!I", payload[:4])
+            if code == SSL_REQUEST:
+                self.sock.sendall(b"N")  # no TLS on the simulator listener
+                return self._read_startup()
+            if code == CANCEL_REQUEST:
+                return None
+            if code != PROTO_V3:
+                raise ValueError(f"unsupported protocol {code}")
+            params = {}
+            parts = payload[4:].split(b"\x00")
+            for k, v in zip(parts[::2], parts[1::2]):
+                if k:
+                    params[k.decode()] = v.decode()
+            return params
+
+        def _read_message(self) -> Tuple[bytes, bytes]:
+            kind = self._read_exact(1)
+            (length,) = struct.unpack("!I", self._read_exact(4))
+            return kind, self._read_exact(length - 4)
+
+        # --- backend responses -------------------------------------------
+        def _send_ready(self):
+            self.out.add(b"Z", b"I").flush()
+
+        def _send_error(self, message: str, code: str = SQLSTATE_INTERNAL):
+            fields = (b"S" + _cstr("ERROR") + b"C" + _cstr(code)
+                      + b"M" + _cstr(message) + b"\x00")
+            self.out.add(b"E", fields)
+
+        def _row_description(self, cols: List[str],
+                             table_name: Optional[str] = None):
+            payload = struct.pack("!H", len(cols))
+            table = None
+            if table_name is not None:
+                try:
+                    table = server.db.schema.table(table_name)
+                except SchemaError:
+                    table = None
+            for name in cols:
+                oid = OID_TEXT
+                if table is not None:
+                    try:
+                        oid = _col_oid(table.column(name).sql_type)
+                    except SchemaError:
+                        pass
+                payload += _cstr(name)
+                payload += struct.pack("!IhIhih", 0, 0, oid, -1, -1, 0)
+            self.out.add(b"T", payload)
+
+        def _data_row(self, row: List[Any]):
+            payload = struct.pack("!H", len(row))
+            for v in row:
+                tv = _text_value(v)
+                if tv is None:
+                    payload += struct.pack("!i", -1)
+                else:
+                    payload += struct.pack("!I", len(tv)) + tv
+            self.out.add(b"D", payload)
+
+        def _command_complete(self, tag: str):
+            self.out.add(b"C", _cstr(tag))
+
+        # --- statement execution -----------------------------------------
+        def _table_of(self, sql: str) -> Optional[str]:
+            import re
+
+            m = re.search(r"\b(?:FROM|INTO|UPDATE)\s+([\w\"]+)", sql,
+                          re.IGNORECASE)
+            return m.group(1).strip('"') if m else None
+
+        def _run_sql(self, sql: str, params: Any = None) -> None:
+            sql = _translate_sql(sql)
+            if not sql or sql.rstrip(";") == "":
+                self.out.add(b"I", b"")  # EmptyQueryResponse
+                return
+            upper = sql.upper().rstrip(";")
+            if upper in ("BEGIN", "COMMIT", "ROLLBACK", "END"):
+                self._command_complete(upper.split()[0])
+                return
+            if upper.startswith(("SET ", "RESET ", "DISCARD ")):
+                self._command_complete("SET")
+                return
+            if upper.startswith("SHOW "):
+                name = sql.split(None, 1)[1].rstrip(";")
+                self._row_description([name.lower()])
+                self._data_row([""])
+                self._command_complete("SHOW")
+                return
+            if "PG_CATALOG" in upper or "INFORMATION_SCHEMA" in upper:
+                # the reference fakes these via vtabs; we answer empty
+                self._row_description(["?column?"])
+                self._command_complete("SELECT 0")
+                return
+            if upper.startswith("SELECT"):
+                self._run_select(sql, params)
+                return
+            n = self._run_write(sql, params)
+            verb = upper.split()[0]
+            tag = f"INSERT 0 {n}" if verb == "INSERT" else f"{verb} {n}"
+            self._command_complete(tag)
+
+        def _run_select(self, sql: str, params: Any) -> None:
+            import re
+
+            # constant selects like SELECT 1 / SELECT version()
+            m = re.match(r"SELECT\s+([^\s,]+)\s*;?$", sql, re.IGNORECASE)
+            if m and "FROM" not in sql.upper():
+                expr = m.group(1).rstrip(";")
+                if expr.lower() in ("version()", "current_schema()"):
+                    val = ("corrosion-tpu (PostgreSQL 14.0 compatible)"
+                           if "version" in expr.lower() else "public")
+                else:
+                    try:
+                        val = int(expr)
+                    except ValueError:
+                        val = expr.strip("'")
+                self._row_description(["?column?"])
+                self._data_row([val])
+                self._command_complete("SELECT 1")
+                return
+            cols, rows = server.db.query(self.node, sql, params)
+            self._row_description(cols, self._table_of(sql))
+            n = 0
+            for row in rows:
+                self._data_row(row)
+                n += 1
+            self._command_complete(f"SELECT {n}")
+
+        def _run_write(self, sql: str, params: Any) -> int:
+            results = server.db.execute(self.node, [(sql, params)])
+            return results[0]["rows_affected"]
+
+        # --- protocol phases ---------------------------------------------
+        def handle(self):
+            try:
+                params = self._read_startup()
+                if params is None:
+                    return
+                if "node" in params.get("database", ""):
+                    # database name "node<K>" selects the observer replica
+                    try:
+                        self.node = int(
+                            params["database"].replace("node", ""))
+                    except ValueError:
+                        pass
+                self.out.add(b"R", struct.pack("!I", 0))  # AuthenticationOk
+                for k, v in (("server_version", "14.0"),
+                             ("server_encoding", "UTF8"),
+                             ("client_encoding", "UTF8"),
+                             ("DateStyle", "ISO, MDY")):
+                    self.out.add(b"S", _cstr(k) + _cstr(v))
+                self.out.add(b"K", struct.pack("!II", 0, 0))
+                self._send_ready()
+                self._loop()
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+            except Exception:  # noqa: BLE001
+                logger.exception("pg connection failed")
+
+        def _loop(self):
+            while True:
+                kind, payload = self._read_message()
+                if kind == b"X":  # Terminate
+                    return
+                if kind == b"Q":
+                    self._on_simple_query(payload)
+                elif kind == b"P":
+                    self._on_parse(payload)
+                elif kind == b"B":
+                    self._on_bind(payload)
+                elif kind == b"D":
+                    self._on_describe(payload)
+                elif kind == b"E":
+                    self._on_execute(payload)
+                elif kind == b"C":
+                    self._on_close(payload)
+                elif kind == b"S":  # Sync
+                    self._send_ready()
+                elif kind == b"H":  # Flush
+                    self.out.flush()
+                else:
+                    self._send_error(f"unsupported message {kind!r}")
+                    self._send_ready()
+
+        def _on_simple_query(self, payload: bytes):
+            sql = payload.rstrip(b"\x00").decode()
+            try:
+                for part in [s for s in sql.split(";") if s.strip()] or [""]:
+                    self._run_sql(part)
+            except (SqlError, SchemaError) as e:
+                code = (SQLSTATE_UNDEFINED_TABLE if "no such table" in str(e)
+                        else SQLSTATE_SYNTAX)
+                self._send_error(str(e), code)
+            except Exception as e:  # noqa: BLE001
+                logger.exception("pg simple query failed")
+                self._send_error(str(e))
+            self._send_ready()
+
+        def _on_parse(self, payload: bytes):
+            name, rest = payload.split(b"\x00", 1)
+            sql, rest = rest.split(b"\x00", 1)
+            (n_oids,) = struct.unpack("!H", rest[:2])
+            oids = list(struct.unpack(f"!{n_oids}I", rest[2:2 + 4 * n_oids]))
+            # $1-style placeholders -> positional ?
+            import re
+
+            text = re.sub(r"\$\d+", "?", sql.decode())
+            self.stmts[name.decode()] = _PreparedStatement(text, oids)
+            self.out.add(b"1", b"")  # ParseComplete
+
+        def _on_bind(self, payload: bytes):
+            portal, rest = payload.split(b"\x00", 1)
+            stmt_name, rest = rest.split(b"\x00", 1)
+            off = 0
+            (n_fmt,) = struct.unpack("!H", rest[off:off + 2])
+            off += 2
+            fmts = list(struct.unpack(f"!{n_fmt}H", rest[off:off + 2 * n_fmt]))
+            off += 2 * n_fmt
+            (n_params,) = struct.unpack("!H", rest[off:off + 2])
+            off += 2
+            params: List[Any] = []
+            stmt = self.stmts.get(stmt_name.decode())
+            for i in range(n_params):
+                (plen,) = struct.unpack("!i", rest[off:off + 4])
+                off += 4
+                if plen == -1:
+                    params.append(None)
+                    continue
+                raw = rest[off:off + plen]
+                off += plen
+                fmt = fmts[i] if i < len(fmts) else (fmts[0] if fmts else 0)
+                params.append(self._decode_param(raw, fmt, stmt, i))
+            if stmt is None:
+                self._send_error(f"no such prepared statement "
+                                 f"{stmt_name.decode()!r}", SQLSTATE_SYNTAX)
+                return
+            self.portals[portal.decode()] = _Portal(stmt, params)
+            self.out.add(b"2", b"")  # BindComplete
+
+        def _decode_param(self, raw: bytes, fmt: int,
+                          stmt: Optional[_PreparedStatement], i: int) -> Any:
+            oid = (stmt.param_oids[i]
+                   if stmt and i < len(stmt.param_oids) else 0)
+            if fmt == 1:  # binary
+                if oid == OID_INT8 or len(raw) == 8:
+                    return struct.unpack("!q", raw.rjust(8, b"\x00"))[0]
+                if oid == OID_FLOAT8:
+                    return struct.unpack("!d", raw)[0]
+                return raw
+            text = raw.decode()
+            if oid == OID_INT8:
+                return int(text)
+            if oid == OID_FLOAT8:
+                return float(text)
+            if oid in (0, OID_TEXT):
+                # untyped text: try numeric, else string (SQLite affinity)
+                try:
+                    return int(text)
+                except ValueError:
+                    try:
+                        return float(text)
+                    except ValueError:
+                        return text
+            return text
+
+        def _on_describe(self, payload: bytes):
+            kind, name = payload[:1], payload[1:].rstrip(b"\x00").decode()
+            if kind == b"S":
+                stmt = self.stmts.get(name)
+                if stmt is None:
+                    self._send_error(f"no such statement {name!r}")
+                    return
+                self.out.add(b"t", struct.pack("!H", len(stmt.param_oids))
+                             + b"".join(struct.pack("!I", o or OID_TEXT)
+                                        for o in stmt.param_oids))
+                sql = stmt.sql
+            else:
+                portal = self.portals.get(name)
+                if portal is None:
+                    self._send_error(f"no such portal {name!r}")
+                    return
+                sql = portal.stmt.sql
+            if sql.upper().lstrip().startswith("SELECT"):
+                try:
+                    cols, _ = server.db.query(self.node, sql, None)
+                    self._row_description(cols, self._table_of(sql))
+                except Exception:  # noqa: BLE001 — needs params to plan
+                    self.out.add(b"n", b"")  # NoData
+            else:
+                self.out.add(b"n", b"")
+
+        def _on_execute(self, payload: bytes):
+            name = payload.split(b"\x00", 1)[0].decode()
+            portal = self.portals.get(name)
+            if portal is None:
+                self._send_error(f"no such portal {name!r}")
+                return
+            try:
+                self._run_sql(portal.stmt.sql, portal.params or None)
+            except (SqlError, SchemaError) as e:
+                code = (SQLSTATE_UNDEFINED_TABLE if "no such table" in str(e)
+                        else SQLSTATE_SYNTAX)
+                self._send_error(str(e), code)
+            except Exception as e:  # noqa: BLE001
+                logger.exception("pg execute failed")
+                self._send_error(str(e))
+
+        def _on_close(self, payload: bytes):
+            kind, name = payload[:1], payload[1:].rstrip(b"\x00").decode()
+            if kind == b"S":
+                self.stmts.pop(name, None)
+            else:
+                self.portals.pop(name, None)
+            self.out.add(b"3", b"")  # CloseComplete
+
+    return Handler

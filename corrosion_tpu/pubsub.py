@@ -37,6 +37,25 @@ INSERT = "insert"
 DELETE = "delete"
 
 
+class SubQueue(queue.Queue):
+    """Per-subscriber event queue with lag semantics: the producer (the
+    round thread) never blocks — an overflowing subscriber is marked
+    lagged and disconnected, exactly the tokio broadcast
+    ``RecvError::Lagged`` behavior the reference relies on."""
+
+    def __init__(self, maxsize: int = 65536):
+        super().__init__(maxsize=maxsize)
+        self.lagged = False
+
+    def offer(self, item) -> bool:
+        try:
+            self.put_nowait(item)
+            return True
+        except queue.Full:
+            self.lagged = True
+            return False
+
+
 class Matcher:
     """One subscription query: materialized result + change log."""
 
@@ -53,29 +72,49 @@ class Matcher:
         self.columns: List[str] = list(cols)
         self._table = self._target_table(sql)
         self._pk_name = db.schema.table(self._table).pk.name
+        # the reference rewrites the SELECT to always expose the pks of
+        # every involved table (pubsub.rs:527+); mirror that: if the query
+        # omits the pk, run a pk-prepended variant and strip it on emit
+        if self._pk_name in self.columns:
+            self._key_sql, self._key_prepended = sql, False
+        else:
+            import re
+
+            self._key_sql = re.sub(
+                r"^\s*SELECT\s+", f"SELECT {self._pk_name}, ", sql,
+                count=1, flags=re.IGNORECASE,
+            )
+            self._key_prepended = True
         self._state: Dict[Any, Tuple] = {}
         self._log: List[Tuple[int, str, Any, Optional[List[Any]]]] = []
         self._log_base = 1  # change id of _log[0]
         self.last_change_id = 0
-        self._subs: List[queue.Queue] = []
+        self._subs: List[SubQueue] = []
         self._mu = threading.Lock()
         self._prime()
 
     def _target_table(self, sql: str) -> str:
         import re
 
+        from corrosion_tpu.db.database import SqlError
+
         m = re.search(r"\bFROM\s+([\w\"]+)", sql, re.IGNORECASE)
-        assert m, "query must have a FROM clause"
-        return m.group(1).strip('"')
+        if not m:
+            raise SqlError("subscription queries need a FROM clause")
+        name = m.group(1).strip('"')
+        if name not in self.db.schema.tables:
+            raise SqlError(
+                f"subscriptions support single-table queries over a known "
+                f"table (got FROM {name!r})"
+            )
+        return name
 
     def _current(self) -> Dict[Any, Tuple]:
-        cols, rows = self.db.query(self.node, self.sql, self.params)
-        pk_idx = cols.index(self._pk_name) if self._pk_name in cols else None
-        out: Dict[Any, Tuple] = {}
-        for i, row in enumerate(rows):
-            key = row[pk_idx] if pk_idx is not None else i
-            out[key] = tuple(row)
-        return out
+        cols, rows = self.db.query(self.node, self._key_sql, self.params)
+        if self._key_prepended:
+            return {row[0]: tuple(row[1:]) for row in rows}
+        pk_idx = cols.index(self._pk_name)
+        return {row[pk_idx]: tuple(row) for row in rows}
 
     def _prime(self) -> None:
         self._state = self._current()
@@ -108,32 +147,39 @@ class Matcher:
                 self._log = self._log[drop:]
                 self._log_base += drop
             subs = list(self._subs)
-        for rec in out:
-            for q in subs:
-                q.put(("change", rec))
+        lagged = []
+        for q in subs:
+            for rec in out:
+                if not q.offer(("change", rec)):
+                    lagged.append(q)
+                    break
+        for q in lagged:
+            logger.warning("matcher %s: disconnecting lagged subscriber",
+                           self.id)
+            self.detach(q)
         return len(out)
 
     # --- subscriber attach/detach ---------------------------------------
-    def attach(self, from_change_id: Optional[int] = None) -> queue.Queue:
+    def attach(self, from_change_id: Optional[int] = None) -> "SubQueue":
         """A live event queue, optionally preloaded with the catch-up
         backlog from ``from_change_id`` (exclusive). If the backlog has
         been GC'd past that id, the subscriber gets a full re-dump
         (columns + rows), like the reference's query restart."""
-        q: queue.Queue = queue.Queue(maxsize=65536)
+        q = SubQueue()
         with self._mu:
-            q.put(("columns", self.columns))
+            q.offer(("columns", self.columns))
             if from_change_id is None:
                 for key, row in self._state.items():
-                    q.put(("row", (key, list(row))))
-                q.put(("eoq", self.last_change_id))
+                    q.offer(("row", (key, list(row))))
+                q.offer(("eoq", self.last_change_id))
             elif from_change_id + 1 >= self._log_base:
                 for rec in self._log[from_change_id + 1 - self._log_base:]:
-                    q.put(("change", rec))
+                    q.offer(("change", rec))
             else:
                 # backlog GC'd: full resync
                 for key, row in self._state.items():
-                    q.put(("row", (key, list(row))))
-                q.put(("eoq", self.last_change_id))
+                    q.offer(("row", (key, list(row))))
+                q.offer(("eoq", self.last_change_id))
             self._subs.append(q)
         return q
 
@@ -249,9 +295,9 @@ class UpdatesManager:
         self._mu = threading.Lock()
         db.agent.add_round_listener(self._on_round)
 
-    def attach(self, table: str) -> queue.Queue:
+    def attach(self, table: str) -> SubQueue:
         self.db.schema.table(table)  # raises on unknown table
-        q: queue.Queue = queue.Queue(maxsize=65536)
+        q = SubQueue()
         with self._mu:
             if table not in self._feeds:
                 self._state[table] = self._snapshot_table(table)
@@ -298,6 +344,13 @@ class UpdatesManager:
                         events.append((DELETE, pk))
                 self._state[table] = fresh
                 subs = list(self._feeds.get(table, ()))
-            for ev in events:
-                for q in subs:
-                    q.put(("notify", ev))
+            lagged = []
+            for q in subs:
+                for ev in events:
+                    if not q.offer(("notify", ev)):
+                        lagged.append(q)
+                        break
+            for q in lagged:
+                logger.warning("updates feed %s: disconnecting lagged "
+                               "subscriber", table)
+                self.detach(table, q)

@@ -172,3 +172,30 @@ class RoundTimer:
                     "%s turn took %.3fs (> %.1fs)", self.name, dt, self.warn_seconds
                 )
         return False
+
+
+def start_prometheus_listener(registry: Registry, addr: str = "127.0.0.1",
+                              port: int = 9090):
+    """Standalone Prometheus exposition listener (the reference serves
+    metrics on a dedicated telemetry address, ``command/agent.rs:114-139``).
+    Returns the HTTPServer; call ``.shutdown()`` to stop."""
+    import http.server
+    import threading
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            data = registry.render().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.ThreadingHTTPServer((addr, port), Handler)
+    httpd.daemon_threads = True
+    threading.Thread(target=httpd.serve_forever, name="prometheus",
+                     daemon=True).start()
+    return httpd

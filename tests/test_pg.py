@@ -1,0 +1,211 @@
+"""PG wire server: a minimal raw-socket PostgreSQL v3 client exercises
+startup, simple query, and the extended protocol (``corro-pg`` analog;
+no PG client library ships in this image, so the test speaks the wire
+format directly)."""
+
+import socket
+import struct
+
+import pytest
+
+from corrosion_tpu.agent import Agent
+from corrosion_tpu.config import Config
+from corrosion_tpu.db import Database
+from corrosion_tpu.pg import PgServer
+
+SCHEMA = "CREATE TABLE users (id INTEGER PRIMARY KEY, name TEXT, score INTEGER);"
+
+
+def pg_config():
+    cfg = Config()
+    cfg.sim.mode = "scale"
+    cfg.sim.n_nodes = 16
+    cfg.sim.m_slots = 8
+    cfg.sim.n_origins = 4
+    cfg.sim.n_rows = 8
+    cfg.sim.n_cols = 4
+    cfg.perf.sync_interval = 4
+    cfg.gossip.drop_prob = 0.0
+    return cfg
+
+
+class MiniPg:
+    """Just enough of the PG v3 frontend to test the backend."""
+
+    def __init__(self, addr, port, database="corrosion"):
+        self.sock = socket.create_connection((addr, port), timeout=30)
+        payload = struct.pack("!I", 196608)
+        for k, v in (("user", "test"), ("database", database)):
+            payload += k.encode() + b"\x00" + v.encode() + b"\x00"
+        payload += b"\x00"
+        self.sock.sendall(struct.pack("!I", len(payload) + 4) + payload)
+        self.params = {}
+        self._drain_until_ready()
+
+    def close(self):
+        self.sock.sendall(b"X" + struct.pack("!I", 4))
+        self.sock.close()
+
+    def _read_exact(self, n):
+        data = b""
+        while len(data) < n:
+            chunk = self.sock.recv(n - len(data))
+            if not chunk:
+                raise ConnectionResetError
+            data += chunk
+        return data
+
+    def _read_msg(self):
+        kind = self._read_exact(1)
+        (length,) = struct.unpack("!I", self._read_exact(4))
+        return kind, self._read_exact(length - 4)
+
+    def _drain_until_ready(self):
+        msgs = []
+        while True:
+            kind, payload = self._read_msg()
+            msgs.append((kind, payload))
+            if kind == b"Z":
+                return msgs
+
+    @staticmethod
+    def _parse_rows(msgs):
+        cols, rows, tag, err = [], [], None, None
+        for kind, payload in msgs:
+            if kind == b"T":
+                (n,) = struct.unpack("!H", payload[:2])
+                off = 2
+                for _ in range(n):
+                    end = payload.index(b"\x00", off)
+                    cols.append(payload[off:end].decode())
+                    off = end + 1 + 18
+            elif kind == b"D":
+                (n,) = struct.unpack("!H", payload[:2])
+                off = 2
+                row = []
+                for _ in range(n):
+                    (ln,) = struct.unpack("!i", payload[off:off + 4])
+                    off += 4
+                    if ln == -1:
+                        row.append(None)
+                    else:
+                        row.append(payload[off:off + ln].decode())
+                        off += ln
+                rows.append(row)
+            elif kind == b"C":
+                tag = payload.rstrip(b"\x00").decode()
+            elif kind == b"E":
+                err = payload
+        return cols, rows, tag, err
+
+    def query(self, sql):
+        payload = sql.encode() + b"\x00"
+        self.sock.sendall(b"Q" + struct.pack("!I", len(payload) + 4) + payload)
+        return self._parse_rows(self._drain_until_ready())
+
+    def extended(self, sql, params=()):
+        def msg(kind, payload):
+            return kind + struct.pack("!I", len(payload) + 4) + payload
+
+        out = msg(b"P", b"\x00" + sql.encode() + b"\x00" + struct.pack("!H", 0))
+        bind = b"\x00\x00" + struct.pack("!H", 0)  # portal, stmt, no fmt codes
+        bind += struct.pack("!H", len(params))
+        for p in params:
+            if p is None:
+                bind += struct.pack("!i", -1)
+            else:
+                raw = str(p).encode()
+                bind += struct.pack("!I", len(raw)) + raw
+        bind += struct.pack("!H", 0)
+        out += msg(b"B", bind)
+        out += msg(b"D", b"P\x00")
+        out += msg(b"E", b"\x00" + struct.pack("!I", 0))
+        out += msg(b"S", b"")
+        self.sock.sendall(out)
+        return self._parse_rows(self._drain_until_ready())
+
+
+@pytest.fixture(scope="module")
+def pg():
+    with Agent(pg_config()) as agent:
+        agent.wait_rounds(10, timeout=120)
+        db = Database(agent)
+        db.apply_schema_sql(SCHEMA)
+        with PgServer(db, port=0) as server:
+            client = MiniPg(server.addr, server.port)
+            yield agent, db, server, client
+            client.close()
+
+
+def test_startup_and_constant_select(pg):
+    _, _, _, c = pg
+    cols, rows, tag, err = c.query("SELECT 1")
+    assert err is None and tag == "SELECT 1" and rows == [["1"]]
+    _, rows, _, _ = c.query("SELECT version()")
+    assert "corrosion-tpu" in rows[0][0]
+
+
+def test_simple_write_and_read(pg):
+    _, _, _, c = pg
+    _, _, tag, err = c.query(
+        "INSERT INTO users (id, name, score) VALUES (1, 'ada', 10)")
+    assert err is None and tag == "INSERT 0 1"
+    cols, rows, tag, err = c.query("SELECT id, name, score FROM users")
+    assert err is None
+    assert cols == ["id", "name", "score"]
+    assert ["1", "ada", "10"] in rows
+
+
+def test_transaction_noops_and_set(pg):
+    _, _, _, c = pg
+    for sql, expect in (("BEGIN", "BEGIN"), ("COMMIT", "COMMIT"),
+                        ("SET search_path TO public", "SET")):
+        _, _, tag, err = c.query(sql)
+        assert err is None and tag == expect
+
+
+def test_extended_protocol(pg):
+    _, _, _, c = pg
+    _, _, tag, err = c.extended(
+        "INSERT INTO users (id, name, score) VALUES ($1, $2, $3)",
+        [2, "bob", 5])
+    assert err is None and tag == "INSERT 0 1"
+    cols, rows, tag, err = c.extended(
+        "SELECT name FROM users WHERE id = $1", [2])
+    assert err is None and rows == [["bob"]]
+    _, _, tag, err = c.extended(
+        "UPDATE users SET score = $1 WHERE id = $2", [50, 2])
+    assert err is None and tag == "UPDATE 1"
+
+
+def test_pg_catalog_stub_and_errors(pg):
+    _, _, _, c = pg
+    _, rows, tag, err = c.query("SELECT * FROM pg_catalog.pg_tables")
+    assert err is None and tag == "SELECT 0" and rows == []
+    _, _, _, err = c.query("SELECT * FROM no_such_table")
+    assert err is not None and b"42P01" in err
+    _, _, _, err = c.query("FROBNICATE 1")
+    assert err is not None
+
+
+def test_multi_statement_simple_query(pg):
+    _, _, _, c = pg
+    cols, rows, tag, err = c.query(
+        "INSERT INTO users (id, name, score) VALUES (3, 'eve', 7); "
+        "SELECT name FROM users WHERE id = 3")
+    assert err is None and ["eve"] in rows
+
+
+def test_node_selection_via_database_name(pg):
+    agent, db, server, _ = pg
+    # replicate first, then read the same data from another node's replica
+    reader = 5
+    for _ in range(100):
+        row = db.read_row(reader, "users", 1)
+        if row is not None and row["name"] == "ada" and row["score"] == 10:
+            break
+        agent.wait_rounds(4, timeout=60)
+    c2 = MiniPg(server.addr, server.port, database=f"node{reader}")
+    _, rows, _, err = c2.query("SELECT name FROM users WHERE id = 1")
+    c2.close()
+    assert err is None and rows == [["ada"]]
