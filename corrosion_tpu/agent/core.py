@@ -78,7 +78,11 @@ class Agent:
 
         from corrosion_tpu.sim.transport import NetModel
 
-        self._net = NetModel.create(self.n_nodes, drop_prob=self.config.gossip.drop_prob)
+        self._net = NetModel.create(
+            self.n_nodes,
+            drop_prob=self.config.gossip.drop_prob,
+            n_regions=self.config.gossip.n_regions,
+        )
         self._key = jr.key(sim.seed)
 
         self.metrics = Registry()
@@ -396,10 +400,24 @@ class Agent:
         }
 
     def members(self) -> list:
+        """Member dump incl. region + RTT ring relative to node 0 (the
+        reference's members dump shows per-peer ring membership)."""
+        import numpy as _np
+
+        from corrosion_tpu.sim.transport import RING_RTT_MS, ring_of
+
         snap = self.snapshot()
+        ids = _np.arange(self.n_nodes, dtype=_np.int32)
+        rings = _np.asarray(
+            ring_of(self._net, jnp.zeros(self.n_nodes, jnp.int32),
+                    jnp.asarray(ids))
+        )
+        regions = _np.asarray(self._net.region)
         return [
             {"id": i, "state": "Alive" if bool(a) else "Down",
-             "incarnation": int(inc)}
+             "incarnation": int(inc), "region": int(regions[i]),
+             "ring": int(rings[i]),
+             "rtt_ms": float(RING_RTT_MS[int(rings[i])])}
             for i, (a, inc) in enumerate(
                 zip(snap["alive"], snap["incarnation"])
             )

@@ -74,33 +74,34 @@ def cmd_agent(args) -> int:
         prom_hostport = (host or "127.0.0.1", int(port))
     agent = Agent(cfg).start(pace_seconds=args.pace)
     agent.tripwire.hook_signals()
-    db = Database(agent)
-    for path in cfg.db.schema_paths:
-        with open(path) as f:
-            db.apply_schema_sql(f.read())
-    api = ApiServer(db, addr=cfg.api.addr, port=cfg.api.port).start()
-    admin = AdminServer(agent, cfg.admin.uds_path, db=db).start()
-    pg = None
-    if cfg.pg.enabled:
-        from corrosion_tpu.pg import PgServer
-
-        pg = PgServer(db, addr=cfg.pg.addr, port=cfg.pg.port).start()
-    prom = None
-    if prom_hostport:
-        from corrosion_tpu.utils.metrics import start_prometheus_listener
-
-        prom = start_prometheus_listener(agent.metrics, *prom_hostport)
-    extras = (f" pg {pg.addr}:{pg.port}" if pg else "") + (
-        f" prometheus {cfg.telemetry.prometheus_addr}" if prom else "")
-    print(f"agent up: api http://{api.addr}:{api.port} "
-          f"admin {cfg.admin.uds_path}{extras} nodes={agent.n_nodes}",
-          flush=True)
+    api = admin = pg = prom = None
     try:
+        db = Database(agent)
+        for path in cfg.db.schema_paths:
+            with open(path) as f:
+                db.apply_schema_sql(f.read())
+        api = ApiServer(db, addr=cfg.api.addr, port=cfg.api.port).start()
+        admin = AdminServer(agent, cfg.admin.uds_path, db=db).start()
+        if cfg.pg.enabled:
+            from corrosion_tpu.pg import PgServer
+
+            pg = PgServer(db, addr=cfg.pg.addr, port=cfg.pg.port).start()
+        if prom_hostport:
+            from corrosion_tpu.utils.metrics import start_prometheus_listener
+
+            prom = start_prometheus_listener(agent.metrics, *prom_hostport)
+        extras = (f" pg {pg.addr}:{pg.port}" if pg else "") + (
+            f" prometheus {cfg.telemetry.prometheus_addr}" if prom else "")
+        print(f"agent up: api http://{api.addr}:{api.port} "
+              f"admin {cfg.admin.uds_path}{extras} nodes={agent.n_nodes}",
+              flush=True)
         while not agent.tripwire.tripped:
             agent.tripwire.wait(0.5)
     finally:
-        admin.stop()
-        api.stop()
+        if admin:
+            admin.stop()
+        if api:
+            api.stop()
         if pg:
             pg.stop()
         if prom:

@@ -50,6 +50,7 @@ class GossipConfig:
     bootstrap: tuple = ()  # seed node ids (DNS list analog)
     cluster_id: int = 0
     drop_prob: float = 0.01
+    n_regions: int = 1  # geographic regions feeding the RTT rings
     idle_rounds: int = 16  # announce interval analog
     plaintext: bool = True  # no TLS in the simulator
 

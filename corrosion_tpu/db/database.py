@@ -349,6 +349,21 @@ class Database:
         limit = int(m.group("limit")) if m.group("limit") else None
         return names, self._scan(node, table, names, conds, limit)
 
+    def query_columns(self, sql: str) -> List[str]:
+        """The column names a SELECT would produce — schema-only, no
+        scan (used by the PG Describe phase)."""
+        m = _SELECT_RE.match(sql.strip().rstrip(";").strip())
+        if m is None:
+            raise SqlError(f"not a SELECT: {sql[:80]!r}")
+        table = self.schema.table(_unquote(m.group("table")))
+        raw_cols = m.group("cols").strip()
+        if raw_cols == "*":
+            return [c.name for c in table.columns]
+        names = [_unquote(c) for c in raw_cols.split(",")]
+        for n in names:
+            table.column(n)
+        return names
+
     def _parse_where(self, table, where: Optional[str], p: _Params):
         if not where:
             return []

@@ -29,6 +29,20 @@ def sample_k(mask: jax.Array, k: int, key: jax.Array) -> Tuple[jax.Array, jax.Ar
     return cols.astype(jnp.int32), val >= 0
 
 
+def sample_k_biased(mask: jax.Array, bonus: jax.Array, k: int,
+                    key: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Like :func:`sample_k` but with a per-candidate score ``bonus``
+    added to the uniform draw. A bonus >= 1 gives *strict* priority over
+    un-bonused candidates (uniform draws live in [0, 1)); fractional
+    bonuses give a soft preference. This is how the reference's ordered
+    choices vectorize: ring0-first broadcast fanout
+    (``broadcast/mod.rs:653-713``) and ring-sorted sync peers
+    (``handlers.rs:808-863``)."""
+    scores = jnp.where(mask, jr.uniform(key, mask.shape) + bonus, -1.0)
+    val, cols = jax.lax.top_k(scores, k)
+    return cols.astype(jnp.int32), val >= 0
+
+
 def sample_one(mask: jax.Array, key: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """Per-row uniform sample of one column where ``mask``; (col, ok)."""
     scores = jnp.where(mask, jr.uniform(key, mask.shape), -1.0)

@@ -64,14 +64,55 @@ def _text_value(v: Any) -> Optional[bytes]:
     return str(v).encode()
 
 
+def _split_sql_outside_quotes(sql: str, sep: str) -> List[str]:
+    """Split on ``sep`` only outside single-quoted literals."""
+    parts, start, in_str, i = [], 0, False, 0
+    while i < len(sql):
+        ch = sql[i]
+        if in_str:
+            if ch == "'":
+                # '' is an escaped quote inside the literal
+                if i + 1 < len(sql) and sql[i + 1] == "'":
+                    i += 1
+                else:
+                    in_str = False
+        elif ch == "'":
+            in_str = True
+        elif ch == sep:
+            parts.append(sql[start:i])
+            start = i + 1
+        i += 1
+    parts.append(sql[start:])
+    return parts
+
+
 def _translate_sql(sql: str) -> str:
-    """Light PG -> local dialect cleanup: strip casts and quote styles the
-    parser does not need (the reference runs a full sqlparser -> SQLite
+    """Light PG -> local dialect cleanup: strip ``::type`` casts outside
+    string literals (the reference runs a full sqlparser -> SQLite
     translation)."""
     import re
 
-    out = re.sub(r"::\w+", "", sql)  # $1::text style casts
-    return out.strip()
+    out, i, n = [], 0, len(sql)
+    while i < n:
+        if sql[i] == "'":
+            # literal: scan to the closing quote, '' escapes stay inside
+            j = i + 1
+            while j < n:
+                if sql[j] == "'":
+                    if j + 1 < n and sql[j + 1] == "'":
+                        j += 2
+                        continue
+                    break
+                j += 1
+            out.append(sql[i:min(j + 1, n)])
+            i = j + 1
+        else:
+            j = sql.find("'", i)
+            if j == -1:
+                j = n
+            out.append(re.sub(r"::\w+", "", sql[i:j]))
+            i = j
+    return "".join(out).strip()
 
 
 class _Msg:
@@ -96,9 +137,19 @@ def _cstr(s: str) -> bytes:
 
 
 class _PreparedStatement:
-    def __init__(self, sql: str, param_oids: List[int]):
+    def __init__(self, sql: str, param_oids: List[int],
+                 param_map: Optional[List[int]] = None):
         self.sql = sql
         self.param_oids = param_oids
+        # textual order of $N placeholders: occurrence i consumes
+        # client-param index param_map[i] (handles $2 ... $1 and reuse)
+        self.param_map = param_map or []
+
+    def reorder(self, params: List[Any]) -> List[Any]:
+        if not self.param_map:
+            return params
+        return [params[i] if i < len(params) else None
+                for i in self.param_map]
 
 
 class _Portal:
@@ -355,7 +406,9 @@ def _make_handler(server: PgServer):
         def _on_simple_query(self, payload: bytes):
             sql = payload.rstrip(b"\x00").decode()
             try:
-                for part in [s for s in sql.split(";") if s.strip()] or [""]:
+                parts = [s for s in _split_sql_outside_quotes(sql, ";")
+                         if s.strip()]
+                for part in parts or [""]:
                     self._run_sql(part)
             except (SqlError, SchemaError) as e:
                 code = (SQLSTATE_UNDEFINED_TABLE if "no such table" in str(e)
@@ -371,11 +424,19 @@ def _make_handler(server: PgServer):
             sql, rest = rest.split(b"\x00", 1)
             (n_oids,) = struct.unpack("!H", rest[:2])
             oids = list(struct.unpack(f"!{n_oids}I", rest[2:2 + 4 * n_oids]))
-            # $1-style placeholders -> positional ?
+            # $N placeholders -> positional ?, keeping the N order so
+            # $2 ... $1 and repeated placeholders bind correctly
             import re
 
-            text = re.sub(r"\$\d+", "?", sql.decode())
-            self.stmts[name.decode()] = _PreparedStatement(text, oids)
+            param_map: List[int] = []
+
+            def repl(m):
+                param_map.append(int(m.group(1)) - 1)
+                return "?"
+
+            text = re.sub(r"\$(\d+)", repl, sql.decode())
+            self.stmts[name.decode()] = _PreparedStatement(
+                text, oids, param_map)
             self.out.add(b"1", b"")  # ParseComplete
 
         def _on_bind(self, payload: bytes):
@@ -404,7 +465,7 @@ def _make_handler(server: PgServer):
                 self._send_error(f"no such prepared statement "
                                  f"{stmt_name.decode()!r}", SQLSTATE_SYNTAX)
                 return
-            self.portals[portal.decode()] = _Portal(stmt, params)
+            self.portals[portal.decode()] = _Portal(stmt, stmt.reorder(params))
             self.out.add(b"2", b"")  # BindComplete
 
         def _decode_param(self, raw: bytes, fmt: int,
@@ -412,10 +473,10 @@ def _make_handler(server: PgServer):
             oid = (stmt.param_oids[i]
                    if stmt and i < len(stmt.param_oids) else 0)
             if fmt == 1:  # binary
-                if oid == OID_INT8 or len(raw) == 8:
-                    return struct.unpack("!q", raw.rjust(8, b"\x00"))[0]
                 if oid == OID_FLOAT8:
                     return struct.unpack("!d", raw)[0]
+                if oid == OID_INT8 or (oid == 0 and len(raw) in (2, 4, 8)):
+                    return int.from_bytes(raw, "big", signed=True)
                 return raw
             text = raw.decode()
             if oid == OID_INT8:
@@ -452,9 +513,10 @@ def _make_handler(server: PgServer):
                 sql = portal.stmt.sql
             if sql.upper().lstrip().startswith("SELECT"):
                 try:
-                    cols, _ = server.db.query(self.node, sql, None)
+                    # schema-only plan: no table scan on the Describe phase
+                    cols = server.db.query_columns(_translate_sql(sql))
                     self._row_description(cols, self._table_of(sql))
-                except Exception:  # noqa: BLE001 — needs params to plan
+                except Exception:  # noqa: BLE001 — constant SELECTs etc.
                     self.out.add(b"n", b"")  # NoData
             else:
                 self.out.add(b"n", b"")

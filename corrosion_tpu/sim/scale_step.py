@@ -221,14 +221,24 @@ def scale_sim_step(
     cst = local_write(cfg, st.crdt, inp.write_mask, inp.write_cell, inp.write_val)
     cst, b_info = piggyback_bcast_step(cfg, cst, channels, k_pig)
 
-    # sync peers from the bounded member table (believed-alive entries)
+    # sync peers from the bounded member table (believed-alive entries),
+    # with a soft preference for closer RTT rings (handlers.rs:808-863)
+    from corrosion_tpu.ops.select import sample_k_biased
+    from corrosion_tpu.sim.transport import N_RINGS, ring_of
+
+    iarr = jnp.arange(cfg.n_nodes, dtype=jnp.int32)
     bel_alive = (
         (swim.mem_id >= 0)
-        & (swim.mem_id != jnp.arange(cfg.n_nodes, dtype=jnp.int32)[:, None])
+        & (swim.mem_id != iarr[:, None])
         & (swim.mem_view >= 0)
         & ((swim.mem_view & 3) == STATE_ALIVE)
     )
-    p_slots, p_ok = sample_k(bel_alive, cfg.sync_peers, k_sp)
+    mem_rings = ring_of(
+        net, jnp.broadcast_to(iarr[:, None], swim.mem_id.shape),
+        jnp.clip(swim.mem_id, 0),
+    )
+    ring_bias = 0.5 * (1.0 - mem_rings.astype(jnp.float32) / (N_RINGS - 1))
+    p_slots, p_ok = sample_k_biased(bel_alive, ring_bias, cfg.sync_peers, k_sp)
     peers = jnp.clip(jnp.take_along_axis(swim.mem_id, p_slots, axis=1), 0)
     cst, s_info = sync_step(cfg, cst, peers, p_ok, swim.alive, net, k_sync)
 

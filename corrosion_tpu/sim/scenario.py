@@ -86,7 +86,6 @@ def full_mix(
 
 
 def partitioned_net(cfg: SimConfig, groups: int = 2, drop_prob: float = 0.0) -> NetModel:
-    return NetModel(
+    return NetModel.create(cfg.n_nodes, drop_prob=drop_prob)._replace(
         partition=(jnp.arange(cfg.n_nodes) % groups).astype(jnp.int32),
-        drop_prob=jnp.float32(drop_prob),
     )

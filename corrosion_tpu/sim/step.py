@@ -56,8 +56,9 @@ class RoundInput(NamedTuple):
 
 def sim_step(cfg: SimConfig, st: SimState, net: NetModel, key, inp: RoundInput):
     """One full protocol round for the whole cluster."""
-    from corrosion_tpu.ops.select import sample_k  # local: avoid import cycle
+    from corrosion_tpu.ops.select import sample_k_biased  # local: avoid import cycle
     from corrosion_tpu.sim.sync import sync_step
+    from corrosion_tpu.sim.transport import N_RINGS, ring_of, same_region
 
     n = cfg.n_nodes
     k_swim, k_bcast, k_sync, k_bt, k_sp = jr.split(key, 5)
@@ -68,9 +69,23 @@ def sim_step(cfg: SimConfig, st: SimState, net: NetModel, key, inp: RoundInput):
     cand = believed & ~jnp.eye(n, dtype=bool)
 
     cst = local_write(cfg, st.crdt, inp.write_mask, inp.write_cell, inp.write_val)
-    targets, t_ok = sample_k(cand & swim.alive[:, None], cfg.bcast_fanout, k_bt)
+    # broadcast fanout: ring0 (same-region) members take strict priority,
+    # the rest of the set is random — handle_broadcasts sends local
+    # changes to ring0 first, then random members (broadcast/mod.rs:653-713)
+    ring0 = same_region(net)
+    targets, t_ok = sample_k_biased(
+        cand & swim.alive[:, None], ring0.astype(jnp.float32), cfg.bcast_fanout,
+        k_bt,
+    )
     cst, b_info = bcast_step(cfg, cst, targets, t_ok, swim.alive, net, k_bcast)
-    peers, p_ok = sample_k(cand, cfg.sync_peers, k_sp)
+    # sync peers: soft preference for closer rings (the reference sorts
+    # its 2x sample by need, last-sync, then RTT ring; need/last-sync are
+    # not tracked per-pair here, so the ring term carries the ordering)
+    iarr = jnp.arange(n, dtype=jnp.int32)
+    rings = ring_of(net, jnp.broadcast_to(iarr[:, None], (n, n)),
+                    jnp.broadcast_to(iarr[None, :], (n, n)))
+    ring_bias = 0.5 * (1.0 - rings.astype(jnp.float32) / (N_RINGS - 1))
+    peers, p_ok = sample_k_biased(cand, ring_bias, cfg.sync_peers, k_sp)
     cst, s_info = sync_step(cfg, cst, peers, p_ok, swim.alive, net, k_sync)
 
     info = {**swim_info, **b_info, **s_info}

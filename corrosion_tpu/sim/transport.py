@@ -27,18 +27,48 @@ import jax.numpy as jnp
 import jax.random as jr
 
 
+N_RINGS = 6  # the reference buckets RTT into 6 rings (members.rs:38)
+
+# representative one-way latencies per ring for the members dump
+# (0-6 ms ... 200-300 ms buckets, members.rs:130-178)
+RING_RTT_MS = (3.0, 15.0, 45.0, 80.0, 150.0, 250.0)
+
+
 class NetModel(NamedTuple):
-    """Dynamic network conditions (traced, changeable every round)."""
+    """Dynamic network conditions (traced, changeable every round).
+
+    ``region`` models geography: the RTT between two nodes is a function
+    of their circular region distance, bucketed into the reference's six
+    RTT rings (``members.rs:38,130-178``, fed by QUIC RTT samples at
+    ``transport.rs:220``). Ring 0 = same region (LAN-close) — the set the
+    broadcast layer prefers for local changes."""
 
     partition: jax.Array  # int32 [N] — partition group per node
     drop_prob: jax.Array  # float32 scalar — per-message loss probability
+    region: jax.Array  # int32 [N] — geographic region id
 
     @staticmethod
-    def create(n_nodes: int, drop_prob: float = 0.0) -> "NetModel":
+    def create(n_nodes: int, drop_prob: float = 0.0,
+               n_regions: int = 1) -> "NetModel":
         return NetModel(
             partition=jnp.zeros(n_nodes, jnp.int32),
             drop_prob=jnp.float32(drop_prob),
+            region=(jnp.arange(n_nodes, dtype=jnp.int32) % max(1, n_regions)),
         )
+
+def ring_of(net: NetModel, src, dst):
+    """RTT ring between node ids (int32 arrays, same shape): circular
+    region distance clipped to the six reference buckets."""
+    ra, rb = net.region[src], net.region[dst]
+    d = jnp.abs(ra - rb)
+    n = jnp.maximum(jnp.max(net.region) + 1, 1)
+    circ = jnp.minimum(d, n - d)
+    return jnp.minimum(circ, N_RINGS - 1).astype(jnp.int32)
+
+
+def same_region(net: NetModel):
+    """[N, N] ring-0 adjacency (full-view sims only)."""
+    return net.region[:, None] == net.region[None, :]
 
 
 def _link_ok(net: NetModel, alive, src, dst):

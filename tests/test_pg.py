@@ -188,6 +188,28 @@ def test_pg_catalog_stub_and_errors(pg):
     assert err is not None
 
 
+def test_literal_with_semicolon_and_cast(pg):
+    _, _, _, c = pg
+    _, _, tag, err = c.query(
+        "INSERT INTO users (id, name, score) VALUES (7, 'a;b::c', 1)")
+    assert err is None and tag == "INSERT 0 1"
+    _, rows, _, err = c.query("SELECT name FROM users WHERE id = 7")
+    assert err is None and rows == [["a;b::c"]]
+    # a cast outside literals IS stripped
+    _, rows, _, err = c.extended("SELECT name FROM users WHERE id = $1::int",
+                                 [7])
+    assert err is None and rows == [["a;b::c"]]
+
+
+def test_out_of_order_placeholders(pg):
+    _, _, _, c = pg
+    c.query("INSERT INTO users (id, name, score) VALUES (8, 'swap', 42)")
+    # $2 appears before $1 in the text: binding must follow the numbers
+    _, rows, _, err = c.extended(
+        "SELECT name FROM users WHERE score = $2 AND id = $1", [8, 42])
+    assert err is None and rows == [["swap"]]
+
+
 def test_multi_statement_simple_query(pg):
     _, _, _, c = pg
     cols, rows, tag, err = c.query(

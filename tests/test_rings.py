@@ -1,0 +1,77 @@
+"""RTT rings: region-distance bucketing, ring0-first fanout preference,
+and convergence with a multi-region topology (``members.rs:38,130-178``,
+``broadcast/mod.rs:653-713``)."""
+
+import jax.numpy as jnp
+import jax.random as jr
+import numpy as np
+import pytest
+
+from corrosion_tpu.agent import Agent
+from corrosion_tpu.config import Config
+from corrosion_tpu.ops.select import sample_k_biased
+from corrosion_tpu.sim.transport import N_RINGS, NetModel, ring_of, same_region
+
+
+def test_ring_of_circular_distance():
+    net = NetModel.create(12, n_regions=4)  # regions 0,1,2,3 interleaved
+    src = jnp.zeros(12, jnp.int32)  # node 0 is region 0
+    dst = jnp.arange(12, dtype=jnp.int32)
+    rings = np.asarray(ring_of(net, src, dst))
+    # node 1 -> region 1 -> ring 1; node 2 -> region 2 -> ring 2;
+    # node 3 -> region 3 -> circular distance 1 -> ring 1
+    assert rings[0] == 0 and rings[4] == 0  # same region
+    assert rings[1] == 1 and rings[3] == 1
+    assert rings[2] == 2
+    assert rings.max() < N_RINGS
+
+
+def test_single_region_all_ring0():
+    net = NetModel.create(8)
+    ij = jnp.arange(8, dtype=jnp.int32)
+    rings = np.asarray(ring_of(net, jnp.zeros(8, jnp.int32), ij))
+    assert (rings == 0).all()
+    assert np.asarray(same_region(net)).all()
+
+
+def test_sample_k_biased_strict_priority():
+    # 16 candidates, 4 with bonus 1.0: a k=4 sample must pick exactly those
+    mask = jnp.ones((1, 16), bool)
+    bonus = jnp.zeros((1, 16)).at[0, [2, 5, 9, 13]].set(1.0)
+    cols, ok = sample_k_biased(mask, bonus, 4, jr.key(0))
+    assert ok.all()
+    assert sorted(np.asarray(cols)[0].tolist()) == [2, 5, 9, 13]
+
+
+def test_sample_k_biased_soft_preference():
+    # soft bonus shifts the distribution but does not exclude others
+    mask = jnp.ones((256, 8), bool)
+    bonus = jnp.zeros((256, 8)).at[:, 0].set(0.5)
+    cols, _ = sample_k_biased(mask, bonus, 1, jr.key(1))
+    frac = float(np.mean(np.asarray(cols) == 0))
+    assert frac > 0.3  # uniform would be 0.125
+
+
+def test_multi_region_cluster_converges():
+    cfg = Config()
+    cfg.sim.mode = "scale"
+    cfg.sim.n_nodes = 32
+    cfg.sim.m_slots = 16
+    cfg.sim.n_origins = 4
+    cfg.sim.n_rows = 4
+    cfg.sim.n_cols = 2
+    cfg.perf.sync_interval = 4
+    cfg.gossip.drop_prob = 0.01
+    cfg.gossip.n_regions = 4
+    with Agent(cfg) as agent:
+        assert agent.wait_rounds(30, timeout=120)
+        ms = agent.members()
+        assert {m["region"] for m in ms} == {0, 1, 2, 3}
+        assert any(m["ring"] > 0 for m in ms)
+        agent.write(node=0, cell=1, value=4242)
+        reader = agent.n_nodes - 1  # region 3, cross-region delivery
+        for _ in range(100):
+            if agent.read_cell(reader, 1)["value"] == 4242:
+                break
+            agent.wait_rounds(5, timeout=60)
+        assert agent.read_cell(reader, 1)["value"] == 4242

@@ -90,9 +90,8 @@ def test_partition_then_heal(stepper):
     st, key = run(step, st, net, jr.key(3), 40)
 
     # split 2:1; each side should declare the other Down
-    part = NetModel(
+    part = NetModel.create(N)._replace(
         partition=(jnp.arange(N) % 3 == 0).astype(jnp.int32),
-        drop_prob=jnp.float32(0.0),
     )
     st, key = run(step, st, part, key, 60)
     states = np.asarray(st.view) & 3
