@@ -402,17 +402,15 @@ class Agent:
     def members(self) -> list:
         """Member dump incl. region + RTT ring relative to node 0 (the
         reference's members dump shows per-peer ring membership)."""
-        import numpy as _np
-
         from corrosion_tpu.sim.transport import RING_RTT_MS, ring_of
 
         snap = self.snapshot()
-        ids = _np.arange(self.n_nodes, dtype=_np.int32)
-        rings = _np.asarray(
+        ids = np.arange(self.n_nodes, dtype=np.int32)
+        rings = np.asarray(
             ring_of(self._net, jnp.zeros(self.n_nodes, jnp.int32),
                     jnp.asarray(ids))
         )
-        regions = _np.asarray(self._net.region)
+        regions = np.asarray(self._net.region)
         return [
             {"id": i, "state": "Alive" if bool(a) else "Down",
              "incarnation": int(inc), "region": int(regions[i]),

@@ -338,16 +338,20 @@ class Database:
                            f"{sql[:80]!r}")
         p = _Params(params)
         table = self.schema.table(_unquote(m.group("table")))
-        raw_cols = m.group("cols").strip()
-        if raw_cols == "*":
-            names = [c.name for c in table.columns]
-        else:
-            names = [_unquote(c) for c in raw_cols.split(",")]
-            for n in names:
-                table.column(n)
+        names = self._select_names(table, m.group("cols"))
         conds = self._parse_where(table, m.group("where"), p)
         limit = int(m.group("limit")) if m.group("limit") else None
         return names, self._scan(node, table, names, conds, limit)
+
+    @staticmethod
+    def _select_names(table, raw_cols: str) -> List[str]:
+        raw_cols = raw_cols.strip()
+        if raw_cols == "*":
+            return [c.name for c in table.columns]
+        names = [_unquote(c) for c in raw_cols.split(",")]
+        for n in names:
+            table.column(n)
+        return names
 
     def query_columns(self, sql: str) -> List[str]:
         """The column names a SELECT would produce — schema-only, no
@@ -356,13 +360,7 @@ class Database:
         if m is None:
             raise SqlError(f"not a SELECT: {sql[:80]!r}")
         table = self.schema.table(_unquote(m.group("table")))
-        raw_cols = m.group("cols").strip()
-        if raw_cols == "*":
-            return [c.name for c in table.columns]
-        names = [_unquote(c) for c in raw_cols.split(",")]
-        for n in names:
-            table.column(n)
-        return names
+        return self._select_names(table, m.group("cols"))
 
     def _parse_where(self, table, where: Optional[str], p: _Params):
         if not where:

@@ -35,7 +35,10 @@ SSL_REQUEST = 80877103
 CANCEL_REQUEST = 80877102
 
 # minimal OID map (values always travel in text format)
+OID_INT2 = 21
+OID_INT4 = 23
 OID_INT8 = 20
+OID_INTS = (OID_INT2, OID_INT4, OID_INT8)
 OID_FLOAT8 = 701
 OID_TEXT = 25
 OID_BYTEA = 17
@@ -289,7 +292,11 @@ def _make_handler(server: PgServer):
                           re.IGNORECASE)
             return m.group(1).strip('"') if m else None
 
-        def _run_sql(self, sql: str, params: Any = None) -> None:
+        def _run_sql(self, sql: str, params: Any = None,
+                     send_desc: bool = True) -> None:
+            """``send_desc``: simple query includes RowDescription;
+            extended Execute must NOT (the client learned the shape from
+            Describe — a second 'T' is a protocol violation)."""
             sql = _translate_sql(sql)
             if not sql or sql.rstrip(";") == "":
                 self.out.add(b"I", b"")  # EmptyQueryResponse
@@ -303,24 +310,27 @@ def _make_handler(server: PgServer):
                 return
             if upper.startswith("SHOW "):
                 name = sql.split(None, 1)[1].rstrip(";")
-                self._row_description([name.lower()])
+                if send_desc:
+                    self._row_description([name.lower()])
                 self._data_row([""])
                 self._command_complete("SHOW")
                 return
             if "PG_CATALOG" in upper or "INFORMATION_SCHEMA" in upper:
                 # the reference fakes these via vtabs; we answer empty
-                self._row_description(["?column?"])
+                if send_desc:
+                    self._row_description(["?column?"])
                 self._command_complete("SELECT 0")
                 return
             if upper.startswith("SELECT"):
-                self._run_select(sql, params)
+                self._run_select(sql, params, send_desc)
                 return
             n = self._run_write(sql, params)
             verb = upper.split()[0]
             tag = f"INSERT 0 {n}" if verb == "INSERT" else f"{verb} {n}"
             self._command_complete(tag)
 
-        def _run_select(self, sql: str, params: Any) -> None:
+        def _run_select(self, sql: str, params: Any,
+                        send_desc: bool = True) -> None:
             import re
 
             # constant selects like SELECT 1 / SELECT version()
@@ -335,12 +345,14 @@ def _make_handler(server: PgServer):
                         val = int(expr)
                     except ValueError:
                         val = expr.strip("'")
-                self._row_description(["?column?"])
+                if send_desc:
+                    self._row_description(["?column?"])
                 self._data_row([val])
                 self._command_complete("SELECT 1")
                 return
             cols, rows = server.db.query(self.node, sql, params)
-            self._row_description(cols, self._table_of(sql))
+            if send_desc:
+                self._row_description(cols, self._table_of(sql))
             n = 0
             for row in rows:
                 self._data_row(row)
@@ -475,11 +487,11 @@ def _make_handler(server: PgServer):
             if fmt == 1:  # binary
                 if oid == OID_FLOAT8:
                     return struct.unpack("!d", raw)[0]
-                if oid == OID_INT8 or (oid == 0 and len(raw) in (2, 4, 8)):
+                if oid in OID_INTS or (oid == 0 and len(raw) in (2, 4, 8)):
                     return int.from_bytes(raw, "big", signed=True)
                 return raw
             text = raw.decode()
-            if oid == OID_INT8:
+            if oid in OID_INTS:
                 return int(text)
             if oid == OID_FLOAT8:
                 return float(text)
@@ -528,7 +540,8 @@ def _make_handler(server: PgServer):
                 self._send_error(f"no such portal {name!r}")
                 return
             try:
-                self._run_sql(portal.stmt.sql, portal.params or None)
+                self._run_sql(portal.stmt.sql, portal.params or None,
+                              send_desc=False)
             except (SqlError, SchemaError) as e:
                 code = (SQLSTATE_UNDEFINED_TABLE if "no such table" in str(e)
                         else SQLSTATE_SYNTAX)

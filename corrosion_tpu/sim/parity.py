@@ -1,0 +1,313 @@
+"""State-parity harness: host oracle cluster vs the TPU simulator.
+
+The build plan's step 7 (SURVEY §7): drive a real (CPU, pure-Python)
+cluster and the TPU sim with *identical workload scripts* and compare
+final state — the analog of running corro-devcluster next to the
+simulator and applying the Antithesis ``check_bookkeeping.py`` predicate
+("no needs, equal heads") plus full LWW-store equality.
+
+Determinism contract (SURVEY hard part (d) — RNG models differ, so
+parity is defined on RNG-independent facts):
+
+- **single-writer-per-cell** workloads: a cell's ``col_version`` only
+  ever advances through its one writer's own writes, so the converged
+  store is a pure function of the write script — the oracle and the sim
+  must match **bitwise** on all four planes (ver, val, site, dbv).
+- **multi-writer** workloads: ``col_version`` bumps from the writer's
+  *merged* clock (cr-sqlite semantics, ``local_write``), which depends
+  on delivery timing; parity is then **agreement + validity**: every
+  node converged to the same store, the winning value for each cell was
+  actually written to that cell, and the convergence predicate holds on
+  both systems.
+
+The oracle cluster mirrors the sim's protocol semantics exactly
+(one-cell writes with ``ver = merged_ver + 1``, per-origin ``db_version``
+counters, fanout + rebroadcast budgets, pull-based anti-entropy) in plain
+Python over :class:`OracleNode` — deliberately obvious, nothing shared
+with the array code.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from corrosion_tpu.sim.oracle import OracleNode
+
+Change = Tuple[int, int, int, int, int]  # (cell, ver, val, site, dbv); origin==site
+
+
+@dataclass
+class WorkloadScript:
+    """Per-round write lists, shareable between oracle and sim.
+
+    ``writes[r]`` = list of (node, cell, value) committed in round r.
+    One write per node per round (the sim's RoundInput shape)."""
+
+    n_nodes: int
+    n_origins: int
+    n_cells: int
+    writes: List[List[Tuple[int, int, int]]] = field(default_factory=list)
+
+    @staticmethod
+    def random_single_writer(n_nodes: int, n_origins: int, n_cells: int,
+                             rounds: int, seed: int = 0,
+                             write_prob: float = 0.5) -> "WorkloadScript":
+        """Each cell is owned by one writer (cell % n_origins) — the
+        bitwise-parity regime."""
+        rng = random.Random(seed)
+        ws = WorkloadScript(n_nodes, n_origins, n_cells)
+        for _ in range(rounds):
+            batch = []
+            for w in range(n_origins):
+                if rng.random() < write_prob:
+                    owned = [c for c in range(n_cells) if c % n_origins == w]
+                    if owned:
+                        batch.append((w, rng.choice(owned),
+                                      rng.randrange(1, 1 << 20)))
+            ws.writes.append(batch)
+        return ws
+
+    @staticmethod
+    def random_conflicting(n_nodes: int, n_origins: int, n_cells: int,
+                           rounds: int, seed: int = 0,
+                           write_prob: float = 0.5,
+                           hot_cells: int = 2) -> "WorkloadScript":
+        """All writers hammer a few hot cells — the LWW-conflict regime."""
+        rng = random.Random(seed)
+        ws = WorkloadScript(n_nodes, n_origins, n_cells)
+        for _ in range(rounds):
+            batch = []
+            for w in range(n_origins):
+                if rng.random() < write_prob:
+                    batch.append((w, rng.randrange(hot_cells),
+                                  rng.randrange(1, 1 << 20)))
+            ws.writes.append(batch)
+        return ws
+
+    def written_values(self) -> Dict[int, set]:
+        """cell -> set of all values ever written to it (validity check)."""
+        out: Dict[int, set] = {}
+        for batch in self.writes:
+            for _, cell, val in batch:
+                out.setdefault(cell, set()).add(val)
+        return out
+
+
+class OracleCluster:
+    """N pure-Python nodes speaking the sim's protocol semantics."""
+
+    def __init__(self, n_nodes: int, n_origins: int, n_cells: int,
+                 fanout: int = 3, rebroadcast_budget: int = 3,
+                 sync_peers: int = 2, seed: int = 0):
+        self.n_nodes = n_nodes
+        self.n_origins = n_origins
+        self.n_cells = n_cells
+        self.fanout = fanout
+        self.sync_peers = sync_peers
+        self.budget = rebroadcast_budget
+        self.rng = random.Random(seed)
+        self.nodes = [OracleNode(n_origins) for _ in range(n_nodes)]
+        self.next_dbv = [1] * n_nodes
+        # per-node change payloads for serving sync: (origin, dbv) -> Change
+        self.payloads: List[Dict[Tuple[int, int], Change]] = [
+            {} for _ in range(n_nodes)
+        ]
+        # per-node broadcast queue: (change, remaining transmissions)
+        self.queues: List[List[Tuple[Change, int]]] = [[] for _ in range(n_nodes)]
+
+    # --- write path ------------------------------------------------------
+    def write(self, node: int, cell: int, value: int) -> None:
+        assert node < self.n_origins
+        cur = self.nodes[node].store.get(cell)
+        ver = (cur[0] if cur else 0) + 1  # bump the merged clock (local_write)
+        dbv = self.next_dbv[node]
+        self.next_dbv[node] += 1
+        ch: Change = (cell, ver, value, node, dbv)
+        self.nodes[node].apply((cell, ver, value, node, node, dbv))
+        self.payloads[node][(node, dbv)] = ch
+        self.queues[node].append((ch, self.budget))
+
+    # --- dissemination round ---------------------------------------------
+    def round(self) -> None:
+        # broadcast flush: every queued change goes to a random fanout set
+        deliveries: List[Tuple[int, Change]] = []
+        for src in range(self.n_nodes):
+            newq = []
+            for ch, tx in self.queues[src]:
+                targets = self.rng.sample(
+                    [t for t in range(self.n_nodes) if t != src],
+                    min(self.fanout, self.n_nodes - 1),
+                )
+                deliveries.extend((t, ch) for t in targets)
+                if tx - 1 > 0:
+                    newq.append((ch, tx - 1))
+            self.queues[src] = newq
+        for dst, ch in deliveries:
+            self._ingest(dst, ch)
+        # anti-entropy: each node pulls its missing versions from peers
+        for node in range(self.n_nodes):
+            peers = self.rng.sample(
+                [p for p in range(self.n_nodes) if p != node],
+                min(self.sync_peers, self.n_nodes - 1),
+            )
+            for peer in peers:
+                self._sync_pull(node, peer)
+
+    def _ingest(self, dst: int, ch: Change) -> None:
+        cell, ver, val, site, dbv = ch
+        fresh = self.nodes[dst].apply((cell, ver, val, site, site, dbv))
+        if fresh:
+            self.payloads[dst][(site, dbv)] = ch
+            self.queues[dst].append((ch, max(1, self.budget - 1)))
+
+    def _sync_pull(self, node: int, peer: int) -> None:
+        """compute_available_needs + serve: pull every version the peer
+        can grant that we lack (``sync.rs:127``)."""
+        mine, theirs = self.nodes[node], self.nodes[peer]
+        for origin in range(self.n_origins):
+            their_seen = theirs.seen.get(origin, set())
+            my_seen = mine.seen.get(origin, set())
+            for dbv in sorted(their_seen - my_seen):
+                ch = self.payloads[peer].get((origin, dbv))
+                if ch is not None:
+                    self._ingest(node, ch)
+
+    # --- harness ---------------------------------------------------------
+    def run(self, script: WorkloadScript, settle_rounds: int = 64) -> int:
+        """Apply the script, then settle until converged. Returns rounds
+        taken (-1 if it never converged — a harness failure)."""
+        from corrosion_tpu.sim.oracle import converged
+
+        for batch in script.writes:
+            for node, cell, val in batch:
+                self.write(node, cell, val)
+            self.round()
+        for r in range(settle_rounds):
+            if not any(self.queues) and converged(self.nodes):
+                return len(script.writes) + r
+            self.round()
+        return len(script.writes) + settle_rounds if converged(self.nodes) else -1
+
+    def store_planes(self) -> Tuple[np.ndarray, ...]:
+        """Node-0's converged store as dense (ver, val, site, dbv) planes
+        (after ``run`` all nodes are identical)."""
+        planes = [np.zeros(self.n_cells, np.int32) for _ in range(4)]
+        for cell, (ver, val, site, dbv) in self.nodes[0].store.items():
+            planes[0][cell], planes[1][cell] = ver, val
+            planes[2][cell], planes[3][cell] = site, dbv
+        return tuple(planes)
+
+
+# --- sim-side runner ------------------------------------------------------
+
+def run_sim_script(script: WorkloadScript, seed: int = 0,
+                   settle_rounds: int = 512, drop_prob: float = 0.0,
+                   sync_interval: int = 4):
+    """Run the scale sim under the same script until converged.
+
+    Returns (store planes [N, n_cells] x4, alive mask, rounds-taken or -1).
+    """
+    import jax
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    from corrosion_tpu.sim.scale_step import (
+        ScaleRoundInput,
+        ScaleSimState,
+        scale_crdt_metrics,
+        scale_sim_config,
+        scale_sim_step,
+    )
+    from corrosion_tpu.sim.transport import NetModel
+
+    n_rows = max(1, (script.n_cells + 3) // 4)
+    cfg = scale_sim_config(
+        script.n_nodes, n_origins=script.n_origins,
+        n_rows=n_rows, n_cols=(script.n_cells + n_rows - 1) // n_rows,
+        sync_interval=sync_interval,
+    )
+    # the configured grid must cover the script's cell space
+    assert cfg.n_cells >= script.n_cells
+    st = ScaleSimState.create(cfg)
+    net = NetModel.create(script.n_nodes, drop_prob=drop_prob)
+    step = jax.jit(lambda s, k, i: scale_sim_step(cfg, s, net, k, i))
+    key = jr.key(seed)
+    quiet = ScaleRoundInput.quiet(cfg)
+
+    def round_input(batch):
+        wm = np.zeros(script.n_nodes, bool)
+        wc = np.zeros(script.n_nodes, np.int32)
+        wv = np.zeros(script.n_nodes, np.int32)
+        for node, cell, val in batch:
+            wm[node], wc[node], wv[node] = True, cell, val
+        return quiet._replace(
+            write_mask=jnp.asarray(wm), write_cell=jnp.asarray(wc),
+            write_val=jnp.asarray(wv),
+        )
+
+    for batch in script.writes:
+        key, sub = jr.split(key)
+        st, _ = step(st, sub, round_input(batch))
+    taken = -1
+    for r in range(settle_rounds):
+        m = scale_crdt_metrics(cfg, st)
+        if bool(m["converged"]):
+            taken = len(script.writes) + r
+            break
+        key, sub = jr.split(key)
+        st, _ = step(st, sub, quiet)
+    planes = tuple(np.asarray(p)[:, :script.n_cells] for p in st.crdt.store)
+    return planes, np.asarray(st.swim.alive), taken
+
+
+# --- comparison -----------------------------------------------------------
+
+def check_bitwise_parity(oracle: OracleCluster, sim_planes, alive) -> List[str]:
+    """Single-writer regime: every alive sim node's store must equal the
+    oracle's converged store, plane by plane. Returns mismatch messages."""
+    problems = []
+    o_planes = oracle.store_planes()
+    names = ("col_version", "value", "site", "db_version")
+    for name, op, sp in zip(names, o_planes, sim_planes):
+        for node in np.nonzero(alive)[0]:
+            if not np.array_equal(sp[node], op):
+                bad = np.nonzero(sp[node] != op)[0]
+                problems.append(
+                    f"{name} plane: sim node {node} differs from oracle at "
+                    f"cells {bad.tolist()[:8]} "
+                    f"(sim={sp[node][bad[:8]].tolist()} "
+                    f"oracle={op[bad[:8]].tolist()})"
+                )
+                break  # one node per plane is enough signal
+    return problems
+
+
+def check_agreement_validity(script: WorkloadScript, sim_planes,
+                             alive) -> List[str]:
+    """Multi-writer regime: all alive nodes identical + every winning
+    value was actually written to its cell."""
+    problems = []
+    alive_idx = np.nonzero(alive)[0]
+    ref = alive_idx[0]
+    for name, plane in zip(("ver", "val", "site", "dbv"), sim_planes):
+        same = np.all(plane[alive_idx] == plane[ref], axis=0)
+        if not same.all():
+            problems.append(
+                f"agreement violated on {name} at cells "
+                f"{np.nonzero(~same)[0].tolist()[:8]}"
+            )
+    written = script.written_values()
+    val_plane = sim_planes[1][ref]
+    ver_plane = sim_planes[0][ref]
+    for cell in range(script.n_cells):
+        if ver_plane[cell] > 0 and cell in written:
+            if int(val_plane[cell]) not in written[cell]:
+                problems.append(
+                    f"validity violated: cell {cell} holds "
+                    f"{int(val_plane[cell])}, never written there"
+                )
+    return problems

@@ -1,0 +1,75 @@
+"""State parity: host oracle cluster vs TPU sim under identical workload
+scripts (SURVEY §7 step 7 — the corro-devcluster comparison with the
+``check_bookkeeping`` predicate)."""
+
+import numpy as np
+import pytest
+
+from corrosion_tpu.sim.parity import (
+    OracleCluster,
+    WorkloadScript,
+    check_agreement_validity,
+    check_bitwise_parity,
+    run_sim_script,
+)
+
+N_NODES, N_ORIGINS, N_CELLS, ROUNDS = 24, 4, 8, 12
+
+
+def _run_oracle(script, seed=1):
+    oc = OracleCluster(N_NODES, N_ORIGINS, N_CELLS, seed=seed)
+    taken = oc.run(script)
+    assert taken > 0, "oracle cluster failed to converge"
+    return oc
+
+
+def test_oracle_cluster_converges_alone():
+    script = WorkloadScript.random_single_writer(
+        N_NODES, N_ORIGINS, N_CELLS, ROUNDS, seed=7)
+    oc = _run_oracle(script)
+    # spot-check: the last write per cell won
+    planes = oc.store_planes()
+    last = {}
+    for batch in script.writes:
+        for node, cell, val in batch:
+            last[cell] = val
+    for cell, val in last.items():
+        assert planes[1][cell] == val
+
+
+def test_bitwise_parity_single_writer():
+    script = WorkloadScript.random_single_writer(
+        N_NODES, N_ORIGINS, N_CELLS, ROUNDS, seed=3)
+    oc = _run_oracle(script)
+    planes, alive, taken = run_sim_script(script, seed=3)
+    assert taken > 0, "sim failed to converge"
+    problems = check_bitwise_parity(oc, planes, alive)
+    assert not problems, "\n".join(problems)
+
+
+def test_bitwise_parity_with_loss():
+    """Parity must survive a lossy network (sync repairs the gaps)."""
+    script = WorkloadScript.random_single_writer(
+        N_NODES, N_ORIGINS, N_CELLS, ROUNDS, seed=11)
+    oc = _run_oracle(script)
+    planes, alive, taken = run_sim_script(script, seed=11, drop_prob=0.05)
+    assert taken > 0, "sim failed to converge under loss"
+    problems = check_bitwise_parity(oc, planes, alive)
+    assert not problems, "\n".join(problems)
+
+
+def test_conflict_parity_agreement_and_validity():
+    script = WorkloadScript.random_conflicting(
+        N_NODES, N_ORIGINS, N_CELLS, ROUNDS, seed=5, hot_cells=2)
+    # oracle converges on its own trajectory
+    oc = _run_oracle(script)
+    # sim converges on its own trajectory; agreement + validity must hold
+    planes, alive, taken = run_sim_script(script, seed=5)
+    assert taken > 0
+    problems = check_agreement_validity(script, planes, alive)
+    assert not problems, "\n".join(problems)
+    # both systems settled on SOME valid winner for the hot cells
+    o_planes = oc.store_planes()
+    written = script.written_values()
+    for cell in written:
+        assert int(o_planes[1][cell]) in written[cell]
