@@ -103,6 +103,25 @@ class AdminServer:
         if name == "log":
             set_level(cmd.get("level", "info"))
             return {"ok": cmd.get("level", "info")}
+        if name == "assertions":
+            from corrosion_tpu.utils.assertions import REGISTRY
+
+            return {"ok": {**REGISTRY.snapshot(),
+                           "liveness": REGISTRY.liveness_report()}}
+        if name == "reload":
+            # `corrosion reload` analog: re-apply schema files + log level
+            # from the (possibly edited) config file (command/reload.rs)
+            from corrosion_tpu.config import load_config
+
+            cfg = load_config(cmd["config"])
+            applied = []
+            if self.db is not None:
+                for path in cfg.db.schema_paths:
+                    with open(path) as f:
+                        applied.extend(self.db.apply_schema_sql(f.read()))
+            set_level(cfg.log.level)
+            return {"ok": {"schema_changes": [list(c) for c in applied],
+                           "log_level": cfg.log.level}}
         # --- fault injection (Antithesis driver analog) -------------------
         if name == "kill":
             agent.kill_node(int(cmd["node"]))

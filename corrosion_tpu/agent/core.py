@@ -33,6 +33,7 @@ import jax.random as jr
 import numpy as np
 
 from corrosion_tpu.config import Config
+from corrosion_tpu.utils.assertions import assert_always, assert_sometimes
 from corrosion_tpu.utils.lifecycle import Tripwire, spawn_counted
 from corrosion_tpu.utils.locks import LockRegistry
 from corrosion_tpu.utils.metrics import Registry, RoundTimer, record_round_info
@@ -209,9 +210,21 @@ class Agent:
             self._state, info = self._step(self._state, net, sub, inp)
             jax.block_until_ready(self._state)
 
-        record_round_info(
-            {k: v for k, v in info.items()}, registry=self.metrics
+        vals = {k: float(v) for k, v in info.items()}
+        record_round_info(vals, registry=self.metrics)
+        # inline always/sometimes probes (the Antithesis instrumentation
+        # seam, SURVEY §4): invariants log+count, liveness is aggregated
+        assert_always(
+            all(v >= 0 for v in vals.values()),
+            "round counters non-negative",
+            str({k: v for k, v in vals.items() if v < 0}),
         )
+        assert_sometimes(vals.get("syncs", 0) > 0,
+                         "nodes sync with other nodes")
+        assert_sometimes(vals.get("delivered", 0) > 0,
+                         "broadcasts deliver changes")
+        assert_sometimes(vals.get("acked", 0) > 0,
+                         "SWIM probes are acked")
         # invalidate the cached snapshot BEFORE waking round waiters, so a
         # woken wait_rounds() caller never reads pre-round state
         with self._snap_lock:
@@ -299,6 +312,14 @@ class Agent:
 
     def heal_partition(self):
         self.set_partition(np.zeros(self.n_nodes, np.int32))
+
+    def set_regions(self, regions: np.ndarray):
+        """Assign geographic region per node (drives the RTT rings).
+        Applied between rounds, like partitions."""
+        regions = np.asarray(regions, np.int32)
+        assert regions.shape == (self.n_nodes,)
+        with self._input_lock:
+            self._net = self._net._replace(region=jnp.asarray(regions))
 
     # --- checkpoint / restore -------------------------------------------
     def device_state(self):

@@ -54,13 +54,14 @@ def _params(raw):
     return out
 
 
-def cmd_agent(args) -> int:
+def cmd_agent(args, cfg=None, regions=None) -> int:
     from corrosion_tpu.admin import AdminServer
     from corrosion_tpu.agent import Agent
     from corrosion_tpu.api import ApiServer
     from corrosion_tpu.db import Database
 
-    cfg = load_config(args.config) if args.config else Config()
+    if cfg is None:
+        cfg = load_config(args.config) if args.config else Config()
     # validate listener addresses BEFORE anything starts, so a config typo
     # cannot strand half-booted servers
     prom_hostport = None
@@ -73,6 +74,8 @@ def cmd_agent(args) -> int:
             )
         prom_hostport = (host or "127.0.0.1", int(port))
     agent = Agent(cfg).start(pace_seconds=args.pace)
+    if regions is not None:
+        agent.set_regions(regions)
     agent.tripwire.hook_signals()
     api = admin = pg = prom = None
     try:
@@ -215,6 +218,84 @@ def cmd_default_config(args) -> int:
     return 0
 
 
+def parse_topology(text: str):
+    """``A -> B`` edge-list topology (corro-devcluster's format,
+    ``corro-devcluster/src/topology/mod.rs``): returns (names in
+    first-appearance order, edges as index pairs, group id per node from
+    connected components)."""
+    names: list = []
+    index: dict = {}
+    edges = []
+
+    def nid(name: str) -> int:
+        if name not in index:
+            index[name] = len(names)
+            names.append(name)
+        return index[name]
+
+    for line in text.splitlines():
+        line = line.split("#", 1)[0].strip()
+        if not line:
+            continue
+        if "->" in line:
+            a, b = (s.strip() for s in line.split("->", 1))
+            edges.append((nid(a), nid(b)))
+        else:
+            nid(line)
+    # connected components -> region groups
+    parent = list(range(len(names)))
+
+    def find(x):
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    for a, b in edges:
+        parent[find(a)] = find(b)
+    roots = {}
+    groups = []
+    for i in range(len(names)):
+        r = find(i)
+        groups.append(roots.setdefault(r, len(roots)))
+    return names, edges, groups
+
+
+def cmd_devcluster(args) -> int:
+    """Boot an N-node cluster from a topology file (corro-devcluster
+    analog): node names map to simulator indices, topology components map
+    to regions, and the agent serves the whole cluster."""
+    with open(args.topology) as f:
+        names, edges, groups = parse_topology(f.read())
+    if not names:
+        raise SystemExit(f"no nodes in topology file {args.topology}")
+    cfg = load_config(args.config) if args.config else Config()
+    cfg.sim.n_nodes = len(names)
+    cfg.sim.n_origins = min(cfg.sim.n_origins, len(names))
+    cfg.gossip.n_regions = max(groups) + 1 if groups else 1
+    print(json.dumps({
+        "nodes": {name: i for i, name in enumerate(names)},
+        "edges": [[names[a], names[b]] for a, b in edges],
+        "regions": {name: g for name, g in zip(names, groups)},
+    }, indent=2), flush=True)
+    # thread the per-node component assignment into the RTT-ring model
+    # (region count alone would re-shuffle nodes round-robin)
+    return cmd_agent(args, cfg=cfg, regions=groups)
+
+
+def cmd_reload(args) -> int:
+    with _admin(args) as admin:
+        out = admin.call("reload", config=args.config)
+    print(json.dumps(out))
+    return 0
+
+
+def cmd_assertions(args) -> int:
+    with _admin(args) as admin:
+        print(json.dumps(admin.call("assertions"), indent=2))
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="corrosion-tpu",
@@ -295,6 +376,21 @@ def build_parser() -> argparse.ArgumentParser:
     cs.add_argument("--once", action="store_true")
     cs.add_argument("--node", type=int, default=0)
     cs.set_defaults(fn=cmd_consul)
+
+    dc = sub.add_parser("devcluster",
+                        help="boot a cluster from an `A -> B` topology file")
+    dc.add_argument("topology")
+    dc.add_argument("-c", "--config", default=None)
+    dc.add_argument("--pace", type=float, default=0.05)
+    dc.set_defaults(fn=cmd_devcluster)
+
+    rl = sub.add_parser("reload", help="re-apply config (schema, log level)")
+    rl.add_argument("config")
+    rl.set_defaults(fn=cmd_reload)
+
+    asr = sub.add_parser("assertions",
+                         help="always/sometimes assertion report")
+    asr.set_defaults(fn=cmd_assertions)
 
     d = sub.add_parser("default-config", help="print an example config file")
     d.set_defaults(fn=cmd_default_config)

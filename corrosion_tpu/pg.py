@@ -159,6 +159,11 @@ class _Portal:
     def __init__(self, stmt: _PreparedStatement, params: List[Any]):
         self.stmt = stmt
         self.params = params
+        # True once Describe(portal) emitted a RowDescription; Execute
+        # then must NOT send a second one (protocol), but when Describe
+        # answered NoData (synthetic results: SHOW, constant SELECT,
+        # pg_catalog) Execute still owes the client a description
+        self.described = False
 
 
 class PgServer:
@@ -523,15 +528,19 @@ def _make_handler(server: PgServer):
                     self._send_error(f"no such portal {name!r}")
                     return
                 sql = portal.stmt.sql
+            described = False
             if sql.upper().lstrip().startswith("SELECT"):
                 try:
                     # schema-only plan: no table scan on the Describe phase
                     cols = server.db.query_columns(_translate_sql(sql))
                     self._row_description(cols, self._table_of(sql))
+                    described = True
                 except Exception:  # noqa: BLE001 — constant SELECTs etc.
                     self.out.add(b"n", b"")  # NoData
             else:
                 self.out.add(b"n", b"")
+            if kind == b"P":
+                portal.described = described
 
         def _on_execute(self, payload: bytes):
             name = payload.split(b"\x00", 1)[0].decode()
@@ -540,8 +549,11 @@ def _make_handler(server: PgServer):
                 self._send_error(f"no such portal {name!r}")
                 return
             try:
+                # Describe already told the client the row shape iff it
+                # produced a RowDescription; synthetic results (NoData
+                # from Describe) still need theirs here
                 self._run_sql(portal.stmt.sql, portal.params or None,
-                              send_desc=False)
+                              send_desc=not portal.described)
             except (SqlError, SchemaError) as e:
                 code = (SQLSTATE_UNDEFINED_TABLE if "no such table" in str(e)
                         else SQLSTATE_SYNTAX)

@@ -253,10 +253,12 @@ def run_sim_script(script: WorkloadScript, seed: int = 0,
         key, sub = jr.split(key)
         st, _ = step(st, sub, round_input(batch))
     taken = -1
-    for r in range(settle_rounds):
+    for r in range(settle_rounds + 1):  # +1: check AFTER the last step too
         m = scale_crdt_metrics(cfg, st)
         if bool(m["converged"]):
             taken = len(script.writes) + r
+            break
+        if r == settle_rounds:
             break
         key, sub = jr.split(key)
         st, _ = step(st, sub, quiet)
@@ -304,10 +306,16 @@ def check_agreement_validity(script: WorkloadScript, sim_planes,
     val_plane = sim_planes[1][ref]
     ver_plane = sim_planes[0][ref]
     for cell in range(script.n_cells):
-        if ver_plane[cell] > 0 and cell in written:
-            if int(val_plane[cell]) not in written[cell]:
-                problems.append(
-                    f"validity violated: cell {cell} holds "
-                    f"{int(val_plane[cell])}, never written there"
-                )
+        if ver_plane[cell] <= 0:
+            continue
+        if cell not in written:
+            problems.append(
+                f"validity violated: cell {cell} has version "
+                f"{int(ver_plane[cell])} but the script never wrote it"
+            )
+        elif int(val_plane[cell]) not in written[cell]:
+            problems.append(
+                f"validity violated: cell {cell} holds "
+                f"{int(val_plane[cell])}, never written there"
+            )
     return problems

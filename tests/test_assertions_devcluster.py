@@ -1,0 +1,77 @@
+"""Always/sometimes assertions (Antithesis SDK analog), admin reload, and
+the devcluster topology parser."""
+
+import pytest
+
+from corrosion_tpu.cli import parse_topology
+from corrosion_tpu.utils.assertions import AssertionRegistry
+
+
+def test_assert_always_counts_and_strict(monkeypatch):
+    reg = AssertionRegistry()
+    assert reg.always(True, "inv") is True
+    assert reg.always(False, "inv", "details") is False
+    assert reg.violations() == {"inv": 1}
+    snap = reg.snapshot()
+    assert snap["always"]["inv"] == {"passes": 1, "failures": 1}
+    monkeypatch.setenv("CORRO_TPU_STRICT_ASSERTS", "1")
+    with pytest.raises(AssertionError):
+        reg.always(False, "inv")
+
+
+def test_assert_sometimes_liveness():
+    reg = AssertionRegistry()
+    reg.sometimes(False, "syncs")
+    reg.sometimes(False, "syncs")
+    reg.sometimes(True, "delivers")
+    rep = reg.liveness_report()
+    assert rep["syncs"]["never_hit"] and rep["syncs"]["checks"] == 2
+    assert not rep["delivers"]["never_hit"]
+
+
+def test_unreachable():
+    reg = AssertionRegistry()
+    reg.unreachable("impossible state")
+    assert reg.violations() == {"unreachable: impossible state": 1}
+
+
+def test_parse_topology():
+    names, edges, groups = parse_topology("""
+        # two components
+        a -> b
+        b -> c
+        d -> e
+        loner
+    """)
+    assert names == ["a", "b", "c", "d", "e", "loner"]
+    assert (0, 1) in edges and (3, 4) in edges
+    # a,b,c share a group; d,e share another; loner is its own
+    assert groups[0] == groups[1] == groups[2]
+    assert groups[3] == groups[4] != groups[0]
+    assert len({groups[0], groups[3], groups[5]}) == 3
+
+
+def test_agent_round_assertions_fire():
+    """A running agent's round loop populates the global registry."""
+    from corrosion_tpu.agent import Agent
+    from corrosion_tpu.config import Config
+    from corrosion_tpu.utils.assertions import REGISTRY
+
+    cfg = Config()
+    cfg.sim.n_nodes = 16
+    cfg.sim.m_slots = 8
+    cfg.sim.n_origins = 4
+    cfg.sim.n_rows = 4
+    cfg.sim.n_cols = 2
+    cfg.perf.sync_interval = 2
+    cfg.gossip.drop_prob = 0.0
+    with Agent(cfg) as agent:
+        assert agent.wait_rounds(20, timeout=120)
+        agent.write(0, 1, 99)
+        assert agent.wait_rounds(10, timeout=60)
+    snap = REGISTRY.snapshot()
+    assert "round counters non-negative" in snap["always"]
+    assert snap["always"]["round counters non-negative"]["failures"] == 0
+    live = REGISTRY.liveness_report()
+    assert not live["SWIM probes are acked"]["never_hit"]
+    assert not live["broadcasts deliver changes"]["never_hit"]
