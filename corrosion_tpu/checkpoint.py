@@ -48,8 +48,16 @@ def _state_template(mode: str, cfg):
 
 
 def save_checkpoint(agent, db=None, path: str = "./checkpoint") -> str:
-    """Write the full cluster state to ``path`` (a directory)."""
+    """Write the full cluster state to ``path`` (a directory).
+
+    Crash-safe ordering: the manifest is removed first and (re)written
+    LAST via an atomic rename — a directory without a valid manifest is
+    incomplete by definition, so a crash mid-write can never leave a
+    side that looks restorable but is not."""
     os.makedirs(path, exist_ok=True)
+    manifest_path = os.path.join(path, "manifest.json")
+    if os.path.exists(manifest_path):
+        os.unlink(manifest_path)
     state = agent.device_state()
     leaves = [np.asarray(x) for x in _leaves(state)]
     np.savez_compressed(
@@ -64,8 +72,10 @@ def save_checkpoint(agent, db=None, path: str = "./checkpoint") -> str:
         "n_leaves": len(leaves),
         "db": db.state_dict() if db is not None else None,
     }
-    with open(os.path.join(path, "manifest.json"), "w") as f:
+    tmp = manifest_path + ".tmp"
+    with open(tmp, "w") as f:
         json.dump(manifest, f)
+    os.replace(tmp, manifest_path)
     return path
 
 

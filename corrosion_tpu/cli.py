@@ -80,9 +80,28 @@ def cmd_agent(args, cfg=None, regions=None) -> int:
     api = admin = pg = prom = None
     try:
         db = Database(agent)
+        maint = None
+        if cfg.db.checkpoint_rounds > 0:
+            from corrosion_tpu.maintenance import MaintenanceLoop
+
+            # boot-time resume from the newest restorable rotated side
+            # (the reference replays buffered state at boot, run_root.rs);
+            # runs BEFORE schema files so edited schemas apply on top of
+            # the restored state instead of being reverted by it
+            man = MaintenanceLoop.resume_latest(agent, cfg.db.path, db=db)
+            if man:
+                print(f"resumed from {man['path']} (round {man['round']})",
+                      flush=True)
         for path in cfg.db.schema_paths:
             with open(path) as f:
                 db.apply_schema_sql(f.read())
+        if cfg.db.checkpoint_rounds > 0:
+            from corrosion_tpu.maintenance import MaintenanceLoop
+
+            maint = MaintenanceLoop(
+                agent, db=db, checkpoint_path=cfg.db.path,
+                checkpoint_rounds=cfg.db.checkpoint_rounds,
+            ).start()
         api = ApiServer(db, addr=cfg.api.addr, port=cfg.api.port).start()
         admin = AdminServer(agent, cfg.admin.uds_path, db=db).start()
         if cfg.pg.enabled:

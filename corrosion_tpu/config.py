@@ -33,6 +33,8 @@ class DbConfig:
 
     path: str = "./corro_tpu_state"
     schema_paths: tuple = ()
+    # auto-checkpoint cadence in rounds (WAL-checkpoint analog); 0 = off
+    checkpoint_rounds: int = 0
 
 
 @dataclasses.dataclass

@@ -1,0 +1,140 @@
+"""DB maintenance loop: periodic durability + host-state housekeeping.
+
+Mirrors the reference's maintenance machinery (SURVEY §2.4): WAL
+checkpoint(TRUNCATE) when the WAL grows past a threshold
+(``spawn_handle_db_maintenance``, ``agent/handlers.rs:455-540``),
+incremental vacuum when the freelist grows (``handlers.rs:398-452``), and
+the buffered-meta GC loop (``clear_buffered_meta_loop``,
+``util.rs:430-490``).
+
+TPU reframing — the durable artifact is the checkpoint directory, so:
+
+- **auto-checkpoint**: every ``checkpoint_rounds`` rounds, if the cluster
+  advanced, write a full checkpoint (the WAL-checkpoint analog: bounded
+  recovery replay). Rotated: ``<path>/auto-{a,b}`` alternate so a crash
+  mid-write never corrupts the only copy.
+- **heap watch**: the interned value heap is append-only (SQLite freelist
+  analog); warn past a soft limit so operators raise it consciously.
+- **matcher-log GC** runs inline in the pubsub layer (``max_log``); this
+  loop reports its sizes as metrics.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from corrosion_tpu.utils.tracing import logger
+
+
+class MaintenanceLoop:
+    def __init__(self, agent, db=None, subs=None,
+                 checkpoint_path: Optional[str] = None,
+                 checkpoint_rounds: int = 512,
+                 heap_soft_limit: int = 1_000_000,
+                 interval_seconds: float = 2.0):
+        self.agent = agent
+        self.db = db
+        self.subs = subs
+        self.checkpoint_path = checkpoint_path
+        self.checkpoint_rounds = checkpoint_rounds
+        self.heap_soft_limit = heap_soft_limit
+        self.interval = interval_seconds
+        self._last_ckpt_round = agent.round_no
+        # seed rotation AWAY from the newest complete side, so the first
+        # write after a restart never overwrites the copy just restored
+        self._flip = False
+        if checkpoint_path:
+            latest = self.latest_auto_checkpoint(checkpoint_path)
+            if latest and latest.endswith("auto-a"):
+                self._flip = True  # next write goes to auto-b
+        self._warned_heap = False
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "MaintenanceLoop":
+        self._thread = threading.Thread(
+            target=self._loop, name="db-maintenance", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self.agent.tripwire.wait(self.interval):
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — maintenance must not die
+                logger.exception("maintenance tick failed")
+
+    def tick(self) -> Optional[str]:
+        """One maintenance pass; returns the checkpoint path if one was
+        written."""
+        written = None
+        rounds = self.agent.round_no
+        if (self.checkpoint_path
+                and rounds - self._last_ckpt_round >= self.checkpoint_rounds):
+            from corrosion_tpu.checkpoint import save_checkpoint
+
+            side = "auto-b" if self._flip else "auto-a"
+            target = os.path.join(self.checkpoint_path, side)
+            # flip/cadence advance only on SUCCESS: a failed write retries
+            # the same side (whose manifest save_checkpoint already
+            # removed, marking it incomplete) and never touches the other
+            written = save_checkpoint(self.agent, db=self.db, path=target)
+            self._flip = not self._flip
+            self._last_ckpt_round = rounds
+            self.agent.metrics.counter("corro.db.checkpoint.count")
+            logger.info("auto-checkpoint at round %d -> %s", rounds, target)
+        if self.db is not None:
+            heap_len = len(self.db.heap)
+            self.agent.metrics.gauge("corro.db.value_heap.len", heap_len)
+            if heap_len > self.heap_soft_limit and not self._warned_heap:
+                self._warned_heap = True
+                logger.warning(
+                    "value heap has %d entries (soft limit %d) — the heap "
+                    "is append-only; consider a fresh checkpoint+restart "
+                    "cycle to compact", heap_len, self.heap_soft_limit,
+                )
+        if self.subs is not None:
+            for mid in self.subs.ids():
+                m = self.subs.get(mid)
+                if m is not None:
+                    self.agent.metrics.gauge(
+                        "corro.subs.change_log.len", len(m._log),
+                        labels={"matcher": mid[:8]},
+                    )
+        return written
+
+    @staticmethod
+    def latest_auto_checkpoint(checkpoint_path: str) -> Optional[str]:
+        """The newest complete rotated checkpoint, for boot-time resume."""
+        sides = MaintenanceLoop._sides_newest_first(checkpoint_path)
+        return sides[0] if sides else None
+
+    @staticmethod
+    def _sides_newest_first(checkpoint_path: str) -> list:
+        found = []
+        for side in ("auto-a", "auto-b"):
+            p = os.path.join(checkpoint_path, side)
+            manifest = os.path.join(p, "manifest.json")
+            if os.path.exists(manifest):
+                found.append((os.path.getmtime(manifest), p))
+        return [p for _, p in sorted(found, reverse=True)]
+
+    @staticmethod
+    def resume_latest(agent, checkpoint_path: str, db=None) -> Optional[dict]:
+        """Boot-time resume: try rotated sides newest-first, falling back
+        to the older side if the newest fails to load (a half-written or
+        corrupted side must never brick startup). Returns the restored
+        manifest, or None when nothing restorable exists."""
+        from corrosion_tpu.checkpoint import restore_checkpoint
+
+        for p in MaintenanceLoop._sides_newest_first(checkpoint_path):
+            try:
+                man = restore_checkpoint(agent, p, db=db)
+                man["path"] = p
+                return man
+            except Exception:  # noqa: BLE001 — fall back to the other side
+                logger.exception("checkpoint %s is unrestorable; trying the "
+                                 "other side", p)
+        return None
