@@ -62,6 +62,18 @@ def load(build: bool = True):
         lib.corro_book_n_gaps.argtypes = [p, i32]
         lib.corro_apply_batch.restype = i32
         lib.corro_apply_batch.argtypes = [p, p, ip, i32, ip]
+        lib.corro_cluster_new.restype = p
+        lib.corro_cluster_new.argtypes = [i32, i32, i32, i32, i32, i32, i64]
+        lib.corro_cluster_free.argtypes = [p]
+        lib.corro_cluster_write.argtypes = [p, i32, i32, i32]
+        lib.corro_cluster_round.argtypes = [p]
+        lib.corro_cluster_converged.restype = i32
+        lib.corro_cluster_converged.argtypes = [p]
+        lib.corro_cluster_settle.restype = i32
+        lib.corro_cluster_settle.argtypes = [p, i32]
+        lib.corro_cluster_store.argtypes = [p, i32, ip, ip, ip, ip]
+        lib.corro_cluster_total_needs.restype = i64
+        lib.corro_cluster_total_needs.argtypes = [p]
         _lib = lib
         return _lib
 
@@ -132,4 +144,56 @@ class NativeNode:
             pl.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)) for pl in planes
         ]
         self._lib.corro_lww_dump(self._lww, *ptrs)
+        return planes
+
+
+class NativeCluster:
+    """Whole-cluster round engine in C++ — the 256+-node devcluster
+    oracle (same interface as ``sim/parity.OracleCluster``)."""
+
+    def __init__(self, n_nodes: int, n_origins: int, n_cells: int,
+                 fanout: int = 3, rebroadcast_budget: int = 3,
+                 sync_peers: int = 2, seed: int = 0):
+        self._lib = load()
+        if self._lib is None:
+            raise RuntimeError("native library unavailable (no C++ toolchain?)")
+        self.n_nodes = n_nodes
+        self.n_origins = n_origins
+        self.n_cells = n_cells
+        self._h = self._lib.corro_cluster_new(
+            n_nodes, n_origins, n_cells, fanout, rebroadcast_budget,
+            sync_peers, seed,
+        )
+
+    def __del__(self):
+        lib = getattr(self, "_lib", None)
+        if lib is not None and getattr(self, "_h", None):
+            lib.corro_cluster_free(self._h)
+
+    def write(self, node: int, cell: int, value: int) -> None:
+        self._lib.corro_cluster_write(self._h, node, cell, value)
+
+    def round(self) -> None:
+        self._lib.corro_cluster_round(self._h)
+
+    def converged(self) -> bool:
+        return bool(self._lib.corro_cluster_converged(self._h))
+
+    def total_needs(self) -> int:
+        return self._lib.corro_cluster_total_needs(self._h)
+
+    def run(self, script, settle_rounds: int = 256) -> int:
+        """Apply a WorkloadScript then settle; rounds taken or -1."""
+        for batch in script.writes:
+            for node, cell, val in batch:
+                self.write(node, cell, val)
+            self.round()
+        settled = self._lib.corro_cluster_settle(self._h, settle_rounds)
+        return -1 if settled < 0 else len(script.writes) + settled
+
+    def store_planes(self, node: int = 0):
+        planes = tuple(np.zeros(self.n_cells, np.int32) for _ in range(4))
+        ptrs = [pl.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+                for pl in planes]
+        self._lib.corro_cluster_store(self._h, node, *ptrs)
         return planes

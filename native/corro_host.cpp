@@ -17,7 +17,9 @@
 
 #include <cstdint>
 #include <cstring>
+#include <deque>
 #include <map>
+#include <unordered_map>
 #include <vector>
 
 namespace {
@@ -103,6 +105,141 @@ struct OriginBook {
 
 struct Book {
   std::vector<OriginBook> origins;
+};
+
+// ---------------------------------------------------------------------
+// Whole-cluster round engine: the devcluster-parity oracle at 256+
+// nodes, where the pure-Python cluster (sim/parity.py OracleCluster) is
+// too slow. Same protocol semantics: merged-clock version bumps on
+// write, fanout broadcast with re-transmission budgets, pull-based
+// anti-entropy over the interval books.
+
+struct Change {
+  int32_t cell, ver, val, site, dbv;
+};
+
+inline bool origin_contains(const OriginBook& b, int32_t v) {
+  auto it = b.runs.upper_bound(v);
+  if (it == b.runs.begin()) return false;
+  return std::prev(it)->second >= v;
+}
+
+struct ClusterNode {
+  Lww store;
+  Book book;
+  int32_t next_dbv = 1;
+  // (origin<<32 | dbv) -> payload, for serving sync pulls
+  std::unordered_map<int64_t, Change> payloads;
+  std::deque<std::pair<Change, int32_t>> queue;  // (change, tx budget)
+};
+
+struct Cluster {
+  int32_t n_nodes, n_origins, n_cells, fanout, budget, sync_peers;
+  uint64_t rng;
+  std::vector<ClusterNode> nodes;
+
+  uint32_t next_rand() {  // xorshift64*
+    rng ^= rng >> 12;
+    rng ^= rng << 25;
+    rng ^= rng >> 27;
+    return (uint32_t)((rng * 0x2545F4914F6CDD1DULL) >> 32);
+  }
+  int32_t rand_peer(int32_t self) {
+    int32_t p = (int32_t)(next_rand() % (uint32_t)(n_nodes - 1));
+    return p >= self ? p + 1 : p;
+  }
+
+  static int64_t pkey(int32_t origin, int32_t dbv) {
+    return ((int64_t)origin << 32) | (uint32_t)dbv;
+  }
+
+  void ingest(ClusterNode& dst, const Change& ch) {
+    if (!dst.book.origins[ch.site].record(ch.dbv)) return;
+    Cell& cell = dst.store.cells[ch.cell];
+    if (cell.ver == 0 || incoming_wins(cell, ch.ver, ch.val, ch.site))
+      cell = Cell{ch.ver, ch.val, ch.site, ch.dbv};
+    dst.payloads[pkey(ch.site, ch.dbv)] = ch;
+    int32_t tx = budget > 1 ? budget - 1 : 1;
+    dst.queue.emplace_back(ch, tx);
+  }
+
+  void write(int32_t node, int32_t cell, int32_t val) {
+    ClusterNode& n = nodes[node];
+    int32_t ver = n.store.cells[cell].ver + 1;  // merged-clock bump
+    int32_t dbv = n.next_dbv++;
+    Change ch{cell, ver, val, node, dbv};
+    n.book.origins[node].record(dbv);
+    Cell& c = n.store.cells[cell];
+    if (c.ver == 0 || incoming_wins(c, ver, val, node))
+      c = Cell{ver, val, node, dbv};
+    n.payloads[pkey(node, dbv)] = ch;
+    n.queue.emplace_back(ch, budget);
+  }
+
+  void round() {
+    // broadcast flush: every queued change to a random fanout set
+    std::vector<std::pair<int32_t, Change>> deliveries;
+    for (int32_t src = 0; src < n_nodes; src++) {
+      ClusterNode& n = nodes[src];
+      size_t pending = n.queue.size();
+      for (size_t q = 0; q < pending; q++) {
+        auto [ch, tx] = n.queue.front();
+        n.queue.pop_front();
+        for (int32_t f = 0; f < fanout && n_nodes > 1; f++)
+          deliveries.emplace_back(rand_peer(src), ch);
+        if (tx - 1 > 0) n.queue.emplace_back(ch, tx - 1);
+      }
+    }
+    for (auto& [dst, ch] : deliveries) ingest(nodes[dst], ch);
+    // anti-entropy: each node pulls everything missing from a few peers
+    for (int32_t i = 0; i < n_nodes && n_nodes > 1; i++) {
+      for (int32_t s = 0; s < sync_peers; s++) {
+        int32_t peer = rand_peer(i);
+        sync_pull(i, peer);
+      }
+    }
+  }
+
+  void sync_pull(int32_t node, int32_t peer) {
+    ClusterNode& mine = nodes[node];
+    ClusterNode& theirs = nodes[peer];
+    for (int32_t o = 0; o < n_origins; o++) {
+      for (auto& [lo, hi] : theirs.book.origins[o].runs) {
+        for (int32_t v = lo; v <= hi; v++) {
+          if (origin_contains(mine.book.origins[o], v)) continue;
+          auto it = theirs.payloads.find(pkey(o, v));
+          if (it != theirs.payloads.end()) ingest(mine, it->second);
+        }
+      }
+    }
+  }
+
+  bool queues_empty() const {
+    for (auto& n : nodes)
+      if (!n.queue.empty()) return false;
+    return true;
+  }
+
+  bool converged() const {
+    const ClusterNode& ref = nodes[0];
+    for (int32_t i = 0; i < n_nodes; i++) {
+      const ClusterNode& n = nodes[i];
+      for (int32_t o = 0; o < n_origins; o++) {
+        if (n.book.origins[o].needs() != 0) return false;
+        if (i && n.book.origins[o].head() != ref.book.origins[o].head())
+          return false;
+      }
+      if (i == 0) continue;
+      for (int32_t c = 0; c < n_cells; c++) {
+        const Cell& a = n.store.cells[c];
+        const Cell& b = ref.store.cells[c];
+        if (a.ver != b.ver || a.val != b.val || a.site != b.site ||
+            a.dbv != b.dbv)
+          return false;
+      }
+    }
+    return true;
+  }
 };
 
 }  // namespace
@@ -192,6 +329,69 @@ int32_t corro_apply_batch(void* book_h, void* lww_h, const int32_t* changes,
     if (fresh_out) fresh_out[i] = fresh ? 1 : 0;
   }
   return n_fresh;
+}
+
+// --- cluster round engine ---------------------------------------------
+void* corro_cluster_new(int32_t n_nodes, int32_t n_origins, int32_t n_cells,
+                        int32_t fanout, int32_t budget, int32_t sync_peers,
+                        int64_t seed) {
+  auto* c = new Cluster();
+  c->n_nodes = n_nodes;
+  c->n_origins = n_origins;
+  c->n_cells = n_cells;
+  c->fanout = fanout;
+  c->budget = budget;
+  c->sync_peers = sync_peers;
+  c->rng = (uint64_t)seed * 6364136223846793005ULL + 1442695040888963407ULL;
+  if (!c->rng) c->rng = 0x9E3779B97F4A7C15ULL;
+  c->nodes.resize(n_nodes);
+  for (auto& n : c->nodes) {
+    n.store.cells.resize(n_cells);
+    n.book.origins.resize(n_origins);
+  }
+  return c;
+}
+void corro_cluster_free(void* h) { delete static_cast<Cluster*>(h); }
+
+void corro_cluster_write(void* h, int32_t node, int32_t cell, int32_t val) {
+  static_cast<Cluster*>(h)->write(node, cell, val);
+}
+void corro_cluster_round(void* h) { static_cast<Cluster*>(h)->round(); }
+int32_t corro_cluster_converged(void* h) {
+  return static_cast<Cluster*>(h)->converged() ? 1 : 0;
+}
+
+// Run quiet rounds until converged (and queues drained) or the budget is
+// spent; returns rounds taken, or -1 when unconverged.
+int32_t corro_cluster_settle(void* h, int32_t max_rounds) {
+  auto* c = static_cast<Cluster*>(h);
+  for (int32_t r = 0; r <= max_rounds; r++) {
+    if (c->queues_empty() && c->converged()) return r;
+    if (r == max_rounds) break;
+    c->round();
+  }
+  return -1;
+}
+
+// Dump one node's store planes (each n_cells int32).
+void corro_cluster_store(void* h, int32_t node, int32_t* ver, int32_t* val,
+                         int32_t* site, int32_t* dbv) {
+  auto* c = static_cast<Cluster*>(h);
+  const auto& cells = c->nodes[node].store.cells;
+  for (int32_t i = 0; i < c->n_cells; i++) {
+    ver[i] = cells[i].ver;
+    val[i] = cells[i].val;
+    site[i] = cells[i].site;
+    dbv[i] = cells[i].dbv;
+  }
+}
+
+int64_t corro_cluster_total_needs(void* h) {
+  auto* c = static_cast<Cluster*>(h);
+  int64_t total = 0;
+  for (auto& n : c->nodes)
+    for (auto& o : n.book.origins) total += o.needs();
+  return total;
 }
 
 }  // extern "C"

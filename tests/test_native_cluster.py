@@ -1,0 +1,78 @@
+"""Native C++ cluster engine: agreement with the Python oracle cluster,
+and the 256-node devcluster parity run against the TPU sim (the BASELINE
+correctness configuration)."""
+
+import numpy as np
+import pytest
+
+from corrosion_tpu.native import NativeCluster, available
+from corrosion_tpu.sim.parity import (
+    OracleCluster,
+    WorkloadScript,
+    check_bitwise_parity,
+    run_sim_script,
+)
+
+pytestmark = pytest.mark.skipif(not available(), reason="no C++ toolchain")
+
+
+def test_native_cluster_matches_python_oracle():
+    """Same single-writer script -> bitwise-identical converged stores
+    (trajectories differ — RNG models are unrelated — but the converged
+    state is a pure function of the script)."""
+    script = WorkloadScript.random_single_writer(24, 4, 8, 12, seed=13)
+    py = OracleCluster(24, 4, 8, seed=2)
+    assert py.run(script) > 0
+    nat = NativeCluster(24, 4, 8, seed=7)
+    assert nat.run(script) > 0
+    for name, a, b in zip(("ver", "val", "site", "dbv"),
+                          py.store_planes(), nat.store_planes()):
+        assert np.array_equal(a, b), f"{name} plane differs"
+
+
+def test_native_cluster_convergence_and_needs():
+    nat = NativeCluster(32, 4, 8, seed=1)
+    assert nat.converged()  # empty cluster is trivially converged
+    nat.write(0, 3, 777)
+    assert not nat.converged()
+    for _ in range(64):
+        nat.round()
+        if nat.converged():
+            break
+    assert nat.converged() and nat.total_needs() == 0
+    ver, val, site, dbv = nat.store_planes(node=31)
+    assert val[3] == 777 and site[3] == 0 and ver[3] == 1
+
+
+def test_native_cluster_lww_conflict_resolution():
+    nat = NativeCluster(8, 4, 4, seed=3)
+    # two writers hit the same cell in the same round: LWW must pick one
+    # deterministically by (ver, val, site) and all nodes must agree
+    nat.write(0, 0, 100)
+    nat.write(1, 0, 200)
+    for _ in range(64):
+        nat.round()
+        if nat.converged():
+            break
+    assert nat.converged()
+    ver, val, site, _ = nat.store_planes()
+    # both wrote ver=1; tie -> bigger value wins (200 from site 1)
+    assert ver[0] == 1 and val[0] == 200 and site[0] == 1
+
+
+def test_devcluster_256_parity_with_sim():
+    """The BASELINE correctness run: a 256-node host devcluster (native)
+    and the TPU sim under one workload script, bitwise-equal stores."""
+    script = WorkloadScript.random_single_writer(
+        256, 8, 16, 10, seed=21, write_prob=0.6)
+    nat = NativeCluster(256, 8, 16, fanout=4, sync_peers=2, seed=4)
+    taken_host = nat.run(script, settle_rounds=512)
+    assert taken_host > 0, "host devcluster failed to converge"
+    planes, alive, taken_sim = run_sim_script(script, seed=21)
+    assert taken_sim > 0, "sim failed to converge"
+
+    class _Shim:  # check_bitwise_parity wants an OracleCluster-shaped obj
+        store_planes = nat.store_planes
+
+    problems = check_bitwise_parity(_Shim(), planes, alive)
+    assert not problems, "\n".join(problems)
