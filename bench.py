@@ -1,36 +1,69 @@
-"""Benchmark: gossip-simulator round throughput on one chip.
+"""Benchmark: gossip-simulator round throughput.
 
-Prints one JSON line ``{"metric", "value", "unit", "vs_baseline"}``.
+Prints ONE JSON line ``{"metric", "value", "unit", "vs_baseline", ...}``.
 
 North-star (BASELINE.md): >=10,000 simulated gossip rounds/sec at 100k
-nodes on a v5e-8. This bench runs the fused whole-cluster round at the
+nodes on a v5e-8. The bench runs the fused whole-cluster round at the
 north-star scale — the bounded member-table simulator (``sim/scale_step``:
 SWIM + piggybacked changeset broadcast + anti-entropy sync, O(N*M) state)
-— under ``lax.scan`` on whatever single chip is available and reports
-steady-state rounds/sec; ``vs_baseline`` is the fraction of the 10k
-rounds/sec target (which assumes all 8 chips of a v5e-8; a single chip
-carries the whole cluster here).
+— under ``lax.scan`` and reports steady-state rounds/sec; ``vs_baseline``
+is the fraction of the 10k rounds/sec target.
+
+Robustness (round-1 post-mortem: the TPU backend failed to initialize
+once and the whole round shipped with rc=1 and no number): the module is
+a supervisor/worker pair. The supervisor (default entry) runs the actual
+measurement in a *subprocess* (``BENCH_WORKER=1``) so a backend-init
+crash never takes out the parent; it retries TPU attempts with backoff,
+degrades the node count, and finally falls back to CPU at reduced N. It
+ALWAYS prints exactly one JSON line on stdout — on total failure the line
+is an explicit diagnostic record with ``value=0.0`` — and exits 0 unless
+even the diagnostic cannot be produced. Diagnostics go to stderr.
 """
 
 from __future__ import annotations
 
-import functools
 import json
 import os
+import subprocess
+import sys
 import time
 
-import jax
-
-# this environment's sitecustomize forces a platform via config.update,
-# which outranks the JAX_PLATFORMS env var — re-honor the env var
-if os.environ.get("JAX_PLATFORMS"):
-    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-
-import jax.numpy as jnp
-import jax.random as jr
+TARGET_RPS = 10_000.0
 
 
-def main() -> None:
+# --------------------------------------------------------------------------
+# worker: the actual measurement (runs in a subprocess)
+# --------------------------------------------------------------------------
+
+
+def _probe() -> None:
+    """Tiny worker: init the backend + run one op. Proves the TPU tunnel
+    is alive without paying the full bench compile."""
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import jax.numpy as jnp
+
+    x = jnp.ones((256, 256))
+    (x @ x).block_until_ready()
+    print(json.dumps({"metric": "probe", "value": 1.0,
+                      "platform": jax.devices()[0].platform}))
+
+
+def _worker() -> None:
+    import functools
+
+    import jax
+
+    # this environment's sitecustomize forces a platform via config.update,
+    # which outranks the JAX_PLATFORMS env var — re-honor the env var
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    import jax.numpy as jnp
+    import jax.random as jr
+
     from corrosion_tpu.sim.scale_step import (
         ScaleRoundInput,
         ScaleSimState,
@@ -75,18 +108,145 @@ def main() -> None:
     dt = time.perf_counter() - t0
 
     rps = reps * rounds / dt
-    target = 10_000.0
     print(
         json.dumps(
             {
                 "metric": f"gossip_rounds_per_sec_n{n_nodes}_{platform}",
                 "value": round(rps, 2),
                 "unit": "rounds/s",
-                "vs_baseline": round(rps / target, 4),
+                "vs_baseline": round(rps / TARGET_RPS, 4),
+            }
+        )
+    )
+
+
+# --------------------------------------------------------------------------
+# supervisor: retry ladder, CPU fallback, never-empty output
+# --------------------------------------------------------------------------
+
+
+def _attempt(env_extra: dict, timeout_s: float,
+             probe: bool = False) -> tuple[dict | None, str]:
+    """Run the worker in a subprocess; return (parsed JSON or None, err)."""
+    env = dict(os.environ)
+    env.update(env_extra)
+    env["BENCH_PROBE" if probe else "BENCH_WORKER"] = "1"
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"timeout after {timeout_s:.0f}s"
+    if proc.returncode != 0:
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-12:]
+        return None, f"rc={proc.returncode}: " + " | ".join(tail)
+    for line in reversed((proc.stdout or "").strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rec = json.loads(line)
+                if "metric" in rec and "value" in rec:
+                    return rec, ""
+            except json.JSONDecodeError:
+                continue
+    return None, "worker produced no JSON line"
+
+
+def main() -> None:
+    want_platform = os.environ.get("JAX_PLATFORMS", "")
+    # cheap init probe first: TPU backend init has been observed to hang
+    # for >9 min when the tunnel is down — don't burn full-bench timeouts
+    # discovering that. Two probe tries with backoff, then CPU fallback.
+    backend_ok = want_platform == "cpu"
+    if not backend_ok:
+        for i in range(2):
+            rec, err = _attempt({}, 300.0, probe=True)
+            if rec is not None:
+                plat = rec.get("platform")
+                if want_platform or plat not in (None, "cpu"):
+                    backend_ok = True
+                else:
+                    # jax silently fell back to its CPU backend: an "auto"
+                    # run would measure an incomparable small-N CPU number
+                    # and mask the TPU outage — route to the explicit
+                    # cpu-fallback record instead
+                    err = f"probe initialized platform {plat!r}, not TPU"
+                if backend_ok:
+                    break
+            print(f"backend probe #{i} failed: {err}", file=sys.stderr)
+            time.sleep(15.0)
+
+    # attempt ladder: (label, env overrides, timeout seconds)
+    ladder: list[tuple[str, dict, float]] = []
+    if backend_ok and want_platform and want_platform != "cpu":
+        # explicit platform request: honor it, with retries
+        for i in range(3):
+            ladder.append((f"{want_platform}#{i}", {}, 1500.0))
+    elif backend_ok and want_platform == "cpu":
+        ladder.append(("cpu#0", {}, 1500.0))
+    elif backend_ok:
+        # default: whatever backend jax picks (TPU when the tunnel is up),
+        # retried with backoff; then a degraded-N attempt
+        ladder.append(("auto#0", {}, 1500.0))
+        ladder.append(("auto#1", {}, 1200.0))
+        ladder.append(
+            ("auto-degraded", {"BENCH_NODES": "50000", "BENCH_ROUNDS": "50"}, 1200.0)
+        )
+    # final fallback: CPU at reduced N so the record is never empty
+    ladder.append(
+        (
+            "cpu-fallback",
+            {
+                "JAX_PLATFORMS": "cpu",
+                "BENCH_NODES": os.environ.get("BENCH_CPU_NODES", "4096"),
+                "BENCH_ROUNDS": "8",
+                "BENCH_REPS": "2",
+            },
+            1200.0,
+        )
+    )
+
+    errors: list[str] = []
+    backoff = 10.0
+    for idx, (label, env_extra, timeout_s) in enumerate(ladder):
+        t0 = time.time()
+        rec, err = _attempt(env_extra, timeout_s)
+        if rec is not None:
+            if errors:
+                rec["attempts_failed"] = errors
+            print(json.dumps(rec))
+            return
+        msg = f"attempt {label} failed after {time.time() - t0:.0f}s: {err}"
+        print(msg, file=sys.stderr)
+        errors.append(f"{label}: {err[:300]}")
+        if idx + 1 < len(ladder):
+            time.sleep(backoff)
+            backoff = min(backoff * 2, 60.0)
+
+    # total failure: emit an explicit diagnostic record, never an empty round
+    print(
+        json.dumps(
+            {
+                "metric": "gossip_rounds_per_sec_unavailable",
+                "value": 0.0,
+                "unit": "rounds/s",
+                "vs_baseline": 0.0,
+                "error": "all bench attempts failed",
+                "attempts_failed": errors,
             }
         )
     )
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("BENCH_PROBE"):
+        _probe()
+    elif os.environ.get("BENCH_WORKER"):
+        _worker()
+    else:
+        main()

@@ -1,29 +1,35 @@
 """corrosion_tpu — a TPU-native framework with the capabilities of Corrosion.
 
-Corrosion (the reference, superfly/corrosion) is a gossip-based, eventually
+Corrosion (the reference, somtochiama/corrosion) is a gossip-based, eventually
 consistent distributed SQLite for service discovery: SWIM membership (foca),
 CRDT changeset broadcast over QUIC, periodic anti-entropy sync, LWW register
 merge via the CR-SQLite extension.
 
-This package rebuilds those capabilities TPU-first, in two halves:
+This package rebuilds those capabilities TPU-first. Actual layout:
 
-- ``corrosion_tpu.sim``: the TPU cluster simulator. Nodes are rows of
-  struct-of-arrays state; SWIM probe/ack/suspect/disseminate, changeset
-  fanout, and anti-entropy sync are fused, jittable message-passing steps;
-  CR-SQLite's LWW merge is an elementwise lexicographic max over
-  ``(col_version, value, site_id)`` clocks. State shards across a
-  ``jax.sharding.Mesh`` so 10k-100k node clusters simulate on a TPU pod
-  slice (neighbor exchange rides ICI collectives).
-
-- ``corrosion_tpu.runtime``: the host-side agent runtime — a real,
-  networked eventually-consistent SQLite node (asyncio + stdlib sqlite3)
-  with the same protocol semantics, used both standalone (the product
-  surface: HTTP API, schema management, subscriptions, CLI, admin) and as
-  the small-cluster oracle the simulator is parity-checked against.
-
-Shared pieces live in ``ops`` (jittable kernels), ``parallel`` (mesh and
-sharding helpers), and ``utils`` (tripwire/backoff/spawn/metrics — the
-reference's lifecycle crates, reimagined for asyncio).
+- ``sim``: the TPU cluster simulator. Nodes are rows of struct-of-arrays
+  state; SWIM probe/ack/suspect/disseminate (``sim/swim.py``, bounded-table
+  ``sim/scale.py``), changeset fanout (``sim/broadcast.py``), and
+  anti-entropy sync (``sim/sync.py``) are fused, jittable message-passing
+  steps (``sim/step.py``, ``sim/scale_step.py``); ``sim/parity.py`` holds
+  the host oracle + parity harness. State shards across a
+  ``jax.sharding.Mesh`` (``parallel/mesh.py``) so 10k-100k node clusters
+  simulate on a TPU pod slice.
+- ``ops``: the jittable kernels — LWW merge as lexicographic max over
+  ``(col_version, value, site_id)`` clocks (``ops/lww.py``), per-origin
+  version/gap bookkeeping (``ops/versions.py``), slot allocation and
+  sampling primitives (``ops/slots.py``, ``ops/select.py``).
+- ``agent`` + ``db`` + ``api``: the operator surface around the simulator —
+  the agent round loop (``agent/core.py``), SQL over the LWW store
+  (``db/``), HTTP ``/v1/*`` routes (``api/http.py``).
+- Top-level subsystems mirroring the reference's crates: ``pg`` (PG wire),
+  ``pubsub`` (subscriptions + update feeds), ``admin`` (UDS admin socket),
+  ``cli``, ``client``, ``config``, ``checkpoint``, ``maintenance``,
+  ``consul``, ``tpl`` (templates), ``testing`` (devcluster fixtures).
+- ``utils``: tripwire/backoff/spawn/metrics/locks/assertions/hlc/tracing —
+  the reference's lifecycle crates, reimagined for threads + JAX.
+- ``native``: ctypes bindings to the C++ host engine
+  (``native/corro_host.cpp``) used for parity checking.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"

@@ -253,6 +253,10 @@ class Agent:
     def add_round_listener(self, hook):
         self._listeners.append(hook)
 
+    def remove_round_listener(self, hook) -> None:
+        if hook in self._listeners:
+            self._listeners.remove(hook)
+
     # --- write path (transactions) --------------------------------------
     def write(self, node: int, cell: int, value: int, wait: bool = True,
               timeout: float = 30.0) -> dict:

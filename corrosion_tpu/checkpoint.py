@@ -152,7 +152,16 @@ def restore_backup(agent, path: str, node: Optional[int] = None,
     With ``repivot`` (the site-id ordinal rewrite analog), site-plane
     entries naming the backed-up node are rewritten to the restored
     node's id, so columns the old identity authored are attributed to the
-    new one."""
+    new one — including the per-origin head/known_max bookkeeping rows,
+    so version attribution stays consistent with the rewritten site plane
+    (the reference's restore likewise rewrites the site-id ordinal
+    mapping, ``main.rs:227-330``).
+
+    Restoring onto a cluster where ``src_node`` is still a live, distinct
+    identity is NOT supported: the grafted cells claim (site=target, dbv)
+    pairs drawn from src's version counter, which may collide with or
+    outrun versions target already authored. Use it the way the reference
+    does — to move an identity, not to clone one."""
     with np.load(path) as z:
         fmt, src_node, n_planes = (int(x) for x in z["meta"])
         if fmt != FORMAT_VERSION:
@@ -164,6 +173,15 @@ def restore_backup(agent, path: str, node: Optional[int] = None,
     if repivot and target != src_node:
         site = planes[2]  # (ver, val, site, dbv) plane order
         site[site == src_node] = target
+        # move the origin-axis bookkeeping with the identity: versions the
+        # backup attributes to origin src_node are now target's
+        n_origins = head.shape[0]
+        if src_node < n_origins:
+            if target < n_origins:
+                head[target] = max(head[target], head[src_node])
+                known_max[target] = max(known_max[target], known_max[src_node])
+            head[src_node] = 0
+            known_max[src_node] = 0
     # patch the live state on host, then stage the swap
     state = agent.device_state()
     store = tuple(

@@ -186,12 +186,21 @@ class Database:
     def _cell(self, row: int, col: int) -> int:
         return row * self.n_cols + col
 
-    def _read_plane(self, node: int, row: int, col: int) -> int:
+    def _read_plane(self, node: int, row: int, col: int,
+                    overlay: Optional[Dict[int, int]] = None) -> int:
+        """Value-plane read; ``overlay`` holds this transaction's pending
+        cells so later statements observe earlier ones (the reference runs
+        statements sequentially inside one SQLite tx,
+        ``public/mod.rs:141-174``)."""
+        cell = self._cell(row, col)
+        if overlay is not None and cell in overlay:
+            return overlay[cell]
         snap = self.agent.snapshot()
-        return int(snap["store"][1][node, self._cell(row, col)])
+        return int(snap["store"][1][node, cell])
 
-    def _row_live(self, node: int, row: int) -> bool:
-        return self._read_plane(node, row, CL_COL) % 2 == 1
+    def _row_live(self, node: int, row: int,
+                  overlay: Optional[Dict[int, int]] = None) -> bool:
+        return self._read_plane(node, row, CL_COL, overlay) % 2 == 1
 
     # --- writes ----------------------------------------------------------
     def execute(self, node: int, statements: Sequence,
@@ -201,19 +210,25 @@ class Database:
         ``(sql, params)``; returns one ``ExecResult`` per statement."""
         t0 = time.perf_counter()
         results: List[ExecResult] = []
-        cells: List[Tuple[int, int]] = []
+        merged: Dict[int, int] = {}  # cell -> final value this tx (ordered)
         notifications = []
         for stmt in statements:
             sql, params = (stmt, None) if isinstance(stmt, str) else (
                 stmt[0], stmt[1] if len(stmt) > 1 else None
             )
-            affected, stmt_cells, notes = self._plan_write(node, sql, params)
-            cells.extend(stmt_cells)
+            affected, stmt_cells, notes = self._plan_write(
+                node, sql, params, merged
+            )
+            # later statements override earlier cells for the same target —
+            # last-write-wins within the transaction, like sequential
+            # statements in one SQLite tx (dict update keeps first position)
+            merged.update(stmt_cells)
             notifications.extend(notes)
             results.append(
                 ExecResult(rows_affected=affected,
                            time=time.perf_counter() - t0)
             )
+        cells = self._order_tx_cells(merged)
         if cells:
             self.agent.write_many(node, cells, wait=wait, timeout=timeout)
         for note in notifications:
@@ -221,25 +236,41 @@ class Database:
                 hook(node, *note)
         return results
 
-    def _plan_write(self, node: int, sql: str, params: Any):
+    def _order_tx_cells(self, merged: Dict[int, int]) -> List[Tuple[int, int]]:
+        """Drain order for the transaction's net cell writes: causal-length
+        flips that leave a row LIVE go last (the row only turns visible
+        once its values are in flight) and flips that leave it DEAD go
+        first — ``write_many`` drains one cell per round, so list order is
+        visibility order for local readers."""
+        deaths, values, lives = [], [], []
+        for cell, value in merged.items():
+            if cell % self.n_cols == CL_COL:
+                (lives if value % 2 == 1 else deaths).append((cell, value))
+            else:
+                values.append((cell, value))
+        return deaths + values + lives
+
+    def _plan_write(self, node: int, sql: str, params: Any,
+                    overlay: Optional[Dict[int, int]] = None):
         """-> (rows_affected, [(cell, interned_val)], [notifications])."""
         sql = sql.strip().rstrip(";").strip()
         p = _Params(params)
         m = _INSERT_RE.match(sql)
         if m:
-            return self._plan_insert(node, m, p)
+            return self._plan_insert(node, m, p, overlay)
         m = _UPDATE_RE.match(sql)
         if m:
-            return self._plan_update(node, m, p)
+            return self._plan_update(node, m, p, overlay)
         m = _DELETE_RE.match(sql)
         if m:
-            return self._plan_delete(node, m, p)
+            return self._plan_delete(node, m, p, overlay)
         if _SELECT_RE.match(sql):
             raise SqlError("SELECT not allowed in /v1/transactions (read-only "
                            "statements go to /v1/queries)")
         raise SqlError(f"unsupported statement: {sql[:80]!r}")
 
-    def _plan_insert(self, node: int, m, p: _Params):
+    def _plan_insert(self, node: int, m, p: _Params,
+                     overlay: Optional[Dict[int, int]] = None):
         table = self.schema.table(_unquote(m.group("table")))
         col_names = [_unquote(c) for c in m.group("cols").split(",")]
         vals = [_parse_literal(v, p) for v in _split_top_commas(m.group("vals"))]
@@ -261,19 +292,23 @@ class Database:
             table.column(name)  # raises on unknown column
 
         row = self.rows.get_or_alloc(table.name, pk)
-        cl = self._read_plane(node, row, CL_COL)
+        cl = self._read_plane(node, row, CL_COL, overlay)
         live = cl % 2 == 1
         or_clause = (m.group("or") or "").upper()
         conflict = (m.group("conflict") or "").upper().strip()
         if live and (or_clause == "IGNORE" or "DO NOTHING" in conflict):
             return 0, [], []
         cells: List[Tuple[int, int]] = []
-        if not live:
-            cells.append((self._cell(row, CL_COL), cl + 1))
         for name, value in by_col.items():
             cells.append(
                 (self._cell(row, table.col_index(name)), self.heap.intern(value))
             )
+        if not live:
+            # CL flip staged LAST: write_many drains one cell per round, so
+            # the row must only turn live once its values are already in
+            # flight — otherwise readers observe a live all-NULL row for
+            # n_value_columns rounds (insert atomicity)
+            cells.append((self._cell(row, CL_COL), cl + 1))
         return 1, cells, [(table.name, pk, dict(by_col), False)]
 
     def _split_where_pk(self, table, where: str, p: _Params):
@@ -288,7 +323,8 @@ class Database:
             raise SqlError(f"writes must filter on the pk ({table.pk.name})")
         return _parse_literal(cond.group("val"), p)
 
-    def _plan_update(self, node: int, m, p: _Params):
+    def _plan_update(self, node: int, m, p: _Params,
+                     overlay: Optional[Dict[int, int]] = None):
         table = self.schema.table(_unquote(m.group("table")))
         sets: Dict[str, Any] = {}
         set_parts = _split_top_commas(m.group("sets"))
@@ -303,7 +339,7 @@ class Database:
             sets[name] = _parse_literal(raw, p)
         pk = self._split_where_pk(table, m.group("where"), p)
         row = self.rows.get(table.name, pk)
-        if row is None or not self._row_live(node, row):
+        if row is None or not self._row_live(node, row, overlay):
             return 0, [], []
         for name, value in sets.items():
             if value is None and table.column(name).not_null:
@@ -314,13 +350,14 @@ class Database:
         ]
         return 1, cells, [(table.name, pk, dict(sets), False)]
 
-    def _plan_delete(self, node: int, m, p: _Params):
+    def _plan_delete(self, node: int, m, p: _Params,
+                     overlay: Optional[Dict[int, int]] = None):
         table = self.schema.table(_unquote(m.group("table")))
         pk = self._split_where_pk(table, m.group("where"), p)
         row = self.rows.get(table.name, pk)
         if row is None:
             return 0, [], []
-        cl = self._read_plane(node, row, CL_COL)
+        cl = self._read_plane(node, row, CL_COL, overlay)
         if cl % 2 == 0:
             return 0, [], []
         cells = [(self._cell(row, CL_COL), cl + 1)]

@@ -67,25 +67,48 @@ def _text_value(v: Any) -> Optional[bytes]:
     return str(v).encode()
 
 
+def _iter_sql_segments(sql: str):
+    """Yield ``(is_literal, segment)`` pairs, where literal segments are
+    single-quoted strings (``''`` escapes stay inside one literal). The
+    single quote-scanner every literal-aware transform builds on."""
+    i, n = 0, len(sql)
+    while i < n:
+        if sql[i] == "'":
+            j = i + 1
+            while j < n:
+                if sql[j] == "'":
+                    if j + 1 < n and sql[j + 1] == "'":
+                        j += 2
+                        continue
+                    break
+                j += 1
+            yield True, sql[i:min(j + 1, n)]
+            i = j + 1
+        else:
+            j = sql.find("'", i)
+            if j == -1:
+                j = n
+            yield False, sql[i:j]
+            i = j
+
+
 def _split_sql_outside_quotes(sql: str, sep: str) -> List[str]:
     """Split on ``sep`` only outside single-quoted literals."""
-    parts, start, in_str, i = [], 0, False, 0
-    while i < len(sql):
-        ch = sql[i]
-        if in_str:
-            if ch == "'":
-                # '' is an escaped quote inside the literal
-                if i + 1 < len(sql) and sql[i + 1] == "'":
-                    i += 1
-                else:
-                    in_str = False
-        elif ch == "'":
-            in_str = True
-        elif ch == sep:
-            parts.append(sql[start:i])
-            start = i + 1
-        i += 1
-    parts.append(sql[start:])
+    parts, cur = [], []
+    for is_lit, seg in _iter_sql_segments(sql):
+        if is_lit:
+            cur.append(seg)
+            continue
+        while True:
+            k = seg.find(sep)
+            if k == -1:
+                cur.append(seg)
+                break
+            cur.append(seg[:k])
+            parts.append("".join(cur))
+            cur = []
+            seg = seg[k + 1:]
+    parts.append("".join(cur))
     return parts
 
 
@@ -95,27 +118,30 @@ def _translate_sql(sql: str) -> str:
     translation)."""
     import re
 
-    out, i, n = [], 0, len(sql)
-    while i < n:
-        if sql[i] == "'":
-            # literal: scan to the closing quote, '' escapes stay inside
-            j = i + 1
-            while j < n:
-                if sql[j] == "'":
-                    if j + 1 < n and sql[j + 1] == "'":
-                        j += 2
-                        continue
-                    break
-                j += 1
-            out.append(sql[i:min(j + 1, n)])
-            i = j + 1
-        else:
-            j = sql.find("'", i)
-            if j == -1:
-                j = n
-            out.append(re.sub(r"::\w+", "", sql[i:j]))
-            i = j
-    return "".join(out).strip()
+    return "".join(
+        seg if is_lit else re.sub(r"::\w+", "", seg)
+        for is_lit, seg in _iter_sql_segments(sql)
+    ).strip()
+
+
+def _substitute_placeholders(sql: str) -> "Tuple[str, List[int]]":
+    """Rewrite ``$N`` -> ``?`` *outside single-quoted literals* (a dollar
+    sign inside a string like ``'costs $5'`` is data, not a parameter).
+    Returns ``(text, param_map)`` where occurrence i of ``?`` consumes
+    client-param index ``param_map[i]``."""
+    import re
+
+    param_map: List[int] = []
+
+    def repl(m):
+        param_map.append(int(m.group(1)) - 1)
+        return "?"
+
+    text = "".join(
+        seg if is_lit else re.sub(r"\$(\d+)", repl, seg)
+        for is_lit, seg in _iter_sql_segments(sql)
+    )
+    return text, param_map
 
 
 class _Msg:
@@ -442,16 +468,9 @@ def _make_handler(server: PgServer):
             (n_oids,) = struct.unpack("!H", rest[:2])
             oids = list(struct.unpack(f"!{n_oids}I", rest[2:2 + 4 * n_oids]))
             # $N placeholders -> positional ?, keeping the N order so
-            # $2 ... $1 and repeated placeholders bind correctly
-            import re
-
-            param_map: List[int] = []
-
-            def repl(m):
-                param_map.append(int(m.group(1)) - 1)
-                return "?"
-
-            text = re.sub(r"\$(\d+)", repl, sql.decode())
+            # $2 ... $1 and repeated placeholders bind correctly; quoted
+            # literals are skipped so 'costs $5' stays data
+            text, param_map = _substitute_placeholders(sql.decode())
             self.stmts[name.decode()] = _PreparedStatement(
                 text, oids, param_map)
             self.out.add(b"1", b"")  # ParseComplete

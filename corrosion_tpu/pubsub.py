@@ -37,6 +37,19 @@ INSERT = "insert"
 DELETE = "delete"
 
 
+def _enc(v: Any) -> Any:
+    """JSON-safe encoding for pk/row values (bytes get a tag)."""
+    if isinstance(v, bytes):
+        return {"__bytes__": v.hex()}
+    return v
+
+
+def _dec(v: Any) -> Any:
+    if isinstance(v, dict) and "__bytes__" in v:
+        return bytes.fromhex(v["__bytes__"])
+    return v
+
+
 class SubQueue(queue.Queue):
     """Per-subscriber event queue with lag semantics: the producer (the
     round thread) never blocks — an overflowing subscriber is marked
@@ -60,7 +73,8 @@ class Matcher:
     """One subscription query: materialized result + change log."""
 
     def __init__(self, db, node: int, sql: str, params: Any = None,
-                 sub_id: Optional[str] = None, max_log: int = 4096):
+                 sub_id: Optional[str] = None, max_log: int = 4096,
+                 restore: Optional[dict] = None):
         self.id = sub_id or uuid.uuid4().hex
         self.db = db
         self.node = node
@@ -91,7 +105,32 @@ class Matcher:
         self.last_change_id = 0
         self._subs: List[SubQueue] = []
         self._mu = threading.Lock()
-        self._prime()
+        if restore is not None:
+            # resume the change-id sequence where the persisted manifest
+            # left off (the reference resumes from its per-sub SQLite db,
+            # pubsub.rs:842-878), PLUS a max_log alias gap: the manifest
+            # may be stale by up to a persist interval, so ids in
+            # (persisted, crash] were handed to clients but are not
+            # recorded — restarting right after the persisted id would
+            # re-assign those ids to *different* events. Skipping max_log
+            # ids guarantees no client-held id aliases (a client further
+            # behind than max_log gets the full re-dump path anyway).
+            self.last_change_id = int(restore.get("last_change_id", 0))
+            if self.last_change_id:
+                self.last_change_id += self.max_log
+            self._log_base = self.last_change_id + 1
+            if "state" in restore:
+                # pre-shutdown materialized rows: the first poll() diffs
+                # them against the live replica, so changes that happened
+                # while the agent was down surface as ordinary events
+                self._state = {
+                    _dec(k): tuple(_dec(v) for v in row)
+                    for k, row in restore["state"]
+                }
+            else:
+                self._prime()
+        else:
+            self._prime()
 
     def _target_table(self, sql: str) -> str:
         import re
@@ -172,7 +211,8 @@ class Matcher:
                 for key, row in self._state.items():
                     q.offer(("row", (key, list(row))))
                 q.offer(("eoq", self.last_change_id))
-            elif from_change_id + 1 >= self._log_base:
+            elif (from_change_id + 1 >= self._log_base
+                  and from_change_id <= self.last_change_id):
                 for rec in self._log[from_change_id + 1 - self._log_base:]:
                     q.offer(("change", rec))
             else:
@@ -194,8 +234,15 @@ class Matcher:
 
     # --- persistence (pubsub.rs stores matcher SQL + state on disk) ------
     def manifest(self) -> dict:
+        with self._mu:
+            # cheap pointer copy under the lock; the O(result-set) encode
+            # happens outside so poll()/attach() are not blocked by it
+            state_items = list(self._state.items())
+            last = self.last_change_id
+        state = [[_enc(k), [_enc(v) for v in row]] for k, row in state_items]
         return {"id": self.id, "node": self.node, "sql": self.sql,
-                "params": self.params, "last_change_id": self.last_change_id}
+                "params": self.params, "last_change_id": last,
+                "state": state}
 
 
 class SubsManager:
@@ -206,17 +253,52 @@ class SubsManager:
         self.persist_dir = persist_dir
         self._matchers: Dict[str, Matcher] = {}
         self._by_query: Dict[Tuple, str] = {}
+        self._dirty: set = set()
         self._mu = threading.Lock()
+        self._persist_q: "queue.Queue[Optional[str]]" = queue.Queue()
+        self._persist_thread: Optional[threading.Thread] = None
         db.agent.add_round_listener(self._on_round)
         if persist_dir:
             os.makedirs(persist_dir, exist_ok=True)
+            # manifests are written off-thread: a large materialized state
+            # must not stall the agent round loop
+            self._persist_thread = threading.Thread(
+                target=self._persist_worker, name="subs-persist", daemon=True
+            )
+            self._persist_thread.start()
+
+    PERSIST_EVERY = 16  # rounds between manifest re-writes per dirty matcher
 
     def _on_round(self, round_no: int) -> None:
         for m in list(self._matchers.values()):
             try:
-                m.poll()
+                if m.poll():
+                    self._dirty.add(m.id)
             except Exception:  # noqa: BLE001 — a bad matcher must not stall rounds
                 logger.exception("matcher %s poll failed", m.id)
+        # re-persist dirty matchers periodically (not every round — the
+        # manifest carries the full materialized state) so a restart
+        # resumes the change-id sequence close to where it stopped; a
+        # stale manifest is safe: restore re-diffs from the persisted
+        # state, skips a max_log id alias gap, and attach() treats
+        # from>last_change_id as backlog-lost
+        if self._dirty and round_no % self.PERSIST_EVERY == 0:
+            for mid in list(self._dirty):
+                if mid in self._matchers:
+                    self._persist_q.put(mid)
+            self._dirty.clear()
+
+    def _persist_worker(self) -> None:
+        while True:
+            mid = self._persist_q.get()
+            if mid is None:
+                return
+            m = self._matchers.get(mid)
+            if m is not None:
+                try:
+                    self._persist(m)
+                except Exception:  # noqa: BLE001
+                    logger.exception("failed to persist subscription %s", mid)
 
     def subscribe(self, node: int, sql: str, params: Any = None
                   ) -> Tuple[Matcher, bool]:
@@ -252,6 +334,20 @@ class SubsManager:
     def ids(self) -> List[str]:
         return list(self._matchers)
 
+    def close(self) -> None:
+        """Detach from the agent's round loop and flush pending manifests
+        (matchers stop polling; their state stays restorable)."""
+        self.db.agent.remove_round_listener(self._on_round)
+        if self._persist_thread is not None:
+            self._persist_q.put(None)
+            self._persist_thread.join(timeout=30.0)
+            self._persist_thread = None
+        for mid in list(self._dirty):
+            m = self._matchers.get(mid)
+            if m is not None:
+                self._persist(m)
+        self._dirty.clear()
+
     def _persist(self, m: Matcher) -> None:
         if not self.persist_dir:
             return
@@ -270,7 +366,7 @@ class SubsManager:
                 with open(os.path.join(self.persist_dir, name)) as f:
                     man = json.load(f)
                 m = Matcher(self.db, man["node"], man["sql"], man["params"],
-                            sub_id=man["id"])
+                            sub_id=man["id"], restore=man)
                 with self._mu:
                     self._matchers[m.id] = m
                     key = (m.node, m.sql,

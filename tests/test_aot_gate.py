@@ -1,0 +1,89 @@
+"""AOT compile/memory gate for the flagship (north-star) shape.
+
+Round-1 post-mortem: the TPU bench could have hit an OOM or compile wall
+blind, because nothing ever checked that ``scale_sim_config(100_000)``
+lowers and fits. This gate lowers + compiles the one-round and scanned
+forms on CPU via ``jax.eval_shape``-style abstract inputs (no 100k-node
+arrays are ever materialized) and asserts the XLA memory analysis stays
+far inside a v5e chip's 16 GB HBM.
+"""
+
+import functools
+
+import jax
+import jax.random as jr
+import pytest
+
+from corrosion_tpu.sim.scale_step import (
+    ScaleRoundInput,
+    ScaleSimState,
+    scale_run_rounds,
+    scale_sim_config,
+    scale_sim_step,
+)
+from corrosion_tpu.sim.transport import NetModel
+
+N_FLAGSHIP = 100_000
+HBM_BUDGET = 16 * 2**30  # one v5e chip
+
+
+def _abstract_inputs(cfg, rounds=None):
+    st = jax.eval_shape(lambda: ScaleSimState.create(cfg))
+    net = jax.eval_shape(lambda: NetModel.create(cfg.n_nodes, drop_prob=0.01))
+    key = jax.eval_shape(lambda: jr.key(0))
+    inp = jax.eval_shape(lambda: ScaleRoundInput.quiet(cfg))
+    if rounds is not None:
+        inp = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct((rounds,) + a.shape, a.dtype), inp
+        )
+    return st, net, key, inp
+
+
+def _total_bytes(tree) -> int:
+    return sum(a.size * a.dtype.itemsize for a in jax.tree.leaves(tree))
+
+
+@pytest.fixture(scope="module")
+def flagship_cfg():
+    return scale_sim_config(N_FLAGSHIP, n_origins=16)
+
+
+def test_flagship_state_fits_hbm(flagship_cfg):
+    st, net, _, inp = _abstract_inputs(flagship_cfg)
+    resident = _total_bytes(st) + _total_bytes(net) + _total_bytes(inp)
+    # state must leave plenty of headroom for temps + donated copies
+    assert resident < HBM_BUDGET // 8, f"resident state {resident/2**30:.2f} GiB"
+
+
+def test_flagship_one_round_compiles_within_budget(flagship_cfg):
+    st, net, key, inp = _abstract_inputs(flagship_cfg)
+    lowered = jax.jit(functools.partial(scale_sim_step, flagship_cfg)).lower(
+        st, net, key, inp
+    )
+    compiled = lowered.compile()
+    ma = compiled.memory_analysis()
+    if ma is not None:  # backend-dependent; present on CPU + TPU
+        peak = (
+            ma.argument_size_in_bytes
+            + ma.output_size_in_bytes
+            + ma.temp_size_in_bytes
+        )
+        assert peak < HBM_BUDGET, f"estimated peak {peak/2**30:.2f} GiB"
+
+
+def test_flagship_scanned_form_compiles_within_budget(flagship_cfg):
+    # the bench's actual entry point: lax.scan over stacked round inputs
+    st, net, key, inp = _abstract_inputs(flagship_cfg, rounds=4)
+    lowered = jax.jit(
+        functools.partial(scale_run_rounds, flagship_cfg), donate_argnums=(0,)
+    ).lower(st, net, key, inp)
+    compiled = lowered.compile()
+    ma = compiled.memory_analysis()
+    if ma is not None:
+        peak = (
+            ma.argument_size_in_bytes
+            + ma.output_size_in_bytes
+            + ma.temp_size_in_bytes
+            - ma.alias_size_in_bytes
+        )
+        assert peak < HBM_BUDGET, f"estimated peak {peak/2**30:.2f} GiB"

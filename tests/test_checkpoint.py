@@ -94,7 +94,9 @@ def test_backup_and_graft(tmp_path, rig):
     # ensure node 0 has the data locally
     assert db.read_row(0, "kv", "a") is not None
     bpath = backup_node(agent, 0, db=db, path=str(tmp_path / "b.npz"))
-    target = agent.n_nodes - 1
+    target = 3  # inside the origin pool, so bookkeeping migration is visible
+    with np.load(bpath) as z:
+        src_head_origin0 = int(z["head"][0])
     restored_to = restore_backup(agent, bpath, node=target, db=db)
     assert restored_to == target
     # the grafted node now serves the backed-up replica
@@ -104,3 +106,7 @@ def test_backup_and_graft(tmp_path, rig):
     snap = agent.snapshot()
     site_plane = snap["store"][2][target]
     assert not np.any(site_plane == 0) or np.any(site_plane == target)
+    # ... and the per-origin head bookkeeping moved with the identity
+    # (round-1 advisor finding: previously only the site plane was
+    # rewritten). Heads are monotone, so this holds under live rounds.
+    assert snap["head"][target, target] >= src_head_origin0

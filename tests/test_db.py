@@ -106,6 +106,44 @@ def test_update_delete(db):
     assert db.read_row(0, "users", 2)["name"] == "bob2"
 
 
+def test_transaction_sees_earlier_statements(db):
+    # later statements in ONE transaction must observe earlier ones, like
+    # sequential statements inside a real SQLite tx (public/mod.rs:141-174)
+    results = db.execute(0, [
+        ("INSERT INTO users (id, name, score) VALUES (?, ?, ?)", [7, "zoe", 1]),
+        ("UPDATE users SET score = ? WHERE id = ?", [2, 7]),
+        ("DELETE FROM users WHERE id = ?", [7]),
+        ("INSERT INTO users (id, name) VALUES (?, ?)", [7, "zoe2"]),
+    ])
+    assert [r["rows_affected"] for r in results] == [1, 1, 1, 1]
+    for _ in range(100):
+        row = db.read_row(0, "users", 7)
+        if row is not None and row["name"] == "zoe2":
+            break
+        db.agent.wait_rounds(2, timeout=60)
+    row = db.read_row(0, "users", 7)
+    assert row["name"] == "zoe2"
+    # the re-insert resets unspecified columns to their defaults (SQLite
+    # semantics: a fresh row, not a resurrected one) — score was 2 before
+    # the in-transaction DELETE and must not leak through
+    assert row["score"] is None
+
+
+def test_insert_stages_cl_flip_last(db):
+    # insert atomicity: the causal-length flip that turns the row live must
+    # be staged AFTER the value cells, since write_many drains one cell per
+    # round — otherwise readers see a live all-NULL row for several rounds
+    from corrosion_tpu.db.schema import CL_COL
+
+    _, cells, _ = db._plan_write(
+        0, "INSERT INTO users (id, name, score) VALUES (42, 'x', 1)", None, {}
+    )
+    cl_positions = [
+        i for i, (cell, _) in enumerate(cells) if cell % db.n_cols == CL_COL
+    ]
+    assert cl_positions == [len(cells) - 1]
+
+
 def test_where_and_limit(db):
     _, rows = db.query(0, "SELECT id FROM users WHERE score >= ?", [50])
     assert [1] in list(rows)

@@ -201,6 +201,18 @@ def test_literal_with_semicolon_and_cast(pg):
     assert err is None and rows == [["a;b::c"]]
 
 
+def test_dollar_inside_literal_not_a_placeholder(pg):
+    _, _, _, c = pg
+    # '$5' inside a quoted literal is data — it must not be rewritten into
+    # a bound parameter (round-1 advisor finding)
+    _, _, tag, err = c.extended(
+        "INSERT INTO users (id, name, score) VALUES ($1, 'costs $5', $2)",
+        [9, 3])
+    assert err is None and tag == "INSERT 0 1"
+    _, rows, _, err = c.extended("SELECT name FROM users WHERE id = $1", [9])
+    assert err is None and rows == [["costs $5"]]
+
+
 def test_out_of_order_placeholders(pg):
     _, _, _, c = pg
     c.query("INSERT INTO users (id, name, score) VALUES (8, 'swap', 42)")
