@@ -37,6 +37,52 @@ def alloc_slots(free, want):
     return slot, placed
 
 
+def alloc_slots_evict(free, evict_key, want):
+    """Like :func:`alloc_slots`, but the queue always admits new items:
+    when free slots run out, occupied slots are sacrificed in ascending
+    ``evict_key`` order — with ``evict_key = remaining transmission
+    budget`` this is exactly the reference's broadcast-queue overflow
+    policy, "drop the oldest most-sent changeset to make room"
+    (``crates/corro-agent/src/broadcast/mod.rs:410-812``).
+
+    Items beyond the total slot count K are still dropped.
+    """
+    n, k = free.shape
+    key = jnp.where(free, jnp.int32(-2147483648), evict_key)
+    slot_order = jnp.argsort(key, axis=1, stable=True).astype(jnp.int32)
+    rank = (jnp.cumsum(want, axis=1) - 1).astype(jnp.int32)
+    placed = want & (rank < k)
+    slot = jnp.take_along_axis(slot_order, jnp.clip(rank, 0, k - 1), axis=1)
+    return slot, placed
+
+
+def budget_mask(live, priority, allowed):
+    """Keep only the ``allowed`` highest-``priority`` live slots per row —
+    the per-round send-budget shaping (10 MiB/s governor analog,
+    ``broadcast/mod.rs:460-463``): when a node has more queued changesets
+    than budget, the least-sent (highest remaining budget) go first and
+    the rest wait for a later round.
+
+    ``allowed`` is a static int (same budget every row) or an int32 [N]
+    array (per-row budgets, e.g. scaled by how many packets each sender
+    delivers this round).
+    """
+    n, k = live.shape
+    if isinstance(allowed, int):
+        if allowed >= k:
+            return live
+        allowed = jnp.full((n,), allowed, jnp.int32)
+    order = jnp.argsort(
+        jnp.where(live, -priority, jnp.int32(2147483647)), axis=1, stable=True
+    ).astype(jnp.int32)
+    rank = jnp.zeros((n, k), jnp.int32)
+    rank = scatter_rows(
+        rank, order, jnp.ones((n, k), bool),
+        jnp.broadcast_to(jnp.arange(k, dtype=jnp.int32)[None, :], (n, k)),
+    )
+    return live & (rank < allowed[:, None])
+
+
 def scatter_rows(dest, slot, placed, values):
     """``dest[i, slot[i,j]] = values[i,j]`` where ``placed`` — flat scatter."""
     n, k = dest.shape

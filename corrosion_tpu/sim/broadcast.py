@@ -38,12 +38,24 @@ import jax.numpy as jnp
 import jax.random as jr
 
 from corrosion_tpu.ops.lww import apply_changes_to_store
-from corrosion_tpu.ops.slots import alloc_slots, mailbox_pack, scatter_rows
+from corrosion_tpu.ops.slots import (
+    alloc_slots_evict,
+    budget_mask,
+    mailbox_pack,
+    scatter_rows,
+)
 from corrosion_tpu.ops.versions import Book, record_versions
 from corrosion_tpu.sim.config import SimConfig
 from corrosion_tpu.sim.transport import NetModel, uni_ok
 
 NO_Q = jnp.int32(-1)
+LAST_SYNC_CAP = 4095  # staleness saturates (never-synced == very stale)
+
+# wire-size estimate of one changeset: 6 int32 fields + length-delimited
+# framing overhead — the bytes-per-changeset unit of the send budget
+# (the reference meters serialized ChangeV1 bytes through its governor,
+# broadcast/mod.rs:460-463)
+CHANGE_WIRE_BYTES = 52
 
 
 class CrdtState(NamedTuple):
@@ -59,6 +71,9 @@ class CrdtState(NamedTuple):
     q_val: jax.Array  # int32 [N, Q]
     q_site: jax.Array  # int32 [N, Q]
     q_tx: jax.Array  # int32 [N, Q] — remaining transmissions
+    last_sync: jax.Array  # int32 [N, S] — rounds since last sync per track
+    # (S = peer node id for the full-view sim, member-table slot at scale;
+    #  drives the "then by last-sync time" ordering of handlers.rs:808-863)
 
     @staticmethod
     def create(cfg: SimConfig) -> "CrdtState":
@@ -75,13 +90,16 @@ class CrdtState(NamedTuple):
             q_val=z(n, q),
             q_site=z(n, q),
             q_tx=z(n, q),
+            last_sync=jnp.full((n, cfg.sync_tracks), LAST_SYNC_CAP, jnp.int32),
         )
 
 
 def _enqueue(cst: CrdtState, want, origin, dbv, cell, ver, val, site, tx):
-    """Place per-node batches of changes into free queue slots."""
+    """Place per-node batches of changes into queue slots; on overflow the
+    most-sent queued changeset is evicted to admit the new one
+    (drop-oldest-most-sent, ``broadcast/mod.rs:410-812``)."""
     free = cst.q_origin == NO_Q
-    slot, placed = alloc_slots(free, want)
+    slot, placed = alloc_slots_evict(free, cst.q_tx, want)
     return cst._replace(
         q_origin=scatter_rows(cst.q_origin, slot, placed, origin),
         q_dbv=scatter_rows(cst.q_dbv, slot, placed, dbv),
@@ -210,6 +228,12 @@ def bcast_step(
 
     # --- sendable slots: anything queued with budget left ---------------
     live_slot = (cst.q_origin != NO_Q) & (cst.q_tx > 0)  # [N, Q]
+
+    # per-round send budget (10 MiB/s governor analog): each slot flush
+    # costs CHANGE_WIRE_BYTES * fanout; when over budget, the least-sent
+    # changesets go first and the rest wait (rate shaping, not drop)
+    allowed = max(1, cfg.bcast_budget_bytes // (CHANGE_WIRE_BYTES * max(1, f)))
+    live_slot = budget_mask(live_slot, cst.q_tx, allowed)
 
     # messages: sender x slot x target
     src = jnp.broadcast_to(iarr[:, None, None], (n, q, f))

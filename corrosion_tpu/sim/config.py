@@ -39,6 +39,10 @@ class SimConfig:
     bcast_queue: int = 64  # pending-broadcast slots per node
     bcast_max_transmissions: int = 3  # re-send budget per changeset
     recv_slots: int = 96  # max applied messages per node per round
+    # per-node per-round send budget in wire bytes — the 10 MiB/s governor
+    # analog at one round ~= one second (broadcast/mod.rs:460-463); lower
+    # it to simulate overload shaping
+    bcast_budget_bytes: int = 10 * 1024 * 1024
     # --- anti-entropy sync (parallel_sync analog) -------------------------
     sync_interval: int = 8  # rounds between sync attempts per node
     sync_peers: int = 2  # peers per sync round (clamp(members/100, 3, 10) analog)
@@ -47,6 +51,12 @@ class SimConfig:
     @property
     def n_cells(self) -> int:
         return self.n_rows * self.n_cols
+
+    @property
+    def sync_tracks(self) -> int:
+        """Columns of the per-node last-sync table: the full-view sim
+        tracks last-sync-round per peer *node id*."""
+        return self.n_nodes
 
     def validate(self) -> "SimConfig":
         assert self.n_origins <= self.n_nodes
@@ -66,6 +76,9 @@ def wan_config(n_nodes: int, **overrides) -> SimConfig:
         suspicion_rounds=max(4, log_n),
         piggyback=8,
         bcast_fanout=max(3, min(10, n_nodes // 100 + 3)),
+        # clamp(members/100, 3, 10) — the reference's cluster-size-adaptive
+        # sync fanout (handlers.rs:838); static N stands in for live count
+        sync_peers=max(3, min(10, n_nodes // 100)),
     )
     defaults.update(overrides)
     return SimConfig(n_nodes=n_nodes, **defaults).validate()

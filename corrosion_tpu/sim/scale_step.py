@@ -38,15 +38,23 @@ import jax.random as jr
 
 from corrosion_tpu.ops.lww import STATE_ALIVE
 from corrosion_tpu.ops.select import sample_k
+from corrosion_tpu.ops.slots import budget_mask
 from corrosion_tpu.ops.versions import needs_count
-from corrosion_tpu.sim.broadcast import NO_Q, CrdtState, ingest_changes, local_write
+from corrosion_tpu.sim.broadcast import (
+    CHANGE_WIRE_BYTES,
+    LAST_SYNC_CAP,
+    NO_Q,
+    CrdtState,
+    ingest_changes,
+    local_write,
+)
 from corrosion_tpu.sim.scale import (
     ScaleSwimState,
     scale_config,
     scale_swim_metrics,
     scale_swim_step,
 )
-from corrosion_tpu.sim.transport import NetModel
+from corrosion_tpu.sim.transport import NetModel, ring_of
 
 
 @dataclasses.dataclass(frozen=True)
@@ -71,6 +79,9 @@ class ScaleSimConfig:
     bcast_queue: int = 32
     bcast_max_transmissions: int = 4
     pig_changes: int = 4  # changesets per SWIM packet
+    # per-node per-round send budget in wire bytes (10 MiB/s analog);
+    # bounds how many queued changesets may ride this round's packets
+    bcast_budget_bytes: int = 10 * 1024 * 1024
     # --- anti-entropy sync -----------------------------------------------
     sync_interval: int = 8
     sync_peers: int = 2
@@ -79,6 +90,12 @@ class ScaleSimConfig:
     @property
     def n_cells(self) -> int:
         return self.n_rows * self.n_cols
+
+    @property
+    def sync_tracks(self) -> int:
+        """Columns of the per-node last-sync table: the bounded sim tracks
+        last-sync-round per member-table *slot*."""
+        return self.m_slots
 
     def validate(self) -> "ScaleSimConfig":
         assert self.n_origins <= self.n_nodes and self.m_slots > 0
@@ -104,6 +121,10 @@ def scale_sim_config(n_nodes: int, **overrides) -> ScaleSimConfig:
         announce_interval=swim.announce_interval,
         down_purge_rounds=swim.down_purge_rounds,
         bcast_max_transmissions=max(3, log_n // 2),
+        # clamp(members/100, 3, 10) — the reference's cluster-size-adaptive
+        # sync fanout (handlers.rs:838); static N stands in for the live
+        # member count (a bounded table cannot observe the true size)
+        sync_peers=max(3, min(10, n_nodes // 100)),
     )
     defaults.update(overrides)
     return ScaleSimConfig(n_nodes=n_nodes, **defaults).validate()
@@ -151,7 +172,25 @@ def piggyback_bcast_step(cfg: ScaleSimConfig, cst: CrdtState, channels, key):
     n, q, r = cfg.n_nodes, cfg.bcast_queue, cfg.pig_changes
     iarr = jnp.arange(n, dtype=jnp.int32)
 
+    # delivery multiplicity per sender this round: a node probed/acked by
+    # many peers sends that many packets, and every packet carries its
+    # selected changesets — the real byte cost scales with this count
+    carried = jnp.zeros(n, jnp.int32)
+    for src, valid in channels:
+        carried = carried.at[jnp.clip(src, 0)].add(
+            valid.astype(jnp.int32), mode="drop"
+        )
+
     live_slot = (cst.q_origin != NO_Q) & (cst.q_tx > 0)  # [N, Q]
+    # per-round byte budget (10 MiB/s governor analog): each selected slot
+    # costs CHANGE_WIRE_BYTES per delivered packet; least-sent changesets
+    # get the budget first, the rest wait for a later round
+    allowed = jnp.maximum(
+        cfg.bcast_budget_bytes
+        // (CHANGE_WIRE_BYTES * jnp.maximum(carried, 1)),
+        1,
+    ).astype(jnp.int32)
+    live_slot = budget_mask(live_slot, cst.q_tx, allowed)
     sel_slots, sel_ok = sample_k(live_slot, r, key)  # [N, R] per sender
 
     def sender_fields(src):
@@ -177,11 +216,6 @@ def piggyback_bcast_step(cfg: ScaleSimConfig, cst: CrdtState, channels, key):
     live = jnp.concatenate(valids, axis=1)
 
     # --- sender budget decrement: one per delivered packet ---------------
-    carried = jnp.zeros(n, jnp.int32)
-    for src, valid in channels:
-        carried = carried.at[jnp.clip(src, 0)].add(
-            valid.astype(jnp.int32), mode="drop"
-        )
     dec = jnp.zeros((n, q), jnp.int32)
     rows = jnp.broadcast_to(iarr[:, None], sel_slots.shape)
     flat = jnp.where(sel_ok, rows * q + sel_slots, n * q)
@@ -211,8 +245,9 @@ def scale_sim_step(
     inp: ScaleRoundInput,
 ):
     """One full protocol round at scale. Returns (state, info)."""
-    from corrosion_tpu.sim.sync import sync_step
+    from corrosion_tpu.sim.sync import choose_sync_peers, sync_step
 
+    n, m = cfg.n_nodes, cfg.m_slots
     k_swim, k_pig, k_sp, k_sync = jr.split(key, 4)
     swim, swim_info, channels = scale_swim_step(
         cfg, st.swim, net, k_swim, kill=inp.kill, revive=inp.revive
@@ -221,26 +256,34 @@ def scale_sim_step(
     cst = local_write(cfg, st.crdt, inp.write_mask, inp.write_cell, inp.write_val)
     cst, b_info = piggyback_bcast_step(cfg, cst, channels, k_pig)
 
-    # sync peers from the bounded member table (believed-alive entries),
-    # with a soft preference for closer RTT rings (handlers.rs:808-863)
-    from corrosion_tpu.ops.select import sample_k_biased
-    from corrosion_tpu.sim.transport import N_RINGS, ring_of
-
-    iarr = jnp.arange(cfg.n_nodes, dtype=jnp.int32)
+    # need-driven sync peer choice from a 2x sample of believed-alive
+    # member-table entries: most-needed versions first, then longest since
+    # last sync, then closest RTT ring (handlers.rs:808-894); last_sync
+    # tracks are member-table slots here
+    iarr = jnp.arange(n, dtype=jnp.int32)
     bel_alive = (
         (swim.mem_id >= 0)
         & (swim.mem_id != iarr[:, None])
         & (swim.mem_view >= 0)
         & ((swim.mem_view & 3) == STATE_ALIVE)
     )
-    mem_rings = ring_of(
-        net, jnp.broadcast_to(iarr[:, None], swim.mem_id.shape),
-        jnp.clip(swim.mem_id, 0),
+    p_cnt = cfg.sync_peers
+    cand_slots, cand_sok = sample_k(bel_alive, min(2 * p_cnt, m), k_sp)
+    cand_ids = jnp.take_along_axis(swim.mem_id, cand_slots, axis=1)
+    staleness = jnp.take_along_axis(cst.last_sync, cand_slots, axis=1)
+    rings_c = ring_of(
+        net, jnp.broadcast_to(iarr[:, None], cand_ids.shape),
+        jnp.clip(cand_ids, 0),
     )
-    ring_bias = 0.5 * (1.0 - mem_rings.astype(jnp.float32) / (N_RINGS - 1))
-    p_slots, p_ok = sample_k_biased(bel_alive, ring_bias, cfg.sync_peers, k_sp)
-    peers = jnp.clip(jnp.take_along_axis(swim.mem_id, p_slots, axis=1), 0)
-    cst, s_info = sync_step(cfg, cst, peers, p_ok, swim.alive, net, k_sync)
+    peers, p_ok, c_idx = choose_sync_peers(
+        cfg, cst.book, cand_ids, cand_sok, staleness, rings_c, p_cnt
+    )
+    cst, s_ok, s_info = sync_step(cfg, cst, peers, p_ok, swim.alive, net, k_sync)
+    synced_slots = jnp.take_along_axis(cand_slots, c_idx, axis=1)
+    ls = jnp.minimum(cst.last_sync + 1, LAST_SYNC_CAP)
+    flat = jnp.where(s_ok, iarr[:, None] * m + synced_slots, n * m)
+    ls = ls.reshape(-1).at[flat.reshape(-1)].set(0, mode="drop").reshape(n, m)
+    cst = cst._replace(last_sync=ls)
 
     info = {**swim_info, **b_info, **s_info}
     return ScaleSimState(swim, cst), info

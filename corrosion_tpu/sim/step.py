@@ -56,9 +56,10 @@ class RoundInput(NamedTuple):
 
 def sim_step(cfg: SimConfig, st: SimState, net: NetModel, key, inp: RoundInput):
     """One full protocol round for the whole cluster."""
-    from corrosion_tpu.ops.select import sample_k_biased  # local: avoid import cycle
-    from corrosion_tpu.sim.sync import sync_step
-    from corrosion_tpu.sim.transport import N_RINGS, ring_of, same_region
+    from corrosion_tpu.ops.select import sample_k, sample_k_biased  # local: avoid import cycle
+    from corrosion_tpu.sim.broadcast import LAST_SYNC_CAP
+    from corrosion_tpu.sim.sync import choose_sync_peers, sync_step
+    from corrosion_tpu.sim.transport import ring_of, same_region
 
     n = cfg.n_nodes
     k_swim, k_bcast, k_sync, k_bt, k_sp = jr.split(key, 5)
@@ -78,15 +79,27 @@ def sim_step(cfg: SimConfig, st: SimState, net: NetModel, key, inp: RoundInput):
         k_bt,
     )
     cst, b_info = bcast_step(cfg, cst, targets, t_ok, swim.alive, net, k_bcast)
-    # sync peers: soft preference for closer rings (the reference sorts
-    # its 2x sample by need, last-sync, then RTT ring; need/last-sync are
-    # not tracked per-pair here, so the ring term carries the ordering)
+
+    # need-driven sync peer choice from a 2x random sample: most-needed
+    # versions first, then longest since last sync, then closest ring
+    # (handlers.rs:808-894); last_sync tracks are peer node ids here
     iarr = jnp.arange(n, dtype=jnp.int32)
-    rings = ring_of(net, jnp.broadcast_to(iarr[:, None], (n, n)),
-                    jnp.broadcast_to(iarr[None, :], (n, n)))
-    ring_bias = 0.5 * (1.0 - rings.astype(jnp.float32) / (N_RINGS - 1))
-    peers, p_ok = sample_k_biased(cand, ring_bias, cfg.sync_peers, k_sp)
-    cst, s_info = sync_step(cfg, cst, peers, p_ok, swim.alive, net, k_sync)
+    p_cnt = cfg.sync_peers
+    cand_ids, cand_sok = sample_k(cand, min(2 * p_cnt, n), k_sp)
+    staleness = jnp.take_along_axis(cst.last_sync, cand_ids, axis=1)
+    rings_c = ring_of(
+        net, jnp.broadcast_to(iarr[:, None], cand_ids.shape), cand_ids
+    )
+    peers, p_ok, _ = choose_sync_peers(
+        cfg, cst.book, cand_ids, cand_sok, staleness, rings_c, p_cnt
+    )
+    cst, s_ok, s_info = sync_step(cfg, cst, peers, p_ok, swim.alive, net, k_sync)
+    ls = jnp.minimum(cst.last_sync + 1, LAST_SYNC_CAP)
+    flat = jnp.where(s_ok, iarr[:, None] * n + peers, n * n)
+    ls = (
+        ls.reshape(-1).at[flat.reshape(-1)].set(0, mode="drop").reshape(n, n)
+    )
+    cst = cst._replace(last_sync=ls)
 
     info = {**swim_info, **b_info, **s_info}
     return SimState(swim, cst), info

@@ -33,10 +33,42 @@ import jax.numpy as jnp
 import jax.random as jr
 
 from corrosion_tpu.ops.lww import INT32_MIN, lex_max
-from corrosion_tpu.ops.versions import advance_heads
-from corrosion_tpu.sim.broadcast import CrdtState
+from corrosion_tpu.ops.versions import advance_heads, needs_count
+from corrosion_tpu.sim.broadcast import LAST_SYNC_CAP, CrdtState
 from corrosion_tpu.sim.config import SimConfig
-from corrosion_tpu.sim.transport import NetModel, bi_ok
+from corrosion_tpu.sim.transport import N_RINGS, NetModel, bi_ok
+
+
+def choose_sync_peers(cfg, book, cand_ids, cand_ok, staleness, rings, k):
+    """Need-driven sync peer choice (``handlers.rs:808-894``): from a
+    2x-oversampled candidate set, order by (1) most versions we still need
+    from that peer-as-origin, (2) longest time since we last synced with
+    it, (3) closest RTT ring — and take the top ``k``.
+
+    ``cand_ids``/``staleness``/``rings`` int32 [N, 2k]; ``cand_ok`` bool.
+    Returns ``(peers [N, k], ok [N, k], cand_idx [N, k])`` where
+    ``cand_idx`` indexes back into the candidate axis (for last-sync
+    bookkeeping updates at the caller).
+
+    The three criteria pack into one int32 score — 12 bits of need above
+    12 bits of staleness (:data:`LAST_SYNC_CAP`) above 3 bits of ring
+    closeness — so the ordering is exactly lexicographic (no float
+    mantissa truncation).
+    """
+    n_org = cfg.n_origins
+    needs = jnp.maximum(needs_count(book), 0)  # [N, O]
+    in_pool = (cand_ids >= 0) & (cand_ids < n_org)
+    o = jnp.clip(cand_ids, 0, n_org - 1)
+    need = jnp.where(in_pool, jnp.take_along_axis(needs, o, axis=1), 0)
+    score = (
+        (jnp.minimum(need, 4095) << 15)
+        + (jnp.minimum(staleness, LAST_SYNC_CAP) << 3)
+        + (N_RINGS - 1 - jnp.clip(rings, 0, N_RINGS - 1))
+    ).astype(jnp.int32)
+    score = jnp.where(cand_ok, score, jnp.int32(-1))
+    val, idx = jax.lax.top_k(score, k)
+    peers = jnp.take_along_axis(cand_ids, idx, axis=1)
+    return jnp.clip(peers, 0), val >= 0, idx.astype(jnp.int32)
 
 
 def sync_step(
@@ -49,7 +81,8 @@ def sync_step(
     key: jax.Array,
 ):
     """One sync round: a random subset of nodes each pulls from up to
-    ``sync_peers`` peers. Returns (state, info)."""
+    ``sync_peers`` peers. Returns (state, ok, info) where ``ok`` [N, P]
+    marks pairs that actually exchanged (drives last-sync bookkeeping)."""
     n, p_cnt, n_org = cfg.n_nodes, cfg.sync_peers, cfg.n_origins
     iarr = jnp.arange(n, dtype=jnp.int32)
     k_go, k_bi = jr.split(key)
@@ -111,4 +144,4 @@ def sync_step(
             jnp.maximum(jnp.max(granted, axis=1) - head_i, 0)
         ),
     }
-    return cst._replace(store=store, book=book), info
+    return cst._replace(store=store, book=book), ok, info

@@ -86,11 +86,12 @@ def main():
         return s._replace(crdt=cst)
     timed("bcast only", scan_over(bcast_only), st, key)
 
-    # sync only (fixed peers)
-    peers = jnp.stack([(iarr + 1) % n, (iarr + 2) % n], axis=1)
-    p_ok = jnp.ones((n, 2), bool)
+    # sync only (fixed peers, one per configured fanout slot)
+    p_cnt = cfg.sync_peers
+    peers = jnp.stack([(iarr + 1 + j) % n for j in range(p_cnt)], axis=1)
+    p_ok = jnp.ones((n, p_cnt), bool)
     def sync_only(s, k):
-        cst, _ = sync_step(cfg, s.crdt, peers, p_ok, s.swim.alive, net, k)
+        cst, _, _ = sync_step(cfg, s.crdt, peers, p_ok, s.swim.alive, net, k)
         return s._replace(crdt=cst)
     timed("sync only", scan_over(sync_only), st, key)
 

@@ -1,0 +1,81 @@
+"""Per-round bandwidth budget + queue overflow policy.
+
+The reference meters broadcast at 10 MiB/s through a governor and, when
+the queue overflows, drops the oldest most-sent changeset to admit new
+ones (``crates/corro-agent/src/broadcast/mod.rs:410-812,460-463``). The
+sim analogs: ``bcast_budget_bytes`` shapes how many queued changesets may
+ride each round's packets (least-sent first), and ``alloc_slots_evict``
+implements drop-oldest-most-sent."""
+
+import jax.numpy as jnp
+import jax.random as jr
+import numpy as np
+
+from corrosion_tpu.ops.slots import alloc_slots_evict, budget_mask
+from corrosion_tpu.sim import scenario
+from corrosion_tpu.sim.broadcast import CHANGE_WIRE_BYTES
+from corrosion_tpu.sim.config import wan_config
+from corrosion_tpu.sim.step import SimState, crdt_metrics, run_rounds
+from corrosion_tpu.sim.transport import NetModel
+
+
+def test_alloc_slots_evict_prefers_free_then_most_sent():
+    # row 0: slot 1 free -> used first; then evict slot 2 (lowest key)
+    free = jnp.array([[False, True, False, False]])
+    evict_key = jnp.array([[5, 99, 1, 3]], jnp.int32)
+    want = jnp.array([[True, True, True, False]])
+    slot, placed = alloc_slots_evict(free, evict_key, want)
+    assert placed.all(axis=1)[0] or bool(placed[0, :3].all())
+    got = [int(slot[0, j]) for j in range(3)]
+    assert got[0] == 1  # the free slot
+    assert got[1] == 2  # most-sent (lowest remaining budget) evicted first
+    assert got[2] == 3  # next lowest
+
+
+def test_alloc_slots_evict_caps_at_capacity():
+    free = jnp.zeros((1, 2), bool)
+    evict_key = jnp.array([[1, 2]], jnp.int32)
+    want = jnp.ones((1, 4), bool)
+    slot, placed = alloc_slots_evict(free, evict_key, want)
+    assert int(placed.sum()) == 2  # only K items can land
+
+
+def test_budget_mask_keeps_highest_priority():
+    live = jnp.array([[True, True, True, False]])
+    pri = jnp.array([[3, 9, 5, 7]], jnp.int32)
+    out = budget_mask(live, pri, allowed=2)
+    assert out.tolist() == [[False, True, True, False]]
+    # allowed >= K is a no-op
+    assert budget_mask(live, pri, allowed=4) is live
+
+
+def test_overload_budget_shapes_then_sync_repairs():
+    """Under a send budget far below the offered write load, dissemination
+    is shaped (per-round sends bounded by the budget), the queue evicts
+    rather than wedges, and anti-entropy sync still repairs the cluster to
+    convergence once the load stops."""
+    n = 16
+    budget_slots = 2  # changesets per node-round through the carrier
+    fanout = wan_config(n).bcast_fanout
+    cfg = wan_config(
+        n,
+        n_origins=4,
+        n_rows=4,
+        n_cols=2,
+        sync_interval=2,
+        bcast_queue=8,
+        bcast_budget_bytes=budget_slots * CHANGE_WIRE_BYTES * fanout,
+    )
+    st = SimState.create(cfg)
+    net = NetModel.create(n, drop_prob=0.0)
+    # heavy load: every origin writes every round for 30 rounds
+    inp = scenario.conflict_heavy(cfg, 30, jr.key(1), write_prob=1.0, hot_cells=4)
+    st, infos = run_rounds(cfg, st, net, jr.key(2), inp)
+    sent = np.asarray(infos["sent"])
+    # budget-shaped: a node can flush at most budget_slots slots to at
+    # most fanout targets each round
+    assert (sent <= n * budget_slots * cfg.bcast_fanout).all(), sent.max()
+    # repair: stop writing, let sync close the gaps
+    st, _ = run_rounds(cfg, st, net, jr.key(3), scenario.quiet(cfg, 200))
+    m = crdt_metrics(cfg, st)
+    assert bool(m["converged"]), (int(m["n_diverged"]), int(m["total_needs"]))

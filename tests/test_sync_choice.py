@@ -1,0 +1,111 @@
+"""Need-driven sync peer choice (``handlers.rs:808-894``): most-needed
+versions dominate, then longest-since-last-sync, then closest RTT ring."""
+
+import jax.numpy as jnp
+import jax.random as jr
+
+from corrosion_tpu.ops.versions import Book
+from corrosion_tpu.sim.config import SimConfig, wan_config
+from corrosion_tpu.sim.sync import choose_sync_peers
+from corrosion_tpu.sim.transport import NetModel
+
+
+def _book_with_needs(n, n_org, node, origin, need):
+    book = Book.create(n, n_org, buf_slots=4)
+    return book._replace(
+        known_max=book.known_max.at[node, origin].set(need)
+    )
+
+
+def test_need_dominates():
+    cfg = SimConfig(n_nodes=8, n_origins=4, sync_peers=1)
+    # node 0 needs 10 versions from origin 2 and nothing from anyone else
+    book = _book_with_needs(8, 4, node=0, origin=2, need=10)
+    cand_ids = jnp.array([[1, 2, 3]], jnp.int32)
+    cand_ok = jnp.ones((1, 3), bool)
+    staleness = jnp.array([[500, 0, 500]], jnp.int32)  # 2 is the LEAST stale
+    rings = jnp.zeros((1, 3), jnp.int32)
+    peers, ok, idx = choose_sync_peers(
+        cfg, book, cand_ids, cand_ok, staleness, rings, 1
+    )
+    # need beats staleness: origin 2 is chosen despite having just synced
+    assert bool(ok[0, 0]) and int(peers[0, 0]) == 2
+
+
+def test_staleness_breaks_need_ties():
+    cfg = SimConfig(n_nodes=8, n_origins=4, sync_peers=1)
+    book = Book.create(8, 4, buf_slots=4)  # no needs anywhere
+    cand_ids = jnp.array([[1, 2, 3]], jnp.int32)
+    cand_ok = jnp.ones((1, 3), bool)
+    staleness = jnp.array([[5, 900, 5]], jnp.int32)
+    rings = jnp.zeros((1, 3), jnp.int32)
+    peers, ok, _ = choose_sync_peers(
+        cfg, book, cand_ids, cand_ok, staleness, rings, 1
+    )
+    assert int(peers[0, 0]) == 2  # longest since last sync
+
+
+def test_ring_breaks_full_ties():
+    cfg = SimConfig(n_nodes=8, n_origins=4, sync_peers=1)
+    book = Book.create(8, 4, buf_slots=4)
+    cand_ids = jnp.array([[1, 2, 3]], jnp.int32)
+    cand_ok = jnp.ones((1, 3), bool)
+    staleness = jnp.full((1, 3), 7, jnp.int32)
+    rings = jnp.array([[4, 4, 0]], jnp.int32)  # 3 is ring-closest
+    peers, ok, _ = choose_sync_peers(
+        cfg, book, cand_ids, cand_ok, staleness, rings, 1
+    )
+    assert int(peers[0, 0]) == 3
+
+
+def test_invalid_candidates_never_chosen():
+    cfg = SimConfig(n_nodes=8, n_origins=4, sync_peers=2)
+    book = _book_with_needs(8, 4, node=0, origin=1, need=3)
+    cand_ids = jnp.array([[1, 2, 3, 0]], jnp.int32)
+    cand_ok = jnp.array([[False, True, True, False]])
+    staleness = jnp.zeros((1, 4), jnp.int32)
+    rings = jnp.zeros((1, 4), jnp.int32)
+    peers, ok, _ = choose_sync_peers(
+        cfg, book, cand_ids, cand_ok, staleness, rings, 2
+    )
+    chosen = {int(p) for p, o in zip(peers[0], ok[0]) if bool(o)}
+    assert chosen <= {2, 3} and len(chosen) == 2
+
+
+def test_adaptive_fanout_defaults():
+    # clamp(members/100, 3, 10) analog (handlers.rs:838)
+    assert wan_config(16).sync_peers == 3
+    assert wan_config(500).sync_peers == 5
+    assert wan_config(100_000).sync_peers == 10
+    from corrosion_tpu.sim.scale_step import scale_sim_config
+
+    assert scale_sim_config(16).sync_peers == 3
+    assert scale_sim_config(100_000).sync_peers == 10
+
+
+def test_last_sync_tracks_update():
+    """End-to-end: after rounds run, synced tracks reset to small
+    staleness while never-synced tracks saturate."""
+    import jax
+
+    from corrosion_tpu.sim.broadcast import LAST_SYNC_CAP
+    from corrosion_tpu.sim.scale_step import (
+        ScaleRoundInput,
+        ScaleSimState,
+        scale_run_rounds,
+        scale_sim_config,
+    )
+
+    cfg = scale_sim_config(32, n_origins=4, sync_interval=2)
+    st = ScaleSimState.create(cfg)
+    net = NetModel.create(32, drop_prob=0.0)
+    rounds = 32
+    quiet = ScaleRoundInput.quiet(cfg)
+    inputs = jax.tree.map(
+        lambda a: jnp.broadcast_to(a, (rounds,) + a.shape), quiet
+    )
+    st, infos = scale_run_rounds(cfg, st, net, jr.key(0), inputs)
+    ls = st.crdt.last_sync
+    assert int(infos["syncs"].sum()) > 0
+    # at least one track was synced recently somewhere
+    assert int(ls.min()) < LAST_SYNC_CAP
