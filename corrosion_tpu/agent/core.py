@@ -97,7 +97,7 @@ class Agent:
         # node per tick, the array analog of the reference's broadcast
         # batching queue (``broadcast/mod.rs:395-408``).
         n = self.n_nodes
-        self._write_queues: dict = {}  # node -> list of (cell, val, event|None)
+        self._write_queues: dict = {}  # node -> list of (cell, val, clp, event|None)
         self._pend_kill = np.zeros(n, bool)
         self._pend_revive = np.zeros(n, bool)
         self._pend_partition: Optional[np.ndarray] = None
@@ -148,7 +148,7 @@ class Agent:
             with self._input_lock:
                 self._apply_pend_restore()
                 for q in self._write_queues.values():
-                    for _, _, ev in q:
+                    for *_fields, ev in q:
                         if ev is not None:
                             ev.set()
                 self._write_queues.clear()
@@ -174,13 +174,15 @@ class Agent:
             write_mask = np.zeros(n, bool)
             write_cell = np.zeros(n, np.int32)
             write_val = np.zeros(n, np.int32)
+            write_clp = np.zeros(n, np.int32)
             waiters = []
             drained = []
             for node, q in self._write_queues.items():
-                cell, val, ev = q.pop(0)
+                cell, val, clp, ev = q.pop(0)
                 write_mask[node] = True
                 write_cell[node] = cell
                 write_val[node] = val
+                write_clp[node] = clp
                 if ev is not None:
                     waiters.append(ev)
                 if not q:
@@ -193,6 +195,7 @@ class Agent:
                 write_mask=jnp.asarray(write_mask),
                 write_cell=jnp.asarray(write_cell),
                 write_val=jnp.asarray(write_val),
+                write_clp=jnp.asarray(write_clp),
                 kill=jnp.asarray(np.array(self._pend_kill)),
                 revive=jnp.asarray(np.array(self._pend_revive)),
             )
@@ -269,7 +272,10 @@ class Agent:
 
     def write_many(self, node: int, cells, wait: bool = True,
                    timeout: float = 30.0) -> dict:
-        """Multi-cell transaction at ``node``: a list of ``(cell, value)``.
+        """Multi-cell transaction at ``node``: a list of ``(cell, value)``
+        or ``(cell, value, clp)`` where ``clp`` is the causal-length row
+        lifetime of the write (the DB layer stamps it; raw writes default
+        to 0).
 
         Cells enter rounds in order, one per round (FIFO staging — the
         broadcast-batching analog). With ``wait`` the call returns once
@@ -279,10 +285,10 @@ class Agent:
             raise ValueError(
                 f"node {node} is not a writer (origins are 0..{self.n_origins - 1})"
             )
-        cells = list(cells)
+        cells = [(c[0], c[1], c[2] if len(c) > 2 else 0) for c in cells]
         if not cells:
             return {"rows_affected": 0, "round": self.round_no}
-        for cell, _ in cells:
+        for cell, _, _ in cells:
             if not (0 <= cell < self.n_cells):
                 raise ValueError(f"cell {cell} out of range (n_cells={self.n_cells})")
         if self.tripwire.tripped:
@@ -290,10 +296,10 @@ class Agent:
         ev = threading.Event()
         with self._input_lock:
             q = self._write_queues.setdefault(node, [])
-            for cell, value in cells[:-1]:
-                q.append((int(cell), int(value), None))
-            last_cell, last_val = cells[-1]
-            q.append((int(last_cell), int(last_val), ev))
+            for cell, value, clp in cells[:-1]:
+                q.append((int(cell), int(value), int(clp), None))
+            last_cell, last_val, last_clp = cells[-1]
+            q.append((int(last_cell), int(last_val), int(last_clp), ev))
         if wait and not ev.wait(timeout):
             raise TimeoutError("write did not enter a round in time")
         return {"rows_affected": len(cells), "round": self.round_no}
@@ -397,6 +403,7 @@ class Agent:
             "col_version": int(snap["store"][0][node, cell]),
             "site": int(snap["store"][2][node, cell]),
             "db_version": int(snap["store"][3][node, cell]),
+            "cl_lifetime": int(snap["store"][4][node, cell]),
         }
 
     def node_rows(self, node: int) -> np.ndarray:

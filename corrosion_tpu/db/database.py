@@ -187,19 +187,19 @@ class Database:
         return row * self.n_cols + col
 
     def _read_plane(self, node: int, row: int, col: int,
-                    overlay: Optional[Dict[int, int]] = None) -> int:
+                    overlay: Optional[Dict[int, Tuple[int, int]]] = None) -> int:
         """Value-plane read; ``overlay`` holds this transaction's pending
-        cells so later statements observe earlier ones (the reference runs
-        statements sequentially inside one SQLite tx,
-        ``public/mod.rs:141-174``)."""
+        ``cell -> (value, clp)`` entries so later statements observe
+        earlier ones (the reference runs statements sequentially inside
+        one SQLite tx, ``public/mod.rs:141-174``)."""
         cell = self._cell(row, col)
         if overlay is not None and cell in overlay:
-            return overlay[cell]
+            return overlay[cell][0]
         snap = self.agent.snapshot()
         return int(snap["store"][1][node, cell])
 
     def _row_live(self, node: int, row: int,
-                  overlay: Optional[Dict[int, int]] = None) -> bool:
+                  overlay: Optional[Dict[int, Tuple[int, int]]] = None) -> bool:
         return self._read_plane(node, row, CL_COL, overlay) % 2 == 1
 
     # --- writes ----------------------------------------------------------
@@ -210,7 +210,8 @@ class Database:
         ``(sql, params)``; returns one ``ExecResult`` per statement."""
         t0 = time.perf_counter()
         results: List[ExecResult] = []
-        merged: Dict[int, int] = {}  # cell -> final value this tx (ordered)
+        # cell -> (final value, causal-length lifetime) this tx (ordered)
+        merged: Dict[int, Tuple[int, int]] = {}
         notifications = []
         for stmt in statements:
             sql, params = (stmt, None) if isinstance(stmt, str) else (
@@ -222,7 +223,7 @@ class Database:
             # later statements override earlier cells for the same target —
             # last-write-wins within the transaction, like sequential
             # statements in one SQLite tx (dict update keeps first position)
-            merged.update(stmt_cells)
+            merged.update({c: (v, l) for c, v, l in stmt_cells})
             notifications.extend(notes)
             results.append(
                 ExecResult(rows_affected=affected,
@@ -236,18 +237,19 @@ class Database:
                 hook(node, *note)
         return results
 
-    def _order_tx_cells(self, merged: Dict[int, int]) -> List[Tuple[int, int]]:
-        """Drain order for the transaction's net cell writes: causal-length
-        flips that leave a row LIVE go last (the row only turns visible
-        once its values are in flight) and flips that leave it DEAD go
-        first — ``write_many`` drains one cell per round, so list order is
-        visibility order for local readers."""
+    def _order_tx_cells(self, merged: Dict[int, Tuple[int, int]]
+                        ) -> List[Tuple[int, int, int]]:
+        """Drain order for the transaction's net ``(cell, value, clp)``
+        writes: causal-length flips that leave a row LIVE go last (the row
+        only turns visible once its values are in flight) and flips that
+        leave it DEAD go first — ``write_many`` drains one cell per round,
+        so list order is visibility order for local readers."""
         deaths, values, lives = [], [], []
-        for cell, value in merged.items():
+        for cell, (value, clp) in merged.items():
             if cell % self.n_cols == CL_COL:
-                (lives if value % 2 == 1 else deaths).append((cell, value))
+                (lives if value % 2 == 1 else deaths).append((cell, value, clp))
             else:
-                values.append((cell, value))
+                values.append((cell, value, clp))
         return deaths + values + lives
 
     def _plan_write(self, node: int, sql: str, params: Any,
@@ -298,17 +300,23 @@ class Database:
         conflict = (m.group("conflict") or "").upper().strip()
         if live and (or_clause == "IGNORE" or "DO NOTHING" in conflict):
             return 0, [], []
-        cells: List[Tuple[int, int]] = []
+        # lifetime the write belongs to: the current one for a live-row
+        # upsert, the NEXT odd causal length for an insert/resurrect —
+        # value cells from a previous lifetime must not leak through
+        # (cr-sqlite `cl` semantics, doc/crdts.md:24-40)
+        lifetime = cl if live else cl + 1
+        cells: List[Tuple[int, int, int]] = []
         for name, value in by_col.items():
             cells.append(
-                (self._cell(row, table.col_index(name)), self.heap.intern(value))
+                (self._cell(row, table.col_index(name)),
+                 self.heap.intern(value), lifetime)
             )
         if not live:
             # CL flip staged LAST: write_many drains one cell per round, so
             # the row must only turn live once its values are already in
             # flight — otherwise readers observe a live all-NULL row for
             # n_value_columns rounds (insert atomicity)
-            cells.append((self._cell(row, CL_COL), cl + 1))
+            cells.append((self._cell(row, CL_COL), cl + 1, cl + 1))
         return 1, cells, [(table.name, pk, dict(by_col), False)]
 
     def _split_where_pk(self, table, where: str, p: _Params):
@@ -344,8 +352,10 @@ class Database:
         for name, value in sets.items():
             if value is None and table.column(name).not_null:
                 raise SqlError(f"NOT NULL violation: {table.name}.{name}")
+        lifetime = self._read_plane(node, row, CL_COL, overlay)
         cells = [
-            (self._cell(row, table.col_index(name)), self.heap.intern(value))
+            (self._cell(row, table.col_index(name)),
+             self.heap.intern(value), lifetime)
             for name, value in sets.items()
         ]
         return 1, cells, [(table.name, pk, dict(sets), False)]
@@ -360,7 +370,7 @@ class Database:
         cl = self._read_plane(node, row, CL_COL, overlay)
         if cl % 2 == 0:
             return 0, [], []
-        cells = [(self._cell(row, CL_COL), cl + 1)]
+        cells = [(self._cell(row, CL_COL), cl + 1, cl + 1)]
         return 1, cells, [(table.name, pk, {}, True)]
 
     # --- reads -----------------------------------------------------------
@@ -425,22 +435,31 @@ class Database:
     def _scan(self, node: int, table, names, conds, limit):
         snap = self.agent.snapshot()
         vals = snap["store"][1][node]
+        clps = snap["store"][4][node]
         emitted = 0
         for pk, row in self.rows.rows_of(table.name):
             if int(vals[self._cell(row, CL_COL)]) % 2 == 0:
                 continue
-            rec = self._materialize(table, pk, vals, row)
+            rec = self._materialize(table, pk, vals, clps, row)
             if all(self._eval(c, rec) for c in conds):
                 yield [rec[n] for n in names]
                 emitted += 1
                 if limit is not None and emitted >= limit:
                     return
 
-    def _materialize(self, table, pk, vals, row) -> Dict[str, Any]:
+    def _materialize(self, table, pk, vals, clps, row) -> Dict[str, Any]:
+        """A row's visible values: a cell counts only if it was written in
+        the row's CURRENT causal-length lifetime — values from before a
+        delete/resurrect cycle read as NULL, matching SQLite's fresh-row
+        semantics (cr-sqlite `cl`, doc/crdts.md:24-40)."""
+        row_cl = int(vals[self._cell(row, CL_COL)])
         rec = {table.pk.name: pk}
         for c in table.value_columns:
-            vid = int(vals[self._cell(row, table.col_index(c.name))])
-            rec[c.name] = self.heap.lookup(vid)
+            cell = self._cell(row, table.col_index(c.name))
+            if int(clps[cell]) == row_cl:
+                rec[c.name] = self.heap.lookup(int(vals[cell]))
+            else:
+                rec[c.name] = None
         return rec
 
     def read_row(self, node: int, table_name: str, pk: Any
@@ -452,9 +471,10 @@ class Database:
             return None
         snap = self.agent.snapshot()
         vals = snap["store"][1][node]
+        clps = snap["store"][4][node]
         if int(vals[self._cell(row, CL_COL)]) % 2 == 0:
             return None
-        return self._materialize(table, pk, vals, row)
+        return self._materialize(table, pk, vals, clps, row)
 
     @staticmethod
     def _eval(cond, rec) -> bool:

@@ -28,13 +28,15 @@ def load(build: bool = True):
     with _lock:
         if _lib is not None:
             return _lib
-        if not _LIB_PATH.exists() and build:
+        if build:
+            # always run make: it is a no-op when fresh and rebuilds a
+            # stale .so after corro_host.cpp changes
             try:
                 subprocess.run(
                     ["make", "-s"], cwd=_NATIVE_DIR, check=True, capture_output=True
                 )
             except (OSError, subprocess.CalledProcessError):
-                return None
+                pass
         if not _LIB_PATH.exists():
             return None
         lib = ctypes.CDLL(str(_LIB_PATH))
@@ -44,9 +46,9 @@ def load(build: bool = True):
         lib.corro_lww_new.argtypes = [i32]
         lib.corro_lww_free.argtypes = [p]
         lib.corro_lww_merge.restype = i32
-        lib.corro_lww_merge.argtypes = [p, i32, i32, i32, i32, i32]
+        lib.corro_lww_merge.argtypes = [p, i32, i32, i32, i32, i32, i32]
         lib.corro_lww_get.argtypes = [p, i32, ip]
-        lib.corro_lww_dump.argtypes = [p, ip, ip, ip, ip]
+        lib.corro_lww_dump.argtypes = [p, ip, ip, ip, ip, ip]
         lib.corro_book_new.restype = p
         lib.corro_book_new.argtypes = [i32]
         lib.corro_book_free.argtypes = [p]
@@ -65,13 +67,13 @@ def load(build: bool = True):
         lib.corro_cluster_new.restype = p
         lib.corro_cluster_new.argtypes = [i32, i32, i32, i32, i32, i32, i64]
         lib.corro_cluster_free.argtypes = [p]
-        lib.corro_cluster_write.argtypes = [p, i32, i32, i32]
+        lib.corro_cluster_write.argtypes = [p, i32, i32, i32, i32]
         lib.corro_cluster_round.argtypes = [p]
         lib.corro_cluster_converged.restype = i32
         lib.corro_cluster_converged.argtypes = [p]
         lib.corro_cluster_settle.restype = i32
         lib.corro_cluster_settle.argtypes = [p, i32]
-        lib.corro_cluster_store.argtypes = [p, i32, ip, ip, ip, ip]
+        lib.corro_cluster_store.argtypes = [p, i32, ip, ip, ip, ip, ip]
         lib.corro_cluster_total_needs.restype = i64
         lib.corro_cluster_total_needs.argtypes = [p]
         _lib = lib
@@ -107,9 +109,9 @@ class NativeNode:
                 lib.corro_book_free(self._book)
 
     def apply(self, changes) -> np.ndarray:
-        """Apply [n, 6] int32 rows (cell, ver, val, site, origin, dbv);
-        returns per-change freshness flags."""
-        arr = np.ascontiguousarray(changes, dtype=np.int32).reshape(-1, 6)
+        """Apply [n, 7] int32 rows (cell, ver, val, site, origin, dbv,
+        clp); returns per-change freshness flags."""
+        arr = np.ascontiguousarray(changes, dtype=np.int32).reshape(-1, 7)
         fresh = np.zeros(arr.shape[0], dtype=np.int32)
         self._lib.corro_apply_batch(
             self._book,
@@ -136,9 +138,9 @@ class NativeNode:
         return self._lib.corro_book_n_gaps(self._book, origin)
 
     def store(self):
-        """The four store planes as [n_cells] int32 arrays."""
+        """The (ver, val, site, dbv, clp) planes as [n_cells] int32."""
         planes = tuple(
-            np.zeros(self.n_cells, dtype=np.int32) for _ in range(4)
+            np.zeros(self.n_cells, dtype=np.int32) for _ in range(5)
         )
         ptrs = [
             pl.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)) for pl in planes
@@ -170,8 +172,8 @@ class NativeCluster:
         if lib is not None and getattr(self, "_h", None):
             lib.corro_cluster_free(self._h)
 
-    def write(self, node: int, cell: int, value: int) -> None:
-        self._lib.corro_cluster_write(self._h, node, cell, value)
+    def write(self, node: int, cell: int, value: int, clp: int = 0) -> None:
+        self._lib.corro_cluster_write(self._h, node, cell, value, clp)
 
     def round(self) -> None:
         self._lib.corro_cluster_round(self._h)
@@ -184,15 +186,17 @@ class NativeCluster:
 
     def run(self, script, settle_rounds: int = 256) -> int:
         """Apply a WorkloadScript then settle; rounds taken or -1."""
+        from corrosion_tpu.sim.parity import _write4
+
         for batch in script.writes:
-            for node, cell, val in batch:
-                self.write(node, cell, val)
+            for node, cell, val, clp in (_write4(w) for w in batch):
+                self.write(node, cell, val, clp)
             self.round()
         settled = self._lib.corro_cluster_settle(self._h, settle_rounds)
         return -1 if settled < 0 else len(script.writes) + settled
 
     def store_planes(self, node: int = 0):
-        planes = tuple(np.zeros(self.n_cells, np.int32) for _ in range(4))
+        planes = tuple(np.zeros(self.n_cells, np.int32) for _ in range(5))
         ptrs = [pl.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
                 for pl in planes]
         self._lib.corro_cluster_store(self._h, node, *ptrs)

@@ -98,23 +98,33 @@ def lex_segment_argmax(
 def merge_store(store, incoming):
     """Merge two whole LWW stores.
 
-    A store is ``(ver, val, site, dbv)`` — three lex-key planes plus the
-    origin-db_version payload plane, all int32 of identical shape. This is
-    the array analog of replaying every row of a remote ``crsql_changes``
-    into the local db (``INSERT INTO crsql_changes``, reference
+    A store is ``(ver, val, site, dbv, clp)`` planes, all int32 of
+    identical shape: three LWW clock planes, the origin-db_version
+    payload plane, and the **causal-length lifetime** plane ``clp`` — the
+    row causal length (cr-sqlite ``cl``, ``doc/crdts.md:24-40``) current
+    when the cell was written. The merge key is ``(clp, ver, val, site)``:
+    a write from a later row lifetime beats any write from an earlier one
+    regardless of col_version (cr-sqlite's "greater causal length wins"),
+    and within a lifetime the plain LWW rule applies. This is the array
+    analog of replaying every row of a remote ``crsql_changes`` into the
+    local db (``INSERT INTO crsql_changes``, reference
     ``crates/corro-agent/src/agent/util.rs:1233``): each cell resolves
-    independently by the LWW rule.
+    independently.
     """
     a, b = store, incoming
-    return lex_max(a[:3], b[:3], (a[3], b[3]))
+    m_clp, m_ver, m_val, m_site, m_dbv = lex_max(
+        (a[4], a[0], a[1], a[2]), (b[4], b[0], b[1], b[2]), (a[3], b[3])
+    )
+    return (m_ver, m_val, m_site, m_dbv, m_clp)
 
 
-def apply_changes_to_store(store, flat_idx, ver, val, site, dbv, valid):
+def apply_changes_to_store(store, flat_idx, ver, val, site, dbv, clp, valid):
     """Apply a batch of addressed changes to a flattened LWW store.
 
-    ``store``: ``(ver, val, site, dbv)`` planes flattened to 1-D size S.
-    ``flat_idx`` int32 [M] target cell per change; ``valid`` bool [M]
-    (invalid changes route to scratch segment S and vanish).
+    ``store``: ``(ver, val, site, dbv, clp)`` planes flattened to 1-D
+    size S. ``flat_idx`` int32 [M] target cell per change; ``valid`` bool
+    [M] (invalid changes route to scratch segment S and vanish). Merge
+    key per cell: ``(clp, ver, val, site)`` — see :func:`merge_store`.
 
     Matches applying a batch of remote changes in one SQLite tx
     (``process_multiple_changes``, reference
@@ -123,20 +133,23 @@ def apply_changes_to_store(store, flat_idx, ver, val, site, dbv, valid):
     what makes it a CRDT and what lets the simulator apply a whole gossip
     round's message soup in one fused op.
     """
-    s_ver, s_val, s_site, s_dbv = store
+    s_ver, s_val, s_site, s_dbv, s_clp = store
     size = s_ver.shape[0]
     seg = jnp.where(valid, flat_idx, size).astype(jnp.int32)
-    win, nonempty = lex_segment_argmax((ver, val, site), seg, num_segments=size + 1)
+    win, nonempty = lex_segment_argmax(
+        (clp, ver, val, site), seg, num_segments=size + 1
+    )
     win, nonempty = win[:size], nonempty[:size]
-    b = (ver[win], val[win], site[win], dbv[win])
-    m_ver, m_val, m_site, m_dbv = lex_max(
-        (s_ver, s_val, s_site), b[:3], (s_dbv, b[3])
+    b = (clp[win], ver[win], val[win], site[win], dbv[win])
+    m_clp, m_ver, m_val, m_site, m_dbv = lex_max(
+        (s_clp, s_ver, s_val, s_site), b[:4], (s_dbv, b[4])
     )
     return (
         jnp.where(nonempty, m_ver, s_ver),
         jnp.where(nonempty, m_val, s_val),
         jnp.where(nonempty, m_site, s_site),
         jnp.where(nonempty, m_dbv, s_dbv),
+        jnp.where(nonempty, m_clp, s_clp),
     )
 
 

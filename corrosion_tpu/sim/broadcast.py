@@ -51,17 +51,19 @@ from corrosion_tpu.sim.transport import NetModel, uni_ok
 NO_Q = jnp.int32(-1)
 LAST_SYNC_CAP = 4095  # staleness saturates (never-synced == very stale)
 
-# wire-size estimate of one changeset: 6 int32 fields + length-delimited
+# wire-size estimate of one changeset: 7 int32 fields + length-delimited
 # framing overhead — the bytes-per-changeset unit of the send budget
 # (the reference meters serialized ChangeV1 bytes through its governor,
 # broadcast/mod.rs:460-463)
-CHANGE_WIRE_BYTES = 52
+CHANGE_WIRE_BYTES = 56
 
 
 class CrdtState(NamedTuple):
     """LWW store + bookkeeping + broadcast queues for all N nodes."""
 
-    store: Tuple[jax.Array, jax.Array, jax.Array, jax.Array]  # 4x int32 [N, R*C]
+    # (ver, val, site, dbv, clp) planes — clp is the causal-length row
+    # lifetime the cell was written under (cr-sqlite `cl`, doc/crdts.md)
+    store: Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]
     book: Book
     next_dbv: jax.Array  # int32 [N] — origin's next db_version (1-based)
     q_origin: jax.Array  # int32 [N, Q] — -1 = free slot
@@ -70,6 +72,7 @@ class CrdtState(NamedTuple):
     q_ver: jax.Array  # int32 [N, Q]
     q_val: jax.Array  # int32 [N, Q]
     q_site: jax.Array  # int32 [N, Q]
+    q_clp: jax.Array  # int32 [N, Q] — causal-length lifetime of the cell
     q_tx: jax.Array  # int32 [N, Q] — remaining transmissions
     last_sync: jax.Array  # int32 [N, S] — rounds since last sync per track
     # (S = peer node id for the full-view sim, member-table slot at scale;
@@ -80,7 +83,7 @@ class CrdtState(NamedTuple):
         n, q, c = cfg.n_nodes, cfg.bcast_queue, cfg.n_cells
         z = lambda *s: jnp.zeros(s, jnp.int32)  # noqa: E731
         return CrdtState(
-            store=(z(n, c), z(n, c), z(n, c), z(n, c)),
+            store=(z(n, c), z(n, c), z(n, c), z(n, c), z(n, c)),
             book=Book.create(n, cfg.n_origins, cfg.buf_slots),
             next_dbv=jnp.ones(n, jnp.int32),
             q_origin=jnp.full((n, q), NO_Q, jnp.int32),
@@ -89,12 +92,13 @@ class CrdtState(NamedTuple):
             q_ver=z(n, q),
             q_val=z(n, q),
             q_site=z(n, q),
+            q_clp=z(n, q),
             q_tx=z(n, q),
             last_sync=jnp.full((n, cfg.sync_tracks), LAST_SYNC_CAP, jnp.int32),
         )
 
 
-def _enqueue(cst: CrdtState, want, origin, dbv, cell, ver, val, site, tx):
+def _enqueue(cst: CrdtState, want, origin, dbv, cell, ver, val, site, clp, tx):
     """Place per-node batches of changes into queue slots; on overflow the
     most-sent queued changeset is evicted to admit the new one
     (drop-oldest-most-sent, ``broadcast/mod.rs:410-812``)."""
@@ -107,15 +111,19 @@ def _enqueue(cst: CrdtState, want, origin, dbv, cell, ver, val, site, tx):
         q_ver=scatter_rows(cst.q_ver, slot, placed, ver),
         q_val=scatter_rows(cst.q_val, slot, placed, val),
         q_site=scatter_rows(cst.q_site, slot, placed, site),
+        q_clp=scatter_rows(cst.q_clp, slot, placed, clp),
         q_tx=scatter_rows(cst.q_tx, slot, placed, tx),
     )
 
 
-def local_write(cfg: SimConfig, cst: CrdtState, write_mask, cell, val):
+def local_write(cfg: SimConfig, cst: CrdtState, write_mask, cell, val, clp=None):
     """Commit one-cell write transactions at the writer nodes.
 
     ``write_mask`` bool [N] (only indices < n_origins may be set),
-    ``cell``/``val`` int32 [N]. Mirrors ``POST /v1/transactions``
+    ``cell``/``val`` int32 [N]; ``clp`` int32 [N] is the causal-length
+    row lifetime the write belongs to (the DB layer stamps it from the
+    row's ``cl``; raw sim workloads default to 0 — one immortal
+    lifetime, the pre-delete semantics). Mirrors ``POST /v1/transactions``
     (SURVEY §3.2): assign db_version, bump the cell's col_version from
     the *current* clock (cr-sqlite increments the clock row it sees,
     merged or local), apply locally, queue the changeset for broadcast.
@@ -124,6 +132,8 @@ def local_write(cfg: SimConfig, cst: CrdtState, write_mask, cell, val):
     iarr = jnp.arange(n, dtype=jnp.int32)
     is_origin = iarr < cfg.n_origins
     w = write_mask & is_origin
+    if clp is None:
+        clp = jnp.zeros(n, jnp.int32)
 
     dbv = cst.next_dbv
     cur_ver = cst.store[0][iarr, cell]
@@ -133,7 +143,8 @@ def local_write(cfg: SimConfig, cst: CrdtState, write_mask, cell, val):
     # apply to own store
     flat_idx = iarr * cfg.n_cells + cell
     store = apply_changes_to_store(
-        tuple(p.reshape(-1) for p in cst.store), flat_idx, ver, val, site, dbv, w
+        tuple(p.reshape(-1) for p in cst.store),
+        flat_idx, ver, val, site, dbv, clp, w,
     )
     store = tuple(p.reshape(n, cfg.n_cells) for p in store)
 
@@ -155,11 +166,13 @@ def local_write(cfg: SimConfig, cst: CrdtState, write_mask, cell, val):
         ver[:, None],
         val[:, None],
         site[:, None],
+        clp[:, None],
         jnp.full((n, 1), cfg.bcast_max_transmissions, jnp.int32),
     )
 
 
-def ingest_changes(cfg, cst: CrdtState, live, m_origin, m_dbv, m_cell, m_ver, m_val, m_site):
+def ingest_changes(cfg, cst: CrdtState, live, m_origin, m_dbv, m_cell, m_ver,
+                   m_val, m_site, m_clp):
     """Receiver ingest shared by every dissemination carrier: dedupe via
     the Book, apply fresh cells to the LWW store, re-enqueue fresh changes
     for re-broadcast with a decremented budget (``handlers.rs:548-786``,
@@ -182,6 +195,7 @@ def ingest_changes(cfg, cst: CrdtState, live, m_origin, m_dbv, m_cell, m_ver, m_
         m_val.reshape(-1),
         m_site.reshape(-1),
         m_dbv.reshape(-1),
+        m_clp.reshape(-1),
         fresh.reshape(-1),
     )
     store = tuple(p.reshape(n, cfg.n_cells) for p in store)
@@ -195,6 +209,7 @@ def ingest_changes(cfg, cst: CrdtState, live, m_origin, m_dbv, m_cell, m_ver, m_
         m_ver,
         m_val,
         m_site,
+        m_clp,
         jnp.full(m_origin.shape, max(1, cfg.bcast_max_transmissions - 1), jnp.int32),
     )
     info = {
@@ -245,7 +260,7 @@ def bcast_step(
     )
 
     flat = lambda a: jnp.broadcast_to(a[:, :, None], (n, q, f)).reshape(-1)  # noqa: E731
-    live, (m_origin, m_dbv, m_cell, m_ver, m_val, m_site) = mailbox_pack(
+    live, (m_origin, m_dbv, m_cell, m_ver, m_val, m_site, m_clp) = mailbox_pack(
         dst.reshape(-1),
         m_ok.reshape(-1),
         n_rows=n,
@@ -257,6 +272,7 @@ def bcast_step(
             flat(cst.q_ver),
             flat(cst.q_val),
             flat(cst.q_site),
+            flat(cst.q_clp),
         ),
     )
 
@@ -273,6 +289,6 @@ def bcast_step(
 
     # --- receiver ingest: dedupe, apply, re-broadcast -------------------
     cst, info = ingest_changes(
-        cfg, cst, live, m_origin, m_dbv, m_cell, m_ver, m_val, m_site
+        cfg, cst, live, m_origin, m_dbv, m_cell, m_ver, m_val, m_site, m_clp
     )
     return cst, {**info, "sent": jnp.sum(m_ok)}

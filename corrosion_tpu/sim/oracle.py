@@ -16,11 +16,16 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, Set, Tuple
 
-Change = Tuple[int, int, int, int, int, int]  # (cell, ver, val, site, origin, dbv)
+# (cell, ver, val, site, origin, dbv, clp) — clp is the causal-length
+# row lifetime the cell was written under (cr-sqlite `cl`)
+Change = Tuple[int, int, int, int, int, int, int]
 
 
-def lww_wins(a: Tuple[int, int, int], b: Tuple[int, int, int]) -> bool:
-    """Does clock ``a`` = (col_version, value, site_id) beat ``b``?
+def lww_wins(a: Tuple[int, int, int, int], b: Tuple[int, int, int, int]) -> bool:
+    """Does clock ``a`` = (cl_lifetime, col_version, value, site_id) beat
+    ``b``? A later causal-length lifetime beats anything from an earlier
+    one (cr-sqlite "greater causal length wins", ``doc/crdts.md:24-40``);
+    within a lifetime the plain LWW rule applies.
 
     Ties keep the incumbent ``a`` (identical change)."""
     return a >= b  # Python tuple comparison IS the lexicographic rule
@@ -31,8 +36,8 @@ class OracleNode:
     """One simulated node: LWW store + per-origin version bookkeeping."""
 
     n_origins: int
-    # cell -> (col_version, value, site, origin_db_version)
-    store: Dict[int, Tuple[int, int, int, int]] = field(default_factory=dict)
+    # cell -> (col_version, value, site, origin_db_version, cl_lifetime)
+    store: Dict[int, Tuple[int, int, int, int, int]] = field(default_factory=dict)
     seen: Dict[int, Set[int]] = field(default_factory=dict)  # origin -> versions
     known_max: Dict[int, int] = field(default_factory=dict)
 
@@ -43,10 +48,13 @@ class OracleNode:
             h += 1
         return h
 
-    def merge_cell(self, cell: int, ver: int, val: int, site: int, dbv: int):
+    def merge_cell(self, cell: int, ver: int, val: int, site: int, dbv: int,
+                   clp: int = 0):
         cur = self.store.get(cell)
-        if cur is None or not lww_wins(cur[:3], (ver, val, site)):
-            self.store[cell] = (ver, val, site, dbv)
+        if cur is None or not lww_wins(
+            (cur[4], cur[0], cur[1], cur[2]), (clp, ver, val, site)
+        ):
+            self.store[cell] = (ver, val, site, dbv, clp)
 
     def record(self, origin: int, version: int) -> bool:
         """Record an origin-version; returns True when fresh (unseen)."""
@@ -58,10 +66,10 @@ class OracleNode:
         return True
 
     def apply(self, change: Change) -> bool:
-        cell, ver, val, site, origin, dbv = change
+        cell, ver, val, site, origin, dbv, clp = change
         fresh = self.record(origin, dbv)
         if fresh:
-            self.merge_cell(cell, ver, val, site, dbv)
+            self.merge_cell(cell, ver, val, site, dbv, clp)
         return fresh
 
     def needs(self, origin: int) -> int:

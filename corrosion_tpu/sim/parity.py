@@ -37,20 +37,29 @@ import numpy as np
 
 from corrosion_tpu.sim.oracle import OracleNode
 
-Change = Tuple[int, int, int, int, int]  # (cell, ver, val, site, dbv); origin==site
+# (cell, ver, val, site, dbv, clp); origin==site
+Change = Tuple[int, int, int, int, int, int]
+
+
+def _write4(w):
+    """Normalize a script write to (node, cell, value, clp). Scripts may
+    omit clp (plain LWW workloads — one immortal lifetime, clp=0)."""
+    return (*w, 0) if len(w) == 3 else tuple(w)
 
 
 @dataclass
 class WorkloadScript:
     """Per-round write lists, shareable between oracle and sim.
 
-    ``writes[r]`` = list of (node, cell, value) committed in round r.
-    One write per node per round (the sim's RoundInput shape)."""
+    ``writes[r]`` = list of (node, cell, value[, clp]) committed in round
+    r — ``clp`` is the causal-length lifetime (delete/resurrect
+    workloads; defaults to 0). One write per node per round (the sim's
+    RoundInput shape)."""
 
     n_nodes: int
     n_origins: int
     n_cells: int
-    writes: List[List[Tuple[int, int, int]]] = field(default_factory=list)
+    writes: List[List[Tuple]] = field(default_factory=list)
 
     @staticmethod
     def random_single_writer(n_nodes: int, n_origins: int, n_cells: int,
@@ -88,11 +97,43 @@ class WorkloadScript:
             ws.writes.append(batch)
         return ws
 
+    @staticmethod
+    def random_delete_resurrect(n_nodes: int, n_origins: int, n_rows: int,
+                                n_cols: int, rounds: int, seed: int = 0,
+                                op_prob: float = 0.6) -> "WorkloadScript":
+        """Row-lifecycle workload: inserts, updates, deletes, resurrects —
+        the causal-length regime (``doc/crdts.md`` ``cl``). Cell layout:
+        ``row*n_cols`` is the CL register, value cells follow. Deletes
+        race in-flight updates and resurrects race stale lifetimes
+        through the network — agreement+validity parity regime."""
+        rng = random.Random(seed)
+        ws = WorkloadScript(n_nodes, n_origins, n_rows * n_cols)
+        cl = [0] * n_rows
+        for _ in range(rounds):
+            batch = []
+            for w in rng.sample(range(n_origins), n_origins):
+                if rng.random() >= op_prob:
+                    continue
+                row = rng.randrange(n_rows)
+                live = cl[row] % 2 == 1
+                if not live or rng.random() < 0.3:
+                    # insert/resurrect (dead row) or delete (live row):
+                    # bump the causal length register
+                    cl[row] += 1
+                    batch.append((w, row * n_cols, cl[row], cl[row]))
+                else:
+                    # update a value column within the current lifetime
+                    col = rng.randrange(1, n_cols)
+                    batch.append((w, row * n_cols + col,
+                                  rng.randrange(1, 1 << 20), cl[row]))
+            ws.writes.append(batch)
+        return ws
+
     def written_values(self) -> Dict[int, set]:
         """cell -> set of all values ever written to it (validity check)."""
         out: Dict[int, set] = {}
         for batch in self.writes:
-            for _, cell, val in batch:
+            for _, cell, val, _clp in (_write4(w) for w in batch):
                 out.setdefault(cell, set()).add(val)
         return out
 
@@ -120,14 +161,14 @@ class OracleCluster:
         self.queues: List[List[Tuple[Change, int]]] = [[] for _ in range(n_nodes)]
 
     # --- write path ------------------------------------------------------
-    def write(self, node: int, cell: int, value: int) -> None:
+    def write(self, node: int, cell: int, value: int, clp: int = 0) -> None:
         assert node < self.n_origins
         cur = self.nodes[node].store.get(cell)
         ver = (cur[0] if cur else 0) + 1  # bump the merged clock (local_write)
         dbv = self.next_dbv[node]
         self.next_dbv[node] += 1
-        ch: Change = (cell, ver, value, node, dbv)
-        self.nodes[node].apply((cell, ver, value, node, node, dbv))
+        ch: Change = (cell, ver, value, node, dbv, clp)
+        self.nodes[node].apply((cell, ver, value, node, node, dbv, clp))
         self.payloads[node][(node, dbv)] = ch
         self.queues[node].append((ch, self.budget))
 
@@ -158,8 +199,8 @@ class OracleCluster:
                 self._sync_pull(node, peer)
 
     def _ingest(self, dst: int, ch: Change) -> None:
-        cell, ver, val, site, dbv = ch
-        fresh = self.nodes[dst].apply((cell, ver, val, site, site, dbv))
+        cell, ver, val, site, dbv, clp = ch
+        fresh = self.nodes[dst].apply((cell, ver, val, site, site, dbv, clp))
         if fresh:
             self.payloads[dst][(site, dbv)] = ch
             self.queues[dst].append((ch, max(1, self.budget - 1)))
@@ -183,8 +224,8 @@ class OracleCluster:
         from corrosion_tpu.sim.oracle import converged
 
         for batch in script.writes:
-            for node, cell, val in batch:
-                self.write(node, cell, val)
+            for node, cell, val, clp in (_write4(w) for w in batch):
+                self.write(node, cell, val, clp)
             self.round()
         for r in range(settle_rounds):
             if not any(self.queues) and converged(self.nodes):
@@ -193,12 +234,13 @@ class OracleCluster:
         return len(script.writes) + settle_rounds if converged(self.nodes) else -1
 
     def store_planes(self) -> Tuple[np.ndarray, ...]:
-        """Node-0's converged store as dense (ver, val, site, dbv) planes
-        (after ``run`` all nodes are identical)."""
-        planes = [np.zeros(self.n_cells, np.int32) for _ in range(4)]
-        for cell, (ver, val, site, dbv) in self.nodes[0].store.items():
+        """Node-0's converged store as dense (ver, val, site, dbv, clp)
+        planes (after ``run`` all nodes are identical)."""
+        planes = [np.zeros(self.n_cells, np.int32) for _ in range(5)]
+        for cell, (ver, val, site, dbv, clp) in self.nodes[0].store.items():
             planes[0][cell], planes[1][cell] = ver, val
             planes[2][cell], planes[3][cell] = site, dbv
+            planes[4][cell] = clp
         return tuple(planes)
 
 
@@ -242,11 +284,12 @@ def run_sim_script(script: WorkloadScript, seed: int = 0,
         wm = np.zeros(script.n_nodes, bool)
         wc = np.zeros(script.n_nodes, np.int32)
         wv = np.zeros(script.n_nodes, np.int32)
-        for node, cell, val in batch:
-            wm[node], wc[node], wv[node] = True, cell, val
+        wl = np.zeros(script.n_nodes, np.int32)
+        for node, cell, val, clp in (_write4(w) for w in batch):
+            wm[node], wc[node], wv[node], wl[node] = True, cell, val, clp
         return quiet._replace(
             write_mask=jnp.asarray(wm), write_cell=jnp.asarray(wc),
-            write_val=jnp.asarray(wv),
+            write_val=jnp.asarray(wv), write_clp=jnp.asarray(wl),
         )
 
     for batch in script.writes:
@@ -273,7 +316,7 @@ def check_bitwise_parity(oracle: OracleCluster, sim_planes, alive) -> List[str]:
     oracle's converged store, plane by plane. Returns mismatch messages."""
     problems = []
     o_planes = oracle.store_planes()
-    names = ("col_version", "value", "site", "db_version")
+    names = ("col_version", "value", "site", "db_version", "cl_lifetime")
     for name, op, sp in zip(names, o_planes, sim_planes):
         for node in np.nonzero(alive)[0]:
             if not np.array_equal(sp[node], op):
@@ -295,7 +338,7 @@ def check_agreement_validity(script: WorkloadScript, sim_planes,
     problems = []
     alive_idx = np.nonzero(alive)[0]
     ref = alive_idx[0]
-    for name, plane in zip(("ver", "val", "site", "dbv"), sim_planes):
+    for name, plane in zip(("ver", "val", "site", "dbv", "clp"), sim_planes):
         same = np.all(plane[alive_idx] == plane[ref], axis=0)
         if not same.all():
             problems.append(

@@ -147,6 +147,7 @@ class ScaleRoundInput(NamedTuple):
     write_mask: jax.Array  # bool [N]
     write_cell: jax.Array  # int32 [N]
     write_val: jax.Array  # int32 [N]
+    write_clp: jax.Array  # int32 [N] — causal-length lifetime of the write
 
     @staticmethod
     def quiet(cfg: ScaleSimConfig) -> "ScaleRoundInput":
@@ -157,6 +158,7 @@ class ScaleRoundInput(NamedTuple):
             write_mask=jnp.zeros(n, bool),
             write_cell=jnp.zeros(n, jnp.int32),
             write_val=jnp.zeros(n, jnp.int32),
+            write_clp=jnp.zeros(n, jnp.int32),
         )
 
 
@@ -202,6 +204,7 @@ def piggyback_bcast_step(cfg: ScaleSimConfig, cst: CrdtState, channels, key):
             g(cst.q_ver),
             g(cst.q_val),
             g(cst.q_site),
+            g(cst.q_clp),
         )
 
     # --- gather each channel's payload; [N, n_channels*R] messages ------
@@ -210,8 +213,8 @@ def piggyback_bcast_step(cfg: ScaleSimConfig, cst: CrdtState, channels, key):
         src = jnp.clip(src, 0)
         parts.append(sender_fields(src))
         valids.append(valid[:, None] & sel_ok[src])
-    m_origin, m_dbv, m_cell, m_ver, m_val, m_site = (
-        jnp.concatenate([p[i] for p in parts], axis=1) for i in range(6)
+    m_origin, m_dbv, m_cell, m_ver, m_val, m_site, m_clp = (
+        jnp.concatenate([p[i] for p in parts], axis=1) for i in range(7)
     )
     live = jnp.concatenate(valids, axis=1)
 
@@ -233,7 +236,7 @@ def piggyback_bcast_step(cfg: ScaleSimConfig, cst: CrdtState, channels, key):
 
     # --- receiver ingest: dedupe, apply, re-broadcast --------------------
     return ingest_changes(
-        cfg, cst, live, m_origin, m_dbv, m_cell, m_ver, m_val, m_site
+        cfg, cst, live, m_origin, m_dbv, m_cell, m_ver, m_val, m_site, m_clp
     )
 
 
@@ -253,7 +256,10 @@ def scale_sim_step(
         cfg, st.swim, net, k_swim, kill=inp.kill, revive=inp.revive
     )
 
-    cst = local_write(cfg, st.crdt, inp.write_mask, inp.write_cell, inp.write_val)
+    cst = local_write(
+        cfg, st.crdt, inp.write_mask, inp.write_cell, inp.write_val,
+        inp.write_clp,
+    )
     cst, b_info = piggyback_bcast_step(cfg, cst, channels, k_pig)
 
     # need-driven sync peer choice from a 2x sample of believed-alive

@@ -41,6 +41,7 @@ class RoundInput(NamedTuple):
     write_mask: jax.Array  # bool [N] (effective only for nodes < n_origins)
     write_cell: jax.Array  # int32 [N]
     write_val: jax.Array  # int32 [N]
+    write_clp: jax.Array  # int32 [N] — causal-length lifetime of the write
 
     @staticmethod
     def quiet(cfg: SimConfig) -> "RoundInput":
@@ -51,6 +52,7 @@ class RoundInput(NamedTuple):
             write_mask=jnp.zeros(n, bool),
             write_cell=jnp.zeros(n, jnp.int32),
             write_val=jnp.zeros(n, jnp.int32),
+            write_clp=jnp.zeros(n, jnp.int32),
         )
 
 
@@ -69,7 +71,10 @@ def sim_step(cfg: SimConfig, st: SimState, net: NetModel, key, inp: RoundInput):
     believed = (swim.view >= 0) & ((swim.view & 3) == STATE_ALIVE)
     cand = believed & ~jnp.eye(n, dtype=bool)
 
-    cst = local_write(cfg, st.crdt, inp.write_mask, inp.write_cell, inp.write_val)
+    cst = local_write(
+        cfg, st.crdt, inp.write_mask, inp.write_cell, inp.write_val,
+        inp.write_clp,
+    )
     # broadcast fanout: ring0 (same-region) members take strict priority,
     # the rest of the set is random — handle_broadcasts sends local
     # changes to ring0 first, then random members (broadcast/mod.rs:653-713)

@@ -102,7 +102,7 @@ def sync_step(
     pulled = jnp.int32(0)
     for j in range(p_cnt):
         pj = peers[:, j]  # [N]
-        p_ver, p_val, p_site, p_dbv = (pl[pj] for pl in cst.store)  # [N, C]
+        p_ver, p_val, p_site, p_dbv, p_clp = (pl[pj] for pl in cst.store)  # [N, C]
         # range check per cell: head_i[site] < dbv <= granted[j, site]
         lo = jnp.take_along_axis(head_i, jnp.clip(p_site, 0, n_org - 1), axis=1)
         hi = jnp.take_along_axis(
@@ -116,12 +116,18 @@ def sync_step(
             & (p_dbv <= hi)
             & (p_ver > 0)
         )
+        # merge key (clp, ver, val, site) — causal-length lifetime
+        # dominates, then the LWW clock (ops/lww.py merge_store)
         b = (
+            jnp.where(sel, p_clp, INT32_MIN),
             jnp.where(sel, p_ver, INT32_MIN),
             jnp.where(sel, p_val, INT32_MIN),
             jnp.where(sel, p_site, INT32_MIN),
         )
-        merged = lex_max(store[:3], b, (store[3], p_dbv))
+        m_clp, m_ver, m_val, m_site, m_dbv = lex_max(
+            (store[4], store[0], store[1], store[2]), b, (store[3], p_dbv)
+        )
+        merged = (m_ver, m_val, m_site, m_dbv, m_clp)
         touched = sel  # only selected cells may change
         store = tuple(
             jnp.where(touched, m, s) for m, s in zip(merged, store)

@@ -28,15 +28,20 @@ namespace {
 // LWW store: cell -> (col_version, value, site, dbv); merge rule:
 // biggest col_version wins, tie -> biggest value, tie -> biggest site.
 struct Cell {
-  int32_t ver = 0, val = 0, site = 0, dbv = 0;
+  int32_t ver = 0, val = 0, site = 0, dbv = 0, clp = 0;
 };
 
 struct Lww {
   std::vector<Cell> cells;
 };
 
+// Merge key (clp, ver, val, site): a write from a later causal-length
+// row lifetime beats anything from an earlier one (cr-sqlite "greater
+// causal length wins", doc/crdts.md:24-40); within a lifetime the plain
+// LWW rule applies.
 inline bool incoming_wins(const Cell& cur, int32_t ver, int32_t val,
-                          int32_t site) {
+                          int32_t site, int32_t clp) {
+  if (clp != cur.clp) return clp > cur.clp;
   if (ver != cur.ver) return ver > cur.ver;
   if (val != cur.val) return val > cur.val;
   return site > cur.site;
@@ -115,7 +120,7 @@ struct Book {
 // anti-entropy over the interval books.
 
 struct Change {
-  int32_t cell, ver, val, site, dbv;
+  int32_t cell, ver, val, site, dbv, clp;
 };
 
 inline bool origin_contains(const OriginBook& b, int32_t v) {
@@ -156,22 +161,22 @@ struct Cluster {
   void ingest(ClusterNode& dst, const Change& ch) {
     if (!dst.book.origins[ch.site].record(ch.dbv)) return;
     Cell& cell = dst.store.cells[ch.cell];
-    if (cell.ver == 0 || incoming_wins(cell, ch.ver, ch.val, ch.site))
-      cell = Cell{ch.ver, ch.val, ch.site, ch.dbv};
+    if (cell.ver == 0 || incoming_wins(cell, ch.ver, ch.val, ch.site, ch.clp))
+      cell = Cell{ch.ver, ch.val, ch.site, ch.dbv, ch.clp};
     dst.payloads[pkey(ch.site, ch.dbv)] = ch;
     int32_t tx = budget > 1 ? budget - 1 : 1;
     dst.queue.emplace_back(ch, tx);
   }
 
-  void write(int32_t node, int32_t cell, int32_t val) {
+  void write(int32_t node, int32_t cell, int32_t val, int32_t clp) {
     ClusterNode& n = nodes[node];
     int32_t ver = n.store.cells[cell].ver + 1;  // merged-clock bump
     int32_t dbv = n.next_dbv++;
-    Change ch{cell, ver, val, node, dbv};
+    Change ch{cell, ver, val, node, dbv, clp};
     n.book.origins[node].record(dbv);
     Cell& c = n.store.cells[cell];
-    if (c.ver == 0 || incoming_wins(c, ver, val, node))
-      c = Cell{ver, val, node, dbv};
+    if (c.ver == 0 || incoming_wins(c, ver, val, node, clp))
+      c = Cell{ver, val, node, dbv, clp};
     n.payloads[pkey(node, dbv)] = ch;
     n.queue.emplace_back(ch, budget);
   }
@@ -234,7 +239,7 @@ struct Cluster {
         const Cell& a = n.store.cells[c];
         const Cell& b = ref.store.cells[c];
         if (a.ver != b.ver || a.val != b.val || a.site != b.site ||
-            a.dbv != b.dbv)
+            a.dbv != b.dbv || a.clp != b.clp)
           return false;
       }
     }
@@ -256,29 +261,31 @@ void corro_lww_free(void* h) { delete static_cast<Lww*>(h); }
 
 // Returns 1 when the incoming change won the cell.
 int32_t corro_lww_merge(void* h, int32_t cell, int32_t ver, int32_t val,
-                        int32_t site, int32_t dbv) {
+                        int32_t site, int32_t dbv, int32_t clp) {
   auto* l = static_cast<Lww*>(h);
   Cell& c = l->cells[cell];
-  if (c.ver == 0 || incoming_wins(c, ver, val, site)) {
-    c = Cell{ver, val, site, dbv};
+  if (c.ver == 0 || incoming_wins(c, ver, val, site, clp)) {
+    c = Cell{ver, val, site, dbv, clp};
     return 1;
   }
   return 0;
 }
 
-// Writes (ver, val, site, dbv) for `cell` into out[0..3].
+// Writes (ver, val, site, dbv, clp) for `cell` into out[0..4].
 void corro_lww_get(void* h, int32_t cell, int32_t* out) {
   const Cell& c = static_cast<Lww*>(h)->cells[cell];
   out[0] = c.ver; out[1] = c.val; out[2] = c.site; out[3] = c.dbv;
+  out[4] = c.clp;
 }
 
-// Dump the whole store as 4 planes of n_cells int32 each.
+// Dump the whole store as 5 planes of n_cells int32 each.
 void corro_lww_dump(void* h, int32_t* ver, int32_t* val, int32_t* site,
-                    int32_t* dbv) {
+                    int32_t* dbv, int32_t* clp) {
   auto* l = static_cast<Lww*>(h);
   for (size_t i = 0; i < l->cells.size(); i++) {
     ver[i] = l->cells[i].ver; val[i] = l->cells[i].val;
     site[i] = l->cells[i].site; dbv[i] = l->cells[i].dbv;
+    clp[i] = l->cells[i].clp;
   }
 }
 
@@ -307,7 +314,7 @@ int64_t corro_book_n_gaps(void* h, int32_t origin) {
 }
 
 // --- batched node: Book + Lww behind one apply ------------------------
-// changes: flat [n, 6] int32 rows (cell, ver, val, site, origin, dbv).
+// changes: flat [n, 7] int32 rows (cell, ver, val, site, origin, dbv, clp).
 // fresh_out (optional, may be null): per-change freshness flags.
 // Returns number of fresh changes. Fresh changes merge into the store;
 // stale ones are dropped — exactly process_multiple_changes'
@@ -318,13 +325,13 @@ int32_t corro_apply_batch(void* book_h, void* lww_h, const int32_t* changes,
   auto* l = static_cast<Lww*>(lww_h);
   int32_t n_fresh = 0;
   for (int32_t i = 0; i < n; i++) {
-    const int32_t* c = changes + 6 * i;
+    const int32_t* c = changes + 7 * i;
     bool fresh = b->origins[c[4]].record(c[5]);
     if (fresh) {
       n_fresh++;
       Cell& cell = l->cells[c[0]];
-      if (cell.ver == 0 || incoming_wins(cell, c[1], c[2], c[3]))
-        cell = Cell{c[1], c[2], c[3], c[5]};
+      if (cell.ver == 0 || incoming_wins(cell, c[1], c[2], c[3], c[6]))
+        cell = Cell{c[1], c[2], c[3], c[5], c[6]};
     }
     if (fresh_out) fresh_out[i] = fresh ? 1 : 0;
   }
@@ -353,8 +360,9 @@ void* corro_cluster_new(int32_t n_nodes, int32_t n_origins, int32_t n_cells,
 }
 void corro_cluster_free(void* h) { delete static_cast<Cluster*>(h); }
 
-void corro_cluster_write(void* h, int32_t node, int32_t cell, int32_t val) {
-  static_cast<Cluster*>(h)->write(node, cell, val);
+void corro_cluster_write(void* h, int32_t node, int32_t cell, int32_t val,
+                         int32_t clp) {
+  static_cast<Cluster*>(h)->write(node, cell, val, clp);
 }
 void corro_cluster_round(void* h) { static_cast<Cluster*>(h)->round(); }
 int32_t corro_cluster_converged(void* h) {
@@ -375,7 +383,7 @@ int32_t corro_cluster_settle(void* h, int32_t max_rounds) {
 
 // Dump one node's store planes (each n_cells int32).
 void corro_cluster_store(void* h, int32_t node, int32_t* ver, int32_t* val,
-                         int32_t* site, int32_t* dbv) {
+                         int32_t* site, int32_t* dbv, int32_t* clp) {
   auto* c = static_cast<Cluster*>(h);
   const auto& cells = c->nodes[node].store.cells;
   for (int32_t i = 0; i < c->n_cells; i++) {
@@ -383,6 +391,7 @@ void corro_cluster_store(void* h, int32_t node, int32_t* ver, int32_t* val,
     val[i] = cells[i].val;
     site[i] = cells[i].site;
     dbv[i] = cells[i].dbv;
+    clp[i] = cells[i].clp;
   }
 }
 

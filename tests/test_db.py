@@ -139,9 +139,46 @@ def test_insert_stages_cl_flip_last(db):
         0, "INSERT INTO users (id, name, score) VALUES (42, 'x', 1)", None, {}
     )
     cl_positions = [
-        i for i, (cell, _) in enumerate(cells) if cell % db.n_cols == CL_COL
+        i for i, (cell, _v, _l) in enumerate(cells)
+        if cell % db.n_cols == CL_COL
     ]
     assert cl_positions == [len(cells) - 1]
+
+
+def test_concurrent_delete_beats_update(db):
+    """cr-sqlite causal-length semantics: a delete racing a concurrent
+    update on another node wins — the row ends deleted everywhere, the
+    update's cell lands in a dead lifetime (doc/crdts.md cl)."""
+    agent = db.agent
+    db.execute(0, [("INSERT INTO users (id, name, score) VALUES (9, 'race', 1)",)])
+    # replicate to node 1 so its update targets a live row
+    for _ in range(100):
+        if db.read_row(1, "users", 9) is not None:
+            break
+        agent.wait_rounds(2, timeout=60)
+    assert db.read_row(1, "users", 9) is not None
+    # fire both without waiting in between: they race through the rounds
+    db.execute(0, [("DELETE FROM users WHERE id = ?", [9])], wait=False)
+    db.execute(1, [("UPDATE users SET score = ? WHERE id = ?", [777, 9])],
+               wait=False)
+    # converge: the delete's higher causal length wins on every replica
+    for _ in range(150):
+        views = [db.read_row(n, "users", 9) for n in (0, 1, agent.n_nodes - 1)]
+        if all(v is None for v in views):
+            break
+        agent.wait_rounds(2, timeout=60)
+    assert all(
+        db.read_row(n, "users", 9) is None for n in (0, 1, agent.n_nodes - 1)
+    )
+    # resurrect: a fresh lifetime, stale columns do not leak back
+    db.execute(0, [("INSERT INTO users (id, name) VALUES (9, 'back')",)])
+    for _ in range(100):
+        row = db.read_row(0, "users", 9)
+        if row is not None and row["name"] == "back":
+            break
+        agent.wait_rounds(2, timeout=60)
+    row = db.read_row(0, "users", 9)
+    assert row["name"] == "back" and row["score"] is None
 
 
 def test_where_and_limit(db):

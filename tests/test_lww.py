@@ -19,25 +19,28 @@ from corrosion_tpu.sim.oracle import OracleNode, lww_wins
 
 
 def rand_changes(rng, n_changes, n_cells, hi=6):
-    """Small value ranges on purpose: force col_version/value/site ties."""
+    """Small value ranges on purpose: force col_version/value/site ties
+    (and causal-length lifetime collisions)."""
     cell = rng.integers(0, n_cells, n_changes)
     ver = rng.integers(1, hi, n_changes)
     val = rng.integers(-hi, hi, n_changes)
     site = rng.integers(0, hi, n_changes)
-    dbv = ver * 100 + site  # deterministic fn of (ver, site): ties stay consistent
-    return cell, ver, val, site, dbv
+    clp = rng.integers(0, 3, n_changes)
+    # deterministic fn of the clock keys: ties stay consistent
+    dbv = clp * 1000 + ver * 100 + site
+    return cell, ver, val, site, dbv, clp
 
 
-def apply_oracle(oracle, cell, ver, val, site, dbv, valid):
-    for c, v1, v2, v3, v4, ok in zip(cell, ver, val, site, dbv, valid):
+def apply_oracle(oracle, cell, ver, val, site, dbv, clp, valid):
+    for c, v1, v2, v3, v4, v5, ok in zip(cell, ver, val, site, dbv, clp, valid):
         if ok:
-            oracle.merge_cell(int(c), int(v1), int(v2), int(v3), int(v4))
+            oracle.merge_cell(int(c), int(v1), int(v2), int(v3), int(v4), int(v5))
 
 
 def store_of(oracle, n_cells):
-    out = np.zeros((4, n_cells), np.int32)
-    for c, (ver, val, site, dbv) in oracle.store.items():
-        out[:, c] = (ver, val, site, dbv)
+    out = np.zeros((5, n_cells), np.int32)
+    for c, (ver, val, site, dbv, clp) in oracle.store.items():
+        out[:, c] = (ver, val, site, dbv, clp)
     return out
 
 
@@ -54,13 +57,13 @@ def test_apply_changes_matches_oracle_and_is_order_independent():
     rng = np.random.default_rng(1)
     n_cells = 32
     for trial in range(10):
-        cell, ver, val, site, dbv = rand_changes(rng, 200, n_cells)
+        cell, ver, val, site, dbv, clp = rand_changes(rng, 200, n_cells)
         valid = rng.random(200) < 0.8
 
         oracle = OracleNode(n_origins=1)
-        apply_oracle(oracle, cell, ver, val, site, dbv, valid)
+        apply_oracle(oracle, cell, ver, val, site, dbv, clp, valid)
 
-        store = tuple(jnp.zeros(n_cells, jnp.int32) for _ in range(4))
+        store = tuple(jnp.zeros(n_cells, jnp.int32) for _ in range(5))
         got = apply_changes_to_store(
             store,
             jnp.asarray(cell, jnp.int32),
@@ -68,13 +71,15 @@ def test_apply_changes_matches_oracle_and_is_order_independent():
             jnp.asarray(val, jnp.int32),
             jnp.asarray(site, jnp.int32),
             jnp.asarray(dbv, jnp.int32),
+            jnp.asarray(clp, jnp.int32),
             jnp.asarray(valid),
         )
-        np.testing.assert_array_equal(np.stack(got), store_of(oracle, n_cells))
+        got = np.stack([got[0], got[1], got[2], got[3], got[4]])
+        np.testing.assert_array_equal(got, store_of(oracle, n_cells))
 
         # order independence (CRDT commutativity): shuffled batch, two halves
         perm = rng.permutation(200)
-        half = tuple(jnp.zeros(n_cells, jnp.int32) for _ in range(4))
+        half = tuple(jnp.zeros(n_cells, jnp.int32) for _ in range(5))
         for sl in (perm[:100], perm[100:]):
             half = apply_changes_to_store(
                 half,
@@ -83,9 +88,10 @@ def test_apply_changes_matches_oracle_and_is_order_independent():
                 jnp.asarray(val[sl], jnp.int32),
                 jnp.asarray(site[sl], jnp.int32),
                 jnp.asarray(dbv[sl], jnp.int32),
+                jnp.asarray(clp[sl], jnp.int32),
                 jnp.asarray(valid[sl]),
             )
-        np.testing.assert_array_equal(np.stack(half), np.stack(got))
+        np.testing.assert_array_equal(np.stack(half), got)
 
 
 def test_merge_store_matches_pairwise_oracle():
@@ -104,6 +110,33 @@ def test_merge_store_matches_pairwise_oracle():
     for c, clock in b.store.items():
         a.merge_cell(c, *clock)
     np.testing.assert_array_equal(np.stack(merged), store_of(a, n_cells))
+
+
+def test_causal_length_lifetime_dominates():
+    """A write from a later cl lifetime beats any col_version from an
+    earlier one; within a lifetime plain LWW applies (doc/crdts.md cl)."""
+    n_cells = 2
+    store = tuple(jnp.zeros(n_cells, jnp.int32) for _ in range(5))
+    # lifetime 1 write with huge col_version
+    store = apply_changes_to_store(
+        store, jnp.asarray([0]), jnp.asarray([99]), jnp.asarray([7]),
+        jnp.asarray([3]), jnp.asarray([1]), jnp.asarray([1]),
+        jnp.asarray([True]),
+    )
+    # lifetime 3 write with col_version 1 wins the cell
+    store = apply_changes_to_store(
+        store, jnp.asarray([0]), jnp.asarray([1]), jnp.asarray([5]),
+        jnp.asarray([0]), jnp.asarray([2]), jnp.asarray([3]),
+        jnp.asarray([True]),
+    )
+    assert int(store[1][0]) == 5 and int(store[4][0]) == 3
+    # a stale lifetime-1 write can no longer take the cell back
+    store = apply_changes_to_store(
+        store, jnp.asarray([0]), jnp.asarray([100]), jnp.asarray([9]),
+        jnp.asarray([4]), jnp.asarray([3]), jnp.asarray([1]),
+        jnp.asarray([True]),
+    )
+    assert int(store[1][0]) == 5 and int(store[4][0]) == 3
 
 
 def test_lex_segment_argmax_empty_and_ties():

@@ -20,7 +20,10 @@ def random_changes(rng, n, n_cells, n_origins, max_ver=6):
     site = rng.integers(0, n_origins, n)
     origin = rng.integers(0, n_origins, n)
     dbv = rng.integers(1, 40, n)
-    return np.stack([cell, ver, val, site, origin, dbv], axis=1).astype(np.int32)
+    clp = rng.integers(0, 3, n)  # causal-length lifetime collisions
+    return np.stack(
+        [cell, ver, val, site, origin, dbv, clp], axis=1
+    ).astype(np.int32)
 
 
 def test_native_matches_python_oracle():
@@ -37,10 +40,11 @@ def test_native_matches_python_oracle():
         assert nat.head(o) == orc.head(o)
         assert nat.needs(o) == orc.needs(o)
         assert nat.known_max(o) == orc.known_max.get(o, 0)
-    ver, val, site, dbv = nat.store()
+    ver, val, site, dbv, clp = nat.store()
     for c in range(n_cells):
-        got = (int(ver[c]), int(val[c]), int(site[c]), int(dbv[c]))
-        want = orc.store.get(c, (0, 0, 0, 0))
+        got = (int(ver[c]), int(val[c]), int(site[c]), int(dbv[c]),
+               int(clp[c]))
+        want = orc.store.get(c, (0, 0, 0, 0, 0))
         assert got == want, f"cell {c}: {got} != {want}"
 
 

@@ -40,7 +40,7 @@ def test_native_cluster_convergence_and_needs():
         if nat.converged():
             break
     assert nat.converged() and nat.total_needs() == 0
-    ver, val, site, dbv = nat.store_planes(node=31)
+    ver, val, site, dbv, _clp = nat.store_planes(node=31)
     assert val[3] == 777 and site[3] == 0 and ver[3] == 1
 
 
@@ -55,7 +55,7 @@ def test_native_cluster_lww_conflict_resolution():
         if nat.converged():
             break
     assert nat.converged()
-    ver, val, site, _ = nat.store_planes()
+    ver, val, site, *_ = nat.store_planes()
     # both wrote ver=1; tie -> bigger value wins (200 from site 1)
     assert ver[0] == 1 and val[0] == 200 and site[0] == 1
 

@@ -58,6 +58,52 @@ def test_bitwise_parity_with_loss():
     assert not problems, "\n".join(problems)
 
 
+def test_delete_resurrect_parity_all_engines():
+    """Causal-length regime (``doc/crdts.md`` ``cl``): inserts, updates,
+    deletes, and resurrects race through the network; every engine —
+    Python oracle, TPU sim, and the native C++ cluster — must converge,
+    agree across nodes, and settle every row's CL register on the
+    script's final causal length (deletes beat concurrent updates,
+    resurrects beat stale lifetimes)."""
+    n_rows, n_cols = 4, 2
+    script = WorkloadScript.random_delete_resurrect(
+        N_NODES, N_ORIGINS, n_rows, n_cols, rounds=16, seed=9)
+    # final causal length per row per the script
+    final_cl = {}
+    for batch in script.writes:
+        for w in batch:
+            node, cell, val = w[0], w[1], w[2]
+            if cell % n_cols == 0:
+                final_cl[cell] = max(final_cl.get(cell, 0), val)
+
+    oc = OracleCluster(N_NODES, N_ORIGINS, n_rows * n_cols, seed=1)
+    assert oc.run(script) > 0, "oracle failed to converge"
+    o_planes = oc.store_planes()
+
+    planes, alive, taken_sim = run_sim_script(script, seed=9)
+    assert taken_sim > 0, "sim failed to converge"
+    problems = check_agreement_validity(script, planes, alive)
+    assert not problems, "\n".join(problems)
+
+    ref = int(np.argmax(alive))
+    for cell, cl in final_cl.items():
+        assert int(o_planes[1][cell]) == cl, f"oracle row cl at {cell}"
+        assert int(planes[1][ref][cell]) == cl, f"sim row cl at {cell}"
+        # the CL register's lifetime stamp equals its value by construction
+        assert int(planes[4][ref][cell]) == cl
+
+    try:
+        from corrosion_tpu import native
+    except ImportError:
+        native = None
+    if native is not None and native.available():
+        nat = native.NativeCluster(N_NODES, N_ORIGINS, n_rows * n_cols, seed=1)
+        assert nat.run(script) > 0, "native cluster failed to converge"
+        n_planes = nat.store_planes()
+        for cell, cl in final_cl.items():
+            assert int(n_planes[1][cell]) == cl, f"native row cl at {cell}"
+
+
 def test_conflict_parity_agreement_and_validity():
     script = WorkloadScript.random_conflicting(
         N_NODES, N_ORIGINS, N_CELLS, ROUNDS, seed=5, hot_cells=2)
