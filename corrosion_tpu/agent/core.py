@@ -34,6 +34,7 @@ import numpy as np
 
 from corrosion_tpu.config import Config
 from corrosion_tpu.utils.assertions import assert_always, assert_sometimes
+from corrosion_tpu.utils.hlc import HLClock
 from corrosion_tpu.utils.lifecycle import Tripwire, spawn_counted
 from corrosion_tpu.utils.locks import LockRegistry
 from corrosion_tpu.utils.metrics import Registry, RoundTimer, record_round_info
@@ -93,11 +94,19 @@ class Agent:
         self._snap_lock = self.locks.lock("agent.snapshot")
 
         # pending per-node inputs for the next round (host-side staging).
-        # Writes queue in per-node FIFOs — one cell enters the round per
-        # node per tick, the array analog of the reference's broadcast
-        # batching queue (``broadcast/mod.rs:395-408``).
+        # Writes queue in per-node FIFOs — one *transaction* (up to
+        # tx_max_cells cells, committed atomically under one db_version)
+        # enters the round per node per tick, the array analog of the
+        # reference's broadcast batching queue (``broadcast/mod.rs:395-408``)
+        # + chunked-changeset commit (``public/mod.rs:177-256``).
         n = self.n_nodes
-        self._write_queues: dict = {}  # node -> list of (cell, val, clp, event|None)
+        self._tx_k = max(1, getattr(self.cfg, "tx_max_cells", 1))
+        self._write_queues: dict = {}  # node -> list of ([(cell, val, clp)...], event|None)
+        # API-boundary hybrid logical clocks, one per writer node: every
+        # transaction is stamped on entry (crsql_set_ts analog,
+        # public/mod.rs:88-100); the in-round clock lives device-side as
+        # CrdtState.hlc and folds through ingest + sync handshakes
+        self._hlc = {node: HLClock(node) for node in range(self.n_origins)}
         self._pend_kill = np.zeros(n, bool)
         self._pend_revive = np.zeros(n, bool)
         self._pend_partition: Optional[np.ndarray] = None
@@ -148,7 +157,7 @@ class Agent:
             with self._input_lock:
                 self._apply_pend_restore()
                 for q in self._write_queues.values():
-                    for *_fields, ev in q:
+                    for _cells, ev in q:
                         if ev is not None:
                             ev.set()
                 self._write_queues.clear()
@@ -170,19 +179,33 @@ class Agent:
     def _one_round(self):
         with self._input_lock:
             self._apply_pend_restore()
-            n = self.n_nodes
+            n, k = self.n_nodes, self._tx_k
             write_mask = np.zeros(n, bool)
             write_cell = np.zeros(n, np.int32)
             write_val = np.zeros(n, np.int32)
             write_clp = np.zeros(n, np.int32)
+            tx_mask = np.zeros(n, bool)
+            tx_len = np.ones(n, np.int32)
+            tx_cell = np.zeros((n, k), np.int32)
+            tx_val = np.zeros((n, k), np.int32)
+            tx_clp = np.zeros((n, k), np.int32)
             waiters = []
             drained = []
             for node, q in self._write_queues.items():
-                cell, val, clp, ev = q.pop(0)
-                write_mask[node] = True
-                write_cell[node] = cell
-                write_val[node] = val
-                write_clp[node] = clp
+                cells, ev = q.pop(0)
+                if len(cells) == 1:
+                    cell, val, clp = cells[0]
+                    write_mask[node] = True
+                    write_cell[node] = cell
+                    write_val[node] = val
+                    write_clp[node] = clp
+                else:  # multi-cell: one db_version, atomic remote apply
+                    tx_mask[node] = True
+                    tx_len[node] = len(cells)
+                    for i, (cell, val, clp) in enumerate(cells):
+                        tx_cell[node, i] = cell
+                        tx_val[node, i] = val
+                        tx_clp[node, i] = clp
                 if ev is not None:
                     waiters.append(ev)
                 if not q:
@@ -199,6 +222,14 @@ class Agent:
                 kill=jnp.asarray(np.array(self._pend_kill)),
                 revive=jnp.asarray(np.array(self._pend_revive)),
             )
+            if k > 1:
+                inp = inp._replace(
+                    tx_mask=jnp.asarray(tx_mask),
+                    tx_len=jnp.asarray(tx_len),
+                    tx_cell=jnp.asarray(tx_cell),
+                    tx_val=jnp.asarray(tx_val),
+                    tx_clp=jnp.asarray(tx_clp),
+                )
             net = self._net
             if self._pend_partition is not None:
                 net = net._replace(partition=jnp.asarray(self._pend_partition))
@@ -277,10 +308,17 @@ class Agent:
         lifetime of the write (the DB layer stamps it; raw writes default
         to 0).
 
-        Cells enter rounds in order, one per round (FIFO staging — the
-        broadcast-batching analog). With ``wait`` the call returns once
-        the *last* cell entered a round, i.e. the whole transaction is
-        committed locally and queued for dissemination."""
+        Up to ``tx_max_cells`` cells commit atomically under one
+        db_version and are disseminated as a chunked changeset — remote
+        nodes buffer the chunks and never observe the transaction torn
+        (``public/mod.rs:177-256`` + ``util.rs:546-696``). Repeated
+        cells collapse to the last write (the transaction overlay
+        already resolved dependent statements); transactions larger than
+        ``tx_max_cells`` split into several versions, each atomic —
+        whole-transaction atomicity then requires the DB layer's
+        chunking (a size cap the reference does not have; its chunks
+        share one version). With ``wait`` the call returns once the last
+        chunk entered a round."""
         if not (0 <= node < self.n_origins):
             raise ValueError(
                 f"node {node} is not a writer (origins are 0..{self.n_origins - 1})"
@@ -293,16 +331,25 @@ class Agent:
                 raise ValueError(f"cell {cell} out of range (n_cells={self.n_cells})")
         if self.tripwire.tripped:
             raise RuntimeError("agent is shut down")
+        # a version's cells must be distinct (one clock row per cell) —
+        # later statements already observed earlier ones via the tx
+        # overlay, so last-write-wins within the transaction
+        dedup: dict = {}
+        for cell, value, clp in cells:
+            dedup[int(cell)] = (int(cell), int(value), int(clp))
+        flat = list(dedup.values())
+        chunks = [flat[i:i + self._tx_k] for i in range(0, len(flat), self._tx_k)]
+        ts = self._hlc[node].new_timestamp()  # stamp on entry (crsql_set_ts)
         ev = threading.Event()
         with self._input_lock:
             q = self._write_queues.setdefault(node, [])
-            for cell, value, clp in cells[:-1]:
-                q.append((int(cell), int(value), int(clp), None))
-            last_cell, last_val, last_clp = cells[-1]
-            q.append((int(last_cell), int(last_val), int(last_clp), ev))
+            for chunk in chunks[:-1]:
+                q.append((chunk, None))
+            q.append((chunks[-1], ev))
         if wait and not ev.wait(timeout):
             raise TimeoutError("write did not enter a round in time")
-        return {"rows_affected": len(cells), "round": self.round_no}
+        return {"rows_affected": len(cells), "round": self.round_no,
+                "ts": str(ts)}
 
     # --- fault injection (admin surface) --------------------------------
     def kill_node(self, node: int):
@@ -386,6 +433,7 @@ class Agent:
             "store": store,  # (ver, val, site, dbv) planes [N, n_cells]
             "head": np.asarray(st.crdt.book.head),
             "known_max": np.asarray(st.crdt.book.known_max),
+            "hlc": np.asarray(st.crdt.hlc),
             "alive": np.asarray(st.swim.alive),
             "incarnation": np.asarray(
                 getattr(st.swim, "inc", getattr(st.swim, "incarnation", None))
@@ -423,12 +471,18 @@ class Agent:
         needs = np.maximum(
             snap["known_max"][node] - snap["head"][node], 0
         )
+        from corrosion_tpu.sim.broadcast import HLC_ROUND_BITS
+
+        hlc = int(snap["hlc"][node])
         return {
             "actor_id": node,
             "heads": {str(o): int(h) for o, h in enumerate(snap["head"][node])},
             "need": {
                 str(o): int(v) for o, v in enumerate(needs) if v > 0
             },
+            # the node's HLC as round.logical (the sync handshake's clock
+            # message, peer/mod.rs:1439-1458)
+            "ts": f"{hlc >> HLC_ROUND_BITS}.{hlc & ((1 << HLC_ROUND_BITS) - 1)}",
         }
 
     def members(self) -> list:

@@ -68,7 +68,11 @@ def load(build: bool = True):
         lib.corro_cluster_new.argtypes = [i32, i32, i32, i32, i32, i32, i64]
         lib.corro_cluster_free.argtypes = [p]
         lib.corro_cluster_write.argtypes = [p, i32, i32, i32, i32]
+        lib.corro_cluster_write_tx.argtypes = [p, i32, ip, ip, ip, i32]
         lib.corro_cluster_round.argtypes = [p]
+        lib.corro_cluster_kill.argtypes = [p, i32]
+        lib.corro_cluster_revive.argtypes = [p, i32]
+        lib.corro_cluster_set_partition.argtypes = [p, ip]
         lib.corro_cluster_converged.restype = i32
         lib.corro_cluster_converged.argtypes = [p]
         lib.corro_cluster_settle.restype = i32
@@ -175,8 +179,54 @@ class NativeCluster:
     def write(self, node: int, cell: int, value: int, clp: int = 0) -> None:
         self._lib.corro_cluster_write(self._h, node, cell, value, clp)
 
+    def write_tx(self, node: int, cells) -> None:
+        """Multi-statement transaction: ``cells`` = [(cell, value, clp)]
+        commit atomically under one db_version (chunked dissemination)."""
+        arr = np.ascontiguousarray(cells, dtype=np.int32).reshape(-1, 3)
+        ip = ctypes.POINTER(ctypes.c_int32)
+        c = np.ascontiguousarray(arr[:, 0])
+        v = np.ascontiguousarray(arr[:, 1])
+        l = np.ascontiguousarray(arr[:, 2])  # noqa: E741
+        self._lib.corro_cluster_write_tx(
+            self._h, node, c.ctypes.data_as(ip), v.ctypes.data_as(ip),
+            l.ctypes.data_as(ip), arr.shape[0],
+        )
+
     def round(self) -> None:
         self._lib.corro_cluster_round(self._h)
+
+    # --- fault injection (kill/revive/partition/heal drivers) -----------
+    def kill(self, node: int) -> None:
+        self._lib.corro_cluster_kill(self._h, node)
+
+    def revive(self, node: int) -> None:
+        self._lib.corro_cluster_revive(self._h, node)
+
+    def set_partition(self, groups) -> None:
+        g = np.ascontiguousarray(groups, dtype=np.int32)
+        assert g.shape == (self.n_nodes,)
+        self._lib.corro_cluster_set_partition(
+            self._h, g.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        )
+
+    def heal_partition(self) -> None:
+        self.set_partition(np.zeros(self.n_nodes, np.int32))
+
+    def apply_faults(self, events) -> None:
+        """Apply one round's fault events: ("kill", node),
+        ("revive", node), ("partition", groups), ("heal",)."""
+        for ev in events:
+            kind = ev[0]
+            if kind == "kill":
+                self.kill(ev[1])
+            elif kind == "revive":
+                self.revive(ev[1])
+            elif kind == "partition":
+                self.set_partition(ev[1])
+            elif kind == "heal":
+                self.heal_partition()
+            else:
+                raise ValueError(f"unknown fault event {ev!r}")
 
     def converged(self) -> bool:
         return bool(self._lib.corro_cluster_converged(self._h))
@@ -185,13 +235,21 @@ class NativeCluster:
         return self._lib.corro_cluster_total_needs(self._h)
 
     def run(self, script, settle_rounds: int = 256) -> int:
-        """Apply a WorkloadScript then settle; rounds taken or -1."""
-        from corrosion_tpu.sim.parity import _write4
+        """Apply a WorkloadScript (writes + fault events) then settle;
+        rounds taken or -1. Outstanding faults heal/revive before the
+        settle phase so convergence is reachable."""
+        from corrosion_tpu.sim.parity import _as_tx
 
-        for batch in script.writes:
-            for node, cell, val, clp in (_write4(w) for w in batch):
-                self.write(node, cell, val, clp)
+        faults = getattr(script, "faults", None) or []
+        for r, batch in enumerate(script.writes):
+            if r < len(faults):
+                self.apply_faults(faults[r])
+            for node, cells in (_as_tx(w) for w in batch):
+                self.write_tx(node, cells)
             self.round()
+        self.heal_partition()
+        for node in range(self.n_nodes):
+            self.revive(node)
         settled = self._lib.corro_cluster_settle(self._h, settle_rounds)
         return -1 if settled < 0 else len(script.writes) + settled
 

@@ -1,0 +1,186 @@
+"""Partial-changeset buffering — multi-cell transactions under jit.
+
+The reference chunks a transaction's changeset over the wire
+(``ChunkedChanges``, ``crates/corro-types/src/change.rs:66-178``): one
+``db_version`` carries cells stamped ``seq`` 0..last_seq, possibly split
+across packets. Receivers buffer partial seq ranges per version in
+``__corro_buffered_changes`` + ``__corro_seq_bookkeeping`` and only
+apply/expose the version once the whole range is present
+(``process_incomplete_version`` -> ``process_fully_buffered_changes``,
+``crates/corro-agent/src/agent/util.rs:1061-1194,546-696``) — that is
+what makes a multi-statement transaction atomic in remote readers' eyes.
+
+Array re-design: per node, a fixed pool of P partial slots keyed by
+``(origin, db_version)``. Each slot holds a received-``seq`` bitmask
+(int32, so ``seq < 31``) plus K payload lanes, one per seq. Arriving
+cells match-or-allocate a slot, set their seq bit, and park their
+payload; a slot whose mask covers ``0..nseq-1`` is *complete* — its
+cells apply to the LWW store in one batch, the version records into the
+``Book``, the slot frees. Slot-pool overflow drops the cell (the
+reference's queue-cap policy); anti-entropy repairs, because sync
+transfers whole versions from the peer's *store*, which by construction
+only ever contains completed versions.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from corrosion_tpu.ops.slots import alloc_slots, scatter_rows
+
+NO_SLOT = jnp.int32(-1)
+
+
+class Partials(NamedTuple):
+    """Per-node partial-version buffer: [N, P] keys + [N, P, K] payloads."""
+
+    origin: jax.Array  # int32 [N, P], -1 = free
+    dbv: jax.Array  # int32 [N, P]
+    mask: jax.Array  # int32 [N, P] — bitmask of received seqs
+    nseq: jax.Array  # int32 [N, P] — total seqs in the version
+    cell: jax.Array  # int32 [N, P, K]
+    ver: jax.Array  # int32 [N, P, K]
+    val: jax.Array  # int32 [N, P, K]
+    site: jax.Array  # int32 [N, P, K]
+    clp: jax.Array  # int32 [N, P, K]
+
+    @staticmethod
+    def create(n_nodes: int, p_slots: int, k_seqs: int) -> "Partials":
+        assert 1 <= k_seqs <= 30, "seq bitmask lives in an int32"
+        z2 = lambda: jnp.zeros((n_nodes, p_slots), jnp.int32)  # noqa: E731
+        z3 = lambda: jnp.zeros((n_nodes, p_slots, k_seqs), jnp.int32)  # noqa: E731
+        return Partials(
+            origin=jnp.full((n_nodes, p_slots), NO_SLOT, jnp.int32),
+            dbv=z2(), mask=z2(), nseq=z2(),
+            cell=z3(), ver=z3(), val=z3(), site=z3(), clp=z3(),
+        )
+
+
+def ingest_partials(par: Partials, live, m_origin, m_dbv, m_seq, m_nseq,
+                    m_cell, m_ver, m_val, m_site, m_clp):
+    """Buffer a per-node batch of partial-changeset cells.
+
+    All message fields int32 [N, M]; ``live`` bool [N, M] marks candidate
+    cells (caller has already dropped stale/seen versions). Returns
+    ``(par, fresh)`` — ``fresh`` [N, M] marks cells newly buffered (the
+    per-seq dedupe; fresh cells re-broadcast, duplicates drop — the seq
+    overlap check of ``process_incomplete_version``, ``util.rs:1090``).
+    """
+    n, p = par.origin.shape
+    k = par.cell.shape[2]
+    m = m_origin.shape[1]
+
+    # --- match existing slots -------------------------------------------
+    slot_live = par.origin != NO_SLOT  # [N, P]
+    eq = (
+        live[:, :, None]
+        & slot_live[:, None, :]
+        & (par.origin[:, None, :] == m_origin[:, :, None])
+        & (par.dbv[:, None, :] == m_dbv[:, :, None])
+    )  # [N, M, P]
+    has_match = jnp.any(eq, axis=2)
+    match_slot = jnp.argmax(eq, axis=2).astype(jnp.int32)
+
+    # --- group the batch by (origin, dbv); allocate one slot per leader --
+    same_key = (
+        live[:, :, None]
+        & live[:, None, :]
+        & (m_origin[:, :, None] == m_origin[:, None, :])
+        & (m_dbv[:, :, None] == m_dbv[:, None, :])
+    )  # [N, M, M'] — does message i share a key with message j
+    leader_idx = jnp.argmax(same_key, axis=2).astype(jnp.int32)  # first j
+    is_leader = live & (leader_idx == jnp.arange(m, dtype=jnp.int32)[None, :])
+    seq_ok = (m_seq >= 0) & (m_seq < k) & (m_nseq >= 1) & (m_nseq <= k)
+    alloc_want = is_leader & ~has_match & seq_ok
+    free = ~slot_live
+    slot_alloc, placed = alloc_slots(free, alloc_want)
+    l_placed = jnp.take_along_axis(placed, leader_idx, axis=1)
+    l_slot = jnp.take_along_axis(slot_alloc, leader_idx, axis=1)
+    slot = jnp.where(has_match, match_slot, l_slot)
+    found = has_match | (live & ~has_match & l_placed)
+
+    # --- per-seq dedupe --------------------------------------------------
+    seqc = jnp.clip(m_seq, 0, k - 1)
+    bit = (jnp.int32(1) << seqc).astype(jnp.int32)
+    pre_mask = jnp.where(
+        has_match,
+        jnp.take_along_axis(par.mask, jnp.clip(slot, 0, p - 1), axis=1),
+        0,
+    )
+    already = (pre_mask >> seqc) & 1 == 1
+    earlier = jnp.tril(jnp.ones((m, m), bool), k=-1)
+    dup = jnp.any(
+        same_key & (m_seq[:, :, None] == m_seq[:, None, :]) & earlier[None],
+        axis=2,
+    )
+    fresh = live & found & seq_ok & ~already & ~dup
+
+    # --- scatter: slot keys, nseq, mask bits, payload lanes --------------
+    origin2 = scatter_rows(par.origin, slot_alloc, placed, m_origin)
+    dbv2 = scatter_rows(par.dbv, slot_alloc, placed, m_dbv)
+    rows = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[:, None], (n, m))
+    flat_slot = jnp.where(fresh, rows * p + slot, n * p)
+    nseq2 = (
+        par.nseq.reshape(-1)
+        .at[flat_slot.reshape(-1)]
+        .max(m_nseq.reshape(-1), mode="drop")
+        .reshape(n, p)
+    )
+    # each fresh cell adds a bit not yet set (dedupe above), so add == or
+    mask2 = (
+        par.mask.reshape(-1)
+        .at[flat_slot.reshape(-1)]
+        .add(jnp.where(fresh, bit, 0).reshape(-1), mode="drop")
+        .reshape(n, p)
+    )
+    flat_lane = jnp.where(fresh, (rows * p + slot) * k + seqc, n * p * k)
+
+    def put(dest, v):
+        return (
+            dest.reshape(-1)
+            .at[flat_lane.reshape(-1)]
+            .set(v.reshape(-1), mode="drop")
+            .reshape(n, p, k)
+        )
+
+    par = Partials(
+        origin=origin2, dbv=dbv2, mask=mask2, nseq=nseq2,
+        cell=put(par.cell, m_cell), ver=put(par.ver, m_ver),
+        val=put(par.val, m_val), site=put(par.site, m_site),
+        clp=put(par.clp, m_clp),
+    )
+    return par, fresh
+
+
+def complete_mask(par: Partials):
+    """Which slots hold every seq of their version (``0..nseq-1`` all
+    present) — ready for the atomic apply (the gap-closed trigger of
+    ``process_fully_buffered_changes``, ``util.rs:546-696``)."""
+    full_bits = (jnp.int32(1) << par.nseq) - 1
+    return (par.origin != NO_SLOT) & (par.nseq > 0) & (par.mask == full_bits)
+
+
+def free_slots(par: Partials, drop):
+    """Release slots marked by ``drop`` bool [N, P]."""
+    return par._replace(
+        origin=jnp.where(drop, NO_SLOT, par.origin),
+        dbv=jnp.where(drop, 0, par.dbv),
+        mask=jnp.where(drop, 0, par.mask),
+        nseq=jnp.where(drop, 0, par.nseq),
+    )
+
+
+def drop_stale_partials(par: Partials, head):
+    """Free slots whose version is already at/below the node's head for
+    that origin — the version arrived whole via sync (store merge + head
+    jump), so the buffered fragments are garbage (the reference's
+    buffered-meta GC, ``clear_buffered_meta_loop``, ``util.rs:430-490``).
+    ``head`` int32 [N, O]."""
+    n_origins = head.shape[1]
+    live = par.origin != NO_SLOT
+    in_range = live & (par.origin >= 0) & (par.origin < n_origins)
+    h = jnp.take_along_axis(head, jnp.clip(par.origin, 0, n_origins - 1), axis=1)
+    return free_slots(par, in_range & (par.dbv <= h))

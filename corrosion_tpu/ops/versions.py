@@ -70,15 +70,8 @@ def record_versions(book: Book, origin, ver, valid):
     like the bounded processing queue, ``config.rs:15-27``; sync repairs),
     then heads advance over any newly-closed gaps.
     """
-    n_nodes, n_slots = book.buf_origin.shape
-
     # --- seen-checks -----------------------------------------------------
-    behind_head = ver <= jnp.take_along_axis(book.head, origin, axis=1)
-    in_buffer = jnp.any(
-        (book.buf_origin[:, None, :] == origin[:, :, None])
-        & (book.buf_ver[:, None, :] == ver[:, :, None]),
-        axis=2,
-    )
+    seen = seen_versions(book, origin, ver, valid)
     # dedupe within the batch: keep only the first of identical (o, v) pairs
     same = (
         (origin[:, :, None] == origin[:, None, :])
@@ -89,7 +82,7 @@ def record_versions(book: Book, origin, ver, valid):
     earlier = jnp.tril(jnp.ones((m, m), bool), k=-1)
     dup_in_batch = jnp.any(same & earlier[None, :, :], axis=2)
 
-    fresh = valid & ~behind_head & ~in_buffer & ~dup_in_batch
+    fresh = valid & ~seen & ~dup_in_batch
 
     # --- slot allocation (per node, vectorized) --------------------------
     free = book.buf_origin == NO_ORIGIN
@@ -97,21 +90,50 @@ def record_versions(book: Book, origin, ver, valid):
     buf_origin = scatter_rows(book.buf_origin, slot, placed, origin)
     buf_ver = scatter_rows(book.buf_ver, slot, placed, ver)
 
-    # --- known_max scatter-max ------------------------------------------
-    n_origins = book.head.shape[1]
+    known_max = _scatter_max(book.known_max, origin, ver, valid)
+    book = Book(book.head, known_max, buf_origin, buf_ver)
+    return advance_heads(book), fresh
+
+
+def _scatter_max(dest, origin, ver, valid):
+    """``dest[i, origin[i,j]] = max(dest, ver[i,j])`` where valid."""
+    n_nodes, n_origins = dest.shape
     rows = jnp.broadcast_to(
         jnp.arange(n_nodes, dtype=jnp.int32)[:, None], origin.shape
     )
-    flat_ko = jnp.where(valid, rows * n_origins + origin, n_nodes * n_origins)
-    known_max = (
-        book.known_max.reshape(-1)
-        .at[flat_ko.reshape(-1)]
+    flat = jnp.where(valid, rows * n_origins + origin, n_nodes * n_origins)
+    return (
+        dest.reshape(-1)
+        .at[flat.reshape(-1)]
         .max(ver.reshape(-1), mode="drop")
-        .reshape(book.known_max.shape)
+        .reshape(dest.shape)
     )
 
-    book = Book(book.head, known_max, buf_origin, buf_ver)
-    return advance_heads(book), fresh
+
+def bump_known_max(book: Book, origin, ver, valid) -> Book:
+    """Raise ``known_max`` for heard-of (origin, version) pairs without
+    recording them as seen — hearing a *fragment* of a chunked version
+    still teaches a node the version exists (drives need computation and
+    sync peer choice) even though the version is not applied until its
+    seq range completes (``partial_need`` in ``SyncStateV1``, reference
+    ``crates/corro-types/src/sync.rs:80``)."""
+    return book._replace(
+        known_max=_scatter_max(book.known_max, origin, ver, valid)
+    )
+
+
+def seen_versions(book: Book, origin, ver, valid):
+    """Has this node already *fully* seen each (origin, version)? bool
+    [N, M] — true when the version is at/below the contiguous head or
+    parked in the out-of-order buffer (the seen-cache + bookie check of
+    ``handle_changes``, ``handlers.rs:548-786``)."""
+    behind_head = ver <= jnp.take_along_axis(book.head, origin, axis=1)
+    in_buffer = jnp.any(
+        (book.buf_origin[:, None, :] == origin[:, :, None])
+        & (book.buf_ver[:, None, :] == ver[:, :, None]),
+        axis=2,
+    )
+    return valid & (behind_head | in_buffer)
 
 
 def advance_heads(book: Book) -> Book:

@@ -38,24 +38,64 @@ import jax.numpy as jnp
 import jax.random as jr
 
 from corrosion_tpu.ops.lww import apply_changes_to_store
+from corrosion_tpu.ops.partials import (
+    Partials,
+    complete_mask,
+    free_slots,
+    ingest_partials,
+)
 from corrosion_tpu.ops.slots import (
     alloc_slots_evict,
     budget_mask,
     mailbox_pack,
     scatter_rows,
 )
-from corrosion_tpu.ops.versions import Book, record_versions
+from corrosion_tpu.ops.versions import (
+    Book,
+    bump_known_max,
+    record_versions,
+    seen_versions,
+)
 from corrosion_tpu.sim.config import SimConfig
 from corrosion_tpu.sim.transport import NetModel, uni_ok
 
 NO_Q = jnp.int32(-1)
 LAST_SYNC_CAP = 4095  # staleness saturates (never-synced == very stale)
 
-# wire-size estimate of one changeset: 7 int32 fields + length-delimited
-# framing overhead — the bytes-per-changeset unit of the send budget
-# (the reference meters serialized ChangeV1 bytes through its governor,
-# broadcast/mod.rs:460-463)
-CHANGE_WIRE_BYTES = 56
+# --- hybrid logical clock, in sim units ---------------------------------
+# The reference stamps every local write with its uhlc HLC
+# (``crsql_set_ts``, ``public/mod.rs:88-100``), folds every received ts
+# (``handlers.rs:689-701``) and sync clock message
+# (``peer/mod.rs:1439-1458``), and drops stamps >300 ms ahead
+# (``setup.rs:96-101``). Here physical time is the round counter: a stamp
+# is ``round << HLC_ROUND_BITS | logical``; drift rejection compares the
+# stamp's round part against the receiver's current round.
+HLC_ROUND_BITS = 10
+HLC_MAX_DRIFT_ROUNDS = 2  # the 300 ms analog, in rounds
+
+
+def hlc_tick(hlc, now, active):
+    """Issue per-node stamps: strictly monotonic, >= round<<bits
+    (uhlc ``new_timestamp``). Returns (stamp [N], hlc')."""
+    stamp = jnp.maximum(hlc + 1, now << HLC_ROUND_BITS)
+    return stamp, jnp.where(active, stamp, hlc)
+
+
+def hlc_fold(hlc, now, m_ts, live):
+    """Fold received stamps into each node's clock, rejecting stamps too
+    far ahead of local physical time. Returns (hlc', ok [N, M], rejects).
+    Rejected stamps' changes are dropped, as the reference drops them
+    (``handlers.rs:696-701``)."""
+    phys = m_ts >> HLC_ROUND_BITS
+    ok = live & (phys <= now + HLC_MAX_DRIFT_ROUNDS)
+    folded = jnp.max(jnp.where(ok, m_ts, 0), axis=1)
+    return jnp.maximum(hlc, folded), ok, jnp.sum(live & ~ok)
+
+# wire-size estimate of one changeset cell: 9 int32 fields (incl. the
+# seq/nseq chunking stamps) + length-delimited framing overhead — the
+# bytes-per-changeset unit of the send budget (the reference meters
+# serialized ChangeV1 bytes through its governor, broadcast/mod.rs:460-463)
+CHANGE_WIRE_BYTES = 64
 
 
 class CrdtState(NamedTuple):
@@ -73,7 +113,13 @@ class CrdtState(NamedTuple):
     q_val: jax.Array  # int32 [N, Q]
     q_site: jax.Array  # int32 [N, Q]
     q_clp: jax.Array  # int32 [N, Q] — causal-length lifetime of the cell
+    q_seq: jax.Array  # int32 [N, Q] — cell's seq within its version
+    q_nseq: jax.Array  # int32 [N, Q] — total seqs in the version
+    q_ts: jax.Array  # int32 [N, Q] — HLC stamp of the change's write
     q_tx: jax.Array  # int32 [N, Q] — remaining transmissions
+    partials: Partials  # buffered incomplete multi-cell versions
+    hlc: jax.Array  # int32 [N] — per-node hybrid logical clock (uhlc)
+    now: jax.Array  # int32 [] — round counter (the HLC's physical time)
     last_sync: jax.Array  # int32 [N, S] — rounds since last sync per track
     # (S = peer node id for the full-view sim, member-table slot at scale;
     #  drives the "then by last-sync time" ordering of handlers.rs:808-863)
@@ -93,12 +139,22 @@ class CrdtState(NamedTuple):
             q_val=z(n, q),
             q_site=z(n, q),
             q_clp=z(n, q),
+            q_seq=z(n, q),
+            q_nseq=jnp.ones((n, q), jnp.int32),
+            q_ts=z(n, q),
             q_tx=z(n, q),
+            hlc=z(n),
+            now=jnp.int32(0),
+            partials=Partials.create(
+                n, cfg.partial_slots if cfg.tx_max_cells > 1 else 1,
+                max(1, cfg.tx_max_cells),
+            ),
             last_sync=jnp.full((n, cfg.sync_tracks), LAST_SYNC_CAP, jnp.int32),
         )
 
 
-def _enqueue(cst: CrdtState, want, origin, dbv, cell, ver, val, site, clp, tx):
+def _enqueue(cst: CrdtState, want, origin, dbv, cell, ver, val, site, clp,
+             seq, nseq, ts, tx):
     """Place per-node batches of changes into queue slots; on overflow the
     most-sent queued changeset is evicted to admit the new one
     (drop-oldest-most-sent, ``broadcast/mod.rs:410-812``)."""
@@ -112,6 +168,9 @@ def _enqueue(cst: CrdtState, want, origin, dbv, cell, ver, val, site, clp, tx):
         q_val=scatter_rows(cst.q_val, slot, placed, val),
         q_site=scatter_rows(cst.q_site, slot, placed, site),
         q_clp=scatter_rows(cst.q_clp, slot, placed, clp),
+        q_seq=scatter_rows(cst.q_seq, slot, placed, seq),
+        q_nseq=scatter_rows(cst.q_nseq, slot, placed, nseq),
+        q_ts=scatter_rows(cst.q_ts, slot, placed, ts),
         q_tx=scatter_rows(cst.q_tx, slot, placed, tx),
     )
 
@@ -139,6 +198,9 @@ def local_write(cfg: SimConfig, cst: CrdtState, write_mask, cell, val, clp=None)
     cur_ver = cst.store[0][iarr, cell]
     ver = cur_ver + 1
     site = iarr
+    # stamp the write with the node's HLC (crsql_set_ts analog)
+    ts, hlc = hlc_tick(cst.hlc, cst.now, w)
+    cst = cst._replace(hlc=hlc)
 
     # apply to own store
     flat_idx = iarr * cfg.n_cells + cell
@@ -167,23 +229,116 @@ def local_write(cfg: SimConfig, cst: CrdtState, write_mask, cell, val, clp=None)
         val[:, None],
         site[:, None],
         clp[:, None],
+        jnp.zeros((n, 1), jnp.int32),
+        jnp.ones((n, 1), jnp.int32),
+        ts[:, None],
         jnp.full((n, 1), cfg.bcast_max_transmissions, jnp.int32),
     )
 
 
+def local_write_tx(cfg: SimConfig, cst: CrdtState, tx_mask, tx_cell, tx_val,
+                   tx_clp, tx_len):
+    """Commit multi-cell write transactions at the writer nodes.
+
+    ``tx_mask`` bool [N]; ``tx_cell``/``tx_val``/``tx_clp`` int32 [N, K]
+    (K = ``cfg.tx_max_cells``); ``tx_len`` int32 [N] — how many lanes are
+    real (1..K). A transaction's cells must be distinct. All cells share
+    one ``db_version`` and are stamped ``seq`` 0..len-1 — the array
+    ``ChunkedChanges`` (``crates/corro-types/src/change.rs:66-178``): the
+    writer applies them atomically to its own store and queues each cell
+    as a chunk; remote nodes buffer the chunks and apply only once the
+    whole seq range is present (multi-statement ``POST
+    /v1/transactions`` atomicity, ``public/mod.rs:177-256``).
+    """
+    n, k = cfg.n_nodes, tx_cell.shape[1]
+    assert k <= max(1, cfg.tx_max_cells)
+    iarr = jnp.arange(n, dtype=jnp.int32)
+    is_origin = iarr < cfg.n_origins
+    w = tx_mask & is_origin
+    lane = jnp.arange(k, dtype=jnp.int32)[None, :]
+    lane_ok = w[:, None] & (lane < tx_len[:, None])  # [N, K]
+
+    dbv = cst.next_dbv
+    cur_ver = jnp.take_along_axis(cst.store[0], tx_cell, axis=1)
+    ver = cur_ver + 1
+    site = jnp.broadcast_to(iarr[:, None], (n, k))
+    # one HLC stamp per transaction (the whole tx commits at one ts)
+    ts, hlc = hlc_tick(cst.hlc, cst.now, w)
+    cst = cst._replace(hlc=hlc)
+
+    flat_idx = (iarr[:, None] * cfg.n_cells + tx_cell).reshape(-1)
+    store = apply_changes_to_store(
+        tuple(p.reshape(-1) for p in cst.store),
+        flat_idx,
+        ver.reshape(-1),
+        tx_val.reshape(-1),
+        site.reshape(-1),
+        jnp.broadcast_to(dbv[:, None], (n, k)).reshape(-1),
+        tx_clp.reshape(-1),
+        lane_ok.reshape(-1),
+    )
+    store = tuple(p.reshape(n, cfg.n_cells) for p in store)
+
+    book, _ = record_versions(cst.book, iarr[:, None], dbv[:, None], w[:, None])
+    cst = cst._replace(
+        store=store, book=book, next_dbv=jnp.where(w, dbv + 1, cst.next_dbv)
+    )
+    return _enqueue(
+        cst,
+        lane_ok,
+        site,
+        jnp.broadcast_to(dbv[:, None], (n, k)),
+        tx_cell,
+        ver,
+        tx_val,
+        site,
+        tx_clp,
+        jnp.broadcast_to(lane, (n, k)),
+        jnp.broadcast_to(tx_len[:, None], (n, k)),
+        jnp.broadcast_to(ts[:, None], (n, k)),
+        jnp.full((n, k), cfg.bcast_max_transmissions, jnp.int32),
+    )
+
+
 def ingest_changes(cfg, cst: CrdtState, live, m_origin, m_dbv, m_cell, m_ver,
-                   m_val, m_site, m_clp):
+                   m_val, m_site, m_clp, m_seq=None, m_nseq=None, m_ts=None):
     """Receiver ingest shared by every dissemination carrier: dedupe via
     the Book, apply fresh cells to the LWW store, re-enqueue fresh changes
     for re-broadcast with a decremented budget (``handlers.rs:548-786``,
     rebroadcast ``handlers.rs:768-779``).
+
+    Single-cell versions (``nseq == 1`` — the complete-changeset fast
+    path, ``process_complete_version``, ``util.rs:1197``) apply on
+    arrival. Cells of chunked versions (``nseq > 1``) park in the partial
+    buffer and apply atomically once the whole seq range is present
+    (``process_incomplete_version`` -> ``process_fully_buffered_changes``,
+    ``util.rs:1061-1194,546-696``) — remote readers never observe a torn
+    transaction.
 
     Message fields are [N, M] per-receiver batches; ``live`` masks real
     messages. Returns ``(cst, info)``.
     """
     n = cfg.n_nodes
     iarr = jnp.arange(n, dtype=jnp.int32)
-    book, fresh = record_versions(cst.book, m_origin, m_dbv, live)
+    if m_seq is None:
+        m_seq = jnp.zeros_like(m_origin)
+    if m_nseq is None:
+        m_nseq = jnp.ones_like(m_origin)
+    if m_ts is None:
+        m_ts = jnp.zeros_like(m_origin)
+    rebudget = jnp.full(
+        m_origin.shape, max(1, cfg.bcast_max_transmissions - 1), jnp.int32
+    )
+
+    # fold received HLC stamps into each node's clock; stamps too far
+    # ahead of local time get their changes dropped (handlers.rs:689-701)
+    hlc, ts_ok, drift_rejects = hlc_fold(cst.hlc, cst.now, m_ts, live)
+    cst = cst._replace(hlc=hlc)
+    live = ts_ok  # live & within max drift; rejected changes drop
+
+    # --- complete (single-cell) versions: record + apply on arrival -----
+    single = live & (m_nseq <= 1)
+    book, fresh1 = record_versions(cst.book, m_origin, m_dbv, single)
 
     flat_idx = (
         jnp.broadcast_to(iarr[:, None], m_cell.shape) * cfg.n_cells + m_cell
@@ -196,12 +351,50 @@ def ingest_changes(cfg, cst: CrdtState, live, m_origin, m_dbv, m_cell, m_ver,
         m_site.reshape(-1),
         m_dbv.reshape(-1),
         m_clp.reshape(-1),
-        fresh.reshape(-1),
+        fresh1.reshape(-1),
     )
     store = tuple(p.reshape(n, cfg.n_cells) for p in store)
+    cst = cst._replace(store=store, book=book)
+
+    fresh = fresh1
+    completed = jnp.int32(0)
+    if cfg.tx_max_cells > 1:
+        # --- chunked versions: buffer, complete, then apply atomically --
+        multi = live & (m_nseq > 1)
+        seen = seen_versions(cst.book, m_origin, m_dbv, multi)
+        book = bump_known_max(cst.book, m_origin, m_dbv, multi)
+        par, fresh_m = ingest_partials(
+            cst.partials, multi & ~seen, m_origin, m_dbv, m_seq, m_nseq,
+            m_cell, m_ver, m_val, m_site, m_clp,
+        )
+        full = complete_mask(par)  # [N, P]
+        p, k = par.cell.shape[1], par.cell.shape[2]
+        lane = jnp.arange(k, dtype=jnp.int32)[None, None, :]
+        lane_ok = full[:, :, None] & (lane < par.nseq[:, :, None])
+        pk = p * k
+        flat_idx2 = (
+            jnp.broadcast_to(iarr[:, None, None], (n, p, k)) * cfg.n_cells
+            + par.cell
+        )
+        store = apply_changes_to_store(
+            tuple(pl.reshape(-1) for pl in cst.store),
+            flat_idx2.reshape(n * pk),
+            par.ver.reshape(-1),
+            par.val.reshape(-1),
+            par.site.reshape(-1),
+            jnp.broadcast_to(par.dbv[:, :, None], (n, p, k)).reshape(-1),
+            par.clp.reshape(-1),
+            lane_ok.reshape(-1),
+        )
+        store = tuple(pl.reshape(n, cfg.n_cells) for pl in store)
+        book, _ = record_versions(book, par.origin, par.dbv, full)
+        par = free_slots(par, full)
+        cst = cst._replace(store=store, book=book, partials=par)
+        fresh = fresh1 | fresh_m
+        completed = jnp.sum(full)
 
     cst = _enqueue(
-        cst._replace(store=store, book=book),
+        cst,
         fresh,
         m_origin,
         m_dbv,
@@ -210,11 +403,16 @@ def ingest_changes(cfg, cst: CrdtState, live, m_origin, m_dbv, m_cell, m_ver,
         m_val,
         m_site,
         m_clp,
-        jnp.full(m_origin.shape, max(1, cfg.bcast_max_transmissions - 1), jnp.int32),
+        m_seq,
+        m_nseq,
+        m_ts,
+        rebudget,
     )
     info = {
         "delivered": jnp.sum(live),
         "fresh": jnp.sum(fresh),
+        "tx_completed": completed,
+        "clock_drift_rejects": drift_rejects,
         "queued": jnp.sum(cst.q_origin != NO_Q),
     }
     return cst, info
@@ -260,7 +458,8 @@ def bcast_step(
     )
 
     flat = lambda a: jnp.broadcast_to(a[:, :, None], (n, q, f)).reshape(-1)  # noqa: E731
-    live, (m_origin, m_dbv, m_cell, m_ver, m_val, m_site, m_clp) = mailbox_pack(
+    live, (m_origin, m_dbv, m_cell, m_ver, m_val, m_site, m_clp, m_seq,
+           m_nseq, m_ts) = mailbox_pack(
         dst.reshape(-1),
         m_ok.reshape(-1),
         n_rows=n,
@@ -273,6 +472,9 @@ def bcast_step(
             flat(cst.q_val),
             flat(cst.q_site),
             flat(cst.q_clp),
+            flat(cst.q_seq),
+            flat(cst.q_nseq),
+            flat(cst.q_ts),
         ),
     )
 
@@ -289,6 +491,7 @@ def bcast_step(
 
     # --- receiver ingest: dedupe, apply, re-broadcast -------------------
     cst, info = ingest_changes(
-        cfg, cst, live, m_origin, m_dbv, m_cell, m_ver, m_val, m_site, m_clp
+        cfg, cst, live, m_origin, m_dbv, m_cell, m_ver, m_val, m_site, m_clp,
+        m_seq, m_nseq, m_ts,
     )
     return cst, {**info, "sent": jnp.sum(m_ok)}

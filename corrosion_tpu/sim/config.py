@@ -34,6 +34,12 @@ class SimConfig:
     n_rows: int = 16  # LWW rows per table
     n_cols: int = 4  # LWW columns per row
     buf_slots: int = 64  # out-of-order version buffer per node
+    # --- multi-cell transactions (ChunkedChanges analog) ------------------
+    # max cells per write transaction == max seqs per version (chunked
+    # delivery + receiver-side buffering, change.rs:66-178); 1 = single-
+    # cell versions only, which skips the partial buffer entirely
+    tx_max_cells: int = 8
+    partial_slots: int = 16  # incomplete-version buffer slots per node
     # --- broadcast dissemination (handle_broadcasts analog) --------------
     bcast_fanout: int = 5  # random member fanout per flush
     bcast_queue: int = 64  # pending-broadcast slots per node
@@ -61,6 +67,7 @@ class SimConfig:
     def validate(self) -> "SimConfig":
         assert self.n_origins <= self.n_nodes
         assert self.piggyback >= 1 and self.n_indirect >= 0
+        assert 1 <= self.tx_max_cells <= 30, "seq bitmask lives in an int32"
         return self
 
 

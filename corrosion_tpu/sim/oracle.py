@@ -40,6 +40,13 @@ class OracleNode:
     store: Dict[int, Tuple[int, int, int, int, int]] = field(default_factory=dict)
     seen: Dict[int, Set[int]] = field(default_factory=dict)  # origin -> versions
     known_max: Dict[int, int] = field(default_factory=dict)
+    # (origin, dbv) -> {seq: (cell, ver, val, site, clp)} — buffered cells
+    # of incomplete chunked versions (the __corro_buffered_changes analog,
+    # reference crates/corro-agent/src/agent/util.rs:1061-1194); applied
+    # atomically once seqs 0..nseq-1 are all present
+    partial: Dict[Tuple[int, int], Dict[int, Tuple[int, int, int, int, int]]] = (
+        field(default_factory=dict)
+    )
 
     def head(self, origin: int) -> int:
         s = self.seen.get(origin, set())
@@ -71,6 +78,31 @@ class OracleNode:
         if fresh:
             self.merge_cell(cell, ver, val, site, dbv, clp)
         return fresh
+
+    def apply_chunk(self, change: Change, seq: int, nseq: int) -> bool:
+        """Ingest one cell of a chunked version. ``nseq == 1`` is the
+        complete-changeset fast path; otherwise the cell buffers until
+        the whole seq range 0..nseq-1 is present, then the version
+        applies atomically and records as seen
+        (``process_incomplete_version`` ->
+        ``process_fully_buffered_changes``, ``util.rs:1061-1194,546-696``).
+        Returns True when this cell was fresh (re-broadcast it)."""
+        if nseq <= 1:
+            return self.apply(change)
+        cell, ver, val, site, origin, dbv, clp = change
+        self.known_max[origin] = max(self.known_max.get(origin, 0), dbv)
+        if dbv in self.seen.get(origin, set()):
+            return False  # whole version already seen
+        buf = self.partial.setdefault((origin, dbv), {})
+        if seq in buf:
+            return False  # duplicate chunk
+        buf[seq] = (cell, ver, val, site, clp)
+        if len(buf) == nseq:  # seq range closed -> atomic apply
+            self.seen.setdefault(origin, set()).add(dbv)
+            for c, v, vl, st, cl in buf.values():
+                self.merge_cell(c, v, vl, st, dbv, cl)
+            del self.partial[(origin, dbv)]
+        return True
 
     def needs(self, origin: int) -> int:
         s = self.seen.get(origin, set())

@@ -47,6 +47,19 @@ def _write4(w):
     return (*w, 0) if len(w) == 3 else tuple(w)
 
 
+def _as_tx(w):
+    """Normalize a script write to transaction form:
+    ``(node, [(cell, value, clp), ...])``. Scripts may record plain
+    single-cell writes ``(node, cell, value[, clp])`` or multi-statement
+    transactions ``(node, [(cell, value[, clp]), ...])``."""
+    if isinstance(w[1], (list, tuple)) and w[1] and isinstance(
+        w[1][0], (list, tuple)
+    ):
+        return w[0], [(*c, 0) if len(c) == 2 else tuple(c) for c in w[1]]
+    node, cell, val, clp = _write4(w)
+    return node, [(cell, val, clp)]
+
+
 @dataclass
 class WorkloadScript:
     """Per-round write lists, shareable between oracle and sim.
@@ -60,6 +73,10 @@ class WorkloadScript:
     n_origins: int
     n_cells: int
     writes: List[List[Tuple]] = field(default_factory=list)
+    # faults[r] = events applied before round r's writes: ("kill", node),
+    # ("revive", node), ("partition", [group per node]), ("heal",) —
+    # the Antithesis driver surface (kill/revive/partition/heal)
+    faults: List[List[Tuple]] = field(default_factory=list)
 
     @staticmethod
     def random_single_writer(n_nodes: int, n_origins: int, n_cells: int,
@@ -129,12 +146,89 @@ class WorkloadScript:
             ws.writes.append(batch)
         return ws
 
+    @staticmethod
+    def random_transactions(n_nodes: int, n_origins: int, n_cells: int,
+                            rounds: int, tx_cells: int = 4, seed: int = 0,
+                            write_prob: float = 0.5) -> "WorkloadScript":
+        """Multi-statement transactions over single-writer-owned cells —
+        the chunked-changeset regime (``change.rs:66-178``): each commit
+        writes ``tx_cells`` distinct owned cells under one db_version;
+        remote nodes must apply them atomically. Single-writer per cell
+        keeps the bitwise-parity determinism contract."""
+        rng = random.Random(seed)
+        ws = WorkloadScript(n_nodes, n_origins, n_cells)
+        for _ in range(rounds):
+            batch = []
+            for w in range(n_origins):
+                if rng.random() < write_prob:
+                    owned = [c for c in range(n_cells) if c % n_origins == w]
+                    k = min(tx_cells, len(owned))
+                    if k:
+                        cells = rng.sample(owned, k)
+                        batch.append((w, [(c, rng.randrange(1, 1 << 20))
+                                          for c in cells]))
+            ws.writes.append(batch)
+        return ws
+
+    @staticmethod
+    def random_full_mix(n_nodes: int, n_origins: int, n_cells: int,
+                        rounds: int, seed: int = 0, write_prob: float = 0.5,
+                        hot_cells: int = 4, kill_prob: float = 0.08,
+                        revive_prob: float = 0.3,
+                        partition_window: Tuple[int, int] = None) -> "WorkloadScript":
+        """BASELINE's full-mix correctness config: multi-writer hot cells
+        + kill/revive churn + a partition window (split into two halves,
+        healed later). Writes only fire at alive, reachable... any alive
+        origin (partitioned writers keep writing — divergence repairs on
+        heal). The agreement+validity parity regime."""
+        rng = random.Random(seed)
+        ws = WorkloadScript(n_nodes, n_origins, n_cells)
+        alive = [True] * n_nodes
+        if partition_window is None:
+            partition_window = (rounds // 3, 2 * rounds // 3)
+        p_start, p_end = partition_window
+        for r in range(rounds):
+            events: List[Tuple] = []
+            # churn: kill a random alive non-seed node / revive a dead one
+            dead = [i for i in range(n_nodes) if not alive[i]]
+            if dead and rng.random() < revive_prob:
+                node = rng.choice(dead)
+                alive[node] = True
+                events.append(("revive", node))
+            candidates = [i for i in range(4, n_nodes) if alive[i]]
+            if candidates and rng.random() < kill_prob:
+                node = rng.choice(candidates)
+                alive[node] = False
+                events.append(("kill", node))
+            if r == p_start:
+                half = [1 if i >= n_nodes // 2 else 0 for i in range(n_nodes)]
+                events.append(("partition", half))
+            elif r == p_end:
+                events.append(("heal",))
+            ws.faults.append(events)
+            batch = []
+            for w in range(n_origins):
+                if alive[w] and rng.random() < write_prob:
+                    batch.append((w, rng.randrange(hot_cells),
+                                  rng.randrange(1, 1 << 20)))
+            ws.writes.append(batch)
+        return ws
+
+    @property
+    def max_tx_cells(self) -> int:
+        return max(
+            (len(cells) for batch in self.writes
+             for _, cells in (_as_tx(w) for w in batch)),
+            default=1,
+        )
+
     def written_values(self) -> Dict[int, set]:
         """cell -> set of all values ever written to it (validity check)."""
         out: Dict[int, set] = {}
         for batch in self.writes:
-            for _, cell, val, _clp in (_write4(w) for w in batch):
-                out.setdefault(cell, set()).add(val)
+            for _node, cells in (_as_tx(w) for w in batch):
+                for cell, val, _clp in cells:
+                    out.setdefault(cell, set()).add(val)
         return out
 
 
@@ -153,42 +247,65 @@ class OracleCluster:
         self.rng = random.Random(seed)
         self.nodes = [OracleNode(n_origins) for _ in range(n_nodes)]
         self.next_dbv = [1] * n_nodes
-        # per-node change payloads for serving sync: (origin, dbv) -> Change
-        self.payloads: List[Dict[Tuple[int, int], Change]] = [
+        # per-node *complete* version payloads for serving sync:
+        # (origin, dbv) -> tuple of (Change, seq, nseq) — a node can only
+        # serve versions it holds whole (its store never contains torn
+        # versions, so neither can what it serves)
+        self.payloads: List[Dict[Tuple[int, int], tuple]] = [
             {} for _ in range(n_nodes)
         ]
-        # per-node broadcast queue: (change, remaining transmissions)
-        self.queues: List[List[Tuple[Change, int]]] = [[] for _ in range(n_nodes)]
+        # chunks of not-yet-complete versions, promoted to payloads at
+        # completion: (origin, dbv) -> {seq: (Change, seq, nseq)}
+        self.payload_chunks: List[Dict[Tuple[int, int], dict]] = [
+            {} for _ in range(n_nodes)
+        ]
+        # per-node broadcast queue: (change, seq, nseq, remaining tx)
+        self.queues: List[List[tuple]] = [[] for _ in range(n_nodes)]
 
     # --- write path ------------------------------------------------------
     def write(self, node: int, cell: int, value: int, clp: int = 0) -> None:
+        self.write_tx(node, [(cell, value, clp)])
+
+    def write_tx(self, node: int, cells) -> None:
+        """Commit a multi-statement transaction: all cells share one
+        db_version, stamped seq 0..n-1 (``ChunkedChanges``,
+        ``change.rs:66-178``); applied atomically to the writer's own
+        store. ``cells`` = [(cell, value, clp), ...], distinct cells."""
         assert node < self.n_origins
-        cur = self.nodes[node].store.get(cell)
-        ver = (cur[0] if cur else 0) + 1  # bump the merged clock (local_write)
+        me = self.nodes[node]
         dbv = self.next_dbv[node]
         self.next_dbv[node] += 1
-        ch: Change = (cell, ver, value, node, dbv, clp)
-        self.nodes[node].apply((cell, ver, value, node, node, dbv, clp))
-        self.payloads[node][(node, dbv)] = ch
-        self.queues[node].append((ch, self.budget))
+        nseq = len(cells)
+        chunks = []
+        for seq, (cell, value, clp) in enumerate(cells):
+            cur = me.store.get(cell)
+            ver = (cur[0] if cur else 0) + 1  # bump the merged clock
+            chunks.append(((cell, ver, value, node, dbv, clp), seq, nseq))
+        me.record(node, dbv)
+        for (cell, ver, value, site, dbv_, clp), seq, _n in chunks:
+            me.merge_cell(cell, ver, value, site, dbv_, clp)
+            self.queues[node].append(
+                ((cell, ver, value, site, dbv_, clp), seq, nseq, self.budget)
+            )
+        self.payloads[node][(node, dbv)] = tuple(chunks)
 
     # --- dissemination round ---------------------------------------------
     def round(self) -> None:
         # broadcast flush: every queued change goes to a random fanout set
-        deliveries: List[Tuple[int, Change]] = []
+        deliveries: List[tuple] = []
         for src in range(self.n_nodes):
             newq = []
-            for ch, tx in self.queues[src]:
+            for ch, seq, nseq, tx in self.queues[src]:
                 targets = self.rng.sample(
                     [t for t in range(self.n_nodes) if t != src],
                     min(self.fanout, self.n_nodes - 1),
                 )
-                deliveries.extend((t, ch) for t in targets)
+                deliveries.extend((t, ch, seq, nseq) for t in targets)
                 if tx - 1 > 0:
-                    newq.append((ch, tx - 1))
+                    newq.append((ch, seq, nseq, tx - 1))
             self.queues[src] = newq
-        for dst, ch in deliveries:
-            self._ingest(dst, ch)
+        for dst, ch, seq, nseq in deliveries:
+            self._ingest(dst, ch, seq, nseq)
         # anti-entropy: each node pulls its missing versions from peers
         for node in range(self.n_nodes):
             peers = self.rng.sample(
@@ -198,24 +315,33 @@ class OracleCluster:
             for peer in peers:
                 self._sync_pull(node, peer)
 
-    def _ingest(self, dst: int, ch: Change) -> None:
+    def _ingest(self, dst: int, ch: Change, seq: int = 0, nseq: int = 1) -> None:
         cell, ver, val, site, dbv, clp = ch
-        fresh = self.nodes[dst].apply((cell, ver, val, site, site, dbv, clp))
+        fresh = self.nodes[dst].apply_chunk(
+            (cell, ver, val, site, site, dbv, clp), seq, nseq
+        )
         if fresh:
-            self.payloads[dst][(site, dbv)] = ch
-            self.queues[dst].append((ch, max(1, self.budget - 1)))
+            chunks = self.payload_chunks[dst].setdefault((site, dbv), {})
+            chunks[seq] = (ch, seq, nseq)
+            if dbv in self.nodes[dst].seen.get(site, set()):
+                # version now whole -> servable via sync
+                self.payloads[dst][(site, dbv)] = tuple(chunks.values())
+                del self.payload_chunks[dst][(site, dbv)]
+            self.queues[dst].append((ch, seq, nseq, max(1, self.budget - 1)))
 
     def _sync_pull(self, node: int, peer: int) -> None:
         """compute_available_needs + serve: pull every version the peer
-        can grant that we lack (``sync.rs:127``)."""
+        can grant whole that we lack (``sync.rs:127``) — the bi channel
+        transfers a version's full seq range atomically."""
         mine, theirs = self.nodes[node], self.nodes[peer]
         for origin in range(self.n_origins):
             their_seen = theirs.seen.get(origin, set())
             my_seen = mine.seen.get(origin, set())
             for dbv in sorted(their_seen - my_seen):
-                ch = self.payloads[peer].get((origin, dbv))
-                if ch is not None:
-                    self._ingest(node, ch)
+                chunks = self.payloads[peer].get((origin, dbv))
+                if chunks is not None:
+                    for ch, seq, nseq in chunks:
+                        self._ingest(node, ch, seq, nseq)
 
     # --- harness ---------------------------------------------------------
     def run(self, script: WorkloadScript, settle_rounds: int = 64) -> int:
@@ -224,8 +350,8 @@ class OracleCluster:
         from corrosion_tpu.sim.oracle import converged
 
         for batch in script.writes:
-            for node, cell, val, clp in (_write4(w) for w in batch):
-                self.write(node, cell, val, clp)
+            for node, cells in (_as_tx(w) for w in batch):
+                self.write_tx(node, cells)
             self.round()
         for r in range(settle_rounds):
             if not any(self.queues) and converged(self.nodes):
@@ -267,34 +393,93 @@ def run_sim_script(script: WorkloadScript, seed: int = 0,
     from corrosion_tpu.sim.transport import NetModel
 
     n_rows = max(1, (script.n_cells + 3) // 4)
+    tx_k = script.max_tx_cells
     cfg = scale_sim_config(
         script.n_nodes, n_origins=script.n_origins,
         n_rows=n_rows, n_cols=(script.n_cells + n_rows - 1) // n_rows,
-        sync_interval=sync_interval,
+        sync_interval=sync_interval, tx_max_cells=tx_k,
     )
     # the configured grid must cover the script's cell space
     assert cfg.n_cells >= script.n_cells
     st = ScaleSimState.create(cfg)
     net = NetModel.create(script.n_nodes, drop_prob=drop_prob)
-    step = jax.jit(lambda s, k, i: scale_sim_step(cfg, s, net, k, i))
+    step = jax.jit(lambda s, nt, k, i: scale_sim_step(cfg, s, nt, k, i))
     key = jr.key(seed)
     quiet = ScaleRoundInput.quiet(cfg)
 
     def round_input(batch):
-        wm = np.zeros(script.n_nodes, bool)
-        wc = np.zeros(script.n_nodes, np.int32)
-        wv = np.zeros(script.n_nodes, np.int32)
-        wl = np.zeros(script.n_nodes, np.int32)
-        for node, cell, val, clp in (_write4(w) for w in batch):
-            wm[node], wc[node], wv[node], wl[node] = True, cell, val, clp
+        n = script.n_nodes
+        wm = np.zeros(n, bool)
+        wc = np.zeros(n, np.int32)
+        wv = np.zeros(n, np.int32)
+        wl = np.zeros(n, np.int32)
+        tm = np.zeros(n, bool)
+        tl = np.ones(n, np.int32)
+        tc = np.zeros((n, tx_k), np.int32)
+        tv = np.zeros((n, tx_k), np.int32)
+        tp = np.zeros((n, tx_k), np.int32)
+        seen_nodes = set()
+        for node, cells in (_as_tx(w) for w in batch):
+            # the sim's RoundInput holds ONE write per node per round; a
+            # second same-node write would silently overwrite the lanes
+            # and diverge from the oracle's apply-all-in-order semantics
+            assert node not in seen_nodes, (
+                f"script batch has two writes for node {node}; the sim "
+                "round carries one write per node per round"
+            )
+            seen_nodes.add(node)
+            if len(cells) == 1:
+                cell, val, clp = cells[0]
+                wm[node], wc[node], wv[node], wl[node] = True, cell, val, clp
+            else:
+                tm[node], tl[node] = True, len(cells)
+                for i, (cell, val, clp) in enumerate(cells):
+                    tc[node, i], tv[node, i], tp[node, i] = cell, val, clp
         return quiet._replace(
             write_mask=jnp.asarray(wm), write_cell=jnp.asarray(wc),
             write_val=jnp.asarray(wv), write_clp=jnp.asarray(wl),
+            tx_mask=jnp.asarray(tm), tx_len=jnp.asarray(tl),
+            tx_cell=jnp.asarray(tc), tx_val=jnp.asarray(tv),
+            tx_clp=jnp.asarray(tp),
         )
 
-    for batch in script.writes:
+    def apply_faults(inp, net, events):
+        """Fold one round's fault events into the RoundInput + NetModel."""
+        kill = np.zeros(script.n_nodes, bool)
+        revive = np.zeros(script.n_nodes, bool)
+        for ev in events:
+            if ev[0] == "kill":
+                kill[ev[1]] = True
+            elif ev[0] == "revive":
+                revive[ev[1]] = True
+            elif ev[0] == "partition":
+                net = net._replace(partition=jnp.asarray(ev[1], jnp.int32))
+            elif ev[0] == "heal":
+                net = net._replace(
+                    partition=jnp.zeros(script.n_nodes, jnp.int32)
+                )
+            else:
+                raise ValueError(f"unknown fault event {ev!r}")
+        if kill.any() or revive.any():
+            inp = inp._replace(kill=jnp.asarray(kill),
+                               revive=jnp.asarray(revive))
+        return inp, net
+
+    for r, batch in enumerate(script.writes):
+        inp = round_input(batch)
+        if r < len(script.faults):
+            inp, net = apply_faults(inp, net, script.faults[r])
         key, sub = jr.split(key)
-        st, _ = step(st, sub, round_input(batch))
+        st, _ = step(st, net, sub, inp)
+    # settle with every node revived and partitions healed (the harness's
+    # final repair phase — dead nodes rejoin and catch up via sync)
+    if script.faults:
+        net = net._replace(partition=jnp.zeros(script.n_nodes, jnp.int32))
+        revive_all = quiet._replace(
+            revive=jnp.asarray(~np.asarray(st.swim.alive))
+        )
+        key, sub = jr.split(key)
+        st, _ = step(st, net, sub, revive_all)
     taken = -1
     for r in range(settle_rounds + 1):  # +1: check AFTER the last step too
         m = scale_crdt_metrics(cfg, st)
@@ -304,7 +489,7 @@ def run_sim_script(script: WorkloadScript, seed: int = 0,
         if r == settle_rounds:
             break
         key, sub = jr.split(key)
-        st, _ = step(st, sub, quiet)
+        st, _ = step(st, net, sub, quiet)
     planes = tuple(np.asarray(p)[:, :script.n_cells] for p in st.crdt.store)
     return planes, np.asarray(st.swim.alive), taken
 

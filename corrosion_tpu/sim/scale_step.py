@@ -47,6 +47,7 @@ from corrosion_tpu.sim.broadcast import (
     CrdtState,
     ingest_changes,
     local_write,
+    local_write_tx,
 )
 from corrosion_tpu.sim.scale import (
     ScaleSwimState,
@@ -75,6 +76,11 @@ class ScaleSimConfig:
     n_rows: int = 16
     n_cols: int = 4
     buf_slots: int = 32
+    # multi-cell transactions: 1 keeps the 100k hot path free of the
+    # partial buffer (single-cell versions complete on arrival); raise it
+    # to run chunked-changeset workloads at scale (change.rs:66-178)
+    tx_max_cells: int = 1
+    partial_slots: int = 8
     # --- dissemination ---------------------------------------------------
     bcast_queue: int = 32
     bcast_max_transmissions: int = 4
@@ -99,6 +105,7 @@ class ScaleSimConfig:
 
     def validate(self) -> "ScaleSimConfig":
         assert self.n_origins <= self.n_nodes and self.m_slots > 0
+        assert 1 <= self.tx_max_cells <= 30, "seq bitmask lives in an int32"
         # shares the sender-election int32 packing (see ScaleConfig.validate)
         assert self.n_nodes <= 1 << 19, "max 2^19 nodes per sender-election word"
         return self
@@ -148,10 +155,17 @@ class ScaleRoundInput(NamedTuple):
     write_cell: jax.Array  # int32 [N]
     write_val: jax.Array  # int32 [N]
     write_clp: jax.Array  # int32 [N] — causal-length lifetime of the write
+    # multi-cell transactions (K = tx_max_cells lanes; [N, 1] dummies when
+    # the scale path runs single-cell versions only)
+    tx_mask: jax.Array  # bool [N]
+    tx_len: jax.Array  # int32 [N]
+    tx_cell: jax.Array  # int32 [N, K]
+    tx_val: jax.Array  # int32 [N, K]
+    tx_clp: jax.Array  # int32 [N, K]
 
     @staticmethod
     def quiet(cfg: ScaleSimConfig) -> "ScaleRoundInput":
-        n = cfg.n_nodes
+        n, k = cfg.n_nodes, max(1, cfg.tx_max_cells)
         return ScaleRoundInput(
             kill=jnp.zeros(n, bool),
             revive=jnp.zeros(n, bool),
@@ -159,6 +173,11 @@ class ScaleRoundInput(NamedTuple):
             write_cell=jnp.zeros(n, jnp.int32),
             write_val=jnp.zeros(n, jnp.int32),
             write_clp=jnp.zeros(n, jnp.int32),
+            tx_mask=jnp.zeros(n, bool),
+            tx_len=jnp.ones(n, jnp.int32),
+            tx_cell=jnp.zeros((n, k), jnp.int32),
+            tx_val=jnp.zeros((n, k), jnp.int32),
+            tx_clp=jnp.zeros((n, k), jnp.int32),
         )
 
 
@@ -205,6 +224,9 @@ def piggyback_bcast_step(cfg: ScaleSimConfig, cst: CrdtState, channels, key):
             g(cst.q_val),
             g(cst.q_site),
             g(cst.q_clp),
+            g(cst.q_seq),
+            g(cst.q_nseq),
+            g(cst.q_ts),
         )
 
     # --- gather each channel's payload; [N, n_channels*R] messages ------
@@ -213,8 +235,9 @@ def piggyback_bcast_step(cfg: ScaleSimConfig, cst: CrdtState, channels, key):
         src = jnp.clip(src, 0)
         parts.append(sender_fields(src))
         valids.append(valid[:, None] & sel_ok[src])
-    m_origin, m_dbv, m_cell, m_ver, m_val, m_site, m_clp = (
-        jnp.concatenate([p[i] for p in parts], axis=1) for i in range(7)
+    (m_origin, m_dbv, m_cell, m_ver, m_val, m_site, m_clp, m_seq, m_nseq,
+     m_ts) = (
+        jnp.concatenate([p[i] for p in parts], axis=1) for i in range(10)
     )
     live = jnp.concatenate(valids, axis=1)
 
@@ -236,7 +259,8 @@ def piggyback_bcast_step(cfg: ScaleSimConfig, cst: CrdtState, channels, key):
 
     # --- receiver ingest: dedupe, apply, re-broadcast --------------------
     return ingest_changes(
-        cfg, cst, live, m_origin, m_dbv, m_cell, m_ver, m_val, m_site, m_clp
+        cfg, cst, live, m_origin, m_dbv, m_cell, m_ver, m_val, m_site, m_clp,
+        m_seq, m_nseq, m_ts,
     )
 
 
@@ -256,10 +280,17 @@ def scale_sim_step(
         cfg, st.swim, net, k_swim, kill=inp.kill, revive=inp.revive
     )
 
+    # tick the round counter — the HLC's physical time axis
+    cst = st.crdt._replace(now=st.crdt.now + 1)
     cst = local_write(
-        cfg, st.crdt, inp.write_mask, inp.write_cell, inp.write_val,
+        cfg, cst, inp.write_mask, inp.write_cell, inp.write_val,
         inp.write_clp,
     )
+    if cfg.tx_max_cells > 1:
+        cst = local_write_tx(
+            cfg, cst, inp.tx_mask, inp.tx_cell, inp.tx_val, inp.tx_clp,
+            inp.tx_len,
+        )
     cst, b_info = piggyback_bcast_step(cfg, cst, channels, k_pig)
 
     # need-driven sync peer choice from a 2x sample of believed-alive

@@ -18,7 +18,12 @@ import jax.random as jr
 
 from corrosion_tpu.ops.lww import STATE_ALIVE
 from corrosion_tpu.ops.versions import needs_count
-from corrosion_tpu.sim.broadcast import CrdtState, bcast_step, local_write
+from corrosion_tpu.sim.broadcast import (
+    CrdtState,
+    bcast_step,
+    local_write,
+    local_write_tx,
+)
 from corrosion_tpu.sim.config import SimConfig
 from corrosion_tpu.sim.swim import SwimState, swim_metrics, swim_step
 from corrosion_tpu.sim.transport import NetModel
@@ -42,10 +47,17 @@ class RoundInput(NamedTuple):
     write_cell: jax.Array  # int32 [N]
     write_val: jax.Array  # int32 [N]
     write_clp: jax.Array  # int32 [N] — causal-length lifetime of the write
+    # multi-cell transactions (one per node per round, K = tx_max_cells
+    # lanes; chunked delivery + remote atomicity — change.rs:66-178)
+    tx_mask: jax.Array  # bool [N]
+    tx_len: jax.Array  # int32 [N] — real lanes (1..K)
+    tx_cell: jax.Array  # int32 [N, K]
+    tx_val: jax.Array  # int32 [N, K]
+    tx_clp: jax.Array  # int32 [N, K]
 
     @staticmethod
     def quiet(cfg: SimConfig) -> "RoundInput":
-        n = cfg.n_nodes
+        n, k = cfg.n_nodes, max(1, cfg.tx_max_cells)
         return RoundInput(
             kill=jnp.zeros(n, bool),
             revive=jnp.zeros(n, bool),
@@ -53,6 +65,11 @@ class RoundInput(NamedTuple):
             write_cell=jnp.zeros(n, jnp.int32),
             write_val=jnp.zeros(n, jnp.int32),
             write_clp=jnp.zeros(n, jnp.int32),
+            tx_mask=jnp.zeros(n, bool),
+            tx_len=jnp.ones(n, jnp.int32),
+            tx_cell=jnp.zeros((n, k), jnp.int32),
+            tx_val=jnp.zeros((n, k), jnp.int32),
+            tx_clp=jnp.zeros((n, k), jnp.int32),
         )
 
 
@@ -71,10 +88,17 @@ def sim_step(cfg: SimConfig, st: SimState, net: NetModel, key, inp: RoundInput):
     believed = (swim.view >= 0) & ((swim.view & 3) == STATE_ALIVE)
     cand = believed & ~jnp.eye(n, dtype=bool)
 
+    # tick the round counter — the HLC's physical time axis
+    cst = st.crdt._replace(now=st.crdt.now + 1)
     cst = local_write(
-        cfg, st.crdt, inp.write_mask, inp.write_cell, inp.write_val,
+        cfg, cst, inp.write_mask, inp.write_cell, inp.write_val,
         inp.write_clp,
     )
+    if cfg.tx_max_cells > 1:
+        cst = local_write_tx(
+            cfg, cst, inp.tx_mask, inp.tx_cell, inp.tx_val, inp.tx_clp,
+            inp.tx_len,
+        )
     # broadcast fanout: ring0 (same-region) members take strict priority,
     # the rest of the set is random — handle_broadcasts sends local
     # changes to ring0 first, then random members (broadcast/mod.rs:653-713)

@@ -33,8 +33,9 @@ import jax.numpy as jnp
 import jax.random as jr
 
 from corrosion_tpu.ops.lww import INT32_MIN, lex_max
+from corrosion_tpu.ops.partials import drop_stale_partials
 from corrosion_tpu.ops.versions import advance_heads, needs_count
-from corrosion_tpu.sim.broadcast import LAST_SYNC_CAP, CrdtState
+from corrosion_tpu.sim.broadcast import LAST_SYNC_CAP, CrdtState, hlc_fold
 from corrosion_tpu.sim.config import SimConfig
 from corrosion_tpu.sim.transport import N_RINGS, NetModel, bi_ok
 
@@ -142,6 +143,27 @@ def sync_step(
     book = advance_heads(
         cst.book._replace(head=new_head, known_max=new_km)
     )
+    # versions that arrived whole through sync obsolete their buffered
+    # fragments (the buffered-meta GC analog, util.rs:430-490)
+    if cst.partials.origin.shape[1] > 1 or cst.partials.cell.shape[2] > 1:
+        cst = cst._replace(
+            partials=drop_stale_partials(cst.partials, book.head)
+        )
+
+    # sync handshake exchanges HLC clocks; BOTH sides fold, with the same
+    # max-drift rejection as change ingest (peer/mod.rs:1439-1458)
+    hlc, _, _ = hlc_fold(cst.hlc, cst.now, cst.hlc[peers], ok)
+    # server side: peer p folds the client's clock (scatter-max)
+    from corrosion_tpu.sim.broadcast import HLC_MAX_DRIFT_ROUNDS, HLC_ROUND_BITS
+    client_ts = jnp.broadcast_to(cst.hlc[:, None], peers.shape)
+    within = ok & ((client_ts >> HLC_ROUND_BITS) <= cst.now + HLC_MAX_DRIFT_ROUNDS)
+    flat = jnp.where(within, peers, n)
+    hlc = (
+        jnp.concatenate([hlc, jnp.zeros(1, jnp.int32)])
+        .at[flat.reshape(-1)]
+        .max(client_ts.reshape(-1), mode="drop")[:n]
+    )
+    cst = cst._replace(hlc=hlc)
 
     info = {
         "syncs": jnp.sum(ok),

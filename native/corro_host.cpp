@@ -121,6 +121,7 @@ struct Book {
 
 struct Change {
   int32_t cell, ver, val, site, dbv, clp;
+  int32_t seq = 0, nseq = 1;  // chunked-changeset stamps (change.rs:66-178)
 };
 
 inline bool origin_contains(const OriginBook& b, int32_t v) {
@@ -133,8 +134,13 @@ struct ClusterNode {
   Lww store;
   Book book;
   int32_t next_dbv = 1;
-  // (origin<<32 | dbv) -> payload, for serving sync pulls
-  std::unordered_map<int64_t, Change> payloads;
+  // (origin<<32 | dbv) -> the version's full cell set, for serving sync
+  // pulls — only versions held whole are servable
+  std::unordered_map<int64_t, std::vector<Change>> payloads;
+  // buffered cells of incomplete chunked versions, applied atomically
+  // once seqs 0..nseq-1 are all present (__corro_buffered_changes /
+  // process_fully_buffered_changes, util.rs:1061-1194,546-696)
+  std::unordered_map<int64_t, std::map<int32_t, Change>> partial;
   std::deque<std::pair<Change, int32_t>> queue;  // (change, tx budget)
 };
 
@@ -142,6 +148,16 @@ struct Cluster {
   int32_t n_nodes, n_origins, n_cells, fanout, budget, sync_peers;
   uint64_t rng;
   std::vector<ClusterNode> nodes;
+  // fault surface (the Antithesis driver's kill/revive/partition/heal):
+  // dead nodes keep their state (the reference restarts from the
+  // persisted DB) but neither send nor receive; messages only deliver
+  // within a partition group
+  std::vector<char> alive;
+  std::vector<int32_t> group;
+
+  bool connected(int32_t a, int32_t b) const {
+    return alive[a] && alive[b] && group[a] == group[b];
+  }
 
   uint32_t next_rand() {  // xorshift64*
     rng ^= rng >> 12;
@@ -158,49 +174,93 @@ struct Cluster {
     return ((int64_t)origin << 32) | (uint32_t)dbv;
   }
 
-  void ingest(ClusterNode& dst, const Change& ch) {
-    if (!dst.book.origins[ch.site].record(ch.dbv)) return;
+  void merge_cell(ClusterNode& dst, const Change& ch) {
     Cell& cell = dst.store.cells[ch.cell];
     if (cell.ver == 0 || incoming_wins(cell, ch.ver, ch.val, ch.site, ch.clp))
       cell = Cell{ch.ver, ch.val, ch.site, ch.dbv, ch.clp};
-    dst.payloads[pkey(ch.site, ch.dbv)] = ch;
+  }
+
+  void ingest(ClusterNode& dst, const Change& ch) {
     int32_t tx = budget > 1 ? budget - 1 : 1;
+    if (ch.nseq <= 1) {  // complete version: apply on arrival
+      if (!dst.book.origins[ch.site].record(ch.dbv)) return;
+      merge_cell(dst, ch);
+      dst.payloads[pkey(ch.site, ch.dbv)] = {ch};
+      dst.queue.emplace_back(ch, tx);
+      return;
+    }
+    // chunked version: buffer until the whole seq range is present
+    OriginBook& ob = dst.book.origins[ch.site];
+    if (ch.dbv > ob.known_max) ob.known_max = ch.dbv;
+    if (origin_contains(ob, ch.dbv)) return;  // already seen whole
+    int64_t key = pkey(ch.site, ch.dbv);
+    auto& buf = dst.partial[key];
+    if (!buf.emplace(ch.seq, ch).second) return;  // duplicate chunk
     dst.queue.emplace_back(ch, tx);
+    if ((int32_t)buf.size() == ch.nseq) {  // range closed -> atomic apply
+      ob.record(ch.dbv);
+      std::vector<Change> whole;
+      whole.reserve(buf.size());
+      for (auto& [s, c] : buf) {
+        merge_cell(dst, c);
+        whole.push_back(c);
+      }
+      dst.payloads[key] = std::move(whole);
+      dst.partial.erase(key);
+    }
   }
 
   void write(int32_t node, int32_t cell, int32_t val, int32_t clp) {
+    write_tx(node, &cell, &val, &clp, 1);
+  }
+
+  // Multi-statement transaction: all cells (distinct) share one
+  // db_version, applied atomically locally, disseminated as chunks.
+  void write_tx(int32_t node, const int32_t* cells, const int32_t* vals,
+                const int32_t* clps, int32_t count) {
     ClusterNode& n = nodes[node];
-    int32_t ver = n.store.cells[cell].ver + 1;  // merged-clock bump
     int32_t dbv = n.next_dbv++;
-    Change ch{cell, ver, val, node, dbv, clp};
+    std::vector<Change> whole;
+    whole.reserve(count);
+    for (int32_t i = 0; i < count; i++) {
+      int32_t ver = n.store.cells[cells[i]].ver + 1;  // merged-clock bump
+      whole.push_back(
+          Change{cells[i], ver, vals[i], node, dbv, clps[i], i, count});
+    }
     n.book.origins[node].record(dbv);
-    Cell& c = n.store.cells[cell];
-    if (c.ver == 0 || incoming_wins(c, ver, val, node, clp))
-      c = Cell{ver, val, node, dbv, clp};
-    n.payloads[pkey(node, dbv)] = ch;
-    n.queue.emplace_back(ch, budget);
+    for (auto& ch : whole) {
+      merge_cell(n, ch);
+      n.queue.emplace_back(ch, budget);
+    }
+    n.payloads[pkey(node, dbv)] = std::move(whole);
   }
 
   void round() {
-    // broadcast flush: every queued change to a random fanout set
+    // broadcast flush: every queued change to a random fanout set;
+    // dead senders hold their queues, cross-partition packets drop (the
+    // budget still burns — the sender cannot observe datagram loss)
     std::vector<std::pair<int32_t, Change>> deliveries;
     for (int32_t src = 0; src < n_nodes; src++) {
       ClusterNode& n = nodes[src];
+      if (!alive[src]) continue;
       size_t pending = n.queue.size();
       for (size_t q = 0; q < pending; q++) {
         auto [ch, tx] = n.queue.front();
         n.queue.pop_front();
-        for (int32_t f = 0; f < fanout && n_nodes > 1; f++)
-          deliveries.emplace_back(rand_peer(src), ch);
+        for (int32_t f = 0; f < fanout && n_nodes > 1; f++) {
+          int32_t dst = rand_peer(src);
+          if (connected(src, dst)) deliveries.emplace_back(dst, ch);
+        }
         if (tx - 1 > 0) n.queue.emplace_back(ch, tx - 1);
       }
     }
     for (auto& [dst, ch] : deliveries) ingest(nodes[dst], ch);
     // anti-entropy: each node pulls everything missing from a few peers
     for (int32_t i = 0; i < n_nodes && n_nodes > 1; i++) {
+      if (!alive[i]) continue;
       for (int32_t s = 0; s < sync_peers; s++) {
         int32_t peer = rand_peer(i);
-        sync_pull(i, peer);
+        if (connected(i, peer)) sync_pull(i, peer);
       }
     }
   }
@@ -213,31 +273,39 @@ struct Cluster {
         for (int32_t v = lo; v <= hi; v++) {
           if (origin_contains(mine.book.origins[o], v)) continue;
           auto it = theirs.payloads.find(pkey(o, v));
-          if (it != theirs.payloads.end()) ingest(mine, it->second);
+          if (it != theirs.payloads.end())  // whole version, atomically
+            for (const Change& ch : it->second) ingest(mine, ch);
         }
       }
     }
   }
 
   bool queues_empty() const {
-    for (auto& n : nodes)
-      if (!n.queue.empty()) return false;
+    for (int32_t i = 0; i < n_nodes; i++)
+      if (alive[i] && !nodes[i].queue.empty()) return false;
     return true;
   }
 
+  // "no needs, equal heads" + identical stores — over ALIVE nodes only
+  // (check_bookkeeping.py skips dead nodes; they repair on revive)
   bool converged() const {
-    const ClusterNode& ref = nodes[0];
+    int32_t ref = -1;
+    for (int32_t i = 0; i < n_nodes; i++)
+      if (alive[i]) { ref = i; break; }
+    if (ref < 0) return true;
+    const ClusterNode& r = nodes[ref];
     for (int32_t i = 0; i < n_nodes; i++) {
+      if (!alive[i]) continue;
       const ClusterNode& n = nodes[i];
       for (int32_t o = 0; o < n_origins; o++) {
         if (n.book.origins[o].needs() != 0) return false;
-        if (i && n.book.origins[o].head() != ref.book.origins[o].head())
+        if (i != ref && n.book.origins[o].head() != r.book.origins[o].head())
           return false;
       }
-      if (i == 0) continue;
+      if (i == ref) continue;
       for (int32_t c = 0; c < n_cells; c++) {
         const Cell& a = n.store.cells[c];
-        const Cell& b = ref.store.cells[c];
+        const Cell& b = r.store.cells[c];
         if (a.ver != b.ver || a.val != b.val || a.site != b.site ||
             a.dbv != b.dbv || a.clp != b.clp)
           return false;
@@ -356,6 +424,8 @@ void* corro_cluster_new(int32_t n_nodes, int32_t n_origins, int32_t n_cells,
     n.store.cells.resize(n_cells);
     n.book.origins.resize(n_origins);
   }
+  c->alive.assign(n_nodes, 1);
+  c->group.assign(n_nodes, 0);
   return c;
 }
 void corro_cluster_free(void* h) { delete static_cast<Cluster*>(h); }
@@ -364,7 +434,27 @@ void corro_cluster_write(void* h, int32_t node, int32_t cell, int32_t val,
                          int32_t clp) {
   static_cast<Cluster*>(h)->write(node, cell, val, clp);
 }
+// Multi-statement transaction: `count` (cell, val, clp) triples commit
+// atomically under one db_version and disseminate as a chunked changeset.
+void corro_cluster_write_tx(void* h, int32_t node, const int32_t* cells,
+                            const int32_t* vals, const int32_t* clps,
+                            int32_t count) {
+  static_cast<Cluster*>(h)->write_tx(node, cells, vals, clps, count);
+}
 void corro_cluster_round(void* h) { static_cast<Cluster*>(h)->round(); }
+
+// --- fault injection (kill/revive/partition/heal drivers) --------------
+void corro_cluster_kill(void* h, int32_t node) {
+  static_cast<Cluster*>(h)->alive[node] = 0;
+}
+void corro_cluster_revive(void* h, int32_t node) {
+  static_cast<Cluster*>(h)->alive[node] = 1;
+}
+// groups: n_nodes int32 partition ids (same id = connected)
+void corro_cluster_set_partition(void* h, const int32_t* groups) {
+  auto* c = static_cast<Cluster*>(h);
+  c->group.assign(groups, groups + c->n_nodes);
+}
 int32_t corro_cluster_converged(void* h) {
   return static_cast<Cluster*>(h)->converged() ? 1 : 0;
 }

@@ -1,0 +1,229 @@
+"""Partial-changeset buffering: multi-cell transactions are atomic.
+
+The reference buffers chunked changesets per (version, seq-range) and
+only applies a version once its whole range is present
+(``process_incomplete_version`` -> ``process_fully_buffered_changes``,
+``crates/corro-agent/src/agent/util.rs:1061-1194,546-696``), which is
+what keeps a multi-statement transaction from being observed torn on
+remote nodes. These tests drive the array analogs directly and through
+the full sim round."""
+
+import jax.numpy as jnp
+import jax.random as jr
+import numpy as np
+import pytest
+
+from corrosion_tpu.ops.partials import (
+    Partials,
+    complete_mask,
+    drop_stale_partials,
+    free_slots,
+    ingest_partials,
+)
+
+
+def _msgs(rows):
+    """rows: list (per node) of lists of (origin, dbv, seq, nseq, cell,
+    ver, val, site, clp); pad to rectangular [N, M] arrays + live mask."""
+    m = max(len(r) for r in rows)
+    n = len(rows)
+    fields = [np.zeros((n, m), np.int32) for _ in range(9)]
+    live = np.zeros((n, m), bool)
+    for i, r in enumerate(rows):
+        for j, msg in enumerate(r):
+            live[i, j] = True
+            for f, v in zip(fields, msg):
+                f[i, j] = v
+    return jnp.asarray(live), tuple(jnp.asarray(f) for f in fields)
+
+
+def test_buffer_until_complete_then_apply():
+    par = Partials.create(2, 4, 4)
+    # node 0 gets seqs 0,1 of a 3-cell version — incomplete
+    live, f = _msgs([
+        [(0, 1, 0, 3, 10, 1, 100, 0, 0), (0, 1, 1, 3, 11, 1, 101, 0, 0)],
+        [],
+    ])
+    par, fresh = ingest_partials(par, live, *f)
+    assert np.asarray(fresh).tolist() == [[True, True], [False, False]]
+    assert not np.asarray(complete_mask(par)).any()
+    # the final seq closes the range
+    live, f = _msgs([[(0, 1, 2, 3, 12, 1, 102, 0, 0)], []])
+    par, fresh = ingest_partials(par, live, *f)
+    assert bool(np.asarray(fresh)[0, 0])
+    full = np.asarray(complete_mask(par))
+    assert full[0].sum() == 1 and full[1].sum() == 0
+    slot = int(np.argmax(full[0]))
+    assert int(par.nseq[0, slot]) == 3 and int(par.mask[0, slot]) == 0b111
+    cells = sorted(np.asarray(par.cell)[0, slot, :3].tolist())
+    assert cells == [10, 11, 12]
+    par = free_slots(par, jnp.asarray(full))
+    assert not np.asarray(complete_mask(par)).any()
+
+
+def test_duplicate_seqs_not_fresh():
+    par = Partials.create(1, 4, 4)
+    live, f = _msgs([[(0, 1, 0, 2, 10, 1, 100, 0, 0),
+                      (0, 1, 0, 2, 10, 1, 100, 0, 0)]])  # dup in one batch
+    par, fresh = ingest_partials(par, live, *f)
+    assert np.asarray(fresh).tolist() == [[True, False]]
+    live, f = _msgs([[(0, 1, 0, 2, 10, 1, 100, 0, 0)]])  # dup across rounds
+    par, fresh = ingest_partials(par, live, *f)
+    assert not bool(np.asarray(fresh)[0, 0])
+    assert int(par.mask[0, int(np.argmax(np.asarray(par.origin[0]) >= 0))]) == 0b1
+
+
+def test_interleaved_versions_share_no_slot():
+    par = Partials.create(1, 4, 4)
+    live, f = _msgs([[
+        (0, 1, 0, 2, 10, 1, 100, 0, 0),
+        (1, 7, 0, 2, 20, 1, 200, 1, 0),
+        (0, 1, 1, 2, 11, 1, 101, 0, 0),
+        (1, 7, 1, 2, 21, 1, 201, 1, 0),
+    ]])
+    par, fresh = ingest_partials(par, live, *f)
+    assert np.asarray(fresh).all()
+    full = np.asarray(complete_mask(par))
+    assert full.sum() == 2  # both versions complete, in distinct slots
+    origins = sorted(np.asarray(par.origin)[0][full[0]].tolist())
+    assert origins == [0, 1]
+
+
+def test_slot_overflow_drops():
+    par = Partials.create(1, 2, 4)  # only 2 slots
+    live, f = _msgs([[
+        (0, 1, 0, 2, 10, 1, 1, 0, 0),
+        (0, 2, 0, 2, 11, 1, 1, 0, 0),
+        (0, 3, 0, 2, 12, 1, 1, 0, 0),  # no slot left -> dropped
+    ]])
+    par, fresh = ingest_partials(par, live, *f)
+    assert np.asarray(fresh).tolist() == [[True, True, False]]
+
+
+def test_drop_stale_partials_frees_synced_versions():
+    par = Partials.create(1, 4, 4)
+    live, f = _msgs([[(0, 5, 0, 2, 10, 1, 1, 0, 0)]])
+    par, _ = ingest_partials(par, live, *f)
+    head = jnp.asarray([[5, 0]], jnp.int32)  # origin 0's head reached 5
+    par = drop_stale_partials(par, head)
+    assert not (np.asarray(par.origin) >= 0).any()
+
+
+def test_transaction_never_observed_torn_under_loss():
+    """A 4-statement transaction must never be visible partially on any
+    remote node, at ANY round, under 5% packet drop (VERDICT #3's done
+    criterion; atomicity per ``process_fully_buffered_changes``)."""
+    import jax
+
+    from corrosion_tpu.sim.scale_step import (
+        ScaleRoundInput,
+        ScaleSimState,
+        scale_crdt_metrics,
+        scale_sim_config,
+        scale_sim_step,
+    )
+    from corrosion_tpu.sim.transport import NetModel
+
+    n, k = 24, 4
+    cfg = scale_sim_config(n, n_origins=4, n_rows=4, n_cols=4,
+                           tx_max_cells=k, sync_interval=4)
+    st = ScaleSimState.create(cfg)
+    net = NetModel.create(n, drop_prob=0.05)
+    step = jax.jit(lambda s, key, i: scale_sim_step(cfg, s, net, key, i))
+    key = jr.key(7)
+    quiet = ScaleRoundInput.quiet(cfg)
+
+    # node 0 commits a 4-cell transaction on cells 1,5,9,13 (written by
+    # nothing else); fanout + loss scatter the chunks across rounds
+    tx_cells = np.array([1, 5, 9, 13], np.int32)
+    inp = quiet._replace(
+        tx_mask=jnp.asarray(np.eye(1, n, 0, dtype=bool)[0]),
+        tx_len=jnp.full(n, k, jnp.int32),
+        tx_cell=jnp.broadcast_to(jnp.asarray(tx_cells), (n, k)),
+        tx_val=jnp.broadcast_to(jnp.asarray([11, 22, 33, 44], jnp.int32), (n, k)),
+    )
+    key, sub = jr.split(key)
+    st, _ = step(st, sub, inp)
+    converged_at = None
+    for r in range(200):
+        vers = np.asarray(st.crdt.store[0])[:, tx_cells]  # [N, 4]
+        present = (vers > 0).sum(axis=1)
+        torn = np.nonzero((present > 0) & (present < k))[0]
+        assert torn.size == 0, (
+            f"round {r}: nodes {torn.tolist()} observe a torn transaction "
+            f"(cells present: {present[torn].tolist()})"
+        )
+        m = scale_crdt_metrics(cfg, st)
+        if bool(m["converged"]) and present.min() == k:
+            converged_at = r
+            break
+        key, sub = jr.split(key)
+        st, _ = step(st, sub, quiet)
+    assert converged_at is not None, "transaction never converged"
+    # every node holds the full transaction with one shared db_version
+    dbvs = np.asarray(st.crdt.store[3])[:, tx_cells]
+    assert (dbvs == dbvs[0, 0]).all()
+    vals = np.asarray(st.crdt.store[1])[:, tx_cells]
+    assert (vals == np.array([11, 22, 33, 44])).all()
+
+
+def test_transaction_parity_oracle_vs_sim():
+    """Chunked-changeset regime end-to-end: random multi-cell
+    transactions, oracle and sim converge to bitwise-identical stores."""
+    from corrosion_tpu.sim.parity import (
+        OracleCluster,
+        WorkloadScript,
+        check_bitwise_parity,
+        run_sim_script,
+    )
+
+    script = WorkloadScript.random_transactions(
+        24, 4, 32, rounds=10, tx_cells=4, seed=3
+    )
+    oc = OracleCluster(24, 4, 32, seed=1)
+    assert oc.run(script) > 0, "oracle failed to converge"
+    planes, alive, taken = run_sim_script(script, seed=3)
+    assert taken > 0, "sim failed to converge"
+    problems = check_bitwise_parity(oc, planes, alive)
+    assert not problems, "\n".join(problems)
+
+
+def test_transaction_parity_native_engine():
+    """The C++ cluster engine buffers chunked versions the same way:
+    bitwise-identical converged stores on the transaction workload."""
+    from corrosion_tpu import native
+    from corrosion_tpu.sim.parity import OracleCluster, WorkloadScript
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    script = WorkloadScript.random_transactions(
+        24, 4, 32, rounds=10, tx_cells=4, seed=3
+    )
+    nat = native.NativeCluster(24, 4, 32, seed=1)
+    assert nat.run(script) > 0
+    oc = OracleCluster(24, 4, 32, seed=1)
+    assert oc.run(script) > 0
+    for name, op, npn in zip(("ver", "val", "site", "dbv", "clp"),
+                             oc.store_planes(), nat.store_planes()):
+        assert np.array_equal(op, npn), f"{name} plane diverged"
+
+
+def test_transaction_parity_under_drop():
+    """Same regime with 5% loss: convergence via re-broadcast + sync
+    repair, still bitwise-identical to the loss-free oracle."""
+    from corrosion_tpu.sim.parity import (
+        OracleCluster,
+        WorkloadScript,
+        check_bitwise_parity,
+        run_sim_script,
+    )
+
+    script = WorkloadScript.random_transactions(
+        16, 4, 24, rounds=8, tx_cells=3, seed=11
+    )
+    oc = OracleCluster(16, 4, 24, seed=2)
+    assert oc.run(script) > 0
+    planes, alive, taken = run_sim_script(script, seed=11, drop_prob=0.05)
+    assert taken > 0, "sim failed to converge under drop"
+    problems = check_bitwise_parity(oc, planes, alive)
+    assert not problems, "\n".join(problems)
