@@ -11,8 +11,11 @@ Supported dialect (the write/read surface the reference's API exercises):
 ``INSERT [OR IGNORE] INTO t (cols) VALUES (...)`` (upsert semantics, as
 cr-sqlite rewrites inserts), ``UPDATE t SET c=? WHERE pk=?``,
 ``DELETE FROM t WHERE pk=?`` (causal-length tombstone), and
-``SELECT cols FROM t [WHERE simple-conjunction] [LIMIT n]`` with the
-``corro_json_contains`` function from ``sqlite-functions``.
+``SELECT`` with projection aliases, aggregates (COUNT/SUM/MIN/MAX/AVG/
+TOTAL), ``[LEFT] JOIN ... ON a.x = b.y`` equi-joins, ``WHERE``
+conjunctions (incl. the ``corro_json_contains`` function from
+``sqlite-functions``), ``GROUP BY``, ``ORDER BY ... [ASC|DESC]``, and
+``LIMIT n [OFFSET m]``.
 """
 
 from __future__ import annotations
@@ -51,13 +54,27 @@ _DELETE_RE = re.compile(
     r"DELETE\s+FROM\s+(?P<table>[\w\"]+)\s+WHERE\s+(?P<where>.*)$",
     re.IGNORECASE | re.DOTALL,
 )
-_SELECT_RE = re.compile(
-    r"SELECT\s+(?P<cols>.*?)\s+FROM\s+(?P<table>[\w\"]+)"
-    r"(?:\s+WHERE\s+(?P<where>.*?))?(?:\s+LIMIT\s+(?P<limit>\d+))?\s*$",
+_SELECT_RE = re.compile(r"SELECT\b", re.IGNORECASE)
+# top-level clause keywords of the supported SELECT grammar:
+# SELECT cols FROM t [alias] [[LEFT] JOIN t2 [alias] ON a.c = b.c]*
+#   [WHERE conj] [GROUP BY cols] [ORDER BY col [ASC|DESC], ...]
+#   [LIMIT n [OFFSET m]]
+_KW_RE = re.compile(
+    r"\b(FROM|LEFT\s+OUTER\s+JOIN|LEFT\s+JOIN|INNER\s+JOIN|JOIN|ON|WHERE|"
+    r"GROUP\s+BY|ORDER\s+BY|LIMIT|OFFSET)\b",
+    re.IGNORECASE,
+)
+_AGG_RE = re.compile(
+    r"^(?P<fn>COUNT|SUM|MIN|MAX|AVG|TOTAL)\s*\(\s*(?P<arg>\*|[\w\".]+)\s*\)"
+    r"(?:\s+AS\s+(?P<alias>[\w\"]+))?$",
+    re.IGNORECASE | re.DOTALL,
+)
+_COL_AS_RE = re.compile(
+    r"^(?P<col>[\w\".]+)(?:\s+AS\s+(?P<alias>[\w\"]+))?$",
     re.IGNORECASE | re.DOTALL,
 )
 _COND_RE = re.compile(
-    r"^(?P<col>[\w\"]+)\s*(?P<op>=|!=|<>|<=|>=|<|>)\s*(?P<val>.+)$", re.DOTALL
+    r"^(?P<col>[\w\".]+)\s*(?P<op>=|!=|<>|<=|>=|<|>)\s*(?P<val>.+)$", re.DOTALL
 )
 _FUNC_RE = re.compile(
     r"^corro_json_contains\s*\(\s*(?P<a>[^,]+)\s*,\s*(?P<b>.+)\s*\)$",
@@ -242,8 +259,10 @@ class Database:
         """Drain order for the transaction's net ``(cell, value, clp)``
         writes: causal-length flips that leave a row LIVE go last (the row
         only turns visible once its values are in flight) and flips that
-        leave it DEAD go first — ``write_many`` drains one cell per round,
-        so list order is visibility order for local readers."""
+        leave it DEAD go first. Within one ``tx_max_cells`` chunk the
+        commit is atomic (one db_version, remote buffering), so order only
+        matters when an oversized transaction splits into several
+        versions — there, list order is chunk order is visibility order."""
         deaths, values, lives = [], [], []
         for cell, (value, clp) in merged.items():
             if cell % self.n_cols == CL_COL:
@@ -312,10 +331,10 @@ class Database:
                  self.heap.intern(value), lifetime)
             )
         if not live:
-            # CL flip staged LAST: write_many drains one cell per round, so
-            # the row must only turn live once its values are already in
-            # flight — otherwise readers observe a live all-NULL row for
-            # n_value_columns rounds (insert atomicity)
+            # CL flip staged LAST: within a tx_max_cells chunk the commit
+            # is atomic, but an oversized transaction splits into several
+            # versions — the row must only turn live once its values are
+            # already committed/in flight (insert atomicity for readers)
             cells.append((self._cell(row, CL_COL), cl + 1, cl + 1))
         return 1, cells, [(table.name, pk, dict(by_col), False)]
 
@@ -377,75 +396,375 @@ class Database:
     def query(self, node: int, sql: str, params: Any = None
               ) -> Tuple[List[str], Iterable[List[Any]]]:
         """Read-only query against ``node``'s replica (``/v1/queries``).
-        Returns ``(column_names, row_iterator)``."""
-        sql = sql.strip().rstrip(";").strip()
-        m = _SELECT_RE.match(sql)
-        if m is None:
-            raise SqlError(f"only SELECT is allowed on the query path: "
-                           f"{sql[:80]!r}")
-        p = _Params(params)
-        table = self.schema.table(_unquote(m.group("table")))
-        names = self._select_names(table, m.group("cols"))
-        conds = self._parse_where(table, m.group("where"), p)
-        limit = int(m.group("limit")) if m.group("limit") else None
-        return names, self._scan(node, table, names, conds, limit)
+        Returns ``(column_names, row_iterator)``.
 
-    @staticmethod
-    def _select_names(table, raw_cols: str) -> List[str]:
-        raw_cols = raw_cols.strip()
-        if raw_cols == "*":
-            return [c.name for c in table.columns]
-        names = [_unquote(c) for c in raw_cols.split(",")]
-        for n in names:
-            table.column(n)
-        return names
+        Dialect (the read surface the reference's templates/consul/admin
+        tooling actually exercises over full SQLite): projection incl.
+        aggregates (COUNT/SUM/MIN/MAX/AVG/TOTAL) with ``AS`` aliases,
+        ``[LEFT] JOIN ... ON a.x = b.y`` equi-joins, ``WHERE``
+        conjunctions, ``GROUP BY``, ``ORDER BY ... [ASC|DESC]``, and
+        ``LIMIT n [OFFSET m]``."""
+        ast = self._parse_select(sql, _Params(params))
+        names = [c[2] for c in ast["cols"]]
+        return names, self._run_select(node, ast)
 
     def query_columns(self, sql: str) -> List[str]:
         """The column names a SELECT would produce — schema-only, no
         scan (used by the PG Describe phase)."""
-        m = _SELECT_RE.match(sql.strip().rstrip(";").strip())
-        if m is None:
-            raise SqlError(f"not a SELECT: {sql[:80]!r}")
-        table = self.schema.table(_unquote(m.group("table")))
-        return self._select_names(table, m.group("cols"))
+        ast = self._parse_select(sql, _Params(None), check_params=False)
+        return [c[2] for c in ast["cols"]]
 
-    def _parse_where(self, table, where: Optional[str], p: _Params):
-        if not where:
-            return []
-        conds = []
-        for clause in re.split(r"\s+AND\s+", where.strip(), flags=re.IGNORECASE):
-            clause = clause.strip()
-            fm = _FUNC_RE.match(clause)
-            if fm:
-                col = _unquote(fm.group("a"))
-                table.column(col)
-                needle = _parse_literal(fm.group("b"), p)
-                conds.append(("json_contains", col, needle))
+    # --- SELECT parsing ---------------------------------------------------
+    @staticmethod
+    def _top_level_mask(sql: str) -> List[bool]:
+        """True where a char sits outside quotes and parens."""
+        mask, depth, in_str = [], 0, False
+        for ch in sql:
+            if in_str:
+                mask.append(False)
+                in_str = ch != "'"
+            elif ch == "'":
+                in_str = True
+                mask.append(False)
+            elif ch == "(":
+                depth += 1
+                mask.append(False)
+            elif ch == ")":
+                depth -= 1
+                mask.append(False)
+            else:
+                mask.append(depth == 0)
+        return mask
+
+    def _parse_select(self, sql: str, p: _Params, check_params: bool = True):
+        sql = sql.strip().rstrip(";").strip()
+        if not _SELECT_RE.match(sql):
+            raise SqlError(f"only SELECT is allowed on the query path: "
+                           f"{sql[:80]!r}")
+        mask = self._top_level_mask(sql)
+        marks = [
+            (m.start(), m.end(), re.sub(r"\s+", " ", m.group(1)).upper())
+            for m in _KW_RE.finditer(sql)
+            if mask[m.start()]
+        ]
+        from_marks = [m for m in marks if m[2] == "FROM"]
+        if not from_marks:
+            raise SqlError(f"SELECT without FROM: {sql[:80]!r}")
+        # clause segmentation: text between consecutive top-level keywords
+        segs = []
+        for i, (s, e, kw) in enumerate(marks):
+            end = marks[i + 1][0] if i + 1 < len(marks) else len(sql)
+            segs.append((kw, sql[e:end].strip()))
+        cols_raw = sql[len("SELECT"):from_marks[0][0]].strip()
+
+        # FROM + JOINs
+        def table_spec(raw):
+            parts = raw.split()
+            name = _unquote(parts[0])
+            alias = _unquote(parts[-1]) if (
+                len(parts) > 1 and parts[-1].upper() != "AS"
+            ) else name
+            return self.schema.table(name), alias
+
+        aliases: Dict[str, Any] = {}
+        joins = []
+        where_raw = group_raw = order_raw = limit_raw = offset_raw = None
+        i = 0
+        while i < len(segs):
+            kw, seg = segs[i]
+            if kw == "FROM":
+                base_table, base_alias = table_spec(seg)
+                aliases[base_alias] = base_table
+            elif kw.endswith("JOIN"):
+                jtype = "left" if kw.startswith("LEFT") else "inner"
+                if i + 1 >= len(segs) or segs[i + 1][0] != "ON":
+                    raise SqlError(f"JOIN without ON: {seg!r}")
+                t, a = table_spec(seg)
+                if a in aliases:
+                    raise SqlError(f"duplicate table alias {a!r}")
+                aliases[a] = t
+                cond = segs[i + 1][1]
+                cm = re.match(
+                    r"^([\w\".]+)\s*=\s*([\w\".]+)$", cond.strip()
+                )
+                if cm is None:
+                    raise SqlError(
+                        f"only equi-join ON a.x = b.y supported: {cond!r}"
+                    )
+                joins.append((jtype, a, cm.group(1), cm.group(2)))
+                i += 1
+            elif kw == "ON":
+                raise SqlError("ON outside a JOIN")
+            elif kw == "WHERE":
+                where_raw = seg
+            elif kw == "GROUP BY":
+                group_raw = seg
+            elif kw == "ORDER BY":
+                order_raw = seg
+            elif kw == "LIMIT":
+                limit_raw = seg
+            elif kw == "OFFSET":
+                offset_raw = seg
+            i += 1
+
+        def resolve(ref: str) -> str:
+            """Column reference -> record key ('alias.col')."""
+            ref = ref.strip()
+            if "." in ref:
+                q, _, c = ref.partition(".")
+                q, c = _unquote(q), _unquote(c)
+                if q not in aliases:
+                    raise SqlError(f"unknown table alias {q!r}")
+                aliases[q].column(c)  # raises on unknown column
+                return f"{q}.{c}"
+            c = _unquote(ref)
+            owners = [a for a, t in aliases.items() if t.has_column(c)]
+            if not owners:
+                raise SqlError(f"unknown column {c!r}")
+            if len(owners) > 1:
+                raise SqlError(f"ambiguous column {c!r} (qualify it)")
+            return f"{owners[0]}.{c}"
+
+        # projection
+        cols = []  # (kind, payload, output name)
+        for raw in _split_top_commas(cols_raw):
+            raw = raw.strip()
+            if raw == "*":
+                for a, t in aliases.items():
+                    for c in t.columns:
+                        cols.append(("col", f"{a}.{c.name}", c.name))
                 continue
-            cm = _COND_RE.match(clause)
+            am = _AGG_RE.match(raw)
+            if am:
+                fn = am.group("fn").upper()
+                arg = am.group("arg")
+                key = None if arg == "*" else resolve(arg)
+                if key is None and fn != "COUNT":
+                    raise SqlError(f"{fn}(*) is not valid SQL")
+                name = _unquote(am.group("alias") or "") or re.sub(
+                    r"\s+", "", raw.split(" AS ")[0].split(" as ")[0]
+                )
+                cols.append(("agg", (fn, key), name))
+                continue
+            cm = _COL_AS_RE.match(raw)
             if cm is None:
-                raise SqlError(f"unsupported WHERE clause: {clause!r}")
-            col = _unquote(cm.group("col"))
-            table.column(col)
-            conds.append(
-                (cm.group("op"), col, _parse_literal(cm.group("val"), p))
-            )
-        return conds
+                raise SqlError(f"unsupported select expression: {raw!r}")
+            key = resolve(cm.group("col"))
+            name = _unquote(cm.group("alias") or "") or key.split(".", 1)[1]
+            cols.append(("col", key, name))
 
-    def _scan(self, node: int, table, names, conds, limit):
-        snap = self.agent.snapshot()
-        vals = snap["store"][1][node]
-        clps = snap["store"][4][node]
-        emitted = 0
+        # WHERE
+        conds = []
+        if where_raw:
+            for clause in re.split(r"\s+AND\s+", where_raw,
+                                   flags=re.IGNORECASE):
+                clause = clause.strip()
+                fm = _FUNC_RE.match(clause)
+                if fm:
+                    key = resolve(fm.group("a"))
+                    needle = (_parse_literal(fm.group("b"), p)
+                              if check_params else None)
+                    conds.append(("json_contains", key, needle))
+                    continue
+                cm = _COND_RE.match(clause)
+                if cm is None:
+                    raise SqlError(f"unsupported WHERE clause: {clause!r}")
+                key = resolve(cm.group("col"))
+                val = (_parse_literal(cm.group("val"), p)
+                       if check_params else None)
+                conds.append((cm.group("op"), key, val))
+
+        group = ([resolve(g) for g in _split_top_commas(group_raw)]
+                 if group_raw else [])
+        order = []
+        if order_raw:
+            for part in _split_top_commas(order_raw):
+                toks = part.split()
+                desc = len(toks) > 1 and toks[-1].upper() == "DESC"
+                if len(toks) > 1 and toks[-1].upper() in ("ASC", "DESC"):
+                    toks = toks[:-1]
+                order.append((" ".join(toks), desc))
+
+        def int_or_param(raw):
+            if raw is None:
+                return None
+            raw = raw.strip()
+            if not check_params:
+                return 0 if raw in ("?",) or raw.startswith((":", "$")) else int(raw)
+            v = _parse_literal(raw, p)
+            if not isinstance(v, int) or v < 0:
+                raise SqlError(f"LIMIT/OFFSET must be a non-negative int: {raw!r}")
+            return v
+
+        return {
+            "aliases": aliases, "base": base_alias, "joins": joins,
+            "cols": cols, "conds": conds, "group": group, "order": order,
+            "limit": int_or_param(limit_raw),
+            "offset": int_or_param(offset_raw),
+            "resolve": resolve,
+        }
+
+    # --- SELECT execution -------------------------------------------------
+    def _table_records(self, node: int, table, alias: str, vals, clps):
+        """All live rows of one table as {'alias.col': value} dicts."""
+        out = []
         for pk, row in self.rows.rows_of(table.name):
             if int(vals[self._cell(row, CL_COL)]) % 2 == 0:
                 continue
             rec = self._materialize(table, pk, vals, clps, row)
-            if all(self._eval(c, rec) for c in conds):
-                yield [rec[n] for n in names]
-                emitted += 1
-                if limit is not None and emitted >= limit:
-                    return
+            out.append({f"{alias}.{k}": v for k, v in rec.items()})
+        return out
+
+    def _run_select(self, node: int, ast) -> Iterable[List[Any]]:
+        snap = self.agent.snapshot()
+        vals = snap["store"][1][node]
+        clps = snap["store"][4][node]
+        aliases = ast["aliases"]
+        has_agg = any(k == "agg" for k, _, _ in ast["cols"])
+        if (not ast["joins"] and not ast["group"] and not ast["order"]
+                and not has_agg):
+            # streaming fast path: plain filtered scan short-circuits at
+            # LIMIT without materializing the table (the /v1/queries
+            # NDJSON stream shape)
+            yield from self._stream_select(node, ast, vals, clps)
+            return
+        records = self._table_records(
+            node, aliases[ast["base"]], ast["base"], vals, clps
+        )
+        # hash equi-joins, in declaration order
+        for jtype, a, lref, rref in ast["joins"]:
+            lkey, rkey = ast["resolve"](lref), ast["resolve"](rref)
+            # probe side = the newly joined table's rows
+            right = self._table_records(node, aliases[a], a, vals, clps)
+            probe_key = rkey if rkey.startswith(f"{a}.") else lkey
+            build_key = lkey if probe_key == rkey else rkey
+            if not probe_key.startswith(f"{a}."):
+                raise SqlError(
+                    f"JOIN ON must reference the joined table {a!r}"
+                )
+            index: Dict[Any, List[dict]] = {}
+            for r in right:
+                if r[probe_key] is not None:  # SQL: NULL = NULL is not true
+                    index.setdefault(r[probe_key], []).append(r)
+            joined = []
+            for rec in records:
+                bkey = rec.get(build_key)
+                matches = index.get(bkey, []) if bkey is not None else []
+                if matches:
+                    for mrec in matches:
+                        joined.append({**rec, **mrec})
+                elif jtype == "left":
+                    joined.append(
+                        {**rec, **{f"{a}.{c.name}": None
+                                   for c in aliases[a].columns}}
+                    )
+            records = joined
+        # WHERE
+        records = [
+            r for r in records
+            if all(self._eval(c, r) for c in ast["conds"])
+        ]
+        # GROUP BY / aggregates
+        if ast["group"] or has_agg:
+            groups: Dict[tuple, List[dict]] = {}
+            for r in records:
+                gkey = tuple(r.get(g) for g in ast["group"])
+                groups.setdefault(gkey, []).append(r)
+            if not records and not ast["group"]:
+                groups[()] = []  # aggregates over an empty table emit 1 row
+            rows = []
+            for gkey, grp in groups.items():
+                out = {}
+                for kind, payload, name in ast["cols"]:
+                    if kind == "col":
+                        out[name] = grp[0].get(payload) if grp else None
+                    else:
+                        out[name] = self._aggregate(payload, grp)
+                rows.append(out)
+        else:
+            rows = [
+                {name: r.get(payload) for _k, payload, name in ast["cols"]}
+                for r in records
+            ]
+            # keep source record reachable for ORDER BY non-projected cols
+            for out, src in zip(rows, records):
+                out["\x00src"] = src
+        # ORDER BY: output alias first, then projected source column,
+        # then (non-aggregate queries) any input column
+        by_payload = {
+            payload: name for kind, payload, name in ast["cols"]
+            if kind == "col"
+        }
+        for ref, desc in reversed(ast["order"]):
+            name = _unquote(ref)
+
+            def key_of(row, name=name, ref=ref):
+                if name in row:
+                    v = row[name]
+                else:
+                    key = ast["resolve"](ref)
+                    if key in by_payload:
+                        v = row[by_payload[key]]
+                    else:
+                        src = row.get("\x00src")
+                        if src is None:
+                            raise SqlError(f"cannot ORDER BY {ref!r} here")
+                        v = src.get(key)
+                # SQLite: NULLs sort first ASC; type-tag mixed values
+                return (v is not None, isinstance(v, (bytes, str)), v)
+
+            rows.sort(key=key_of, reverse=desc)
+        off = ast["offset"] or 0
+        if off:
+            rows = rows[off:]
+        if ast["limit"] is not None:
+            rows = rows[:ast["limit"]]
+        names = [c[2] for c in ast["cols"]]
+        for row in rows:
+            yield [row[n] for n in names]
+
+    def _stream_select(self, node: int, ast, vals, clps):
+        """Lazy single-table scan: filter, offset, project, stop at
+        LIMIT — the early-exit path the bounded read APIs rely on."""
+        alias = ast["base"]
+        table = ast["aliases"][alias]
+        emitted, skipped = 0, 0
+        off = ast["offset"] or 0
+        for pk, row in self.rows.rows_of(table.name):
+            if int(vals[self._cell(row, CL_COL)]) % 2 == 0:
+                continue
+            rec = self._materialize(table, pk, vals, clps, row)
+            rec = {f"{alias}.{k}": v for k, v in rec.items()}
+            if not all(self._eval(c, rec) for c in ast["conds"]):
+                continue
+            if skipped < off:
+                skipped += 1
+                continue
+            yield [rec.get(payload) for _k, payload, _n in ast["cols"]]
+            emitted += 1
+            if ast["limit"] is not None and emitted >= ast["limit"]:
+                return
+
+    @staticmethod
+    def _aggregate(payload, grp: List[dict]):
+        fn, key = payload
+        vals = ([r.get(key) for r in grp if r.get(key) is not None]
+                if key is not None else grp)
+        if fn == "COUNT":
+            return len(vals)
+        if not vals:
+            return 0.0 if fn == "TOTAL" else None
+        if fn == "SUM":
+            return sum(vals)
+        if fn == "TOTAL":
+            return float(sum(vals))
+        if fn == "MIN":
+            return min(vals)
+        if fn == "MAX":
+            return max(vals)
+        if fn == "AVG":
+            return sum(vals) / len(vals)
+        raise SqlError(f"unknown aggregate {fn}")
 
     def _materialize(self, table, pk, vals, clps, row) -> Dict[str, Any]:
         """A row's visible values: a cell counts only if it was written in

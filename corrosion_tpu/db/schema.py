@@ -73,6 +73,9 @@ class Table:
                 return c
         raise SchemaError(f"no such column: {self.name}.{name}")
 
+    def has_column(self, name: str) -> bool:
+        return any(c.name == name for c in self.columns)
+
     def col_index(self, name: str) -> int:
         """Grid column for a value column (pk is implicit in the row map)."""
         idx = CL_COL + 1

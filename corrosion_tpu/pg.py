@@ -11,15 +11,19 @@ path as the HTTP API (the reference routes them through
 node's replica.
 
 Simplifications vs the reference: values are returned in text format
-with a minimal OID mapping (int8/float8/text/bytea); the ``pg_catalog``
-virtual tables are answered as empty result sets (the reference fakes
-``pg_type``/``pg_class``/... with vtabs, ``src/vtab/pg_*.rs``);
-transactions are statement-local (``BEGIN``/``COMMIT``/``ROLLBACK`` are
-accepted no-ops), matching the eventual-consistency write model.
+with a minimal OID mapping (int8/float8/text/bytea); ``pg_catalog`` /
+``information_schema`` introspection is answered from the live schema
+for the common shapes (``pg_class``/``pg_attribute``/``pg_type``/
+``pg_namespace``/``pg_database``, ``information_schema.{tables,columns}``
+— the reference fakes these with vtabs, ``src/vtab/pg_*.rs``);
+unrecognized catalog queries degrade to empty result sets; transactions
+are statement-local (``BEGIN``/``COMMIT``/``ROLLBACK`` are accepted
+no-ops), matching the eventual-consistency write model.
 """
 
 from __future__ import annotations
 
+import re
 import socket
 import socketserver
 import struct
@@ -55,6 +59,216 @@ def _col_oid(sql_type: str) -> int:
         "REAL": OID_FLOAT8,
         "BLOB": OID_BYTEA,
     }.get(sql_type, OID_TEXT)
+
+
+# --- pg_catalog virtual tables (vtab analogs, src/vtab/pg_*.rs) ---------
+# stable synthetic OIDs: namespaces ship PG's well-known values; relation
+# oids are 16384 + table index in schema declaration order
+_NS_CATALOG, _NS_PUBLIC = 11, 2200
+_FIRST_REL_OID = 16384
+_PG_TYPES = [
+    # (oid, typname, typlen)
+    (16, "bool", 1), (17, "bytea", -1), (20, "int8", 8), (21, "int2", 2),
+    (23, "int4", 4), (25, "text", -1), (701, "float8", 8),
+    (1043, "varchar", -1),
+]
+
+
+def _catalog_rows(db, table: str) -> List[Dict[str, Any]]:
+    """Rows of one catalog vtab, generated from the live schema."""
+    tables = list(db.schema.tables.values())
+    rel_oid = {t.name: _FIRST_REL_OID + i for i, t in enumerate(tables)}
+    if table == "pg_namespace":
+        return [
+            {"oid": _NS_CATALOG, "nspname": "pg_catalog"},
+            {"oid": _NS_PUBLIC, "nspname": "public"},
+        ]
+    if table == "pg_database":
+        return [{"oid": 1, "datname": "corrosion"}]
+    if table == "pg_type":
+        return [
+            {"oid": o, "typname": n, "typlen": ln,
+             "typnamespace": _NS_CATALOG, "typtype": "b"}
+            for o, n, ln in _PG_TYPES
+        ]
+    if table == "pg_class":
+        return [
+            {"oid": rel_oid[t.name], "relname": t.name,
+             "relnamespace": _NS_PUBLIC, "relkind": "r",
+             "relowner": 10, "reltuples": -1}
+            for t in tables
+        ]
+    if table == "pg_attribute":
+        rows = []
+        for t in tables:
+            for i, c in enumerate(t.columns):
+                rows.append({
+                    "attrelid": rel_oid[t.name], "attname": c.name,
+                    "atttypid": _col_oid(c.sql_type), "attnum": i + 1,
+                    "attnotnull": c.not_null or c.primary_key,
+                    "attisdropped": False,
+                })
+        return rows
+    if table == "pg_range":
+        return []
+    if table == "tables":  # information_schema.tables
+        return [
+            {"table_catalog": "corrosion", "table_schema": "public",
+             "table_name": t.name, "table_type": "BASE TABLE"}
+            for t in tables
+        ]
+    if table == "columns":  # information_schema.columns
+        rows = []
+        for t in tables:
+            for i, c in enumerate(t.columns):
+                rows.append({
+                    "table_schema": "public", "table_name": t.name,
+                    "column_name": c.name, "ordinal_position": i + 1,
+                    "data_type": c.sql_type.lower(),
+                    "is_nullable": "NO" if (c.not_null or c.primary_key)
+                    else "YES",
+                })
+        return rows
+    return []
+
+
+_CATALOG_TABLES = (
+    "pg_class", "pg_attribute", "pg_type", "pg_namespace", "pg_database",
+    "pg_range", "tables", "columns",
+)
+# a query is a catalog query only when its FROM target is a catalog
+# table — a user query merely *mentioning* pg_class in a literal must
+# still run against the real store
+_CATALOG_FROM_RE = re.compile(
+    r"\bFROM\s+(?:PG_CATALOG\.\w+|INFORMATION_SCHEMA\.\w+|"
+    r"PG_(?:CLASS|ATTRIBUTE|TYPE|NAMESPACE|DATABASE|RANGE|TABLES)\b)",
+    re.IGNORECASE,
+)
+_CATALOG_RE = re.compile(
+    r"^SELECT\s+(?P<cols>.*?)\s+FROM\s+"
+    r"(?:pg_catalog\.|information_schema\.)?(?P<table>\w+)"
+    r"(?:\s+(?:AS\s+)?(?P<alias>(?!WHERE|ORDER|LIMIT)\w+))?"
+    r"(?:\s+WHERE\s+(?P<where>.*?))?"
+    r"(?:\s+ORDER\s+BY\s+(?P<order>.*?))?"
+    r"(?:\s+LIMIT\s+(?P<limit>\d+))?\s*;?\s*$",
+    re.IGNORECASE | re.DOTALL,
+)
+
+
+def _catalog_literal(tok: str, db, params, pos: List[int]) -> Any:
+    """A catalog WHERE literal: int, 'str', ``$N``/``?`` param (``pos``
+    is the running positional-``?`` counter), or ``'name'::regclass``
+    (resolved to the relation oid)."""
+    tok = tok.strip()
+    m = re.match(r"^'([^']*)'\s*::\s*regclass$", tok,
+                               re.IGNORECASE)
+    if m:
+        name = m.group(1).split(".")[-1]
+        for row in _catalog_rows(db, "pg_class"):
+            if row["relname"] == name:
+                return row["oid"]
+        return -1
+    plist = list(params or [])
+    nm = re.match(r"^\$(\d+)$", tok)
+    if nm:
+        i = int(nm.group(1)) - 1
+        return plist[i] if 0 <= i < len(plist) else None
+    if tok == "?":
+        i = pos[0]
+        pos[0] += 1
+        return plist[i] if i < len(plist) else None
+    if tok.startswith("'") and tok.endswith("'"):
+        return tok[1:-1].replace("''", "'")
+    try:
+        return int(tok)
+    except ValueError:
+        return tok
+
+
+def _answer_catalog(db, sql: str, params) -> Optional[Tuple[List[str], List[List[Any]]]]:
+    """Try to answer a catalog introspection query from the live schema.
+    Returns (cols, rows) or None when the shape is unrecognized (caller
+    degrades to an empty result set)."""
+    m = _CATALOG_RE.match(sql.strip())
+    if m is None or m.group("table").lower() not in _CATALOG_TABLES:
+        return None
+    table = m.group("table").lower()
+    alias = (m.group("alias") or table).lower()
+    rows = _catalog_rows(db, table)
+    known = set(rows[0]) if rows else set()
+
+    def strip_alias(ident):
+        ident = ident.strip().strip('"')
+        if "." in ident:
+            q, _, c = ident.partition(".")
+            if q.lower() not in (alias, table):
+                return None
+            ident = c.strip('"')
+        return ident.lower()
+
+    # WHERE: conjunction of col = literal / col IN (lit, ...)
+    if m.group("where"):
+        pos = [0]  # running positional-? parameter counter
+        for clause in re.split(r"\s+AND\s+", m.group("where"),
+                                flags=re.IGNORECASE):
+            cm = re.match(r"^([\w\".]+)\s*=\s*(.+)$", clause.strip(),
+                           re.DOTALL)
+            im = re.match(r"^([\w\".]+)\s+IN\s*\((.+)\)$", clause.strip(),
+                           re.IGNORECASE | re.DOTALL)
+            if im:
+                col = strip_alias(im.group(1))
+                if col is None or (rows and col not in known):
+                    return None
+                vals = {_catalog_literal(t, db, params, pos)
+                        for t in im.group(2).split(",")}
+                rows = [r for r in rows if r.get(col) in vals]
+            elif cm:
+                col = strip_alias(cm.group(1))
+                if col is None or (rows and col not in known):
+                    return None
+                val = _catalog_literal(cm.group(2), db, params, pos)
+                rows = [r for r in rows
+                        if r.get(col) == val or str(r.get(col)) == str(val)]
+            else:
+                return None
+
+    # projection
+    raw = m.group("cols").strip()
+    if raw == "*":
+        names = sorted(known) if rows else []
+    else:
+        names = []
+        for part in raw.split(","):
+            am = re.match(r"^(.*?)\s+AS\s+[\"']?([\w ]+)[\"']?\s*$",
+                           part.strip(), re.IGNORECASE | re.DOTALL)
+            ident = am.group(1) if am else part
+            col = strip_alias(ident)
+            if col is None or col == "count(*)":
+                return None
+            names.append(col)
+        for n in names:
+            if rows and n not in known:
+                return None
+
+    # ORDER BY col [DESC] (output columns only)
+    if m.group("order"):
+        for part in reversed(m.group("order").split(",")):
+            toks = part.split()
+            desc = len(toks) > 1 and toks[-1].upper() == "DESC"
+            col = strip_alias(toks[0])
+            if col is None or (rows and col not in known):
+                return None
+            # ints compare numerically, strings lexically (type-tagged so
+            # attnum 10 sorts after 2, not between 1 and 2)
+            rows = sorted(
+                rows,
+                key=lambda r: (r.get(col) is not None,
+                               isinstance(r.get(col), str), r.get(col)),
+                reverse=desc,
+            )
+    if m.group("limit"):
+        rows = rows[: int(m.group("limit"))]
+    return names, [[r.get(n) for n in names] for r in rows]
 
 
 def _text_value(v: Any) -> Optional[bytes]:
@@ -328,6 +542,7 @@ def _make_handler(server: PgServer):
             """``send_desc``: simple query includes RowDescription;
             extended Execute must NOT (the client learned the shape from
             Describe — a second 'T' is a protocol violation)."""
+            orig_sql = sql  # pre-translation (keeps ::regclass casts)
             sql = _translate_sql(sql)
             if not sql or sql.rstrip(";") == "":
                 self.out.add(b"I", b"")  # EmptyQueryResponse
@@ -346,11 +561,21 @@ def _make_handler(server: PgServer):
                 self._data_row([""])
                 self._command_complete("SHOW")
                 return
-            if "PG_CATALOG" in upper or "INFORMATION_SCHEMA" in upper:
-                # the reference fakes these via vtabs; we answer empty
+            if _CATALOG_FROM_RE.search(upper):
+                # introspection served from the live schema (vtab analog);
+                # unrecognized shapes degrade to an empty result set
+                answer = _answer_catalog(server.db, orig_sql, params)
+                if answer is None:
+                    if send_desc:
+                        self._row_description(["?column?"])
+                    self._command_complete("SELECT 0")
+                    return
+                cols, rows = answer
                 if send_desc:
-                    self._row_description(["?column?"])
-                self._command_complete("SELECT 0")
+                    self._row_description(cols)
+                for row in rows:
+                    self._data_row(row)
+                self._command_complete(f"SELECT {len(rows)}")
                 return
             if upper.startswith("SELECT"):
                 self._run_select(sql, params, send_desc)

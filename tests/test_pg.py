@@ -188,6 +188,54 @@ def test_pg_catalog_stub_and_errors(pg):
     assert err is not None
 
 
+def test_pg_catalog_introspection(pg):
+    """pg_class/pg_attribute/pg_namespace/pg_type answer with real schema
+    rows (the reference's vtabs, src/vtab/pg_*.rs) — the psql-style
+    introspection flow: list tables, then describe one."""
+    _, _, _, c = pg
+    cols, rows, tag, err = c.query(
+        "SELECT relname FROM pg_catalog.pg_class "
+        "WHERE relnamespace = 2200 ORDER BY relname")
+    assert err is None and cols == ["relname"]
+    assert [r[0] for r in rows] == ["users"]
+
+    cols, rows, _, err = c.query(
+        "SELECT oid FROM pg_class WHERE relname = 'users'")
+    assert err is None and len(rows) == 1
+    oid = int(rows[0][0])
+
+    cols, rows, _, err = c.query(
+        "SELECT attname, atttypid FROM pg_catalog.pg_attribute "
+        f"WHERE attrelid = {oid} ORDER BY attnum")
+    assert err is None
+    assert [r[0] for r in rows] == ["id", "name", "score"]
+
+    # the regclass cast psql uses for \d
+    cols, rows, _, err = c.query(
+        "SELECT attname FROM pg_attribute "
+        "WHERE attrelid = 'users'::regclass ORDER BY attnum")
+    assert err is None and len(rows) == 3
+
+    cols, rows, _, err = c.query(
+        "SELECT nspname FROM pg_namespace ORDER BY oid")
+    assert err is None
+    assert [r[0] for r in rows] == ["pg_catalog", "public"]
+
+    cols, rows, _, err = c.query(
+        "SELECT typname FROM pg_type WHERE oid = 25")
+    assert err is None and rows == [["text"]]
+
+    cols, rows, _, err = c.query(
+        "SELECT table_name FROM information_schema.tables "
+        "WHERE table_schema = 'public'")
+    assert err is None and rows == [["users"]]
+
+    cols, rows, _, err = c.query(
+        "SELECT column_name, data_type FROM information_schema.columns "
+        "WHERE table_name = 'users' ORDER BY ordinal_position")
+    assert err is None and [r[0] for r in rows] == ["id", "name", "score"]
+
+
 def test_literal_with_semicolon_and_cast(pg):
     _, _, _, c = pg
     _, _, tag, err = c.query(
@@ -243,3 +291,15 @@ def test_node_selection_via_database_name(pg):
     _, rows, _, err = c2.query("SELECT name FROM users WHERE id = 1")
     c2.close()
     assert err is None and rows == [["ada"]]
+
+
+def test_user_query_mentioning_catalog_name_not_hijacked(pg):
+    """A literal like 'pg_type' in a user query must not trip the
+    catalog branch (it previously degraded to an empty result set)."""
+    _, _, _, c = pg
+    _, _, tag, err = c.query(
+        "INSERT INTO users (id, name, score) VALUES (77, 'pg_type', 1)")
+    assert err is None
+    cols, rows, tag, err = c.query(
+        "SELECT id FROM users WHERE name = 'pg_type'")
+    assert err is None and rows == [["77"]]

@@ -139,3 +139,23 @@ def test_consul_sync(rig, fake_consul):
     assert n_svc == 1
     agent.wait_rounds(2, timeout=60)
     assert db.read_row(0, "consul_services", "web-1") is None
+
+
+def test_render_template_order_by_and_aggregate(rig):
+    """Templates lean on the grown SQL surface (VERDICT #8): ORDER BY
+    drives deterministic config output, aggregates drive summary lines —
+    the shapes the reference's Rhai templates run against full SQLite."""
+    _, db, _ = rig
+    db.execute(0, [
+        ("INSERT INTO svc (name, addr, port) VALUES ('api', '10.0.0.2', 81)",),
+        ("INSERT INTO svc (name, addr, port) VALUES ('cache', '10.0.0.3', 82)",),
+    ])
+    tpl = """
+for r in sql("SELECT name, port FROM svc ORDER BY port DESC LIMIT 2"):
+    write(f"{r['name']}:{r['port']}\\n")
+n = sql("SELECT COUNT(*) AS n FROM svc")[0]["n"]
+write(f"# {n} services\\n")
+"""
+    out, queries = render_template(tpl, lambda q, p: db.query(0, q, p))
+    assert out.splitlines() == ["cache:82", "api:81", "# 3 services"]
+    assert len(queries) == 2
