@@ -88,7 +88,12 @@ class AdminServer:
         if name == "cluster_members":
             return {"ok": agent.members()}
         if name == "cluster_set_id":
+            # live ClusterId change (corro-admin/src/lib.rs:135-140): the
+            # id gates payload delivery — nodes on a different id stop
+            # exchanging traffic until ids agree again
             self.cluster_id = int(cmd["cluster_id"])
+            nodes = cmd.get("nodes")  # None = whole cluster
+            agent.set_cluster_id(self.cluster_id, nodes=nodes)
             return {"ok": self.cluster_id}
         if name == "cluster_rejoin":
             agent.revive_node(int(cmd["node"]))

@@ -86,6 +86,7 @@ class Agent:
             n_regions=self.config.gossip.n_regions,
         )
         self._key = jr.key(sim.seed)
+        self._bootstrap_from_members_file()
 
         self.metrics = Registry()
         self.locks = LockRegistry(logger=logger)
@@ -117,6 +118,62 @@ class Agent:
         self._snapshot_host = None  # (round_no, store planes, heads, alive)
         self._thread = None
         self._listeners = []  # subscription manager hooks
+
+    def _bootstrap_from_members_file(self) -> None:
+        """Replay a persisted member list into the fresh SWIM state — the
+        ``__corro_members`` bootstrap (``initialise_foca``'s ApplyMany
+        from the DB, ``util.rs:69-130``): a restarted cluster starts from
+        yesterday's membership instead of only the static seed set. The
+        maintenance loop keeps the file fresh (``broadcast/mod.rs:814-949``
+        persists foca state diffs every 60 s)."""
+        import json
+        import os
+
+        path = getattr(self.config.db, "members_path", "")
+        if not path or not os.path.exists(path):
+            return
+        try:
+            with open(path) as f:
+                dump = json.load(f)
+            members = [
+                (int(m[0]), int(m[1]))
+                for m in dump.get("members", [])
+                if 0 <= int(m[0]) < self.n_nodes
+            ]
+        except (OSError, ValueError, KeyError, TypeError, IndexError):
+            logger.exception("members bootstrap file unreadable; skipping")
+            return
+        if not members:
+            return
+        ids = [m[0] for m in members]
+        incs = [m[1] for m in members]
+        if self.mode == "scale":
+            from corrosion_tpu.sim.scale import bootstrap_members
+        else:
+            from corrosion_tpu.sim.swim import bootstrap_members
+        self._state = self._state._replace(
+            swim=bootstrap_members(self._state.swim, ids, incs)
+        )
+        logger.info("bootstrapped %d members from %s", len(members), path)
+
+    def persist_members(self, path: str) -> None:
+        """Dump the alive member list (id, incarnation) for restart
+        bootstrap — the ``__corro_members`` upsert."""
+        import json
+        import os
+
+        snap = self.snapshot()
+        members = [
+            [int(i), int(inc)]
+            for i, (a, inc) in enumerate(
+                zip(snap["alive"], snap["incarnation"])
+            )
+            if bool(a)
+        ]
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump({"round": snap["round"], "members": members}, f)
+        os.replace(tmp, path)
 
     # --- lifecycle ------------------------------------------------------
     def start(self, pace_seconds: float = 0.0):
@@ -369,6 +426,21 @@ class Agent:
 
     def heal_partition(self):
         self.set_partition(np.zeros(self.n_nodes, np.int32))
+
+    def set_cluster_id(self, cluster_id: int, nodes=None):
+        """Stamp ``nodes`` (default: all) with a ClusterId. Mismatched
+        payloads stop delivering — the uni-drop / sync-rejection gate
+        (``uni.rs:75-77``, ``peer/mod.rs:1425-1436``); settable live via
+        admin (``corro-admin/src/lib.rs:135-140``)."""
+        with self._input_lock:
+            ids = np.asarray(self._net.cluster_id)
+            if nodes is None:
+                ids = np.full(self.n_nodes, int(cluster_id), np.int32)
+            else:
+                ids = ids.copy()
+                for node in nodes:
+                    ids[int(node)] = int(cluster_id)
+            self._net = self._net._replace(cluster_id=jnp.asarray(ids))
 
     def set_regions(self, regions: np.ndarray):
         """Assign geographic region per node (drives the RTT rings).

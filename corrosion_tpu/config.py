@@ -35,6 +35,11 @@ class DbConfig:
     schema_paths: tuple = ()
     # auto-checkpoint cadence in rounds (WAL-checkpoint analog); 0 = off
     checkpoint_rounds: int = 0
+    # membership persistence (the __corro_members table analog,
+    # broadcast/mod.rs:814-949): the maintenance loop dumps the member
+    # list here; a booting agent bootstraps its SWIM views from it
+    # (initialise_foca's ApplyMany-from-DB, util.rs:69-130). "" = off
+    members_path: str = ""
 
 
 @dataclasses.dataclass

@@ -50,6 +50,7 @@ class MaintenanceLoop:
             if latest and latest.endswith("auto-a"):
                 self._flip = True  # next write goes to auto-b
         self._warned_heap = False
+        self._last_members_round = -1
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> "MaintenanceLoop":
@@ -85,6 +86,13 @@ class MaintenanceLoop:
             self._last_ckpt_round = rounds
             self.agent.metrics.counter("corro.db.checkpoint.count")
             logger.info("auto-checkpoint at round %d -> %s", rounds, target)
+        members_path = getattr(self.agent.config.db, "members_path", "")
+        if members_path and rounds != self._last_members_round:
+            # the __corro_members upsert (foca-state diff persistence,
+            # broadcast/mod.rs:814-949): keep the restart-bootstrap list
+            # fresh; a booting agent replays it (util.rs:69-130)
+            self.agent.persist_members(members_path)
+            self._last_members_round = rounds
         if self.db is not None:
             heap_len = len(self.db.heap)
             self.agent.metrics.gauge("corro.db.value_heap.len", heap_len)

@@ -129,6 +129,42 @@ class ScaleSwimState(NamedTuple):
         )
 
 
+def bootstrap_members(st: ScaleSwimState, member_ids,
+                      incarnations=None) -> "ScaleSwimState":
+    """Seed every node's bounded member table with a persisted member
+    list (the ``__corro_members`` replay at boot, ``util.rs:69-130``).
+    Entries land in their hash class; collisions keep the later id (the
+    table's random-eviction partial-view semantics)."""
+    import numpy as np
+
+    n, m = st.mem_id.shape
+    ids = np.asarray(member_ids, np.int32)
+    incs = (np.asarray(incarnations, np.int32) if incarnations is not None
+            else np.zeros(ids.shape, np.int32))
+    in_range = (ids >= 0) & (ids < n)
+    ids, incs = ids[in_range], incs[in_range]
+    # dedupe hash-colliding slots host-side (last id wins) so the two
+    # scatters below never see duplicate indices — XLA leaves duplicate-
+    # index .set order-undefined, which could tear (id, view) pairs
+    by_slot = {int(i) % m: (int(i), int(inc)) for i, inc in zip(ids, incs)}
+    if not by_slot:
+        return st
+    slots_np = np.fromiter(by_slot.keys(), np.int32)
+    ids = np.asarray([v[0] for v in by_slot.values()], np.int32)
+    incs = np.asarray([v[1] for v in by_slot.values()], np.int32)
+    mem_id, mem_view = st.mem_id, st.mem_view
+    keys = pack_inc_state(jnp.asarray(incs), jnp.int32(STATE_ALIVE))
+    slots = jnp.asarray(slots_np)
+    mem_id = mem_id.at[:, slots].set(jnp.asarray(ids)[None, :])
+    mem_view = mem_view.at[:, slots].set(keys[None, :])
+    # self entry always wins its hash class back
+    iarr = jnp.arange(n, dtype=jnp.int32)
+    self_key = pack_inc_state(st.inc, jnp.int32(STATE_ALIVE))
+    mem_id = mem_id.at[iarr, iarr % m].set(iarr)
+    mem_view = mem_view.at[iarr, iarr % m].set(self_key)
+    return st._replace(mem_id=mem_id, mem_view=mem_view)
+
+
 def _one_sender_per_receiver(n, src_valid, tgt, key):
     """Pick one sender per receiver from competing (sender -> tgt) edges.
 

@@ -71,6 +71,27 @@ class SwimState(NamedTuple):
         )
 
 
+def bootstrap_members(st: SwimState, member_ids, incarnations=None) -> "SwimState":
+    """Seed every node's view with a persisted member list — the boot
+    path that replays ``__corro_members`` into foca (``initialise_foca``'s
+    ApplyMany, ``crates/corro-agent/src/agent/util.rs:69-130``): restart
+    with yesterday's membership instead of just the static seed set."""
+    import numpy as np
+
+    n = st.view.shape[0]
+    ids_np = np.asarray(member_ids, np.int32)
+    incs_np = (np.asarray(incarnations, np.int32)
+               if incarnations is not None
+               else np.zeros(ids_np.shape, np.int32))
+    in_range = (ids_np >= 0) & (ids_np < n)  # foreign ids dropped, never
+    ids_np, incs_np = ids_np[in_range], incs_np[in_range]  # clipped onto
+    if ids_np.size == 0:  # a real node's view
+        return st
+    keys = pack_inc_state(jnp.asarray(incs_np), jnp.int32(STATE_ALIVE))
+    view = st.view.at[:, jnp.asarray(ids_np)].max(keys[None, :])
+    return st._replace(view=view)
+
+
 def swim_step(
     cfg: SimConfig,
     st: SwimState,

@@ -46,6 +46,9 @@ class NetModel(NamedTuple):
     partition: jax.Array  # int32 [N] — partition group per node
     drop_prob: jax.Array  # float32 scalar — per-message loss probability
     region: jax.Array  # int32 [N] — geographic region id
+    cluster_id: jax.Array  # int32 [N] — ClusterId stamped on payloads;
+    # mismatched traffic drops (uni payloads ``uni.rs:75-77``, sync
+    # rejection ``peer/mod.rs:1425-1436``); settable live via admin
 
     @staticmethod
     def create(n_nodes: int, drop_prob: float = 0.0,
@@ -54,6 +57,7 @@ class NetModel(NamedTuple):
             partition=jnp.zeros(n_nodes, jnp.int32),
             drop_prob=jnp.float32(drop_prob),
             region=(jnp.arange(n_nodes, dtype=jnp.int32) % max(1, n_regions)),
+            cluster_id=jnp.zeros(n_nodes, jnp.int32),
         )
 
 def ring_of(net: NetModel, src, dst):
@@ -72,11 +76,14 @@ def same_region(net: NetModel):
 
 
 def _link_ok(net: NetModel, alive, src, dst):
-    """Both endpoints up and in the same partition group."""
+    """Both endpoints up, same partition group, same cluster id (a
+    payload stamped with a foreign ClusterId is dropped at the receiver,
+    ``uni.rs:75-77`` / ``peer/mod.rs:1425-1436``)."""
     return (
         alive[src]
         & alive[dst]
         & (net.partition[src] == net.partition[dst])
+        & (net.cluster_id[src] == net.cluster_id[dst])
     )
 
 

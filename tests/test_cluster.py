@@ -88,3 +88,29 @@ def test_churn_mix_converges_after_quiesce(cfg):
     st, _ = settle(cfg, st, NetModel.create(N), jr.key(43), 150)
     m = crdt_metrics(cfg, st)
     assert bool(m["converged"]), (int(m["n_diverged"]), int(m["total_needs"]))
+
+
+def test_cluster_id_gates_payload_delivery(cfg):
+    """ClusterId payload gating (uni.rs:75-77, peer/mod.rs:1425-1436):
+    nodes stamped with a foreign cluster id receive nothing — no
+    broadcast, no sync — until the id is set back, then they catch up."""
+    st = SimState.create(cfg)
+    net = NetModel.create(N)
+    # last 4 nodes sit on cluster id 7
+    foreign = np.zeros(N, np.int32)
+    foreign[-4:] = 7
+    net_split = net._replace(cluster_id=jnp.asarray(foreign))
+    key = jr.key(40)
+    inp = scenario.single_writer(cfg, 10, jr.key(41), writes_per_round=1)
+    st, _ = run_rounds(cfg, st, net_split, key, inp)
+    st, _ = settle(cfg, st, net_split, jr.key(42), 40)
+    heads = np.asarray(st.crdt.book.head)
+    assert (heads[:-4, 0] == 10).all(), "same-id nodes must converge"
+    assert (heads[-4:, 0] == 0).all(), (
+        "foreign-id nodes must receive no payloads"
+    )
+    # admin sets the id back -> sync repairs the gap
+    st, _ = settle(cfg, st, net, jr.key(43), 80)
+    m = crdt_metrics(cfg, st)
+    assert bool(m["converged"])
+    assert (np.asarray(st.crdt.book.head)[:, 0] == 10).all()

@@ -103,3 +103,24 @@ def test_partition_then_heal(stepper):
     st, key = run(step, st, net, key, 200)
     m = swim_metrics(st)
     assert bool(m["converged"]), float(m["accuracy"])
+
+
+def test_bootstrap_members_full_view():
+    """Persisted-members replay into the full-view sim: every node starts
+    believing the listed members alive (initialise_foca ApplyMany)."""
+    import numpy as np
+
+    from corrosion_tpu.ops.lww import STATE_ALIVE
+    from corrosion_tpu.sim.config import SimConfig
+    from corrosion_tpu.sim.swim import SwimState, bootstrap_members
+
+    cfg = SimConfig(n_nodes=12).validate()
+    st = SwimState.create(cfg, n_seeds=2)
+    st = bootstrap_members(st, [5, 9, 11], incarnations=[0, 3, 1])
+    view = np.asarray(st.view)
+    for nid, inc in ((5, 0), (9, 3), (11, 1)):
+        col = view[:, nid]
+        assert ((col & 3) == STATE_ALIVE).all()
+        assert (col >> 2 >= inc).all()  # incarnation carried over
+    # unlisted non-seed members stay unknown
+    assert (view[:, 4][np.arange(12) != 4] == -1).all()
