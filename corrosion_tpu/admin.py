@@ -91,10 +91,12 @@ class AdminServer:
             # live ClusterId change (corro-admin/src/lib.rs:135-140): the
             # id gates payload delivery — nodes on a different id stop
             # exchanging traffic until ids agree again
-            self.cluster_id = int(cmd["cluster_id"])
+            new_id = int(cmd["cluster_id"])
             nodes = cmd.get("nodes")  # None = whole cluster
-            agent.set_cluster_id(self.cluster_id, nodes=nodes)
-            return {"ok": self.cluster_id}
+            agent.set_cluster_id(new_id, nodes=nodes)
+            if nodes is None:  # the server-wide id only moves wholesale
+                self.cluster_id = new_id
+            return {"ok": new_id}
         if name == "cluster_rejoin":
             agent.revive_node(int(cmd["node"]))
             return {"ok": True}

@@ -158,21 +158,23 @@ class Agent:
 
     def persist_members(self, path: str) -> None:
         """Dump the alive member list (id, incarnation) for restart
-        bootstrap — the ``__corro_members`` upsert."""
+        bootstrap — the ``__corro_members`` upsert. Reads only the two
+        [N] liveness vectors (not the full store snapshot — at 100k that
+        transfer is hundreds of MB the maintenance tick must not pay)."""
         import json
         import os
 
-        snap = self.snapshot()
+        st = self._state
+        alive = np.asarray(st.swim.alive)
+        inc = np.asarray(
+            getattr(st.swim, "inc", getattr(st.swim, "incarnation", None))
+        )
         members = [
-            [int(i), int(inc)]
-            for i, (a, inc) in enumerate(
-                zip(snap["alive"], snap["incarnation"])
-            )
-            if bool(a)
+            [int(i), int(inc[i])] for i in np.nonzero(alive)[0]
         ]
         tmp = f"{path}.tmp"
         with open(tmp, "w") as f:
-            json.dump({"round": snap["round"], "members": members}, f)
+            json.dump({"round": self.round_no, "members": members}, f)
         os.replace(tmp, path)
 
     # --- lifecycle ------------------------------------------------------
@@ -439,7 +441,12 @@ class Agent:
             else:
                 ids = ids.copy()
                 for node in nodes:
-                    ids[int(node)] = int(cluster_id)
+                    node = int(node)
+                    if not (0 <= node < self.n_nodes):
+                        raise ValueError(
+                            f"node {node} out of range (n_nodes={self.n_nodes})"
+                        )
+                    ids[node] = int(cluster_id)
             self._net = self._net._replace(cluster_id=jnp.asarray(ids))
 
     def set_regions(self, regions: np.ndarray):

@@ -1,0 +1,128 @@
+"""Backend-adaptive column lookups/scatters over small static-width tables.
+
+On the target TPU backend, per-ELEMENT index ops — ``take_along_axis``,
+``x.reshape(-1)[flat]``, ``.at[flat].set/add/max`` — execute at ~9 ns per
+element (measured: a [100k, 64] ``take_along_axis`` into a [100k, 16]
+table costs ~59 ms, ~100x the bandwidth cost), while slices and
+elementwise kernels run at full HBM speed. The protocol state is full of
+tiny per-row tables (per-origin heads [N, 16], queue slots [N, 32],
+member slots [N, 64]) indexed by data — so on TPU every such
+lookup/scatter is re-expressed as a **static unrolled loop over the
+table's columns** with elementwise compare+select, which XLA fuses into
+a handful of full-bandwidth kernels.
+
+On CPU the loop form is W× more arithmetic for a scalar core (and W×
+the HLO to compile), so the element-indexed form is kept there. Both
+forms are semantically identical — callers guarantee one writer per
+(row, column) for set-scatters — and ``FORCE_DENSE`` pins a form for
+differential unit tests.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+INT32_MIN = jnp.int32(-2147483648)
+
+# None = decide by backend (dense loops everywhere except CPU);
+# True/False pin the dense/element form (tests)
+FORCE_DENSE: Optional[bool] = None
+
+
+def _dense() -> bool:
+    if FORCE_DENSE is not None:
+        return FORCE_DENSE
+    return jax.default_backend() != "cpu"
+
+
+def _flat(idx, valid, n, w):
+    # out-of-range indices are invalid on BOTH forms (the dense loop
+    # ignores them structurally; mask here so the element form cannot
+    # wrap into a neighboring row)
+    valid = valid & (idx >= 0) & (idx < w)
+    rows = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[:, None], idx.shape)
+    return jnp.where(valid, rows * w + idx, n * w)
+
+
+def lookup_cols(table, idx, fill=0):
+    """``out[n, m] = table[n, idx[n, m]]`` for a small static table width
+    (``fill`` where idx is out of range) — replaces
+    ``take_along_axis(table, idx, axis=1)``."""
+    w = table.shape[1]
+    in_range = (idx >= 0) & (idx < w)
+    if not _dense():
+        got = jnp.take_along_axis(table, jnp.clip(idx, 0, w - 1), axis=1)
+        return jnp.where(in_range, got, jnp.asarray(fill, table.dtype))
+    out = jnp.full(idx.shape, fill, table.dtype)
+    for c in range(w):
+        out = jnp.where(idx == c, table[:, c:c + 1], out)
+    return out
+
+
+def scatter_cols_max(dest, idx, vals, valid):
+    """``dest[n, idx[n, m]] = max(dest, vals[n, m])`` where valid."""
+    n, w = dest.shape
+    if not _dense():
+        flat = _flat(idx, valid, n, w)
+        return (
+            dest.reshape(-1)
+            .at[flat.reshape(-1)]
+            .max(vals.reshape(-1), mode="drop")
+            .reshape(n, w)
+        )
+    cols = []
+    for c in range(w):
+        m = valid & (idx == c)
+        upd = jnp.max(jnp.where(m, vals, INT32_MIN.astype(vals.dtype)), axis=1)
+        cols.append(jnp.maximum(dest[:, c], upd))
+    return jnp.stack(cols, axis=1)
+
+
+def scatter_cols_add(dest, idx, vals, valid):
+    """``dest[n, idx[n, m]] += vals[n, m]`` where valid."""
+    n, w = dest.shape
+    if not _dense():
+        flat = _flat(idx, valid, n, w)
+        return (
+            dest.reshape(-1)
+            .at[flat.reshape(-1)]
+            .add(vals.reshape(-1), mode="drop")
+            .reshape(n, w)
+        )
+    cols = []
+    for c in range(w):
+        m = valid & (idx == c)
+        cols.append(dest[:, c] + jnp.sum(jnp.where(m, vals, 0), axis=1))
+    return jnp.stack(cols, axis=1)
+
+
+def scatter_cols_set(dest, idx, vals, valid):
+    """``dest[n, idx[n, m]] = vals[n, m]`` where valid; at most one valid
+    writer per (row, column) — the unique-slot scatter (queue placement,
+    slot tables). With duplicate writers the max value wins on the dense
+    path (deterministic) while the element path keeps the last."""
+    n, w = dest.shape
+    if not _dense():
+        flat = _flat(idx, valid, n, w)
+        return (
+            dest.reshape(-1)
+            .at[flat.reshape(-1)]
+            .set(vals.reshape(-1), mode="drop")
+            .reshape(n, w)
+        )
+    cols = []
+    for c in range(w):
+        m = valid & (idx == c)
+        has = jnp.any(m, axis=1)
+        v = jnp.max(jnp.where(m, vals, INT32_MIN.astype(vals.dtype)), axis=1)
+        cols.append(jnp.where(has, v, dest[:, c]))
+    return jnp.stack(cols, axis=1)
+
+
+def select_cols(rows, idx):
+    """``out[n, m] = rows[n, idx[n, m]]`` — alias of :func:`lookup_cols`
+    for [N, W] payload rows picked by per-row slot indices."""
+    return lookup_cols(rows, idx)

@@ -19,6 +19,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from corrosion_tpu.ops.dense import lookup_cols, scatter_cols_set
+
 
 def alloc_slots(free, want):
     """Assign free slots of each row to wanting items of the same row.
@@ -33,7 +35,7 @@ def alloc_slots(free, want):
     n_free = jnp.sum(free, axis=1).astype(jnp.int32)
     rank = (jnp.cumsum(want, axis=1) - 1).astype(jnp.int32)
     placed = want & (rank < n_free[:, None])
-    slot = jnp.take_along_axis(slot_order, jnp.clip(rank, 0, k - 1), axis=1)
+    slot = lookup_cols(slot_order, jnp.clip(rank, 0, k - 1))
     return slot, placed
 
 
@@ -52,7 +54,7 @@ def alloc_slots_evict(free, evict_key, want):
     slot_order = jnp.argsort(key, axis=1, stable=True).astype(jnp.int32)
     rank = (jnp.cumsum(want, axis=1) - 1).astype(jnp.int32)
     placed = want & (rank < k)
-    slot = jnp.take_along_axis(slot_order, jnp.clip(rank, 0, k - 1), axis=1)
+    slot = lookup_cols(slot_order, jnp.clip(rank, 0, k - 1))
     return slot, placed
 
 
@@ -75,27 +77,18 @@ def budget_mask(live, priority, allowed):
     order = jnp.argsort(
         jnp.where(live, -priority, jnp.int32(2147483647)), axis=1, stable=True
     ).astype(jnp.int32)
-    rank = jnp.zeros((n, k), jnp.int32)
     rank = scatter_rows(
-        rank, order, jnp.ones((n, k), bool),
+        jnp.zeros((n, k), jnp.int32), order, jnp.ones((n, k), bool),
         jnp.broadcast_to(jnp.arange(k, dtype=jnp.int32)[None, :], (n, k)),
     )
     return live & (rank < allowed[:, None])
 
 
 def scatter_rows(dest, slot, placed, values):
-    """``dest[i, slot[i,j]] = values[i,j]`` where ``placed`` — flat scatter."""
-    n, k = dest.shape
-    rows = jnp.broadcast_to(
-        jnp.arange(n, dtype=jnp.int32)[:, None], slot.shape
-    )
-    flat = jnp.where(placed, rows * k + slot, n * k)
-    return (
-        dest.reshape(-1)
-        .at[flat.reshape(-1)]
-        .set(values.reshape(-1), mode="drop")
-        .reshape(n, k)
-    )
+    """``dest[i, slot[i,j]] = values[i,j]`` where ``placed`` — one writer
+    per (row, slot). Loop-scatter over the static slot axis (see
+    ``ops/dense.py`` for why flat element scatters are avoided)."""
+    return scatter_cols_set(dest, slot, values, placed)
 
 
 def mailbox_pack(recv, valid, n_rows: int, capacity: int, fields):

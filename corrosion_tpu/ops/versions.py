@@ -34,6 +34,11 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from corrosion_tpu.ops.dense import (
+    lookup_cols,
+    scatter_cols_add,
+    scatter_cols_max,
+)
 from corrosion_tpu.ops.slots import alloc_slots, scatter_rows
 
 NO_ORIGIN = jnp.int32(-1)  # free buffer slot marker
@@ -97,17 +102,7 @@ def record_versions(book: Book, origin, ver, valid):
 
 def _scatter_max(dest, origin, ver, valid):
     """``dest[i, origin[i,j]] = max(dest, ver[i,j])`` where valid."""
-    n_nodes, n_origins = dest.shape
-    rows = jnp.broadcast_to(
-        jnp.arange(n_nodes, dtype=jnp.int32)[:, None], origin.shape
-    )
-    flat = jnp.where(valid, rows * n_origins + origin, n_nodes * n_origins)
-    return (
-        dest.reshape(-1)
-        .at[flat.reshape(-1)]
-        .max(ver.reshape(-1), mode="drop")
-        .reshape(dest.shape)
-    )
+    return scatter_cols_max(dest, origin, ver, valid)
 
 
 def bump_known_max(book: Book, origin, ver, valid) -> Book:
@@ -127,7 +122,7 @@ def seen_versions(book: Book, origin, ver, valid):
     [N, M] — true when the version is at/below the contiguous head or
     parked in the out-of-order buffer (the seen-cache + bookie check of
     ``handle_changes``, ``handlers.rs:548-786``)."""
-    behind_head = ver <= jnp.take_along_axis(book.head, origin, axis=1)
+    behind_head = ver <= lookup_cols(book.head, origin)
     in_buffer = jnp.any(
         (book.buf_origin[:, None, :] == origin[:, :, None])
         & (book.buf_ver[:, None, :] == ver[:, :, None]),
@@ -152,15 +147,17 @@ def advance_heads(book: Book) -> Book:
     free = book.buf_origin == NO_ORIGIN
     o_key = jnp.where(free, jnp.int32(n_origins), book.buf_origin)
 
-    def sort_one(o, v):
-        order = jnp.lexsort((v, o)).astype(jnp.int32)
-        return o[order], v[order]
+    # lexsort by (origin, version), batched over nodes: two stable
+    # argsort passes (a vmapped jnp.lexsort lowers to per-row sorts on
+    # TPU; the batched form is one [N, K] sort kernel per pass)
+    order1 = jnp.argsort(book.buf_ver, axis=1, stable=True).astype(jnp.int32)
+    o1 = jnp.take_along_axis(o_key, order1, axis=1)
+    order2 = jnp.argsort(o1, axis=1, stable=True).astype(jnp.int32)
+    order = jnp.take_along_axis(order1, order2, axis=1)
+    o_s = jnp.take_along_axis(o_key, order, axis=1)
+    v_s = jnp.take_along_axis(book.buf_ver, order, axis=1)
 
-    o_s, v_s = jax.vmap(sort_one)(o_key, book.buf_ver)
-
-    head_at = jnp.take_along_axis(
-        book.head, jnp.clip(o_s, 0, n_origins - 1), axis=1
-    )
+    head_at = lookup_cols(book.head, o_s)
     live = o_s < n_origins
     start = live & (v_s == head_at + 1)
     chain = (
@@ -180,21 +177,10 @@ def advance_heads(book: Book) -> Book:
 
     _, consumable = jax.lax.associative_scan(compose, (chain, start), axis=1)
 
-    rows = jnp.broadcast_to(
-        jnp.arange(n_nodes, dtype=jnp.int32)[:, None], o_s.shape
-    )
-    flat = jnp.where(
-        consumable, rows * n_origins + o_s, jnp.int32(n_nodes * n_origins)
-    )
-    head = (
-        book.head.reshape(-1)
-        .at[flat.reshape(-1)]
-        .max(v_s.reshape(-1), mode="drop")
-        .reshape(book.head.shape)
-    )
+    head = scatter_cols_max(book.head, o_s, v_s, consumable)
 
     # free consumed slots and any slot at/below the (possibly jumped) head
-    head_after = jnp.take_along_axis(head, jnp.clip(o_s, 0, n_origins - 1), axis=1)
+    head_after = lookup_cols(head, o_s)
     drop = consumable | (live & (v_s <= head_after))
     o_out = jnp.where(drop, NO_ORIGIN, jnp.where(live, o_s, NO_ORIGIN))
     v_out = jnp.where(drop | ~live, 0, v_s)
@@ -211,19 +197,11 @@ def needs_count(book: Book) -> jax.Array:
     reference's ``check_bookkeeping.py`` Antithesis driver).
     """
     live = book.buf_origin != NO_ORIGIN
-    n_origins = book.head.shape[1]
-    o = jnp.clip(book.buf_origin, 0, n_origins - 1)
-    above_head = book.buf_ver > jnp.take_along_axis(book.head, o, axis=1)
+    o = book.buf_origin
+    above_head = book.buf_ver > lookup_cols(book.head, o)
     counted = live & above_head
-    n_nodes = book.head.shape[0]
-    rows = jnp.broadcast_to(
-        jnp.arange(n_nodes, dtype=jnp.int32)[:, None], o.shape
-    )
-    flat = jnp.where(counted, rows * n_origins + o, n_nodes * n_origins)
-    buffered = (
-        jnp.zeros(n_nodes * n_origins, jnp.int32)
-        .at[flat.reshape(-1)]
-        .add(1, mode="drop")
-        .reshape(book.head.shape)
+    buffered = scatter_cols_add(
+        jnp.zeros(book.head.shape, jnp.int32), o,
+        jnp.ones(o.shape, jnp.int32), counted,
     )
     return jnp.maximum(book.known_max - book.head, 0) - buffered

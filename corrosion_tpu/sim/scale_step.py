@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 import jax.random as jr
 
+from corrosion_tpu.ops.dense import scatter_cols_add, scatter_cols_set, select_cols
 from corrosion_tpu.ops.lww import STATE_ALIVE
 from corrosion_tpu.ops.select import sample_k
 from corrosion_tpu.ops.slots import budget_mask
@@ -215,7 +216,13 @@ def piggyback_bcast_step(cfg: ScaleSimConfig, cst: CrdtState, channels, key):
     sel_slots, sel_ok = sample_k(live_slot, r, key)  # [N, R] per sender
 
     def sender_fields(src):
-        g = lambda a: jnp.take_along_axis(a[src], sel_slots[src], axis=1)  # noqa: E731
+        """Selected queue cells of each receiver's sender. Row gathers
+        (``a[src]``) run at full speed; the slot pick loops over the
+        static queue axis instead of element-gathering (ops/dense.py)."""
+        s_slots = jax.lax.optimization_barrier(sel_slots[src])  # [N, R]
+        def g(a):
+            rows = jax.lax.optimization_barrier(a[src])  # [N, Q]
+            return select_cols(rows, s_slots)
         return (
             g(cst.q_origin),
             g(cst.q_dbv),
@@ -242,14 +249,9 @@ def piggyback_bcast_step(cfg: ScaleSimConfig, cst: CrdtState, channels, key):
     live = jnp.concatenate(valids, axis=1)
 
     # --- sender budget decrement: one per delivered packet ---------------
-    dec = jnp.zeros((n, q), jnp.int32)
-    rows = jnp.broadcast_to(iarr[:, None], sel_slots.shape)
-    flat = jnp.where(sel_ok, rows * q + sel_slots, n * q)
-    dec = (
-        dec.reshape(-1)
-        .at[flat.reshape(-1)]
-        .add(jnp.broadcast_to(carried[:, None], sel_slots.shape).reshape(-1), mode="drop")
-        .reshape(n, q)
+    dec = scatter_cols_add(
+        jnp.zeros((n, q), jnp.int32), sel_slots,
+        jnp.broadcast_to(carried[:, None], sel_slots.shape), sel_ok,
     )
     q_tx = jnp.maximum(cst.q_tx - dec, 0)
     exhausted = (cst.q_origin != NO_Q) & (q_tx <= 0)
@@ -306,8 +308,8 @@ def scale_sim_step(
     )
     p_cnt = cfg.sync_peers
     cand_slots, cand_sok = sample_k(bel_alive, min(2 * p_cnt, m), k_sp)
-    cand_ids = jnp.take_along_axis(swim.mem_id, cand_slots, axis=1)
-    staleness = jnp.take_along_axis(cst.last_sync, cand_slots, axis=1)
+    cand_ids = select_cols(swim.mem_id, cand_slots)
+    staleness = select_cols(cst.last_sync, cand_slots)
     rings_c = ring_of(
         net, jnp.broadcast_to(iarr[:, None], cand_ids.shape),
         jnp.clip(cand_ids, 0),
@@ -316,10 +318,11 @@ def scale_sim_step(
         cfg, cst.book, cand_ids, cand_sok, staleness, rings_c, p_cnt
     )
     cst, s_ok, s_info = sync_step(cfg, cst, peers, p_ok, swim.alive, net, k_sync)
-    synced_slots = jnp.take_along_axis(cand_slots, c_idx, axis=1)
+    synced_slots = select_cols(cand_slots, c_idx)
     ls = jnp.minimum(cst.last_sync + 1, LAST_SYNC_CAP)
-    flat = jnp.where(s_ok, iarr[:, None] * m + synced_slots, n * m)
-    ls = ls.reshape(-1).at[flat.reshape(-1)].set(0, mode="drop").reshape(n, m)
+    ls = scatter_cols_set(
+        ls, synced_slots, jnp.zeros(synced_slots.shape, jnp.int32), s_ok
+    )
     cst = cst._replace(last_sync=ls)
 
     info = {**swim_info, **b_info, **s_info}

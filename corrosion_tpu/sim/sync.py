@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import jax.random as jr
 
+from corrosion_tpu.ops.dense import lookup_cols
 from corrosion_tpu.ops.lww import INT32_MIN, lex_max
 from corrosion_tpu.ops.partials import drop_stale_partials
 from corrosion_tpu.ops.versions import advance_heads, needs_count
@@ -59,8 +60,7 @@ def choose_sync_peers(cfg, book, cand_ids, cand_ok, staleness, rings, k):
     n_org = cfg.n_origins
     needs = jnp.maximum(needs_count(book), 0)  # [N, O]
     in_pool = (cand_ids >= 0) & (cand_ids < n_org)
-    o = jnp.clip(cand_ids, 0, n_org - 1)
-    need = jnp.where(in_pool, jnp.take_along_axis(needs, o, axis=1), 0)
+    need = jnp.where(in_pool, lookup_cols(needs, cand_ids), 0)
     score = (
         (jnp.minimum(need, 4095) << 15)
         + (jnp.minimum(staleness, LAST_SYNC_CAP) << 3)
@@ -68,7 +68,7 @@ def choose_sync_peers(cfg, book, cand_ids, cand_ok, staleness, rings, k):
     ).astype(jnp.int32)
     score = jnp.where(cand_ok, score, jnp.int32(-1))
     val, idx = jax.lax.top_k(score, k)
-    peers = jnp.take_along_axis(cand_ids, idx, axis=1)
+    peers = lookup_cols(cand_ids, idx.astype(jnp.int32))
     return jnp.clip(peers, 0), val >= 0, idx.astype(jnp.int32)
 
 
@@ -103,12 +103,15 @@ def sync_step(
     pulled = jnp.int32(0)
     for j in range(p_cnt):
         pj = peers[:, j]  # [N]
-        p_ver, p_val, p_site, p_dbv, p_clp = (pl[pj] for pl in cst.store)  # [N, C]
+        # row gathers are fast on TPU; the per-cell head lookups below
+        # loop over the small origin axis instead of element-gathering
+        # (ops/dense.py)
+        p_ver, p_val, p_site, p_dbv, p_clp = jax.lax.optimization_barrier(
+            tuple(pl[pj] for pl in cst.store)
+        )  # [N, C]
         # range check per cell: head_i[site] < dbv <= granted[j, site]
-        lo = jnp.take_along_axis(head_i, jnp.clip(p_site, 0, n_org - 1), axis=1)
-        hi = jnp.take_along_axis(
-            granted[:, j, :], jnp.clip(p_site, 0, n_org - 1), axis=1
-        )
+        lo = lookup_cols(head_i, p_site)
+        hi = lookup_cols(granted[:, j, :], p_site)
         sel = (
             ok[:, j : j + 1]
             & (p_site >= 0)

@@ -136,10 +136,14 @@ def test_members_persist_and_bootstrap(tmp_path, rig):
 
     agent = rig.agent
     path = str(tmp_path / "members.json")
+    old_members_path = agent.config.db.members_path
     agent.config.db.members_path = path
-    loop = MaintenanceLoop(agent, db=rig.db, interval_seconds=0.1)
-    agent.wait_rounds(2, timeout=60)
-    loop.tick()
+    try:
+        loop = MaintenanceLoop(agent, db=rig.db, interval_seconds=0.1)
+        agent.wait_rounds(2, timeout=60)
+        loop.tick()
+    finally:
+        agent.config.db.members_path = old_members_path
     dump = json.load(open(path))
     assert len(dump["members"]) == agent.n_nodes  # everyone alive
 
