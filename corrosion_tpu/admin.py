@@ -74,10 +74,20 @@ class AdminServer:
         if name == "ping":
             return {"ok": "pong"}
         if name == "sync":
+            # cross-process trace propagation: the caller's traceparent
+            # rides the command and parents our serving span — the
+            # SyncTraceContextV1 inject/extract seam (sync.rs:33-67,
+            # peer/mod.rs:1017-1020,1414-1416)
+            from corrosion_tpu.utils.tracing import span
+
             node = cmd.get("node")
-            if node is not None:
-                return {"ok": agent.sync_state(int(node))}
-            return {"ok": [agent.sync_state(i) for i in range(agent.n_nodes)]}
+            with span("admin.sync_state", traceparent=cmd.get("traceparent"),
+                      node=node if node is not None else "all"):
+                if node is not None:
+                    return {"ok": agent.sync_state(int(node))}
+                return {
+                    "ok": [agent.sync_state(i) for i in range(agent.n_nodes)]
+                }
         if name == "locks":
             top = int(cmd.get("top", 10))
             snap = sorted(
@@ -205,6 +215,13 @@ class AdminClient:
         self._file = self.sock.makefile("rwb")
 
     def call(self, command: str, **kw) -> dict:
+        # inject the current trace context (the sync client's
+        # traceparent injection, peer/mod.rs:1017-1020)
+        from corrosion_tpu.utils.tracing import inject_traceparent
+
+        tp = inject_traceparent()
+        if tp and "traceparent" not in kw:
+            kw["traceparent"] = tp
         self._file.write(json.dumps({"command": command, **kw}).encode() + b"\n")
         self._file.flush()
         line = self._file.readline()

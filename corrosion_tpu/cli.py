@@ -112,6 +112,10 @@ def cmd_agent(args, cfg=None, regions=None) -> int:
             from corrosion_tpu.utils.metrics import start_prometheus_listener
 
             prom = start_prometheus_listener(agent.metrics, *prom_hostport)
+        if cfg.telemetry.otlp_path:
+            from corrosion_tpu.utils.tracing import configure_otlp_file
+
+            configure_otlp_file(cfg.telemetry.otlp_path)
         extras = (f" pg {pg.addr}:{pg.port}" if pg else "") + (
             f" prometheus {cfg.telemetry.prometheus_addr}" if prom else "")
         print(f"agent up: api http://{api.addr}:{api.port} "
@@ -129,6 +133,9 @@ def cmd_agent(args, cfg=None, regions=None) -> int:
         if prom:
             prom.shutdown()
         agent.shutdown()
+        from corrosion_tpu.utils.tracing import flush_otlp
+
+        flush_otlp()
     return 0
 
 
@@ -168,7 +175,11 @@ def _fmt_cell(v) -> str:
 
 
 def cmd_sync(args) -> int:
-    with _admin(args) as admin:
+    from corrosion_tpu.utils.tracing import span
+
+    # a client-side span whose context rides the admin call into the
+    # agent's serving span (cross-process trace propagation)
+    with span("cli.sync_generate"), _admin(args) as admin:
         out = admin.call("sync", **({"node": args.node}
                                     if args.node is not None else {}))
     print(json.dumps(out, indent=2))

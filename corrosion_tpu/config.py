@@ -108,9 +108,13 @@ class AdminConfig:
 
 @dataclasses.dataclass
 class TelemetryConfig:
-    """Prometheus exposition (``config.rs`` ``telemetry``)."""
+    """Prometheus exposition + OTLP pipeline (``config.rs`` ``telemetry``)."""
 
     prometheus_addr: Optional[str] = None  # "host:port" or None = disabled
+    # OTLP span export (the reference's open-telemetry pipeline,
+    # main.rs:57-150) — a file path here enables the OTLP-JSON file
+    # exporter (zero-egress environments have no collector socket)
+    otlp_path: str = ""
 
 
 @dataclasses.dataclass

@@ -25,7 +25,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-INT32_MIN = jnp.int32(-2147483648)
+from corrosion_tpu.ops.lww import INT32_MIN
 
 # None = decide by backend (dense loops everywhere except CPU);
 # True/False pin the dense/element form (tests)

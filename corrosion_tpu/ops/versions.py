@@ -149,13 +149,15 @@ def advance_heads(book: Book) -> Book:
 
     # lexsort by (origin, version), batched over nodes: two stable
     # argsort passes (a vmapped jnp.lexsort lowers to per-row sorts on
-    # TPU; the batched form is one [N, K] sort kernel per pass)
+    # TPU; the batched form is one [N, K] sort kernel per pass); the
+    # permutation applications go through lookup_cols — per-element
+    # gathers are the op class the dense kernels exist to avoid
     order1 = jnp.argsort(book.buf_ver, axis=1, stable=True).astype(jnp.int32)
-    o1 = jnp.take_along_axis(o_key, order1, axis=1)
+    o1 = lookup_cols(o_key, order1)
     order2 = jnp.argsort(o1, axis=1, stable=True).astype(jnp.int32)
-    order = jnp.take_along_axis(order1, order2, axis=1)
-    o_s = jnp.take_along_axis(o_key, order, axis=1)
-    v_s = jnp.take_along_axis(book.buf_ver, order, axis=1)
+    order = lookup_cols(order1, order2)
+    o_s = lookup_cols(o_key, order)
+    v_s = lookup_cols(book.buf_ver, order)
 
     head_at = lookup_cols(book.head, o_s)
     live = o_s < n_origins

@@ -94,3 +94,85 @@ def test_backoff_grows_and_caps():
         delays.append(d)
     assert delays[0] == 0.1 and delays[1] == 0.2
     assert max(delays) == 1.0 and delays[-1] == 1.0
+
+
+def test_otlp_file_exporter(tmp_path):
+    """Spans export in OTLP-JSON shape with parent/child links intact —
+    the reference's OTLP pipeline (main.rs:57-150) pointed at a file."""
+    import json
+
+    from corrosion_tpu.utils import tracing
+
+    path = str(tmp_path / "spans.otlp.jsonl")
+    tracing.configure_otlp_file(path, service_name="test-svc")
+    try:
+        with span("outer") as outer_ctx:
+            with span("inner", step="apply"):
+                pass
+        tracing.flush_otlp()
+    finally:
+        tracing.configure_otlp_file(None)
+
+    batches = [json.loads(line) for line in open(path)]
+    spans = [
+        s
+        for b in batches
+        for rs in b["resourceSpans"]
+        for ss in rs["scopeSpans"]
+        for s in ss["spans"]
+    ]
+    by_name = {s["name"]: s for s in spans}
+    assert set(by_name) == {"outer", "inner"}
+    svc = batches[0]["resourceSpans"][0]["resource"]["attributes"][0]
+    assert svc["value"]["stringValue"] == "test-svc"
+    inner, outer = by_name["inner"], by_name["outer"]
+    assert inner["traceId"] == outer["traceId"] == outer_ctx.trace_id
+    assert inner["parentSpanId"] == outer["spanId"]
+    assert "parentSpanId" not in outer  # trace root
+    assert int(inner["endTimeUnixNano"]) >= int(inner["startTimeUnixNano"])
+    assert inner["attributes"][0]["key"] == "step"
+
+
+def test_admin_sync_trace_propagation(tmp_path):
+    """CLI-side span context rides the admin socket into the agent's
+    serving span — the SyncTraceContextV1 inject/extract seam
+    (sync.rs:33-67)."""
+    import json
+
+    from corrosion_tpu.admin import AdminClient, AdminServer
+    from corrosion_tpu.agent import Agent
+    from corrosion_tpu.testing import cluster_config
+    from corrosion_tpu.utils import tracing
+
+    path = str(tmp_path / "spans.otlp.jsonl")
+    sock = str(tmp_path / "admin.sock")
+    tracing.configure_otlp_file(path)
+    try:
+        with Agent(cluster_config()) as agent:
+            agent.wait_rounds(2, timeout=120)
+            srv = AdminServer(agent, sock).start()
+            try:
+                with span("cli.sync_generate") as client_ctx:
+                    client = AdminClient(sock)
+                    out = client.call("sync", node=0)
+                    client.close()
+                assert "heads" in out
+            finally:
+                srv.stop()
+        tracing.flush_otlp()
+    finally:
+        tracing.configure_otlp_file(None)
+
+    spans = [
+        s
+        for line in open(path)
+        for rs in json.loads(line)["resourceSpans"]
+        for ss in rs["scopeSpans"]
+        for s in ss["spans"]
+    ]
+    server_spans = [s for s in spans if s["name"] == "admin.sync_state"]
+    assert server_spans, "serving span not exported"
+    sp = server_spans[0]
+    # same trace, parented under the client's span — cross-process link
+    assert sp["traceId"] == client_ctx.trace_id
+    assert sp["parentSpanId"] == client_ctx.span_id
