@@ -175,13 +175,24 @@ def _fmt_cell(v) -> str:
 
 
 def cmd_sync(args) -> int:
-    from corrosion_tpu.utils.tracing import span
+    from corrosion_tpu.utils.tracing import configure_otlp_file, flush_otlp, span
 
-    # a client-side span whose context rides the admin call into the
-    # agent's serving span (cross-process trace propagation)
-    with span("cli.sync_generate"), _admin(args) as admin:
-        out = admin.call("sync", **({"node": args.node}
-                                    if args.node is not None else {}))
+    # export the client-side span too when a config with an OTLP path is
+    # at hand — otherwise the agent's serving span would reference a
+    # parent no export contains (a rootless trace)
+    cfg_path = getattr(args, "config", None)
+    if cfg_path:
+        cfg = load_config(cfg_path)
+        if cfg.telemetry.otlp_path:
+            configure_otlp_file(cfg.telemetry.otlp_path, service_name="corrosion-cli")
+    try:
+        # a client-side span whose context rides the admin call into the
+        # agent's serving span (cross-process trace propagation)
+        with span("cli.sync_generate"), _admin(args) as admin:
+            out = admin.call("sync", **({"node": args.node}
+                                        if args.node is not None else {}))
+    finally:
+        flush_otlp()
     print(json.dumps(out, indent=2))
     return 0
 

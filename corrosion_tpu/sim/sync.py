@@ -80,16 +80,24 @@ def sync_step(
     alive,  # bool [N]
     net: NetModel,
     key: jax.Array,
+    go_all: bool = False,
 ):
     """One sync round: a random subset of nodes each pulls from up to
-    ``sync_peers`` peers. Returns (state, ok, info) where ``ok`` [N, P]
-    marks pairs that actually exchanged (drives last-sync bookkeeping)."""
+    ``sync_peers`` peers (``go_all``: every alive node syncs — the
+    cohort-scheduled caller already rate-limited the rounds). Returns
+    (state, ok, info) where ``ok`` [N, P] marks pairs that actually
+    exchanged (drives last-sync bookkeeping)."""
     n, p_cnt, n_org = cfg.n_nodes, cfg.sync_peers, cfg.n_origins
     iarr = jnp.arange(n, dtype=jnp.int32)
     k_go, k_bi = jr.split(key)
     assert peers.shape == (n, p_cnt)
 
-    syncing = alive & (jr.uniform(k_go, (n,)) < 1.0 / max(1, cfg.sync_interval))
+    if go_all:
+        syncing = alive
+    else:
+        syncing = alive & (
+            jr.uniform(k_go, (n,)) < 1.0 / max(1, cfg.sync_interval)
+        )
     src = jnp.broadcast_to(iarr[:, None], peers.shape)
     ok = syncing[:, None] & p_ok & bi_ok(net, k_bi, alive, src, peers)
 

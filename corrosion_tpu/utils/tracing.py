@@ -55,6 +55,10 @@ class SpanContext:
         parts = tp.split("-")
         if len(parts) != 4 or len(parts[1]) != 32 or len(parts[2]) != 16:
             return None
+        try:  # ids must be hex or they would poison strict OTLP consumers
+            int(parts[1], 16), int(parts[2], 16)
+        except ValueError:
+            return None
         return SpanContext(trace_id=parts[1], span_id=parts[2])
 
 
