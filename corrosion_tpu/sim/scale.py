@@ -57,6 +57,7 @@ from corrosion_tpu.ops.lww import (
     STATE_SUSPECT,
     pack_inc_state,
 )
+from corrosion_tpu.ops.dense import select_cols
 from corrosion_tpu.ops.select import sample_k, sample_one
 from corrosion_tpu.sim.transport import NetModel, datagram_ok
 
@@ -268,7 +269,7 @@ def scale_swim_step(
 
     # --- probe target: one believed-alive table entry -------------------
     probe_slot, has_slot = sample_one(bel_alive, k_tgt)
-    tgt = jnp.clip(mem_id[iarr, probe_slot], 0)
+    tgt = jnp.clip(select_cols(mem_id, probe_slot[:, None])[:, 0], 0)
     has_tgt = alive & has_slot
 
     leg_out = has_tgt & datagram_ok(net, k_p1, alive, iarr, tgt)
@@ -278,7 +279,7 @@ def scale_swim_step(
     # --- indirect probes through helper entries -------------------------
     h_mask = bel_alive & (mem_id != tgt[:, None])
     h_slots, h_valid = sample_k(h_mask, max(1, cfg.n_indirect), k_help)
-    helpers = jnp.clip(jnp.take_along_axis(mem_id, h_slots, axis=1), 0)
+    helpers = jnp.clip(select_cols(mem_id, h_slots), 0)
     k1, k2, k3, k4 = jr.split(k_ind, 4)
     src_b = jnp.broadcast_to(iarr[:, None], helpers.shape)
     tgt_b = jnp.broadcast_to(tgt[:, None], helpers.shape)
@@ -293,7 +294,7 @@ def scale_swim_step(
     failed = has_tgt & ~acked
 
     # --- failed probe: suspect the entry, notify the subject -------------
-    cur = mem_view[iarr, probe_slot]
+    cur = select_cols(mem_view, probe_slot[:, None])[:, 0]
     suspect_key = (cur >> 2) * 4 + STATE_SUSPECT
     mem_view = mem_view.at[iarr, probe_slot].max(
         jnp.where(failed, suspect_key, FREE)
@@ -311,7 +312,7 @@ def scale_swim_step(
     )
     known = occupied & not_self
     ann_slot, has_known = sample_one(known, k_annt)
-    ann_tgt = jnp.clip(mem_id[iarr, ann_slot], 0)
+    ann_tgt = jnp.clip(select_cols(mem_id, ann_slot[:, None])[:, 0], 0)
     announcing = announcing & has_known
     ann_out = announcing & datagram_ok(net, k_ann1, alive, iarr, ann_tgt)
     ann_back = ann_out & datagram_ok(net, k_ann2, alive, ann_tgt, iarr)
