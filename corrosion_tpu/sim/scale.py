@@ -253,15 +253,15 @@ def scale_swim_step(
     old_id, old_view = st.mem_id, st.mem_view
     mem_id, mem_view = old_id, old_view
 
-    # refresh self entry: an alive node always occupies its own hash slot
+    # refresh self entry: an alive node always occupies its own hash
+    # slot; slot = i mod m is a static pattern, so the update is a pure
+    # elementwise mask — no per-element scatter (see ops/dense.py)
     self_slot = iarr % m
+    self_mask = self_slot[:, None] == jnp.arange(m, dtype=jnp.int32)[None, :]
+    own = self_mask & alive[:, None]
     self_key = pack_inc_state(inc, jnp.int32(STATE_ALIVE))
-    mem_id = mem_id.at[iarr, self_slot].set(
-        jnp.where(alive, iarr, mem_id[iarr, self_slot])
-    )
-    mem_view = mem_view.at[iarr, self_slot].set(
-        jnp.where(alive, self_key, mem_view[iarr, self_slot])
-    )
+    mem_id = jnp.where(own, iarr[:, None], mem_id)
+    mem_view = jnp.where(own, self_key[:, None], mem_view)
 
     occupied = mem_id >= 0
     not_self = mem_id != iarr[:, None]
@@ -294,10 +294,12 @@ def scale_swim_step(
     failed = has_tgt & ~acked
 
     # --- failed probe: suspect the entry, notify the subject -------------
+    from corrosion_tpu.ops.dense import scatter_cols_max
+
     cur = select_cols(mem_view, probe_slot[:, None])[:, 0]
     suspect_key = (cur >> 2) * 4 + STATE_SUSPECT
-    mem_view = mem_view.at[iarr, probe_slot].max(
-        jnp.where(failed, suspect_key, FREE)
+    mem_view = scatter_cols_max(
+        mem_view, probe_slot[:, None], suspect_key[:, None], failed[:, None]
     )
     notify_ok = failed & datagram_ok(net, jr.fold_in(k_p1, 1), alive, iarr, tgt)
     sus_heard = (
@@ -320,8 +322,11 @@ def scale_swim_step(
     # down-notice: the announce receiver's (possibly stale) belief about
     # the announcer rides the reply; a non-alive belief at >= our
     # incarnation triggers refutation below
-    bel = old_view[ann_tgt, self_slot]
-    bel_is_me = old_id[ann_tgt, self_slot] == iarr
+    # peer's view row = fast row gather; the self column picks densely
+    peer_view_rows = jax.lax.optimization_barrier(old_view[ann_tgt])
+    peer_id_rows = jax.lax.optimization_barrier(old_id[ann_tgt])
+    bel = select_cols(peer_view_rows, self_slot[:, None])[:, 0]
+    bel_is_me = select_cols(peer_id_rows, self_slot[:, None])[:, 0] == iarr
     notice = jnp.where(ann_back & bel_is_me, bel, -1)
     sus_heard = jnp.maximum(sus_heard, notice)
 
@@ -389,19 +394,15 @@ def scale_swim_step(
     # --- refutation: suspicion about me reached me => bump my incarnation
     # (via direct notify, down-notice, or gossip that landed in my own
     # self slot during the merges)
-    self_gossip = jnp.where(
-        mem_id[iarr, self_slot] == iarr, mem_view[iarr, self_slot], -1
-    )
+    id_at_self = select_cols(mem_id, self_slot[:, None])[:, 0]
+    view_at_self = select_cols(mem_view, self_slot[:, None])[:, 0]
+    self_gossip = jnp.where(id_at_self == iarr, view_at_self, -1)
     heard = jnp.maximum(sus_heard, self_gossip)
     refute = alive & (heard >= inc * 4 + STATE_SUSPECT)
     inc = jnp.where(refute, (heard >> 2) + 1, inc)
     self_key = pack_inc_state(inc, jnp.int32(STATE_ALIVE))
-    mem_view = mem_view.at[iarr, self_slot].set(
-        jnp.where(alive, self_key, mem_view[iarr, self_slot])
-    )
-    mem_id = mem_id.at[iarr, self_slot].set(
-        jnp.where(alive, iarr, mem_id[iarr, self_slot])
-    )
+    mem_view = jnp.where(own, self_key[:, None], mem_view)
+    mem_id = jnp.where(own, iarr[:, None], mem_id)
 
     # --- fresh news refills the dissemination budget ---------------------
     changed = (mem_view != old_view) | (mem_id != old_id)
