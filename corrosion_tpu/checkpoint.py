@@ -193,9 +193,13 @@ def restore_backup(agent, path: str, node: Optional[int] = None,
     km = np.asarray(state.crdt.book.known_max).copy()
     h[target] = head
     km[target] = np.maximum(known_max, km[target])
+    # the seen window is relative to the head being replaced — clear it
+    # (out-of-order dedupe hints only; anti-entropy sync re-derives them)
+    seen = np.asarray(state.crdt.book.seen).copy()
+    seen[target] = 0
     crdt = state.crdt._replace(
         store=tuple(store),
-        book=state.crdt.book._replace(head=h, known_max=km),
+        book=state.crdt.book._replace(head=h, known_max=km, seen=seen),
     )
     if not agent.restore_state(state._replace(crdt=crdt)):
         raise TimeoutError("backup restore did not apply in time")

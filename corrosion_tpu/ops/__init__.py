@@ -15,9 +15,9 @@ from corrosion_tpu.ops.lww import (  # noqa: F401
     unpack_inc_state,
 )
 from corrosion_tpu.ops.versions import (  # noqa: F401
-    NO_ORIGIN,
     Book,
     advance_heads,
     needs_count,
+    raise_heads,
     record_versions,
 )

@@ -122,6 +122,37 @@ def scatter_cols_set(dest, idx, vals, valid):
     return jnp.stack(cols, axis=1)
 
 
+def scatter_cols_or(dest, idx, vals, valid):
+    """``dest[n, idx[n, m]] |= vals[n, m]`` where valid (unsigned int
+    bitmasks). Precondition: within one call, no two valid writers carry
+    the same set bit for the same (row, column) — the element form
+    implements OR as add (there is no ``.at[].or``), which matches OR
+    exactly under that no-carry condition; callers guarantee it by
+    deduping their batches first. (Bits already set in ``dest`` are fine
+    on both forms: the element form masks them out of the addends.)"""
+    n, w = dest.shape
+    if not _dense():
+        flat = _flat(idx, valid, n, w)
+        already = lookup_cols(dest, idx, fill=0)
+        vals = jnp.where(valid, vals & ~already, 0).astype(dest.dtype)
+        return (
+            dest.reshape(-1)
+            .at[flat.reshape(-1)]
+            .add(vals.reshape(-1), mode="drop")
+            .reshape(n, w)
+        )
+    cols = []
+    zero = jnp.zeros((), dest.dtype)
+    for c in range(w):
+        m = valid & (idx == c)
+        upd = jax.lax.reduce(
+            jnp.where(m, vals, zero).astype(dest.dtype),
+            zero, jax.lax.bitwise_or, (1,),
+        )
+        cols.append(dest[:, c] | upd)
+    return jnp.stack(cols, axis=1)
+
+
 def select_cols(rows, idx):
     """``out[n, m] = rows[n, idx[n, m]]`` — alias of :func:`lookup_cols`
     for [N, W] payload rows picked by per-row slot indices."""

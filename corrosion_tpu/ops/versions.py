@@ -17,14 +17,18 @@ have been seen. Per (node, origin) we keep
   (contiguous prefix — the complement of the reference's gap set),
 - ``known_max`` int32 [N, O]: highest origin-version heard of (gossiped
   alongside changes; bounds need computation),
+- ``seen``      uint32 [N, O, W]: a head-relative *bit window* — bit ``b``
+  of word ``w`` set means origin-version ``head + 1 + 32*w + b`` has been
+  seen out of order. The window is the bounded out-of-order buffer analog
+  of the reference's partials/gap bookkeeping with the queue-cap drop
+  policy of ``handle_changes`` (versions beyond ``head + 32*W`` drop;
+  anti-entropy sync repairs them later).
 
-plus a bounded per-node out-of-order buffer of seen versions beyond the
-head — ``buf_origin``/``buf_ver`` int32 [N, K], free slots marked -1 —
-the analog of the reference's partials/gap bookkeeping with the queue-cap
-drop policy of ``handle_changes`` (overflow drops; sync repairs later).
-
-Head advance ("gaps closing") is a sort + segmented boolean scan, fully
-jittable and batched over all nodes at once.
+Everything — seen-checks, recording, head advance ("gaps closing"), need
+counts — is elementwise integer/bit arithmetic: no sorts, no scans, no
+data-dependent gathers, exactly the op mix the TPU runs at full HBM
+bandwidth (see ``ops/dense.py`` for why that matters on this backend).
+Head advance is "count trailing ones, shift the window".
 """
 
 from __future__ import annotations
@@ -33,15 +37,15 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from corrosion_tpu.ops.dense import (
     lookup_cols,
-    scatter_cols_add,
     scatter_cols_max,
+    scatter_cols_or,
 )
-from corrosion_tpu.ops.slots import alloc_slots, scatter_rows
 
-NO_ORIGIN = jnp.int32(-1)  # free buffer slot marker
+_ONES = jnp.uint32(0xFFFFFFFF)
 
 
 class Book(NamedTuple):
@@ -49,17 +53,47 @@ class Book(NamedTuple):
 
     head: jax.Array  # int32 [N, O]
     known_max: jax.Array  # int32 [N, O]
-    buf_origin: jax.Array  # int32 [N, K], -1 = free
-    buf_ver: jax.Array  # int32 [N, K]
+    seen: jax.Array  # uint32 [N, O, W] — head-relative seen-bit window
 
     @staticmethod
     def create(n_nodes: int, n_origins: int, buf_slots: int) -> "Book":
+        """``buf_slots`` sizes the out-of-order window, rounded up to
+        whole 32-bit words (so the window never under-provides the
+        requested capacity)."""
+        words = max(1, -(-buf_slots // 32))
         return Book(
             head=jnp.zeros((n_nodes, n_origins), jnp.int32),
             known_max=jnp.zeros((n_nodes, n_origins), jnp.int32),
-            buf_origin=jnp.full((n_nodes, buf_slots), NO_ORIGIN, jnp.int32),
-            buf_ver=jnp.zeros((n_nodes, buf_slots), jnp.int32),
+            seen=jnp.zeros((n_nodes, n_origins, words), jnp.uint32),
         )
+
+    @property
+    def window_bits(self) -> int:
+        return 32 * self.seen.shape[2]
+
+
+def _window_offsets(book: Book, origin, ver):
+    """Per-message window coordinates: (head-at-origin, bit offset,
+    flat word index into ``seen.reshape(N, O*W)``, in-window mask)."""
+    w = book.seen.shape[2]
+    h = lookup_cols(book.head, origin)
+    off = ver - h - 1
+    in_win = (off >= 0) & (off < 32 * w)
+    word_idx = origin * w + jnp.where(off >= 0, off >> 5, 0)
+    return h, off, word_idx, in_win
+
+
+def seen_versions(book: Book, origin, ver, valid):
+    """Has this node already seen each (origin, version)? bool [N, M] —
+    true when the version is at/below the contiguous head or recorded in
+    the out-of-order window (the seen-cache + bookie check of
+    ``handle_changes``, ``handlers.rs:548-786``)."""
+    n, o, w = book.seen.shape
+    h, off, word_idx, in_win = _window_offsets(book, origin, ver)
+    word = lookup_cols(book.seen.reshape(n, o * w), word_idx, fill=0)
+    bit = (jnp.clip(off, 0, None) & 31).astype(jnp.uint32)
+    hit = ((word >> bit) & 1) == 1
+    return valid & ((ver <= h) | (in_win & hit))
 
 
 def record_versions(book: Book, origin, ver, valid):
@@ -71,38 +105,35 @@ def record_versions(book: Book, origin, ver, valid):
     ``handle_changes``, reference ``handlers.rs:548-786`` — fresh changes
     get applied and re-broadcast, stale ones dropped).
 
-    Fresh messages are placed into free buffer slots (overflow → dropped,
+    Fresh in-window versions set their seen bit (beyond-window → dropped,
     like the bounded processing queue, ``config.rs:15-27``; sync repairs),
     then heads advance over any newly-closed gaps.
     """
-    # --- seen-checks -----------------------------------------------------
+    n, o, w = book.seen.shape
     seen = seen_versions(book, origin, ver, valid)
-    # dedupe within the batch: keep only the first of identical (o, v) pairs
+
+    # dedupe within the batch: keep only the first of identical (o, v)
+    # pairs (also the precondition that lets the element-form bit scatter
+    # below use add — each (word, bit) has at most one writer)
+    m = origin.shape[1]
     same = (
         (origin[:, :, None] == origin[:, None, :])
         & (ver[:, :, None] == ver[:, None, :])
         & valid[:, None, :]
     )
-    m = origin.shape[1]
     earlier = jnp.tril(jnp.ones((m, m), bool), k=-1)
     dup_in_batch = jnp.any(same & earlier[None, :, :], axis=2)
 
     fresh = valid & ~seen & ~dup_in_batch
 
-    # --- slot allocation (per node, vectorized) --------------------------
-    free = book.buf_origin == NO_ORIGIN
-    slot, placed = alloc_slots(free, fresh)
-    buf_origin = scatter_rows(book.buf_origin, slot, placed, origin)
-    buf_ver = scatter_rows(book.buf_ver, slot, placed, ver)
-
-    known_max = _scatter_max(book.known_max, origin, ver, valid)
-    book = Book(book.head, known_max, buf_origin, buf_ver)
+    _, off, word_idx, in_win = _window_offsets(book, origin, ver)
+    bitval = jnp.uint32(1) << (jnp.clip(off, 0, None) & 31).astype(jnp.uint32)
+    flat = scatter_cols_or(
+        book.seen.reshape(n, o * w), word_idx, bitval, fresh & in_win
+    )
+    known_max = scatter_cols_max(book.known_max, origin, ver, valid)
+    book = Book(book.head, known_max, flat.reshape(n, o, w))
     return advance_heads(book), fresh
-
-
-def _scatter_max(dest, origin, ver, valid):
-    """``dest[i, origin[i,j]] = max(dest, ver[i,j])`` where valid."""
-    return scatter_cols_max(dest, origin, ver, valid)
 
 
 def bump_known_max(book: Book, origin, ver, valid) -> Book:
@@ -113,97 +144,93 @@ def bump_known_max(book: Book, origin, ver, valid) -> Book:
     seq range completes (``partial_need`` in ``SyncStateV1``, reference
     ``crates/corro-types/src/sync.rs:80``)."""
     return book._replace(
-        known_max=_scatter_max(book.known_max, origin, ver, valid)
+        known_max=scatter_cols_max(book.known_max, origin, ver, valid)
     )
 
 
-def seen_versions(book: Book, origin, ver, valid):
-    """Has this node already *fully* seen each (origin, version)? bool
-    [N, M] — true when the version is at/below the contiguous head or
-    parked in the out-of-order buffer (the seen-cache + bookie check of
-    ``handle_changes``, ``handlers.rs:548-786``)."""
-    behind_head = ver <= lookup_cols(book.head, origin)
-    in_buffer = jnp.any(
-        (book.buf_origin[:, None, :] == origin[:, :, None])
-        & (book.buf_ver[:, None, :] == ver[:, :, None]),
-        axis=2,
+def _trailing_ones(seen):
+    """Trailing-one count of each (n, o) W-word little-endian bitfield:
+    how many versions directly above the head are already seen."""
+    w = seen.shape[2]
+    x1 = seen + jnp.uint32(1)  # wraps all-ones to 0
+    t_w = jnp.where(
+        seen == _ONES,
+        jnp.int32(32),
+        lax.population_count(seen ^ x1).astype(jnp.int32) - 1,
     )
-    return valid & (behind_head | in_buffer)
+    total = t_w[:, :, 0]
+    carry = t_w[:, :, 0] == 32
+    for j in range(1, w):
+        total = total + jnp.where(carry, t_w[:, :, j], 0)
+        carry = carry & (t_w[:, :, j] == 32)
+    return total
+
+
+def _shift_right(seen, t):
+    """Logical right shift of each (n, o) W-word bitfield by ``t`` bits
+    (``t`` int32 [N, O] >= 0, arbitrary — over-shifts clear the field).
+    The word-offset part of the shift unrolls over the static word axis;
+    everything stays elementwise."""
+    n, o, w = seen.shape
+    t = jnp.minimum(t, 32 * w)
+    s_words = t >> 5  # [N, O]
+    s_bits = (t & 31).astype(jnp.uint32)[:, :, None]  # [N, O, 1]
+    hi_sh = jnp.where(s_bits > 0, jnp.uint32(32) - s_bits, 0)
+    has_bits = s_bits > 0
+
+    zeros = jnp.zeros((n, o, 1), jnp.uint32)
+
+    def word_from(s):  # seen shifted left (towards index 0) by s words
+        if s >= w:
+            return jnp.zeros_like(seen)
+        return jnp.concatenate(
+            [seen[:, :, s:]] + [zeros] * s, axis=2
+        )
+
+    out = jnp.zeros_like(seen)
+    for s in range(w + 1):
+        lo = word_from(s)
+        hi = word_from(s + 1)
+        part = (lo >> s_bits) | jnp.where(has_bits, hi << hi_sh, 0)
+        out = jnp.where((s_words == s)[:, :, None], part, out)
+    return out
 
 
 def advance_heads(book: Book) -> Book:
-    """Advance per-(node, origin) heads over buffered contiguous runs.
+    """Advance per-(node, origin) heads over contiguous seen runs.
 
     The jittable replacement for the reference's gap-merge
-    (``compute_gaps_change``, ``agent.rs:1179-1244``): sort each node's
-    buffer by (origin, version), then a segmented boolean affine scan marks
-    every entry reachable from its origin's head by a contiguous chain;
-    reachable entries advance the head and free their slots. One pass
-    suffices because the sort groups each origin's chain contiguously.
-    """
-    n_nodes, n_slots = book.buf_origin.shape
-    n_origins = book.head.shape[1]
+    (``compute_gaps_change``, ``agent.rs:1179-1244``): count the window's
+    trailing ones, bump the head by that many, shift the window down —
+    three elementwise ops over [N, O, W], no sort, no scan."""
+    t = _trailing_ones(book.seen)
+    head = book.head + t
+    seen = _shift_right(book.seen, t)
+    return Book(head, jnp.maximum(book.known_max, head), seen)
 
-    free = book.buf_origin == NO_ORIGIN
-    o_key = jnp.where(free, jnp.int32(n_origins), book.buf_origin)
 
-    # lexsort by (origin, version), batched over nodes: two stable
-    # argsort passes (a vmapped jnp.lexsort lowers to per-row sorts on
-    # TPU; the batched form is one [N, K] sort kernel per pass); the
-    # permutation applications go through lookup_cols — per-element
-    # gathers are the op class the dense kernels exist to avoid
-    order1 = jnp.argsort(book.buf_ver, axis=1, stable=True).astype(jnp.int32)
-    o1 = lookup_cols(o_key, order1)
-    order2 = jnp.argsort(o1, axis=1, stable=True).astype(jnp.int32)
-    order = lookup_cols(order1, order2)
-    o_s = lookup_cols(o_key, order)
-    v_s = lookup_cols(book.buf_ver, order)
-
-    head_at = lookup_cols(book.head, o_s)
-    live = o_s < n_origins
-    start = live & (v_s == head_at + 1)
-    chain = (
-        live
-        & (o_s == jnp.roll(o_s, 1, axis=1))
-        & (v_s == jnp.roll(v_s, 1, axis=1) + 1)
-    )
-    chain = chain.at[:, 0].set(False)
-
-    # consumable[i] = start[i] | (chain[i] & consumable[i-1]) — an affine
-    # boolean recurrence; solve with an associative scan over map
-    # composition (c, s) ∘ (c', s') = (c & c', s | (c & s')).
-    def compose(g1, g2):
-        c1, s1 = g1
-        c2, s2 = g2
-        return c1 & c2, s2 | (c2 & s1)
-
-    _, consumable = jax.lax.associative_scan(compose, (chain, start), axis=1)
-
-    head = scatter_cols_max(book.head, o_s, v_s, consumable)
-
-    # free consumed slots and any slot at/below the (possibly jumped) head
-    head_after = lookup_cols(head, o_s)
-    drop = consumable | (live & (v_s <= head_after))
-    o_out = jnp.where(drop, NO_ORIGIN, jnp.where(live, o_s, NO_ORIGIN))
-    v_out = jnp.where(drop | ~live, 0, v_s)
-    return Book(head, jnp.maximum(book.known_max, head), o_out, v_out)
+def raise_heads(book: Book, new_head) -> Book:
+    """Jump heads to ``new_head`` (int32 [N, O], e.g. the top of a synced
+    range) and REBASE the seen windows to the new heads — the window is
+    head-relative, so a head jump without the shift would corrupt it.
+    Follow with :func:`advance_heads` to absorb bits now adjacent."""
+    new_head = jnp.maximum(book.head, new_head)
+    seen = _shift_right(book.seen, new_head - book.head)
+    return Book(new_head, jnp.maximum(book.known_max, new_head), seen)
 
 
 def needs_count(book: Book) -> jax.Array:
     """Outstanding need per (node, origin): versions heard of but not seen.
 
-    ``known_max - head - |buffered in (head, known_max]|`` — the scalar
-    magnitude of the reference's gap set, used both for sync peer choice
-    ("most needed versions first", ``handlers.rs:808-863``) and as the
-    convergence predicate (no needs + equal heads — the same check as the
-    reference's ``check_bookkeeping.py`` Antithesis driver).
+    ``known_max - head - popcount(window)`` — every set window bit is a
+    seen version in ``(head, known_max]`` (seeing a version raises
+    ``known_max`` to at least it). The scalar magnitude of the reference's
+    gap set, used both for sync peer choice ("most needed versions first",
+    ``handlers.rs:808-863``) and as the convergence predicate (no needs +
+    equal heads — the same check as the reference's ``check_bookkeeping.py``
+    Antithesis driver).
     """
-    live = book.buf_origin != NO_ORIGIN
-    o = book.buf_origin
-    above_head = book.buf_ver > lookup_cols(book.head, o)
-    counted = live & above_head
-    buffered = scatter_cols_add(
-        jnp.zeros(book.head.shape, jnp.int32), o,
-        jnp.ones(o.shape, jnp.int32), counted,
+    buffered = jnp.sum(
+        lax.population_count(book.seen).astype(jnp.int32), axis=2
     )
     return jnp.maximum(book.known_max - book.head, 0) - buffered

@@ -57,7 +57,7 @@ from corrosion_tpu.ops.lww import (
     STATE_SUSPECT,
     pack_inc_state,
 )
-from corrosion_tpu.ops.dense import select_cols
+from corrosion_tpu.ops.dense import scatter_cols_max, select_cols
 from corrosion_tpu.ops.select import sample_k, sample_one
 from corrosion_tpu.sim.transport import NetModel, datagram_ok
 
@@ -294,8 +294,6 @@ def scale_swim_step(
     failed = has_tgt & ~acked
 
     # --- failed probe: suspect the entry, notify the subject -------------
-    from corrosion_tpu.ops.dense import scatter_cols_max
-
     cur = select_cols(mem_view, probe_slot[:, None])[:, 0]
     suspect_key = (cur >> 2) * 4 + STATE_SUSPECT
     mem_view = scatter_cols_max(

@@ -35,7 +35,7 @@ import jax.random as jr
 from corrosion_tpu.ops.dense import lookup_cols
 from corrosion_tpu.ops.lww import INT32_MIN, lex_max
 from corrosion_tpu.ops.partials import drop_stale_partials
-from corrosion_tpu.ops.versions import advance_heads, needs_count
+from corrosion_tpu.ops.versions import advance_heads, needs_count, raise_heads
 from corrosion_tpu.sim.broadcast import LAST_SYNC_CAP, CrdtState, hlc_fold
 from corrosion_tpu.sim.config import SimConfig
 from corrosion_tpu.sim.transport import N_RINGS, NetModel, bi_ok
@@ -147,12 +147,15 @@ def sync_step(
         pulled = pulled + jnp.sum(sel)
 
     # --- head jump + known_max exchange ---------------------------------
+    # the head jump goes through raise_heads: the seen window is
+    # head-relative and must be rebased alongside the jump
     new_head = jnp.maximum(head_i, jnp.max(granted, axis=1))
     km_p = cst.book.known_max[peers]  # [N, P, O]
     km_p = jnp.where(ok[:, :, None], km_p, 0)
     new_km = jnp.maximum(cst.book.known_max, jnp.max(km_p, axis=1))
+    book = raise_heads(cst.book, new_head)
     book = advance_heads(
-        cst.book._replace(head=new_head, known_max=new_km)
+        book._replace(known_max=jnp.maximum(book.known_max, new_km))
     )
     # versions that arrived whole through sync obsolete their buffered
     # fragments (the buffered-meta GC analog, util.rs:430-490)

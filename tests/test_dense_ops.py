@@ -80,3 +80,47 @@ def test_scatter_cols_set_forms_agree_unique_writers():
         for c in range(w):
             if c not in written:
                 assert an[r, c] == dn[r, c]
+
+
+def test_scatter_cols_or_forms_agree_and_match_numpy():
+    # the record_versions bit scatter: unique (idx, bit) per valid writer
+    # within a call (the documented precondition), but bits may already be
+    # set in dest — both forms must compute the true OR
+    key = jr.key(21)
+    n, w, m = 48, 6, 12
+    dest = jr.randint(key, (n, w), 0, 1 << 16, dtype=jnp.uint32)
+    idx = jr.randint(jr.fold_in(key, 1), (n, m), -1, w + 1, dtype=jnp.int32)
+    # give each message column its own bit -> no two writers share a bit
+    bit = jnp.broadcast_to(jnp.arange(m, dtype=jnp.uint32)[None, :], (n, m))
+    vals = jnp.uint32(1) << bit
+    valid = jr.uniform(jr.fold_in(key, 2), (n, m)) < 0.8
+    valid = valid & (idx >= 0) & (idx < w)
+    a, b = _both(dense.scatter_cols_or, dest, idx, vals, valid)
+    assert np.array_equal(a, b)
+    ref = np.asarray(dest).copy()
+    iN, vN, valN = np.asarray(idx), np.asarray(vals), np.asarray(valid)
+    for r in range(n):
+        for j in range(m):
+            if valN[r, j]:
+                ref[r, iN[r, j]] |= vN[r, j]
+    assert np.array_equal(a, ref)
+
+
+def test_versions_oracle_holds_on_dense_form():
+    # CI runs on CPU (element form); pin the dense/TPU form and re-run the
+    # Book-vs-oracle property check so the hot-path form is covered too
+    from tests.test_versions import run_rounds as book_rounds
+
+    try:
+        dense.FORCE_DENSE = True
+        rng = np.random.default_rng(11)
+        book, oracles, fresh_ok = book_rounds(
+            rng, n_nodes=4, n_origins=3, slots=64, batch=6, rounds=8,
+            max_ver=15,
+        )
+    finally:
+        dense.FORCE_DENSE = None
+    assert fresh_ok
+    heads = np.asarray(book.head)
+    for n_, o in np.ndindex(heads.shape):
+        assert heads[n_, o] == oracles[n_].head(o), (n_, o)

@@ -128,8 +128,11 @@ def test_agent_api_boundary_stamps():
     cfg.sim.n_nodes = 8
     cfg.sim.n_origins = 2
     with Agent(cfg) as a:
-        r1 = a.write(0, 0, 1)
-        r2 = a.write_many(0, [(1, 2), (2, 3)])
+        # first round includes jit compile; generous timeouts keep the
+        # test robust on a loaded CI machine
+        a.wait_rounds(1, timeout=180.0)
+        r1 = a.write(0, 0, 1, timeout=120.0)
+        r2 = a.write_many(0, [(1, 2), (2, 3)], timeout=120.0)
         assert "ts" in r1 and "ts" in r2
         t1 = tuple(map(int, r1["ts"].split("@")[0].split(".")))
         t2 = tuple(map(int, r2["ts"].split("@")[0].split(".")))

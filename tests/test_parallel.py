@@ -49,6 +49,9 @@ def test_sharded_matches_single_device(mesh_factory):
 
     for a, b in zip(jax.tree.leaves(ref), jax.tree.leaves(out)):
         assert jnp.array_equal(a, b)
+    # per-round info reductions cross shards — they must agree too
+    for k in ref_infos:
+        assert jnp.array_equal(ref_infos[k], infos[k]), k
     # the store plane is really split 8 ways across the mesh
     assert len(out.crdt.store[0].sharding.device_set) == 8
 
