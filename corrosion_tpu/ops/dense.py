@@ -25,7 +25,11 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from corrosion_tpu.ops.lww import INT32_MIN
+from corrosion_tpu.ops.lww import (
+    INT32_MIN,
+    apply_changes_cols,
+    apply_changes_to_store,
+)
 
 # None = decide by backend (dense loops everywhere except CPU);
 # True/False pin the dense/element form (tests)
@@ -157,3 +161,35 @@ def select_cols(rows, idx):
     """``out[n, m] = rows[n, idx[n, m]]`` — alias of :func:`lookup_cols`
     for [N, W] payload rows picked by per-row slot indices."""
     return lookup_cols(rows, idx)
+
+
+def apply_changes(store, cell, ver, val, site, dbv, clp, valid):
+    """Backend-adaptive LWW apply of per-node message batches.
+
+    ``store``: ``(ver, val, site, dbv, clp)`` planes [N, C]; message
+    fields [N, M] addressed by ``cell`` (column per message). On TPU this
+    is the column-loop form (``lww.apply_changes_cols``); on CPU the
+    flatten + segment-reduce form (``lww.apply_changes_to_store``) —
+    identical semantics, differentially tested like the other dense ops.
+    """
+    if _dense():
+        return apply_changes_cols(store, cell, ver, val, site, dbv, clp, valid)
+    n, c_cnt = store[0].shape
+    # out-of-range cells are invalid on BOTH forms (the column loop skips
+    # them structurally; mask here so the flat index cannot wrap rows)
+    valid = valid & (cell >= 0) & (cell < c_cnt)
+    rows = jnp.broadcast_to(
+        jnp.arange(n, dtype=jnp.int32)[:, None], cell.shape
+    )
+    flat_idx = rows * c_cnt + jnp.clip(cell, 0, c_cnt - 1)
+    out = apply_changes_to_store(
+        tuple(p.reshape(-1) for p in store),
+        flat_idx.reshape(-1),
+        ver.reshape(-1),
+        val.reshape(-1),
+        site.reshape(-1),
+        dbv.reshape(-1),
+        clp.reshape(-1),
+        valid.reshape(-1),
+    )
+    return tuple(p.reshape(n, c_cnt) for p in out)

@@ -153,6 +153,46 @@ def apply_changes_to_store(store, flat_idx, ver, val, site, dbv, clp, valid):
     )
 
 
+def apply_changes_cols(store, cell, ver, val, site, dbv, clp, valid):
+    """Apply per-node message batches to [N, C] store planes — the
+    column-loop (TPU) form of :func:`apply_changes_to_store`.
+
+    ``store``: ``(ver, val, site, dbv, clp)`` planes [N, C]; messages are
+    [N, M] with ``cell`` the target column per message. Per column: mask
+    the messages addressing it, reduce the lexicographic max along the
+    message axis (successive masking passes, one per key — same scheme as
+    :func:`lex_segment_argmax` without the scatters), then merge with the
+    incumbent. All reductions are over the small static M axis — no
+    per-element scatter/gather (see ``ops/dense.py`` for why).
+    """
+    s_ver, s_val, s_site, s_dbv, s_clp = store
+    n, c_cnt = s_ver.shape
+    keys_in = (clp, ver, val, site)
+    out = ([], [], [], [], [])
+    for c in range(c_cnt):
+        alive = valid & (cell == c)
+        nonempty = jnp.any(alive, axis=1)
+        mx = []
+        for k in keys_in:
+            kk = jnp.where(alive, k, INT32_MIN)
+            m = jnp.max(kk, axis=1)
+            alive = alive & (kk == m[:, None])
+            mx.append(m)
+        # ties carry identical keys (a (site, ver) pair names one change),
+        # so any tied payload is the change's payload
+        b_dbv = jnp.max(jnp.where(alive, dbv, INT32_MIN), axis=1)
+        a = (s_clp[:, c], s_ver[:, c], s_val[:, c], s_site[:, c])
+        m_clp, m_ver, m_val, m_site, m_dbv = lex_max(
+            a, tuple(mx), (s_dbv[:, c], b_dbv)
+        )
+        for dst, merged, cur in zip(
+            out, (m_ver, m_val, m_site, m_dbv, m_clp),
+            (s_ver[:, c], s_val[:, c], s_site[:, c], s_dbv[:, c], s_clp[:, c]),
+        ):
+            dst.append(jnp.where(nonempty, merged, cur))
+    return tuple(jnp.stack(cols, axis=1) for cols in out)
+
+
 def pack_inc_state(incarnation, state):
     """Pack (incarnation, member-state) into one comparable int32.
 

@@ -37,7 +37,7 @@ import jax
 import jax.numpy as jnp
 import jax.random as jr
 
-from corrosion_tpu.ops.lww import apply_changes_to_store
+from corrosion_tpu.ops.dense import apply_changes, lookup_cols
 from corrosion_tpu.ops.partials import (
     Partials,
     complete_mask,
@@ -195,7 +195,7 @@ def local_write(cfg: SimConfig, cst: CrdtState, write_mask, cell, val, clp=None)
         clp = jnp.zeros(n, jnp.int32)
 
     dbv = cst.next_dbv
-    cur_ver = cst.store[0][iarr, cell]
+    cur_ver = lookup_cols(cst.store[0], cell[:, None])[:, 0]
     ver = cur_ver + 1
     site = iarr
     # stamp the write with the node's HLC (crsql_set_ts analog)
@@ -203,12 +203,10 @@ def local_write(cfg: SimConfig, cst: CrdtState, write_mask, cell, val, clp=None)
     cst = cst._replace(hlc=hlc)
 
     # apply to own store
-    flat_idx = iarr * cfg.n_cells + cell
-    store = apply_changes_to_store(
-        tuple(p.reshape(-1) for p in cst.store),
-        flat_idx, ver, val, site, dbv, clp, w,
+    store = apply_changes(
+        cst.store, cell[:, None], ver[:, None], val[:, None], site[:, None],
+        dbv[:, None], clp[:, None], w[:, None],
     )
-    store = tuple(p.reshape(n, cfg.n_cells) for p in store)
 
     # record own version in own bookkeeping (a writer has trivially seen
     # its own db_versions; its head over itself == next_dbv - 1)
@@ -259,25 +257,17 @@ def local_write_tx(cfg: SimConfig, cst: CrdtState, tx_mask, tx_cell, tx_val,
     lane_ok = w[:, None] & (lane < tx_len[:, None])  # [N, K]
 
     dbv = cst.next_dbv
-    cur_ver = jnp.take_along_axis(cst.store[0], tx_cell, axis=1)
+    cur_ver = lookup_cols(cst.store[0], tx_cell)
     ver = cur_ver + 1
     site = jnp.broadcast_to(iarr[:, None], (n, k))
     # one HLC stamp per transaction (the whole tx commits at one ts)
     ts, hlc = hlc_tick(cst.hlc, cst.now, w)
     cst = cst._replace(hlc=hlc)
 
-    flat_idx = (iarr[:, None] * cfg.n_cells + tx_cell).reshape(-1)
-    store = apply_changes_to_store(
-        tuple(p.reshape(-1) for p in cst.store),
-        flat_idx,
-        ver.reshape(-1),
-        tx_val.reshape(-1),
-        site.reshape(-1),
-        jnp.broadcast_to(dbv[:, None], (n, k)).reshape(-1),
-        tx_clp.reshape(-1),
-        lane_ok.reshape(-1),
+    store = apply_changes(
+        cst.store, tx_cell, ver, tx_val, site,
+        jnp.broadcast_to(dbv[:, None], (n, k)), tx_clp, lane_ok,
     )
-    store = tuple(p.reshape(n, cfg.n_cells) for p in store)
 
     book, _ = record_versions(cst.book, iarr[:, None], dbv[:, None], w[:, None])
     cst = cst._replace(
@@ -340,20 +330,9 @@ def ingest_changes(cfg, cst: CrdtState, live, m_origin, m_dbv, m_cell, m_ver,
     single = live & (m_nseq <= 1)
     book, fresh1 = record_versions(cst.book, m_origin, m_dbv, single)
 
-    flat_idx = (
-        jnp.broadcast_to(iarr[:, None], m_cell.shape) * cfg.n_cells + m_cell
+    store = apply_changes(
+        cst.store, m_cell, m_ver, m_val, m_site, m_dbv, m_clp, fresh1
     )
-    store = apply_changes_to_store(
-        tuple(p.reshape(-1) for p in cst.store),
-        flat_idx.reshape(-1),
-        m_ver.reshape(-1),
-        m_val.reshape(-1),
-        m_site.reshape(-1),
-        m_dbv.reshape(-1),
-        m_clp.reshape(-1),
-        fresh1.reshape(-1),
-    )
-    store = tuple(p.reshape(n, cfg.n_cells) for p in store)
     cst = cst._replace(store=store, book=book)
 
     fresh = fresh1
@@ -372,21 +351,16 @@ def ingest_changes(cfg, cst: CrdtState, live, m_origin, m_dbv, m_cell, m_ver,
         lane = jnp.arange(k, dtype=jnp.int32)[None, None, :]
         lane_ok = full[:, :, None] & (lane < par.nseq[:, :, None])
         pk = p * k
-        flat_idx2 = (
-            jnp.broadcast_to(iarr[:, None, None], (n, p, k)) * cfg.n_cells
-            + par.cell
+        store = apply_changes(
+            cst.store,
+            par.cell.reshape(n, pk),
+            par.ver.reshape(n, pk),
+            par.val.reshape(n, pk),
+            par.site.reshape(n, pk),
+            jnp.broadcast_to(par.dbv[:, :, None], (n, p, k)).reshape(n, pk),
+            par.clp.reshape(n, pk),
+            lane_ok.reshape(n, pk),
         )
-        store = apply_changes_to_store(
-            tuple(pl.reshape(-1) for pl in cst.store),
-            flat_idx2.reshape(n * pk),
-            par.ver.reshape(-1),
-            par.val.reshape(-1),
-            par.site.reshape(-1),
-            jnp.broadcast_to(par.dbv[:, :, None], (n, p, k)).reshape(-1),
-            par.clp.reshape(-1),
-            lane_ok.reshape(-1),
-        )
-        store = tuple(pl.reshape(n, cfg.n_cells) for pl in store)
         book, _ = record_versions(book, par.origin, par.dbv, full)
         par = free_slots(par, full)
         cst = cst._replace(store=store, book=book, partials=par)
