@@ -176,12 +176,9 @@ def apply_changes(store, cell, ver, val, site, dbv, clp, valid):
         return apply_changes_cols(store, cell, ver, val, site, dbv, clp, valid)
     n, c_cnt = store[0].shape
     # out-of-range cells are invalid on BOTH forms (the column loop skips
-    # them structurally; mask here so the flat index cannot wrap rows)
+    # them structurally; _flat routes them to the scratch segment)
     valid = valid & (cell >= 0) & (cell < c_cnt)
-    rows = jnp.broadcast_to(
-        jnp.arange(n, dtype=jnp.int32)[:, None], cell.shape
-    )
-    flat_idx = rows * c_cnt + jnp.clip(cell, 0, c_cnt - 1)
+    flat_idx = _flat(cell, valid, n, c_cnt)
     out = apply_changes_to_store(
         tuple(p.reshape(-1) for p in store),
         flat_idx.reshape(-1),

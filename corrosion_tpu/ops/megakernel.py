@@ -1,0 +1,395 @@
+"""Fused receiver-side ingest as one pallas TPU kernel per node block.
+
+The XLA form of change ingest (``sim/broadcast.ingest_changes``) lowers
+to thousands of small [N]-wide kernels (the dense column loops over the
+store's 64 cells, the queue's 32 slots, the book's 16 origins), each
+paying a launch and an HBM round-trip at ~400 KB operand sizes. Every
+step is *row-local* — node i's messages touch only node i's tables — so
+the whole phase maps onto a pallas grid over node blocks: each program
+instance pulls one block's planes into VMEM, runs dedupe + bookkeeping +
+LWW apply + re-broadcast enqueue in-register, and writes each plane back
+once. State traffic collapses to one read + one write per plane per
+round — the bandwidth bound PERF.md derives.
+
+Protocol semantics are IDENTICAL to the unfused path (the reference
+behaviors mirrored are the same ones cited in ``sim/broadcast.py`` /
+``ops/versions.py``: seen-cache dedupe ``handlers.rs:548-786``, HLC fold
+``handlers.rs:689-701``, drop-oldest-most-sent queue overflow
+``broadcast/mod.rs:410-812``); a differential test pins fused ==
+unfused exactly. Only the single-cell fast path is fused (``nseq == 1``,
+``process_complete_version``, reference ``util.rs:1197``); configs with
+multi-cell transactions keep the XLA partial-buffer path.
+
+CPU/tests run the kernel in pallas interpret mode; the scale simulator
+uses the fused path automatically on TPU backends (``FORCE_FUSED``
+overrides for tests, mirroring ``dense.FORCE_DENSE``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+# None = fused on non-CPU backends; True/False pin (tests)
+FORCE_FUSED: Optional[bool] = None
+
+_pallas_ok_cache: dict = {}
+
+
+def _pallas_works() -> bool:
+    """Compile + run the REAL ingest kernel once per backend on tiny
+    shapes, cached — if the backend's pallas lowering can't handle it
+    (experimental tunnel plugins), every caller degrades to the XLA path
+    instead of failing the bench."""
+    backend = jax.default_backend()
+    if backend not in _pallas_ok_cache:
+        try:
+            import numpy as np
+
+            from corrosion_tpu.sim.broadcast import CrdtState
+            from corrosion_tpu.sim.config import SimConfig
+
+            cfg = SimConfig(n_nodes=32, n_origins=2).validate()
+            cst = CrdtState.create(cfg)
+            z = jnp.zeros((32, 2), jnp.int32)
+            live = jnp.zeros((32, 2), bool).at[0, 0].set(True)
+            cst2, info = ingest_changes_fused(
+                cfg, cst, live, z, z + 1, z, z + 1, z + 7, z, z, z,
+                interpret=False,
+            )
+            _pallas_ok_cache[backend] = (
+                int(info["fresh"]) == 1
+                and int(np.asarray(cst2.store[1])[0, 0]) == 7
+                and int(np.asarray(cst2.book.head)[0, 0]) == 1
+            )
+        except Exception:  # noqa: BLE001 — any lowering failure means "no"
+            _pallas_ok_cache[backend] = False
+    return _pallas_ok_cache[backend]
+
+
+def use_fused() -> bool:
+    if FORCE_FUSED is not None:
+        return FORCE_FUSED
+    return jax.default_backend() != "cpu" and _pallas_works()
+
+
+def _cols(table, idx, fill=0):
+    """``table[b, idx[b, m]]`` via a static column loop (VMEM registers)."""
+    w = table.shape[1]
+    out = jnp.full(idx.shape, fill, table.dtype)
+    for c in range(w):
+        out = jnp.where(idx == c, table[:, c : c + 1], out)
+    return out
+
+
+def _ingest_kernel(
+    cfg_tuple,
+    # inputs (VMEM refs)
+    live_ref, origin_ref, dbv_ref, cell_ref, ver_ref, val_ref, site_ref,
+    clp_ref, ts_ref,
+    s_ver_ref, s_val_ref, s_site_ref, s_dbv_ref, s_clp_ref,
+    head_ref, km_ref, seen_ref,
+    q_origin_ref, q_dbv_ref, q_cell_ref, q_ver_ref, q_val_ref, q_site_ref,
+    q_clp_ref, q_ts_ref, q_tx_ref,
+    hlc_ref, now_ref,
+    # outputs
+    o_s_ver, o_s_val, o_s_site, o_s_dbv, o_s_clp,
+    o_head, o_km, o_seen,
+    o_q_origin, o_q_dbv, o_q_cell, o_q_ver, o_q_val, o_q_site, o_q_clp,
+    o_q_ts, o_q_tx,
+    o_hlc, o_fresh, o_drift,
+):
+    (n_origins, n_cells, q_slots, seen_words, max_tx, hlc_round_bits,
+     hlc_max_drift, no_q) = cfg_tuple
+
+    imin = jnp.int32(-2147483648)
+    imax = jnp.int32(2147483647)
+    ones32 = jnp.uint32(0xFFFFFFFF)
+
+    live = live_ref[:] != 0
+    origin = origin_ref[:]
+    dbv = dbv_ref[:]
+    cell = cell_ref[:]
+    ver = ver_ref[:]
+    val = val_ref[:]
+    site = site_ref[:]
+    clp = clp_ref[:]
+    ts = ts_ref[:]
+    b, m = origin.shape
+    now = now_ref[0]
+
+    # --- HLC fold with max-drift rejection (handlers.rs:689-701) --------
+    hlc = hlc_ref[:][:, 0]
+    phys = ts >> hlc_round_bits
+    ts_ok = live & (phys <= now + hlc_max_drift)
+    folded = jnp.max(jnp.where(ts_ok, ts, 0), axis=1)
+    o_hlc[:] = jnp.maximum(hlc, folded)[:, None]
+    o_drift[:] = jnp.sum(live & ~ts_ok, axis=1, keepdims=True).astype(
+        jnp.int32
+    )
+    live = ts_ok
+
+    # --- seen-check + in-batch dedupe (versions.record_versions) --------
+    head = head_ref[:]
+    km = km_ref[:]
+    flat_seen = seen_ref[:]  # [B, O*W]
+    h_at = _cols(head, origin)
+    off = dbv - h_at - 1
+    in_win = (off >= 0) & (off < 32 * seen_words)
+    word_idx = origin * seen_words + jnp.where(off >= 0, off >> 5, 0)
+    bit = (jnp.clip(off, 0, None) & 31).astype(jnp.uint32)
+    bitval = jnp.uint32(1) << bit
+    word_val = _cols(flat_seen, word_idx)
+    hit = ((word_val >> bit) & 1) == 1
+    seen_b = live & ((dbv <= h_at) | (in_win & hit))
+
+    same = (
+        (origin[:, :, None] == origin[:, None, :])
+        & (dbv[:, :, None] == dbv[:, None, :])
+        & live[:, None, :]
+    )
+    earlier = jnp.tril(jnp.ones((m, m), bool), k=-1)
+    dup = jnp.any(same & earlier[None, :, :], axis=2)
+    fresh = live & ~seen_b & ~dup
+    o_fresh[:] = fresh.astype(jnp.int32)
+
+    # --- seen-bit OR + known_max scatter-max + head advance -------------
+    set_mask = fresh & in_win
+    new_cols = []
+    for c in range(n_origins * seen_words):
+        sel = set_mask & (word_idx == c)
+        acc = flat_seen[:, c]
+        for j in range(m):
+            acc = acc | jnp.where(sel[:, j], bitval[:, j], jnp.uint32(0))
+        new_cols.append(acc)
+    seen3 = jnp.stack(new_cols, axis=1).reshape(b, n_origins, seen_words)
+
+    km_cols = []
+    for c in range(n_origins):
+        sel = live & (origin == c)
+        km_cols.append(
+            jnp.maximum(
+                km[:, c], jnp.max(jnp.where(sel, dbv, imin), axis=1)
+            )
+        )
+    km = jnp.stack(km_cols, axis=1)
+
+    # head advance: count trailing ones per window, then shift it down
+    x1 = seen3 + jnp.uint32(1)
+    t_w = jnp.where(
+        seen3 == ones32,
+        jnp.int32(32),
+        jax.lax.population_count(seen3 ^ x1).astype(jnp.int32) - 1,
+    )
+    total = t_w[:, :, 0]
+    carry = t_w[:, :, 0] == 32
+    for j in range(1, seen_words):
+        total = total + jnp.where(carry, t_w[:, :, j], 0)
+        carry = carry & (t_w[:, :, j] == 32)
+    head = head + total
+    s_words = total >> 5
+    s_bits = (total & 31).astype(jnp.uint32)[:, :, None]
+    hi_sh = jnp.where(s_bits > 0, jnp.uint32(32) - s_bits, 0)
+    zeros_w = jnp.zeros((b, n_origins, 1), jnp.uint32)
+
+    def word_from(s):
+        if s >= seen_words:
+            return jnp.zeros_like(seen3)
+        return jnp.concatenate([seen3[:, :, s:]] + [zeros_w] * s, axis=2)
+
+    shifted = jnp.zeros_like(seen3)
+    for s in range(seen_words + 1):
+        part = (word_from(s) >> s_bits) | jnp.where(
+            s_bits > 0, word_from(s + 1) << hi_sh, 0
+        )
+        shifted = jnp.where((s_words == s)[:, :, None], part, shifted)
+    o_head[:] = head
+    o_km[:] = jnp.maximum(km, head)
+    o_seen[:] = shifted.reshape(b, n_origins * seen_words)
+
+    # --- LWW apply of fresh cells (lww.apply_changes_cols) --------------
+    s_ver = s_ver_ref[:]
+    s_val = s_val_ref[:]
+    s_site = s_site_ref[:]
+    s_dbv = s_dbv_ref[:]
+    s_clp = s_clp_ref[:]
+    keys_in = (clp, ver, val, site)
+    out_cols = ([], [], [], [], [])
+    for c in range(n_cells):
+        alive = fresh & (cell == c)
+        nonempty = jnp.any(alive, axis=1)
+        mx = []
+        for k in keys_in:
+            kk = jnp.where(alive, k, imin)
+            mk = jnp.max(kk, axis=1)
+            alive = alive & (kk == mk[:, None])
+            mx.append(mk)
+        b_dbv = jnp.max(jnp.where(alive, dbv, imin), axis=1)
+        a_keys = (s_clp[:, c], s_ver[:, c], s_val[:, c], s_site[:, c])
+        wins = a_keys[-1] >= mx[-1]
+        for ak, bk in zip(reversed(a_keys[:-1]), reversed(mx[:-1])):
+            wins = (ak > bk) | ((ak == bk) & wins)
+        take = nonempty & ~wins
+        for dst, cur, new in zip(
+            out_cols,
+            (s_ver[:, c], s_val[:, c], s_site[:, c], s_dbv[:, c],
+             s_clp[:, c]),
+            (mx[1], mx[2], mx[3], b_dbv, mx[0]),
+        ):
+            dst.append(jnp.where(take, new, cur))
+    o_s_ver[:] = jnp.stack(out_cols[0], axis=1)
+    o_s_val[:] = jnp.stack(out_cols[1], axis=1)
+    o_s_site[:] = jnp.stack(out_cols[2], axis=1)
+    o_s_dbv[:] = jnp.stack(out_cols[3], axis=1)
+    o_s_clp[:] = jnp.stack(out_cols[4], axis=1)
+
+    # --- re-broadcast enqueue with evict-most-sent ----------------------
+    # sequential argmin over the batch == the batch rank assignment of
+    # slots.alloc_slots_evict (the r-th fresh item takes the r-th
+    # smallest evict key; ties resolve to the lowest slot on both forms;
+    # items beyond the slot count drop on both forms)
+    q_origin = q_origin_ref[:]
+    q_tx_now = q_tx_ref[:]
+    evict_key = jnp.where(q_origin == no_q, imin, q_tx_now)
+    rebudget = jnp.full((b, m), max(1, max_tx - 1), jnp.int32)
+    planes = [
+        [q_origin, origin],
+        [q_dbv_ref[:], dbv],
+        [q_cell_ref[:], cell],
+        [q_ver_ref[:], ver],
+        [q_val_ref[:], val],
+        [q_site_ref[:], site],
+        [q_clp_ref[:], clp],
+        [q_ts_ref[:], ts],
+        [q_tx_now, rebudget],
+    ]
+    col_iota = jax.lax.broadcasted_iota(jnp.int32, evict_key.shape, 1)
+    for j in range(m):
+        kmin = jnp.min(evict_key, axis=1)
+        slot = jnp.argmin(evict_key, axis=1).astype(jnp.int32)
+        write = (fresh[:, j] & (kmin < imax))[:, None] & (
+            col_iota == slot[:, None]
+        )
+        for pair in planes:
+            pair[0] = jnp.where(write, pair[1][:, j : j + 1], pair[0])
+        evict_key = jnp.where(write, imax, evict_key)
+    for ref, pair in zip(
+        (o_q_origin, o_q_dbv, o_q_cell, o_q_ver, o_q_val, o_q_site,
+         o_q_clp, o_q_ts, o_q_tx),
+        planes,
+    ):
+        ref[:] = pair[0]
+
+
+def _block_size(n: int) -> int:
+    for b in (1024, 800, 640, 512, 400, 256, 200, 128, 100, 64, 50, 32):
+        if n % b == 0:
+            return b
+    return n
+
+
+def ingest_changes_fused(cfg, cst, live, m_origin, m_dbv, m_cell, m_ver,
+                         m_val, m_site, m_clp, m_ts, *,
+                         interpret: Optional[bool] = None):
+    """Drop-in fused form of the single-cell ``ingest_changes`` path.
+
+    Same contract as ``sim.broadcast.ingest_changes`` minus the seq/nseq
+    chunking fields — callers use this path only when
+    ``cfg.tx_max_cells == 1``, where every version is single-cell (the
+    queue's seq/nseq planes stay at their constant 0/1 values).
+    """
+    from corrosion_tpu.sim.broadcast import (
+        HLC_MAX_DRIFT_ROUNDS,
+        HLC_ROUND_BITS,
+        NO_Q,
+    )
+
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+
+    n = live.shape[0]
+    o_cnt = cst.book.head.shape[1]
+    w = cst.book.seen.shape[2]
+    q = cst.q_origin.shape[1]
+    c_cnt = cst.store[0].shape[1]
+    blk = _block_size(n)
+
+    cfg_tuple = (
+        o_cnt, c_cnt, q, w, int(cfg.bcast_max_transmissions),
+        HLC_ROUND_BITS, HLC_MAX_DRIFT_ROUNDS, int(NO_Q),
+    )
+
+    def spec(width):
+        return pl.BlockSpec((blk, width), lambda i: (i, 0))
+
+    s_ver, s_val, s_site, s_dbv, s_clp = cst.store
+    seen_flat = cst.book.seen.reshape(n, o_cnt * w)
+
+    in_arrays = [
+        live.astype(jnp.int32), m_origin, m_dbv, m_cell, m_ver, m_val,
+        m_site, m_clp, m_ts,
+        s_ver, s_val, s_site, s_dbv, s_clp,
+        cst.book.head, cst.book.known_max, seen_flat,
+        cst.q_origin, cst.q_dbv, cst.q_cell, cst.q_ver, cst.q_val,
+        cst.q_site, cst.q_clp, cst.q_ts, cst.q_tx,
+        cst.hlc[:, None],
+    ]
+    in_specs = [spec(a.shape[1]) for a in in_arrays]
+    now_arr = jnp.asarray(cst.now, jnp.int32)[None]
+    in_arrays.append(now_arr)
+    in_specs.append(pl.BlockSpec((1,), lambda i: (0,)))
+
+    m = m_origin.shape[1]
+    out_shapes = (
+        [jax.ShapeDtypeStruct((n, c_cnt), jnp.int32)] * 5
+        + [
+            jax.ShapeDtypeStruct((n, o_cnt), jnp.int32),
+            jax.ShapeDtypeStruct((n, o_cnt), jnp.int32),
+            jax.ShapeDtypeStruct((n, o_cnt * w), jnp.uint32),
+        ]
+        + [jax.ShapeDtypeStruct((n, q), jnp.int32)] * 9
+        + [
+            jax.ShapeDtypeStruct((n, 1), jnp.int32),  # hlc
+            jax.ShapeDtypeStruct((n, m), jnp.int32),  # fresh
+            jax.ShapeDtypeStruct((n, 1), jnp.int32),  # drift rejects
+        ]
+    )
+    out_specs = [spec(s.shape[1]) for s in out_shapes]
+
+    outs = pl.pallas_call(
+        functools.partial(_ingest_kernel, cfg_tuple),
+        grid=(n // blk,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shapes,
+        interpret=interpret,
+    )(*in_arrays)
+
+    (s_ver, s_val, s_site, s_dbv, s_clp, head, km, seen_flat,
+     q_origin, q_dbv, q_cell, q_ver, q_val, q_site, q_clp, q_ts, q_tx,
+     hlc, fresh, drift) = outs
+
+    book = cst.book._replace(
+        head=head, known_max=km, seen=seen_flat.reshape(n, o_cnt, w)
+    )
+    cst = cst._replace(
+        store=(s_ver, s_val, s_site, s_dbv, s_clp),
+        book=book,
+        q_origin=q_origin, q_dbv=q_dbv, q_cell=q_cell, q_ver=q_ver,
+        q_val=q_val, q_site=q_site, q_clp=q_clp, q_ts=q_ts, q_tx=q_tx,
+        hlc=hlc[:, 0],
+    )
+    fresh = fresh != 0
+    info = {
+        # delivered counts post-drift-rejection, like the unfused path
+        "delivered": jnp.sum(live) - jnp.sum(drift),
+        "fresh": jnp.sum(fresh),
+        "tx_completed": jnp.int32(0),
+        "clock_drift_rejects": jnp.sum(drift),
+        "queued": jnp.sum(q_origin != NO_Q),
+    }
+    return cst, info

@@ -316,6 +316,18 @@ def ingest_changes(cfg, cst: CrdtState, live, m_origin, m_dbv, m_cell, m_ver,
         m_nseq = jnp.ones_like(m_origin)
     if m_ts is None:
         m_ts = jnp.zeros_like(m_origin)
+
+    if cfg.tx_max_cells <= 1:
+        from corrosion_tpu.ops import megakernel
+
+        if megakernel.use_fused():
+            # single-cell configs take the whole phase as one pallas
+            # kernel per node block (ops/megakernel.py) — identical
+            # semantics, differentially tested
+            return megakernel.ingest_changes_fused(
+                cfg, cst, live, m_origin, m_dbv, m_cell, m_ver, m_val,
+                m_site, m_clp, m_ts,
+            )
     rebudget = jnp.full(
         m_origin.shape, max(1, cfg.bcast_max_transmissions - 1), jnp.int32
     )

@@ -57,7 +57,12 @@ from corrosion_tpu.ops.lww import (
     STATE_SUSPECT,
     pack_inc_state,
 )
-from corrosion_tpu.ops.dense import scatter_cols_max, select_cols
+from corrosion_tpu.ops.dense import (
+    lookup_cols,
+    scatter_cols_max,
+    scatter_cols_set,
+    select_cols,
+)
 from corrosion_tpu.ops.select import sample_k, sample_one
 from corrosion_tpu.sim.transport import NetModel, datagram_ok
 
@@ -189,10 +194,18 @@ def _merge_packet(mem_id, mem_view, sender_id, sender_view, src, valid, sendable
     alignment makes incoming entry k target exactly slot k. Insert-or-merge
     per slot: same subject -> packed max (foca precedence); free slot ->
     insert; collision -> keep, unless the incumbent is Down and the
-    incoming subject is Alive (fresh members displace corpses)."""
-    in_id = sender_id[src]
-    in_view = sender_view[src]
-    ok = valid[:, None] & (in_id >= 0) & sendable[src]
+    incoming subject is Alive (fresh members displace corpses).
+
+    The row gathers are barriered: fused into their elementwise consumers
+    they scalarize on the target backend (~2 GB/s vs full bandwidth as a
+    standalone gather kernel — see PERF.md)."""
+    in_id = jax.lax.optimization_barrier(sender_id[src])
+    in_view = jax.lax.optimization_barrier(sender_view[src])
+    ok = (
+        valid[:, None]
+        & (in_id >= 0)
+        & jax.lax.optimization_barrier(sendable[src])
+    )
     same = ok & (mem_id == in_id)
     ins = ok & (mem_id < 0)
     take = (
@@ -210,22 +223,17 @@ def _merge_packet(mem_id, mem_view, sender_id, sender_view, src, valid, sendable
 
 def _assert_sender_alive(n, m, mem_id, mem_view, snd, valid, s_key):
     """A delivered packet is liveness evidence: merge (sender, Alive@inc)
-    into each receiver's table at the sender's hash slot (O(N) scatter)."""
-    iarr = jnp.arange(n, dtype=jnp.int32)
-    slot = snd % m
-    cell = iarr * m + slot
-    cur_id = mem_id[iarr, slot]
+    into each receiver's table at the sender's hash slot — one column
+    write per receiver, through the dense column ops (ops/dense.py)."""
+    slot = (snd % m)[:, None]
+    cur_id = lookup_cols(mem_id, slot)[:, 0]
     same = cur_id == snd
     free = cur_id < 0
-    upd = jnp.where(valid & (same | free), cell, n * m)
-    mem_view = (
-        mem_view.reshape(-1).at[upd].max(s_key, mode="drop").reshape(n, m)
+    mem_view = scatter_cols_max(
+        mem_view, slot, s_key[:, None], (valid & (same | free))[:, None]
     )
-    mem_id = (
-        mem_id.reshape(-1)
-        .at[jnp.where(valid & free, cell, n * m)]
-        .set(snd, mode="drop")
-        .reshape(n, m)
+    mem_id = scatter_cols_set(
+        mem_id, slot, snd[:, None], (valid & free)[:, None]
     )
     return mem_id, mem_view
 

@@ -1,0 +1,133 @@
+"""Sub-phase profile of the piggyback broadcast path at scale: times the
+selection (queue sampling + field gathers), the receiver ingest (dedupe +
+apply + re-enqueue), and the enqueue machinery separately, printing each
+number as soon as it's measured.
+
+Usage: python scripts/profile_bcast.py [n_nodes] [scan_rounds]
+"""
+
+import os
+import sys
+import time
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax.numpy as jnp  # noqa: E402
+import jax.random as jr  # noqa: E402
+
+from corrosion_tpu.ops.select import sample_k  # noqa: E402
+from corrosion_tpu.ops.slots import budget_mask  # noqa: E402
+from corrosion_tpu.sim.broadcast import (  # noqa: E402
+    CHANGE_WIRE_BYTES,
+    NO_Q,
+    _enqueue,
+    ingest_changes,
+)
+from corrosion_tpu.sim.scale_step import (  # noqa: E402
+    ScaleSimState,
+    scale_sim_config,
+)
+from corrosion_tpu.ops.dense import select_cols  # noqa: E402
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    rounds = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+    cfg = scale_sim_config(n, n_origins=min(16, n))
+    st = ScaleSimState.create(cfg)
+    cst0 = st.crdt
+    key = jr.key(0)
+    q, r = cfg.bcast_queue, cfg.pig_changes
+    n_chan = 4
+    m = n_chan * r
+    iarr = jnp.arange(n, dtype=jnp.int32)
+    print(
+        f"n={n} q={q} r={r} m={m} platform={jax.devices()[0].platform}",
+        flush=True,
+    )
+
+    def timed(name, step, carry):
+        def run(c, key):
+            def body(cr, _):
+                c, k = cr
+                k, sub = jr.split(k)
+                return (step(c, sub), k), ()
+
+            (c, _), _ = jax.lax.scan(body, (c, key), None, length=rounds)
+            return c
+
+        f = jax.jit(run)
+        t0 = time.perf_counter()
+        jax.block_until_ready(f(carry, key))
+        compile_s = time.perf_counter() - t0
+        reps = 3
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            jax.block_until_ready(f(carry, key))
+        dt = (time.perf_counter() - t0) / reps / rounds
+        print(
+            f"{name:18s} {dt * 1000:9.2f} ms/round  (compile {compile_s:.0f}s)",
+            flush=True,
+        )
+
+    # synthetic channels: ring senders
+    channels = [((iarr + 1 + j) % n, jnp.ones(n, bool)) for j in range(n_chan)]
+
+    # (a) selection: budget + sample + field gathers for all channels
+    def selection(cst, k):
+        live_slot = (cst.q_origin != NO_Q) & (cst.q_tx > 0)
+        live_slot = budget_mask(
+            live_slot, cst.q_tx,
+            max(1, cfg.bcast_budget_bytes // (CHANGE_WIRE_BYTES * n_chan)),
+        )
+        sel_slots, sel_ok = sample_k(live_slot, r, k)
+        acc = cst.q_val
+        for src, valid in channels:
+            s_slots = jax.lax.optimization_barrier(sel_slots[src])
+            for a in (cst.q_origin, cst.q_dbv, cst.q_cell, cst.q_ver,
+                      cst.q_val, cst.q_site, cst.q_clp, cst.q_seq,
+                      cst.q_nseq, cst.q_ts):
+                rows = jax.lax.optimization_barrier(a[src])
+                got = select_cols(rows, s_slots)  # [N, R]
+                acc = acc.at[:, :r].add(got)
+        return cst._replace(q_val=acc)
+
+    timed("selection", selection, cst0)
+
+    # (b) ingest with synthetic messages
+    def ingest(cst, k):
+        k1, k2 = jr.split(k)
+        origin = jr.randint(k1, (n, m), 0, cfg.n_origins, dtype=jnp.int32)
+        dbv = jr.randint(k2, (n, m), 1, 64, dtype=jnp.int32)
+        cell = (origin * 4 + dbv) % cfg.n_cells
+        live = jnp.ones((n, m), bool)
+        cst, _ = ingest_changes(
+            cfg, cst, live, origin, dbv, cell, dbv, dbv, origin,
+            jnp.zeros((n, m), jnp.int32),
+        )
+        return cst
+
+    timed("ingest", ingest, cst0)
+
+    # (c) enqueue alone
+    def enq(cst, k):
+        k1, k2 = jr.split(k)
+        origin = jr.randint(k1, (n, m), 0, cfg.n_origins, dtype=jnp.int32)
+        dbv = jr.randint(k2, (n, m), 1, 1 << 20, dtype=jnp.int32)
+        z = jnp.zeros((n, m), jnp.int32)
+        return _enqueue(
+            cst, jnp.ones((n, m), bool), origin, dbv, z, dbv, dbv, origin, z,
+            z, jnp.ones((n, m), jnp.int32), z,
+            jnp.full((n, m), 3, jnp.int32),
+        )
+
+    timed("enqueue", enq, cst0)
+
+
+if __name__ == "__main__":
+    main()

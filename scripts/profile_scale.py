@@ -1,0 +1,109 @@
+"""Differential phase profile of the 100k scale round on the current
+backend: times 4 programs (full round, swim only, swim+bcast, sync) and
+prints each as soon as it's measured (no buffering — tunnel runs die
+mid-way often enough that partial output matters).
+
+Usage: python scripts/profile_scale.py [n_nodes] [scan_rounds]
+"""
+
+import os
+import sys
+import time
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax.numpy as jnp  # noqa: E402
+import jax.random as jr  # noqa: E402
+
+from corrosion_tpu.sim.broadcast import local_write  # noqa: E402
+from corrosion_tpu.sim.scale import scale_swim_step  # noqa: E402
+from corrosion_tpu.sim.scale_step import (  # noqa: E402
+    ScaleRoundInput,
+    ScaleSimState,
+    piggyback_bcast_step,
+    scale_sim_config,
+    scale_sim_step,
+)
+from corrosion_tpu.sim.sync import sync_step  # noqa: E402
+from corrosion_tpu.sim.transport import NetModel  # noqa: E402
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    rounds = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+    cfg = scale_sim_config(n, n_origins=min(16, n))
+    net = NetModel.create(n, drop_prob=0.01)
+    st = ScaleSimState.create(cfg)
+    inp = ScaleRoundInput.quiet(cfg)
+    key = jr.key(0)
+    print(
+        f"n={n} m={cfg.m_slots} rounds={rounds} "
+        f"platform={jax.devices()[0].platform}",
+        flush=True,
+    )
+
+    def timed(name, step):
+        def run(st, key):
+            def body(carry, _):
+                s, k = carry
+                k, sub = jr.split(k)
+                return (step(s, sub), k), ()
+
+            (s, _), _ = jax.lax.scan(body, (st, key), None, length=rounds)
+            return s
+
+        f = jax.jit(run)
+        t0 = time.perf_counter()
+        jax.block_until_ready(f(st, key))
+        compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        reps = 3
+        for _ in range(reps):
+            jax.block_until_ready(f(st, key))
+        dt = (time.perf_counter() - t0) / reps / rounds
+        print(
+            f"{name:16s} {dt * 1000:9.2f} ms/round  (compile {compile_s:.0f}s)",
+            flush=True,
+        )
+
+    timed("full", lambda s, k: scale_sim_step(cfg, s, net, k, inp)[0])
+
+    def swim_only(s, k):
+        swim, _, _ = scale_swim_step(cfg, s.swim, net, k)
+        return s._replace(swim=swim)
+
+    timed("swim", swim_only)
+
+    def swim_bcast(s, k):
+        k1, k2 = jr.split(k)
+        swim, _, channels = scale_swim_step(cfg, s.swim, net, k1)
+        cst = local_write(
+            cfg, s.crdt._replace(now=s.crdt.now + 1), inp.write_mask,
+            inp.write_cell, inp.write_val, inp.write_clp,
+        )
+        cst, _ = piggyback_bcast_step(cfg, cst, channels, k2)
+        return ScaleSimState(swim, cst)
+
+    timed("swim+bcast", swim_bcast)
+
+    iarr = jnp.arange(n, dtype=jnp.int32)
+    p = cfg.sync_peers
+    peers = jnp.stack([(iarr + 1 + j) % n for j in range(p)], axis=1)
+
+    def sync_only(s, k):
+        cst, _, _ = sync_step(
+            cfg, s.crdt, peers, jnp.ones((n, p), bool), s.swim.alive, net, k,
+            go_all=True,
+        )
+        return s._replace(crdt=cst)
+
+    timed("sync(go_all)", sync_only)
+
+
+if __name__ == "__main__":
+    main()

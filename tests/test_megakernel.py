@@ -1,0 +1,131 @@
+"""The fused pallas ingest must equal the unfused XLA path exactly
+(ops/megakernel.py vs sim/broadcast.ingest_changes)."""
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+import numpy as np
+import pytest
+
+from corrosion_tpu.ops import megakernel
+from corrosion_tpu.sim.broadcast import CrdtState, ingest_changes, local_write
+from corrosion_tpu.sim.config import SimConfig
+
+
+def _random_batch(key, n, m, cfg):
+    k1, k2, k3, k4, k5 = jr.split(key, 5)
+    origin = jr.randint(k1, (n, m), 0, cfg.n_origins, dtype=jnp.int32)
+    dbv = jr.randint(k2, (n, m), 1, 40, dtype=jnp.int32)
+    cell = jr.randint(k3, (n, m), 0, cfg.n_cells, dtype=jnp.int32)
+    val = jr.randint(k4, (n, m), 0, 1 << 20, dtype=jnp.int32)
+    live = jr.uniform(k5, (n, m)) < 0.8
+    ver = dbv  # monotone enough for LWW exercises
+    site = origin
+    clp = jnp.zeros((n, m), jnp.int32)
+    # wide physical range so HLC max-drift rejection actually fires
+    ts = jr.randint(jr.fold_in(key, 9), (n, m), 0, 12 << 10, dtype=jnp.int32)
+    return live, origin, dbv, cell, ver, val, site, clp, ts
+
+
+@pytest.mark.parametrize("rounds", [3])
+def test_fused_ingest_matches_unfused(rounds):
+    n, m = 64, 12
+    cfg = SimConfig(n_nodes=n, n_origins=4, tx_max_cells=1).validate()
+    key = jr.key(5)
+
+    st_a = CrdtState.create(cfg)  # unfused
+    st_b = CrdtState.create(cfg)  # fused
+    for r in range(rounds):
+        key, kb, kw = jr.split(key, 3)
+        live, origin, dbv, cell, ver, val, site, clp, ts = _random_batch(
+            kb, n, m, cfg
+        )
+        # seed some queue state via local writes so eviction paths differ
+        wmask = jr.uniform(kw, (n,)) < 0.3
+        wcell = jr.randint(jr.fold_in(kw, 1), (n,), 0, cfg.n_cells,
+                           dtype=jnp.int32)
+        wval = jr.randint(jr.fold_in(kw, 2), (n,), 0, 99, dtype=jnp.int32)
+        st_a = local_write(cfg, st_a._replace(now=st_a.now + 1), wmask,
+                           wcell, wval)
+        st_b = local_write(cfg, st_b._replace(now=st_b.now + 1), wmask,
+                           wcell, wval)
+
+        try:
+            megakernel.FORCE_FUSED = False
+            st_a, info_a = ingest_changes(
+                cfg, st_a, live, origin, dbv, cell, ver, val, site, clp,
+                m_ts=ts,
+            )
+            megakernel.FORCE_FUSED = True
+            st_b, info_b = ingest_changes(
+                cfg, st_b, live, origin, dbv, cell, ver, val, site, clp,
+                m_ts=ts,
+            )
+        finally:
+            megakernel.FORCE_FUSED = None
+
+        for a, b in zip(jax.tree.leaves(st_a), jax.tree.leaves(st_b)):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+        for k in info_a:
+            assert int(info_a[k]) == int(info_b[k]), k
+
+
+def test_fused_flag_respects_config():
+    # multi-cell configs must NOT take the fused path (partials live in
+    # the XLA branch)
+    cfg = SimConfig(n_nodes=16, n_origins=4, tx_max_cells=4).validate()
+    st = CrdtState.create(cfg)
+    z = jnp.zeros((16, 2), jnp.int32)
+    try:
+        megakernel.FORCE_FUSED = True
+        st2, info = ingest_changes(
+            cfg, st, jnp.zeros((16, 2), bool), z, z, z, z, z, z, z,
+            m_seq=z, m_nseq=jnp.ones((16, 2), jnp.int32),
+        )
+    finally:
+        megakernel.FORCE_FUSED = None
+    assert int(info["delivered"]) == 0
+
+
+def test_fused_scale_round_matches_unfused():
+    # the whole 100k bench path at miniature scale: piggyback broadcast +
+    # ingest through the fused kernel must reproduce the unfused round
+    # bit for bit
+    from corrosion_tpu.sim.scale_step import (
+        ScaleRoundInput,
+        ScaleSimState,
+        scale_run_rounds,
+        scale_sim_config,
+    )
+    from corrosion_tpu.sim.transport import NetModel
+
+    n, rounds = 128, 4
+    cfg = scale_sim_config(n, n_origins=8)
+    net = NetModel.create(n, drop_prob=0.05)
+    key = jr.key(3)
+    quiet = ScaleRoundInput.quiet(cfg)
+    inputs = jax.tree.map(
+        lambda a: jnp.broadcast_to(a, (rounds,) + a.shape), quiet
+    )
+    k1, k2, k3 = jr.split(jr.key(4), 3)
+    w = (jr.uniform(k1, (rounds, n)) < 0.3) & (
+        jnp.arange(n)[None, :] < cfg.n_origins
+    )
+    inputs = inputs._replace(
+        write_mask=w,
+        write_cell=jr.randint(k2, (rounds, n), 0, cfg.n_cells,
+                              dtype=jnp.int32),
+        write_val=jr.randint(k3, (rounds, n), 0, 1 << 20, dtype=jnp.int32),
+    )
+
+    outs = {}
+    for fused in (False, True):
+        try:
+            megakernel.FORCE_FUSED = fused
+            st = ScaleSimState.create(cfg)
+            st, infos = scale_run_rounds(cfg, st, net, key, inputs)
+            outs[fused] = (st, infos)
+        finally:
+            megakernel.FORCE_FUSED = None
+    for a, b in zip(jax.tree.leaves(outs[False]), jax.tree.leaves(outs[True])):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
