@@ -61,11 +61,64 @@ def _pallas_works() -> bool:
                 cfg, cst, live, z, z + 1, z, z + 1, z + 7, z, z, z,
                 interpret=False,
             )
-            _pallas_ok_cache[backend] = (
+            ok = (
                 int(info["fresh"]) == 1
                 and int(np.asarray(cst2.store[1])[0, 0]) == 7
                 and int(np.asarray(cst2.book.head)[0, 0]) == 1
             )
+            # the swim kernel lowers differently (dense column scatters
+            # inside pallas) — probe it too, against the shared XLA form
+            if ok:
+                from corrosion_tpu.sim.scale import swim_tables_update
+
+                import jax.random as jr
+
+                n, m = 32, 4
+                iarr = jnp.arange(n, dtype=jnp.int32)
+                key = jr.key(0)
+
+                mem_id = jr.randint(key, (n, m), -1, n, dtype=jnp.int32)
+                mem_view = jr.randint(
+                    jr.fold_in(key, 1), (n, m), -1, 64, dtype=jnp.int32
+                )
+                planes = dict(
+                    mem_id=mem_id, mem_view=mem_view, old_id=mem_id,
+                    old_view=mem_view,
+                    mem_timer=jnp.zeros((n, m), jnp.int32),
+                    mem_tx=jnp.ones((n, m), jnp.int32),
+                )
+                vecs = dict(
+                    alive=jnp.ones(n, bool),
+                    inc=jnp.zeros(n, jnp.int32),
+                    node_id=iarr,
+                    self_slot=iarr % m,
+                    sus_heard=jnp.full(n, -1, jnp.int32),
+                    sends=jnp.ones(n, jnp.int32),
+                    probe_slot=iarr % m,
+                    suspect_key=jnp.ones(n, jnp.int32),
+                    probe_failed=jnp.zeros(n, bool),
+                )
+                chans = dict(
+                    ch_in_id=[mem_id] * 4, ch_in_view=[mem_view] * 4,
+                    ch_in_send=[jnp.ones((n, m), bool)] * 4,
+                    ch_valid=[jnp.ones(n, bool)] * 4,
+                    ch_snd=[(iarr + 1) % n] * 4,
+                    ch_snd_inc=[jnp.zeros(n, jnp.int32)] * 4,
+                )
+                consts = (m, 4, 8, 6)
+                want = swim_tables_update(
+                    consts, *planes.values(), *vecs.values(),
+                    *chans.values(),
+                )
+                got = swim_tables_fused(
+                    consts, *planes.values(), *vecs.values(),
+                    *chans.values(), interpret=False,
+                )
+                ok = all(
+                    bool(jnp.array_equal(a, b))
+                    for a, b in zip(want, got)
+                )
+            _pallas_ok_cache[backend] = ok
         except Exception:  # noqa: BLE001 — any lowering failure means "no"
             _pallas_ok_cache[backend] = False
     return _pallas_ok_cache[backend]
@@ -90,7 +143,7 @@ def _ingest_kernel(
     cfg_tuple,
     # inputs (VMEM refs)
     live_ref, origin_ref, dbv_ref, cell_ref, ver_ref, val_ref, site_ref,
-    clp_ref, ts_ref,
+    clp_ref, ts_ref, budget_ref,
     s_ver_ref, s_val_ref, s_site_ref, s_dbv_ref, s_clp_ref,
     head_ref, km_ref, seen_ref,
     q_origin_ref, q_dbv_ref, q_cell_ref, q_ver_ref, q_val_ref, q_site_ref,
@@ -103,7 +156,7 @@ def _ingest_kernel(
     o_q_ts, o_q_tx,
     o_hlc, o_fresh, o_drift,
 ):
-    (n_origins, n_cells, q_slots, seen_words, max_tx, hlc_round_bits,
+    (n_origins, n_cells, q_slots, seen_words, hlc_round_bits,
      hlc_max_drift, no_q) = cfg_tuple
 
     imin = jnp.int32(-2147483648)
@@ -255,7 +308,7 @@ def _ingest_kernel(
     q_origin = q_origin_ref[:]
     q_tx_now = q_tx_ref[:]
     evict_key = jnp.where(q_origin == no_q, imin, q_tx_now)
-    rebudget = jnp.full((b, m), max(1, max_tx - 1), jnp.int32)
+    rebudget = budget_ref[:]
     planes = [
         [q_origin, origin],
         [q_dbv_ref[:], dbv],
@@ -293,7 +346,8 @@ def _block_size(n: int) -> int:
 
 
 def ingest_changes_fused(cfg, cst, live, m_origin, m_dbv, m_cell, m_ver,
-                         m_val, m_site, m_clp, m_ts, *,
+                         m_val, m_site, m_clp, m_ts, *, m_budget=None,
+                         drift_rounds: Optional[int] = None,
                          interpret: Optional[bool] = None):
     """Drop-in fused form of the single-cell ``ingest_changes`` path.
 
@@ -319,8 +373,10 @@ def ingest_changes_fused(cfg, cst, live, m_origin, m_dbv, m_cell, m_ver,
     blk = _block_size(n)
 
     cfg_tuple = (
-        o_cnt, c_cnt, q, w, int(cfg.bcast_max_transmissions),
-        HLC_ROUND_BITS, HLC_MAX_DRIFT_ROUNDS, int(NO_Q),
+        o_cnt, c_cnt, q, w,
+        HLC_ROUND_BITS,
+        HLC_MAX_DRIFT_ROUNDS if drift_rounds is None else drift_rounds,
+        int(NO_Q),
     )
 
     def spec(width):
@@ -329,9 +385,14 @@ def ingest_changes_fused(cfg, cst, live, m_origin, m_dbv, m_cell, m_ver,
     s_ver, s_val, s_site, s_dbv, s_clp = cst.store
     seen_flat = cst.book.seen.reshape(n, o_cnt * w)
 
+    if m_budget is None:
+        m_budget = jnp.full(
+            m_origin.shape, max(1, int(cfg.bcast_max_transmissions) - 1),
+            jnp.int32,
+        )
     in_arrays = [
         live.astype(jnp.int32), m_origin, m_dbv, m_cell, m_ver, m_val,
-        m_site, m_clp, m_ts,
+        m_site, m_clp, m_ts, m_budget,
         s_ver, s_val, s_site, s_dbv, s_clp,
         cst.book.head, cst.book.known_max, seen_flat,
         cst.q_origin, cst.q_dbv, cst.q_cell, cst.q_ver, cst.q_val,
@@ -393,3 +454,136 @@ def ingest_changes_fused(cfg, cst, live, m_origin, m_dbv, m_cell, m_ver,
         "queued": jnp.sum(q_origin != NO_Q),
     }
     return cst, info
+
+
+def local_write_fused(cfg, cst, write_mask, cell, val, clp=None, *,
+                      interpret: Optional[bool] = None):
+    """Fused form of ``sim.broadcast.local_write`` — a local commit is one
+    self-addressed message (origin = site = self, dbv = next_dbv,
+    ver = cell's current clock + 1, full transmission budget) pushed
+    through the ingest kernel: identical apply/record/enqueue semantics
+    (``POST /v1/transactions`` commit, reference ``public/mod.rs:177-256``),
+    one kernel launch."""
+    from corrosion_tpu.ops.dense import lookup_cols
+    from corrosion_tpu.sim.broadcast import hlc_tick
+
+    n = cfg.n_nodes
+    iarr = jnp.arange(n, dtype=jnp.int32)
+    w = write_mask & (iarr < cfg.n_origins)
+    if clp is None:
+        clp = jnp.zeros(n, jnp.int32)
+
+    dbv = cst.next_dbv
+    cur_ver = lookup_cols(cst.store[0], cell[:, None])[:, 0]
+    ts, _ = hlc_tick(cst.hlc, cst.now, w)
+    # the kernel's HLC fold lands the same stamp: max(hlc, ts) == ts for
+    # writers (hlc_tick is strictly ahead), untouched for others
+    cst2, _ = ingest_changes_fused(
+        cfg, cst,
+        w[:, None],
+        iarr[:, None],
+        dbv[:, None],
+        cell[:, None],
+        (cur_ver + 1)[:, None],
+        val[:, None],
+        iarr[:, None],
+        clp[:, None],
+        ts[:, None],
+        m_budget=jnp.full((n, 1), int(cfg.bcast_max_transmissions),
+                          jnp.int32),
+        # a node never drift-rejects its own stamp (the unfused
+        # local_write commits unconditionally) — disable rejection here
+        drift_rounds=1 << 20,
+        interpret=interpret,
+    )
+    return cst2._replace(next_dbv=jnp.where(w, dbv + 1, cst.next_dbv))
+
+
+def _swim_kernel(consts, *refs):
+    """Loads one node block's planes and defers to the shared row-local
+    transform ``sim.scale.swim_tables_update`` — the pallas and XLA paths
+    execute literally the same function, so they cannot drift."""
+    from corrosion_tpu.sim.scale import swim_tables_update
+
+    (mem_id_ref, mem_view_ref, old_id_ref, old_view_ref, timer_ref,
+     tx_ref, alive_ref, inc_ref, node_id_ref, self_slot_ref, sus_ref,
+     sends_ref, probe_slot_ref, suspect_key_ref, failed_ref) = refs[:15]
+    ch_refs = refs[15:15 + 4 * 6]
+    (o_id, o_view, o_timer, o_tx, o_inc, o_refute) = refs[15 + 4 * 6:]
+
+    vec = lambda r: r[:][:, 0]  # noqa: E731 — [B,1] operand to [B]
+    ch_in_id = [ch_refs[i][:] for i in range(4)]
+    ch_in_view = [ch_refs[4 + i][:] for i in range(4)]
+    ch_in_send = [ch_refs[8 + i][:] != 0 for i in range(4)]
+    ch_valid = [vec(ch_refs[12 + i]) != 0 for i in range(4)]
+    ch_snd = [vec(ch_refs[16 + i]) for i in range(4)]
+    ch_snd_inc = [vec(ch_refs[20 + i]) for i in range(4)]
+
+    mem_id, mem_view, timer, tx, inc, refute = swim_tables_update(
+        consts,
+        mem_id_ref[:], mem_view_ref[:], old_id_ref[:], old_view_ref[:],
+        timer_ref[:], tx_ref[:],
+        vec(alive_ref) != 0, vec(inc_ref), vec(node_id_ref),
+        vec(self_slot_ref), vec(sus_ref), vec(sends_ref),
+        vec(probe_slot_ref), vec(suspect_key_ref), vec(failed_ref) != 0,
+        ch_in_id, ch_in_view, ch_in_send, ch_valid, ch_snd, ch_snd_inc,
+    )
+    o_id[:] = mem_id
+    o_view[:] = mem_view
+    o_timer[:] = timer
+    o_tx[:] = tx
+    o_inc[:] = inc[:, None]
+    o_refute[:] = refute.astype(jnp.int32)[:, None]
+
+
+def swim_tables_fused(
+    consts,
+    mem_id, mem_view, old_id, old_view, mem_timer, mem_tx,
+    alive, inc, node_id, self_slot, sus_heard, sends,
+    probe_slot, suspect_key, probe_failed,
+    ch_in_id, ch_in_view, ch_in_send, ch_valid, ch_snd, ch_snd_inc,
+    *, interpret: Optional[bool] = None,
+):
+    """Pallas-fused form of ``sim.scale.swim_tables_update`` (same
+    argument order; channel groups as length-4 lists)."""
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    n, m = mem_id.shape
+    blk = _block_size(n)
+
+    def col(v):
+        return v.astype(jnp.int32)[:, None]
+
+    in_arrays = (
+        [mem_id, mem_view, old_id, old_view, mem_timer, mem_tx,
+         col(alive), col(inc), col(node_id), col(self_slot),
+         col(sus_heard), col(sends),
+         col(probe_slot), col(suspect_key), col(probe_failed)]
+        + list(ch_in_id)
+        + list(ch_in_view)
+        + [p.astype(jnp.int32) for p in ch_in_send]
+        + [col(v) for v in ch_valid]
+        + [col(v) for v in ch_snd]
+        + [col(v) for v in ch_snd_inc]
+    )
+
+    def spec(width):
+        return pl.BlockSpec((blk, width), lambda i: (i, 0))
+
+    in_specs = [spec(a.shape[1]) for a in in_arrays]
+    out_shapes = (
+        [jax.ShapeDtypeStruct((n, m), jnp.int32)] * 4
+        + [jax.ShapeDtypeStruct((n, 1), jnp.int32)] * 2
+    )
+    out_specs = [spec(s.shape[1]) for s in out_shapes]
+
+    outs = pl.pallas_call(
+        functools.partial(_swim_kernel, consts),
+        grid=(n // blk,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shapes,
+        interpret=interpret,
+    )(*in_arrays)
+    mem_id, mem_view, timer, tx, inc_o, refute = outs
+    return mem_id, mem_view, timer, tx, inc_o[:, 0], refute[:, 0] != 0

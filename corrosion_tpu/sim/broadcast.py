@@ -188,6 +188,13 @@ def local_write(cfg: SimConfig, cst: CrdtState, write_mask, cell, val, clp=None)
     merged or local), apply locally, queue the changeset for broadcast.
     """
     n = cfg.n_nodes
+    if cfg.tx_max_cells <= 1:
+        from corrosion_tpu.ops import megakernel
+
+        if megakernel.use_fused():
+            return megakernel.local_write_fused(
+                cfg, cst, write_mask, cell, val, clp
+            )
     iarr = jnp.arange(n, dtype=jnp.int32)
     is_origin = iarr < cfg.n_origins
     w = write_mask & is_origin

@@ -66,7 +66,8 @@ from corrosion_tpu.ops.dense import (
 from corrosion_tpu.ops.select import sample_k, sample_one
 from corrosion_tpu.sim.transport import NetModel, datagram_ok
 
-FREE = jnp.int32(-1)
+FREE = -1  # plain int: referenced inside the pallas swim kernel, where a
+# module-level device array would be a captured constant
 
 
 @dataclasses.dataclass(frozen=True)
@@ -186,56 +187,111 @@ def _one_sender_per_receiver(n, src_valid, tgt, key):
     return best & ((1 << bits) - 1), best >= 0
 
 
-def _merge_packet(mem_id, mem_view, sender_id, sender_view, src, valid, sendable):
-    """Fold one dense gossip packet into the receivers' member tables.
+def swim_tables_update(
+    consts,
+    mem_id, mem_view, old_id, old_view, mem_timer, mem_tx,
+    alive, inc, node_id, self_slot, sus_heard, sends,
+    probe_slot, suspect_key, probe_failed,
+    ch_in_id, ch_in_view, ch_in_sendable, ch_valid, ch_snd, ch_snd_inc,
+):
+    """The row-local back half of a SWIM round: suspect-mark, the four
+    packet merges + sender-alive assertions, send-budget decrement,
+    suspicion/down timers, purge, refutation, self refresh, budget
+    refill. Shared verbatim by the XLA path and the pallas swim kernel
+    (``ops/megakernel.swim_tables_fused``) so the two can never drift.
 
-    ``src`` int32 [N]: sender per receiver; ``valid`` bool [N]. The packet
-    is the sender's (start-of-round) row masked by its budget — hash-slot
-    alignment makes incoming entry k target exactly slot k. Insert-or-merge
-    per slot: same subject -> packed max (foca precedence); free slot ->
-    insert; collision -> keep, unless the incumbent is Down and the
-    incoming subject is Alive (fresh members displace corpses).
+    ``ch_*`` carry the four delivered-packet channels with their sender
+    rows already gathered (cross-node row gathers stay outside):
+    ``ch_in_id``/``ch_in_view``/``ch_in_sendable`` are length-4 lists of
+    [N, M] planes; ``ch_valid``/``ch_snd``/``ch_snd_inc`` length-4 lists
+    of [N] vectors; ``node_id`` is each row's global node id. Returns ``(mem_id, mem_view, timer, mem_tx, inc,
+    refute)``.
+    """
+    (m, suspicion_rounds, down_purge_rounds, max_transmissions) = consts
+    # node_id carries each row's GLOBAL id: inside the pallas kernel a
+    # block sees only its slice, so an arange here would be block-local
+    # and corrupt every self-entry write beyond the first block
+    iarr = node_id
 
-    The row gathers are barriered: fused into their elementwise consumers
-    they scalarize on the target backend (~2 GB/s vs full bandwidth as a
-    standalone gather kernel — see PERF.md)."""
-    in_id = jax.lax.optimization_barrier(sender_id[src])
-    in_view = jax.lax.optimization_barrier(sender_view[src])
-    ok = (
-        valid[:, None]
-        & (in_id >= 0)
-        & jax.lax.optimization_barrier(sendable[src])
-    )
-    same = ok & (mem_id == in_id)
-    ins = ok & (mem_id < 0)
-    take = (
-        ok
-        & (mem_id >= 0)
-        & (mem_id != in_id)
-        & ((mem_view & 3) == STATE_DOWN)
-        & ((in_view & 3) == STATE_ALIVE)
-    )
-    view = jnp.where(same, jnp.maximum(mem_view, in_view), mem_view)
-    view = jnp.where(ins | take, in_view, view)
-    new_id = jnp.where(ins | take, in_id, mem_id)
-    return new_id, view
-
-
-def _assert_sender_alive(n, m, mem_id, mem_view, snd, valid, s_key):
-    """A delivered packet is liveness evidence: merge (sender, Alive@inc)
-    into each receiver's table at the sender's hash slot — one column
-    write per receiver, through the dense column ops (ops/dense.py)."""
-    slot = (snd % m)[:, None]
-    cur_id = lookup_cols(mem_id, slot)[:, 0]
-    same = cur_id == snd
-    free = cur_id < 0
+    # --- failed probe: suspect the probed entry --------------------------
     mem_view = scatter_cols_max(
-        mem_view, slot, s_key[:, None], (valid & (same | free))[:, None]
+        mem_view, probe_slot[:, None], suspect_key[:, None],
+        probe_failed[:, None],
     )
-    mem_id = scatter_cols_set(
-        mem_id, slot, snd[:, None], (valid & free)[:, None]
+
+    # --- four dense packet merges + sender-alive assertions --------------
+    sendable = mem_tx > 0
+    for in_id, in_view, in_sendable, valid in zip(
+        ch_in_id, ch_in_view, ch_in_sendable, ch_valid
+    ):
+        ok = valid[:, None] & (in_id >= 0) & in_sendable
+        same = ok & (mem_id == in_id)
+        ins = ok & (mem_id < 0)
+        take = (
+            ok
+            & (mem_id >= 0)
+            & (mem_id != in_id)
+            & ((mem_view & 3) == STATE_DOWN)
+            & ((in_view & 3) == STATE_ALIVE)
+        )
+        mem_view = jnp.where(same, jnp.maximum(mem_view, in_view), mem_view)
+        mem_view = jnp.where(ins | take, in_view, mem_view)
+        mem_id = jnp.where(ins | take, in_id, mem_id)
+
+    for snd, valid, s_inc in zip(ch_snd, ch_valid, ch_snd_inc):
+        s_key = pack_inc_state(s_inc, jnp.int32(STATE_ALIVE))
+        slot = (snd % m)[:, None]
+        cur_id = lookup_cols(mem_id, slot)[:, 0]
+        same1 = cur_id == snd
+        free1 = cur_id < 0
+        mem_view = scatter_cols_max(
+            mem_view, slot, s_key[:, None], (valid & (same1 | free1))[:, None]
+        )
+        mem_id = scatter_cols_set(
+            mem_id, slot, snd[:, None], (valid & free1)[:, None]
+        )
+
+    # --- budget decrement for attempted sends ---------------------------
+    mem_tx = jnp.maximum(
+        jnp.where(sendable, mem_tx - sends[:, None], mem_tx), 0
     )
-    return mem_id, mem_view
+
+    # --- suspicion timers / down conversion / purge ----------------------
+    occupied = mem_id >= 0
+    changed = (mem_view != old_view) | (mem_id != old_id)
+    is_suspect = occupied & (mem_view >= 0) & ((mem_view & 3) == STATE_SUSPECT)
+    newly = changed & is_suspect
+    timer = jnp.where(newly, suspicion_rounds, mem_timer)
+    ticking = is_suspect & ~newly & alive[:, None]
+    timer = jnp.where(ticking, timer - 1, timer)
+    expired = is_suspect & (timer <= 0) & alive[:, None]
+    mem_view = jnp.where(expired, (mem_view >> 2) * 4 + STATE_DOWN, mem_view)
+
+    is_down = occupied & (mem_view >= 0) & ((mem_view & 3) == STATE_DOWN)
+    newly_down = expired | (changed & is_down)
+    timer = jnp.where(is_down & newly_down, down_purge_rounds, timer)
+    timer = jnp.where(is_down & ~newly_down & alive[:, None], timer - 1, timer)
+    purge = is_down & (timer <= 0) & alive[:, None]
+    mem_id = jnp.where(purge, FREE, mem_id)
+    mem_view = jnp.where(purge, FREE, mem_view)
+
+    # --- refutation ------------------------------------------------------
+    id_at_self = lookup_cols(mem_id, self_slot[:, None])[:, 0]
+    view_at_self = lookup_cols(mem_view, self_slot[:, None], fill=-1)[:, 0]
+    self_gossip = jnp.where(id_at_self == iarr, view_at_self, -1)
+    heard = jnp.maximum(sus_heard, self_gossip)
+    refute = alive & (heard >= inc * 4 + STATE_SUSPECT)
+    inc = jnp.where(refute, (heard >> 2) + 1, inc)
+    self_key = pack_inc_state(inc, jnp.int32(STATE_ALIVE))
+    self_mask = self_slot[:, None] == jnp.arange(m, dtype=jnp.int32)[None, :]
+    own = self_mask & alive[:, None]
+    mem_view = jnp.where(own, self_key[:, None], mem_view)
+    mem_id = jnp.where(own, iarr[:, None], mem_id)
+
+    # --- fresh news refills the dissemination budget ---------------------
+    changed = (mem_view != old_view) | (mem_id != old_id)
+    mem_tx = jnp.where(changed, max_transmissions, mem_tx)
+    return mem_id, mem_view, timer, mem_tx, inc, refute
 
 
 def scale_swim_step(
@@ -302,11 +358,9 @@ def scale_swim_step(
     failed = has_tgt & ~acked
 
     # --- failed probe: suspect the entry, notify the subject -------------
+    # (the suspect mark itself lands inside swim_tables_update)
     cur = select_cols(mem_view, probe_slot[:, None])[:, 0]
     suspect_key = (cur >> 2) * 4 + STATE_SUSPECT
-    mem_view = scatter_cols_max(
-        mem_view, probe_slot[:, None], suspect_key[:, None], failed[:, None]
-    )
     notify_ok = failed & datagram_ok(net, jr.fold_in(k_p1, 1), alive, iarr, tgt)
     sus_heard = (
         jnp.full(n, -1, jnp.int32)
@@ -342,77 +396,57 @@ def scale_swim_step(
         n, ann_out, ann_tgt, k_ca
     )
 
-    # --- four dense packet merges ----------------------------------------
+    # --- row-local back half: merges, assertions, timers, refutation ----
+    # sender rows gathered here (barriered — see PERF.md on fused-gather
+    # scalarization); the table transforms run either as plain XLA or as
+    # one pallas kernel per node block (ops/megakernel.py)
     sendable = st.mem_tx > 0
-    for src, valid in (
-        (prober_of, has_prober),
+    # the one channel list: consumed here for the table update AND
+    # returned for the piggyback layer (scale_step.py) — a single source
+    # so membership packets and the changesets riding them cannot drift
+    channels = [
+        (jnp.clip(prober_of, 0), has_prober),
         (tgt, probe_ok),
-        (announcer_of, has_announcer),
+        (jnp.clip(announcer_of, 0), has_announcer),
         (ann_tgt, ann_back),
-    ):
-        mem_id, mem_view = _merge_packet(
-            mem_id, mem_view, old_id, old_view, jnp.clip(src, 0), valid, sendable
-        )
+    ]
+    ch_in_id, ch_in_view, ch_in_send, ch_valid, ch_snd, ch_snd_inc = (
+        [], [], [], [], [], [],
+    )
+    for src, valid in channels:
+        ch_in_id.append(jax.lax.optimization_barrier(old_id[src]))
+        ch_in_view.append(jax.lax.optimization_barrier(old_view[src]))
+        ch_in_send.append(jax.lax.optimization_barrier(sendable[src]))
+        ch_valid.append(valid)
+        ch_snd.append(src)
+        ch_snd_inc.append(inc[src])
 
-    # every delivered packet also asserts its sender alive at current inc
-    for snd, valid in (
-        (prober_of, has_prober),
-        (tgt, probe_ok),
-        (announcer_of, has_announcer),
-        (ann_tgt, ann_back),
-    ):
-        snd = jnp.clip(snd, 0)
-        mem_id, mem_view = _assert_sender_alive(
-            n, m, mem_id, mem_view, snd, valid, pack_inc_state(inc[snd], jnp.int32(STATE_ALIVE))
-        )
-
-    # --- budget decrement for attempted sends ---------------------------
     sends = (
         has_tgt.astype(jnp.int32)  # probe we sent
         + announcing.astype(jnp.int32)  # announce we sent
         + has_prober.astype(jnp.int32)  # ack we sent back to our prober
         + has_announcer.astype(jnp.int32)  # reply we sent to our announcer
     )
-    mem_tx = jnp.maximum(
-        jnp.where(sendable, st.mem_tx - sends[:, None], st.mem_tx), 0
+    consts = (
+        m, int(cfg.suspicion_rounds), int(cfg.down_purge_rounds),
+        int(cfg.max_transmissions),
     )
+    args = (
+        mem_id, mem_view, old_id, old_view, st.mem_timer, st.mem_tx,
+        alive, inc, iarr, self_slot, sus_heard, sends,
+        probe_slot, suspect_key, failed,
+        ch_in_id, ch_in_view, ch_in_send, ch_valid, ch_snd, ch_snd_inc,
+    )
+    from corrosion_tpu.ops import megakernel
 
-    # --- suspicion timers / down conversion / purge ----------------------
-    occupied = mem_id >= 0
-    changed = (mem_view != old_view) | (mem_id != old_id)
-    is_suspect = occupied & (mem_view >= 0) & ((mem_view & 3) == STATE_SUSPECT)
-    newly = changed & is_suspect
-    timer = jnp.where(newly, cfg.suspicion_rounds, st.mem_timer)
-    ticking = is_suspect & ~newly & alive[:, None]
-    timer = jnp.where(ticking, timer - 1, timer)
-    expired = is_suspect & (timer <= 0) & alive[:, None]
-    mem_view = jnp.where(expired, (mem_view >> 2) * 4 + STATE_DOWN, mem_view)
-
-    # down entries linger for down_purge_rounds, then free the slot
-    is_down = occupied & (mem_view >= 0) & ((mem_view & 3) == STATE_DOWN)
-    newly_down = expired | (changed & is_down)
-    timer = jnp.where(is_down & newly_down, cfg.down_purge_rounds, timer)
-    timer = jnp.where(is_down & ~newly_down & alive[:, None], timer - 1, timer)
-    purge = is_down & (timer <= 0) & alive[:, None]
-    mem_id = jnp.where(purge, FREE, mem_id)
-    mem_view = jnp.where(purge, FREE, mem_view)
-
-    # --- refutation: suspicion about me reached me => bump my incarnation
-    # (via direct notify, down-notice, or gossip that landed in my own
-    # self slot during the merges)
-    id_at_self = select_cols(mem_id, self_slot[:, None])[:, 0]
-    view_at_self = select_cols(mem_view, self_slot[:, None])[:, 0]
-    self_gossip = jnp.where(id_at_self == iarr, view_at_self, -1)
-    heard = jnp.maximum(sus_heard, self_gossip)
-    refute = alive & (heard >= inc * 4 + STATE_SUSPECT)
-    inc = jnp.where(refute, (heard >> 2) + 1, inc)
-    self_key = pack_inc_state(inc, jnp.int32(STATE_ALIVE))
-    mem_view = jnp.where(own, self_key[:, None], mem_view)
-    mem_id = jnp.where(own, iarr[:, None], mem_id)
-
-    # --- fresh news refills the dissemination budget ---------------------
-    changed = (mem_view != old_view) | (mem_id != old_id)
-    mem_tx = jnp.where(changed, cfg.max_transmissions, mem_tx)
+    if megakernel.use_fused():
+        mem_id, mem_view, timer, mem_tx, inc, refute = (
+            megakernel.swim_tables_fused(consts, *args)
+        )
+    else:
+        mem_id, mem_view, timer, mem_tx, inc, refute = swim_tables_update(
+            consts, *args
+        )
 
     st2 = ScaleSwimState(alive, inc, mem_id, mem_view, timer, mem_tx)
     info = {
@@ -420,14 +454,8 @@ def scale_swim_step(
         "failed_probes": jnp.sum(failed),
         "refutes": jnp.sum(refute),
     }
-    # the four delivered-packet channels, (sender, valid) per receiver —
-    # higher layers piggyback changesets on exactly these packets
-    channels = [
-        (jnp.clip(prober_of, 0), has_prober),
-        (tgt, probe_ok),
-        (jnp.clip(announcer_of, 0), has_announcer),
-        (ann_tgt, ann_back),
-    ]
+    # channels: the four delivered-packet (sender, valid) pairs built
+    # above — higher layers piggyback changesets on exactly these packets
     return st2, info, channels
 
 

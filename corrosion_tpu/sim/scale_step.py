@@ -222,33 +222,29 @@ def piggyback_bcast_step(cfg: ScaleSimConfig, cst: CrdtState, channels, key):
     live_slot = budget_mask(live_slot, cst.q_tx, allowed)
     sel_slots, sel_ok = sample_k(live_slot, r, key)  # [N, R] per sender
 
-    def sender_fields(src):
-        """Selected queue cells of each receiver's sender. Row gathers
-        (``a[src]``) run at full speed; the slot pick loops over the
-        static queue axis instead of element-gathering (ops/dense.py)."""
-        s_slots = jax.lax.optimization_barrier(sel_slots[src])  # [N, R]
-        def g(a):
-            rows = jax.lax.optimization_barrier(a[src])  # [N, Q]
-            return select_cols(rows, s_slots)
-        return (
-            g(cst.q_origin),
-            g(cst.q_dbv),
-            g(cst.q_cell),
-            g(cst.q_ver),
-            g(cst.q_val),
-            g(cst.q_site),
-            g(cst.q_clp),
-            g(cst.q_seq),
-            g(cst.q_nseq),
-            g(cst.q_ts),
-        )
+    # --- sender-side payload, packed once --------------------------------
+    # every channel carries the SAME selected slots of its sender, so the
+    # field selection happens once per sender (not once per receiver):
+    # pack the 10 payload lanes plus an ok lane into one [N, 11*R] plane; each
+    # channel is ONE fast row gather of that small plane (barriered — a
+    # fused row gather scalarizes on this backend, see PERF.md)
+    fields = (
+        cst.q_origin, cst.q_dbv, cst.q_cell, cst.q_ver, cst.q_val,
+        cst.q_site, cst.q_clp, cst.q_seq, cst.q_nseq, cst.q_ts,
+    )
+    payload = jnp.concatenate(
+        [select_cols(f, sel_slots) for f in fields]
+        + [sel_ok.astype(jnp.int32)],
+        axis=1,
+    )  # [N, 11*R]
 
     # --- gather each channel's payload; [N, n_channels*R] messages ------
     parts, valids = [], []
     for src, valid in channels:
         src = jnp.clip(src, 0)
-        parts.append(sender_fields(src))
-        valids.append(valid[:, None] & sel_ok[src])
+        got = jax.lax.optimization_barrier(payload[src])  # [N, 11*R]
+        parts.append([got[:, i * r:(i + 1) * r] for i in range(10)])
+        valids.append(valid[:, None] & (got[:, 10 * r:11 * r] != 0))
     (m_origin, m_dbv, m_cell, m_ver, m_val, m_site, m_clp, m_seq, m_nseq,
      m_ts) = (
         jnp.concatenate([p[i] for p in parts], axis=1) for i in range(10)

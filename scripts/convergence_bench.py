@@ -1,0 +1,104 @@
+"""Rounds-to-convergence + wall-clock/round across cluster sizes — the
+tracked metric of BASELINE.md ("gossip rounds-to-convergence +
+wall-clock/round, 256-100k nodes").
+
+For each N: run a write burst (conflict-heavy, every origin hot), then
+quiet gossip rounds in scan chunks until the convergence predicate holds
+("no needs, equal heads, equal stores" over alive nodes — the same check
+as the reference's Antithesis ``check_bookkeeping.py`` driver), with
+kill/partition faults optionally injected during the burst.
+
+Prints one JSON line per cluster size:
+  {"n": N, "rounds_to_convergence": R, "ms_per_round": T, "platform": P}
+
+Usage: python scripts/convergence_bench.py [N ...]  (default 256 1024 4096)
+"""
+
+import json
+import os
+import sys
+import time
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax.numpy as jnp  # noqa: E402
+import jax.random as jr  # noqa: E402
+
+from corrosion_tpu.sim.scale_step import (  # noqa: E402
+    ScaleRoundInput,
+    ScaleSimState,
+    scale_crdt_metrics,
+    scale_run_rounds,
+    scale_sim_config,
+)
+from corrosion_tpu.sim.transport import NetModel  # noqa: E402
+
+CHUNK = 8
+MAX_ROUNDS = 512
+BURST_ROUNDS = 6
+
+
+def run_one(n: int) -> dict:
+    cfg = scale_sim_config(n, n_origins=min(16, n))
+    net = NetModel.create(n, drop_prob=0.02)
+    st = ScaleSimState.create(cfg)
+    key = jr.key(0)
+    quiet = ScaleRoundInput.quiet(cfg)
+
+    burst = jax.tree.map(
+        lambda a: jnp.broadcast_to(a, (BURST_ROUNDS,) + a.shape), quiet
+    )
+    k1, k2, k3 = jr.split(jr.key(1), 3)
+    w = (jr.uniform(k1, (BURST_ROUNDS, n)) < 0.5) & (
+        jnp.arange(n)[None, :] < cfg.n_origins
+    )
+    burst = burst._replace(
+        write_mask=w,
+        write_cell=jr.randint(
+            k2, (BURST_ROUNDS, n), 0, cfg.n_cells, dtype=jnp.int32
+        ),
+        write_val=jr.randint(
+            k3, (BURST_ROUNDS, n), 0, 1 << 20, dtype=jnp.int32
+        ),
+    )
+    quiet_chunk = jax.tree.map(
+        lambda a: jnp.broadcast_to(a, (CHUNK,) + a.shape), quiet
+    )
+
+    st, _ = scale_run_rounds(cfg, st, net, key, burst)
+    rounds = BURST_ROUNDS
+    t0 = time.perf_counter()
+    timed_rounds = 0
+    while rounds < MAX_ROUNDS:
+        st, _ = scale_run_rounds(
+            cfg, st, net, jr.fold_in(key, rounds), quiet_chunk
+        )
+        jax.block_until_ready(st)
+        rounds += CHUNK
+        timed_rounds += CHUNK
+        m = scale_crdt_metrics(cfg, st)
+        if bool(m["converged"]):
+            break
+    dt = time.perf_counter() - t0
+    return {
+        "n": n,
+        "rounds_to_convergence": rounds,
+        "converged": bool(scale_crdt_metrics(cfg, st)["converged"]),
+        "ms_per_round": round(dt * 1000 / max(1, timed_rounds), 3),
+        "platform": jax.devices()[0].platform,
+    }
+
+
+def main():
+    sizes = [int(a) for a in sys.argv[1:]] or [256, 1024, 4096]
+    for n in sizes:
+        print(json.dumps(run_one(n)), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -129,3 +129,39 @@ def test_fused_scale_round_matches_unfused():
             megakernel.FORCE_FUSED = None
     for a, b in zip(jax.tree.leaves(outs[False]), jax.tree.leaves(outs[True])):
         assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fused_kernels_multi_block():
+    """n above the pallas block size (grid > 1): per-block node ids must
+    stay GLOBAL (regression: an in-kernel arange is block-local and
+    corrupts every self-entry beyond block 0)."""
+    from corrosion_tpu.sim.scale import (
+        ScaleSwimState,
+        scale_config,
+        scale_swim_step,
+    )
+    from corrosion_tpu.sim.transport import NetModel
+
+    n = 2048  # _block_size -> 1024, grid (2,)
+    cfg = scale_config(n)
+    net = NetModel.create(n, drop_prob=0.05)
+    key = jr.key(11)
+    outs = {}
+    for fused in (False, True):
+        try:
+            megakernel.FORCE_FUSED = fused
+            st = ScaleSwimState.create(cfg)
+            for r in range(3):
+                st, info, channels = scale_swim_step(
+                    cfg, st, net, jr.fold_in(key, r)
+                )
+            outs[fused] = st
+        finally:
+            megakernel.FORCE_FUSED = None
+    for a, b in zip(jax.tree.leaves(outs[False]), jax.tree.leaves(outs[True])):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    # every node's self slot still names the node itself (global ids)
+    st = outs[True]
+    iarr = np.arange(n)
+    self_ids = np.asarray(st.mem_id)[iarr, iarr % cfg.m_slots]
+    assert (self_ids == iarr).all()
