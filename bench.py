@@ -43,6 +43,9 @@ def _probe() -> None:
 
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    from corrosion_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
     import jax.numpy as jnp
 
     x = jnp.ones((256, 256))
@@ -60,6 +63,10 @@ def _worker() -> None:
     # which outranks the JAX_PLATFORMS env var — re-honor the env var
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    from corrosion_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
 
     import jax.numpy as jnp
     import jax.random as jr
@@ -82,7 +89,8 @@ def _worker() -> None:
     rounds = int(os.environ.get("BENCH_ROUNDS", 8 if on_tpu else 4))
     reps = int(os.environ.get("BENCH_REPS", 12 if on_tpu else 2))
 
-    cfg = scale_sim_config(n_nodes, n_origins=min(16, n_nodes))
+    n_origins = min(int(os.environ.get("BENCH_ORIGINS", "16")), n_nodes)
+    cfg = scale_sim_config(n_nodes, n_origins=n_origins)
     key = jr.key(0)
     st = ScaleSimState.create(cfg)
     net = NetModel.create(n_nodes, drop_prob=0.01)
@@ -112,6 +120,8 @@ def _worker() -> None:
     dt = time.perf_counter() - t0
 
     rps = reps * rounds / dt
+    from corrosion_tpu.ops import megakernel
+
     print(
         json.dumps(
             {
@@ -122,6 +132,12 @@ def _worker() -> None:
                 "value": round(rps, 2),
                 "unit": "rounds/s",
                 "vs_baseline": round(rps / TARGET_RPS, 4),
+                "platform": platform,
+                "n_origins": cfg.n_origins,
+                # loud fused-path visibility (VERDICT r2 weak #2): a TPU
+                # record measured on the XLA fallback is flagged, not
+                # silently reported as if it were the pallas path
+                "pallas_fused": bool(megakernel.use_fused()),
             }
         )
     )
@@ -165,88 +181,145 @@ def _attempt(env_extra: dict, timeout_s: float,
 
 
 def main() -> None:
-    want_platform = os.environ.get("JAX_PLATFORMS", "")
-    # cheap init probe first: TPU backend init has been observed to hang
-    # for >9 min when the tunnel is down — don't burn full-bench timeouts
-    # discovering that. Two probe tries with backoff, then CPU fallback.
-    backend_ok = want_platform == "cpu"
-    if not backend_ok:
-        for i in range(2):
-            rec, err = _attempt({}, 300.0, probe=True)
-            if rec is not None:
-                plat = rec.get("platform")
-                if want_platform or plat not in (None, "cpu"):
-                    backend_ok = True
-                else:
-                    # jax silently fell back to its CPU backend: an "auto"
-                    # run would measure an incomparable small-N CPU number
-                    # and mask the TPU outage — route to the explicit
-                    # cpu-fallback record instead
-                    err = f"probe initialized platform {plat!r}, not TPU"
-                if backend_ok:
-                    break
-            print(f"backend probe #{i} failed: {err}", file=sys.stderr)
-            time.sleep(15.0)
+    """TPU-or-bust supervisor (round-2 post-mortem: two 300 s probes
+    failed and the ladder never made a single full TPU attempt — the
+    round shipped a CPU record while the builder's own later runs showed
+    the tunnel recovering >10 min in).
 
-    # attempt ladder: (label, env overrides, timeout seconds)
-    ladder: list[tuple[str, dict, float]] = []
-    if backend_ok and want_platform and want_platform != "cpu":
-        # explicit platform request: honor it, with retries
-        for i in range(3):
-            ladder.append((f"{want_platform}#{i}", {}, 1500.0))
-    elif backend_ok and want_platform == "cpu":
-        ladder.append(("cpu#0", {}, 1500.0))
-    elif backend_ok:
-        # default: whatever backend jax picks (TPU when the tunnel is up),
-        # retried with backoff; then a degraded-N attempt
-        ladder.append(("auto#0", {}, 1500.0))
-        ladder.append(("auto#1", {}, 1200.0))
-        ladder.append(
-            ("auto-degraded", {"BENCH_NODES": "50000", "BENCH_ROUNDS": "50"}, 1200.0)
-        )
-    # final fallback: CPU at reduced N so the record is never empty
-    ladder.append(
-        (
-            "cpu-fallback",
-            {
-                "JAX_PLATFORMS": "cpu",
-                "BENCH_NODES": os.environ.get("BENCH_CPU_NODES", "4096"),
-                "BENCH_ROUNDS": "8",
-                "BENCH_REPS": "2",
-            },
-            1200.0,
-        )
-    )
+    Strategy: within a deadline budget (``BENCH_DEADLINE_S``, default
+    5400 s), alternate cheap init probes with FULL TPU attempts — a probe
+    failure *degrades* the next attempt (smaller N compiles faster) but
+    never skips TPU. The persistent compilation cache
+    (``corrosion_tpu/utils/compile_cache.py``) makes every retry after
+    the first compile-free. A 900 s reserve always leaves room for the
+    CPU fallback so the round is never benchless."""
+    want_platform = os.environ.get("JAX_PLATFORMS", "")
+    deadline = time.time() + float(os.environ.get("BENCH_DEADLINE_S", "5400"))
+    cpu_reserve = 900.0
+
+    def remaining() -> float:
+        return deadline - time.time()
 
     errors: list[str] = []
-    backoff = 10.0
-    for idx, (label, env_extra, timeout_s) in enumerate(ladder):
+
+    def finish(rec: dict) -> None:
+        if errors:
+            rec["attempts_failed"] = errors
+        print(json.dumps(rec))
+
+    def try_one(label: str, env_extra: dict, timeout_s: float,
+                probe: bool = False, is_reserve: bool = False):
+        # TPU rungs leave the CPU reserve untouched; the fallback itself
+        # spends the reserve
+        budget = remaining() if is_reserve else remaining() - cpu_reserve
+        timeout_s = min(timeout_s, max(60.0, budget))
         t0 = time.time()
-        rec, err = _attempt(env_extra, timeout_s)
+        rec, err = _attempt(env_extra, timeout_s, probe=probe)
+        if rec is None:
+            msg = f"attempt {label} failed after {time.time() - t0:.0f}s: {err}"
+            print(msg, file=sys.stderr)
+            errors.append(f"{label}: {err[:300]}")
+        return rec
+
+    if want_platform == "cpu":
+        rec = try_one("cpu#0", {}, 1500.0)
         if rec is not None:
-            if errors:
-                rec["attempts_failed"] = errors
-            print(json.dumps(rec))
-            return
-        msg = f"attempt {label} failed after {time.time() - t0:.0f}s: {err}"
-        print(msg, file=sys.stderr)
-        errors.append(f"{label}: {err[:300]}")
-        if idx + 1 < len(ladder):
-            time.sleep(backoff)
-            backoff = min(backoff * 2, 60.0)
+            return finish(rec)
+    else:
+        # TPU pursuit: (probe?, label, env, timeout, sleep_after_failure)
+        plan = [
+            (True, "probe#0", {}, 300.0, 30.0),
+            (False, "full#0", {}, 1600.0, 60.0),
+            (True, "probe#1", {}, 300.0, 60.0),
+            (False, "degraded-50k", {"BENCH_NODES": "50000"}, 1200.0, 120.0),
+            (True, "probe#2", {}, 450.0, 120.0),
+            (False, "full#1", {}, 1600.0, 120.0),
+            (True, "probe#3", {}, 600.0, 60.0),
+            (False, "degraded-25k",
+             {"BENCH_NODES": "25000", "BENCH_REPS": "8"}, 1200.0, 60.0),
+            (False, "full#2", {}, 1600.0, 0.0),
+        ]
+        def probe_says_tpu(label, env_extra, timeout_s) -> bool:
+            rec = try_one(label, env_extra, timeout_s, probe=True)
+            if rec is None:
+                return False
+            plat = rec.get("platform")
+            if plat in (None, "cpu") and not want_platform:
+                # jax silently fell back to its CPU backend: a full
+                # "auto" run would measure an incomparable small-N CPU
+                # number and mask the TPU outage
+                errors.append(
+                    f"{label}: initialized platform {plat!r}, not TPU"
+                )
+                return False
+            return True
+
+        def full_attempt(label, env_extra, timeout_s):
+            rec = try_one(label, env_extra, timeout_s)
+            if rec is not None and (
+                rec.get("platform") == "cpu" and not want_platform
+            ):
+                errors.append(f"{label}: worker ran on cpu backend, not TPU")
+                return None
+            return rec
+
+        for is_probe, label, env_extra, timeout_s, sleep_s in plan:
+            if remaining() <= cpu_reserve + 120.0:
+                errors.append(f"{label}: skipped, deadline budget exhausted")
+                break
+            if is_probe:
+                ok = probe_says_tpu(label, env_extra, timeout_s)
+            else:
+                # degraded rungs run whenever reached — a full-N attempt
+                # already failed by then, and the failure may be
+                # N-dependent (timeout/OOM) even on a healthy tunnel
+                rec = full_attempt(label, env_extra, timeout_s)
+                if rec is not None:
+                    return finish(rec)
+                ok = False
+            # sleep after ANY failed rung: the tunnel has been observed
+            # to hang >9 min and then recover — give it time
+            if not ok and sleep_s and remaining() > cpu_reserve + sleep_s:
+                time.sleep(sleep_s)
+
+        # recovery loop: the plan burned ~30 min at most; spend whatever
+        # deadline budget remains alternating probe -> full attempt so a
+        # tunnel that comes back late in the window still yields a TPU
+        # record (compilation is cached, so retries are cheap)
+        r = 0
+        while remaining() > cpu_reserve + 720.0:
+            r += 1
+            if probe_says_tpu(f"probe#r{r}", {}, 300.0):
+                rec = full_attempt(f"full#r{r}", {}, 1600.0)
+                if rec is not None:
+                    return finish(rec)
+            if remaining() > cpu_reserve + 720.0:
+                time.sleep(240.0)
+
+    # final fallback: CPU at reduced N so the record is never empty
+    rec = try_one(
+        "cpu-fallback",
+        {
+            "JAX_PLATFORMS": "cpu",
+            "BENCH_NODES": os.environ.get("BENCH_CPU_NODES", "4096"),
+            "BENCH_ROUNDS": "8",
+            "BENCH_REPS": "2",
+        },
+        1200.0,
+        is_reserve=True,
+    )
+    if rec is not None:
+        return finish(rec)
 
     # total failure: emit an explicit diagnostic record, never an empty round
-    print(
-        json.dumps(
-            {
-                "metric": "gossip_rounds_per_sec_unavailable",
-                "value": 0.0,
-                "unit": "rounds/s",
-                "vs_baseline": 0.0,
-                "error": "all bench attempts failed",
-                "attempts_failed": errors,
-            }
-        )
+    finish(
+        {
+            "metric": "gossip_rounds_per_sec_unavailable",
+            "value": 0.0,
+            "unit": "rounds/s",
+            "vs_baseline": 0.0,
+            "error": "all bench attempts failed",
+        }
     )
 
 

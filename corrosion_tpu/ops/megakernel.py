@@ -119,8 +119,29 @@ def _pallas_works() -> bool:
                     for a, b in zip(want, got)
                 )
             _pallas_ok_cache[backend] = ok
+            if not ok and backend != "cpu":
+                import sys
+
+                print(
+                    "WARNING: pallas megakernel probe MISMATCHED the XLA "
+                    f"path on backend {backend!r}; every caller degrades "
+                    "to the (much slower) XLA form. Investigate "
+                    "ops/megakernel.py before trusting TPU perf numbers.",
+                    file=sys.stderr, flush=True,
+                )
         except Exception:  # noqa: BLE001 — any lowering failure means "no"
             _pallas_ok_cache[backend] = False
+            if backend != "cpu":
+                import sys
+                import traceback
+
+                print(
+                    "WARNING: pallas megakernel failed to lower/run on "
+                    f"backend {backend!r}; every caller degrades to the "
+                    "(much slower) XLA form. Traceback:",
+                    file=sys.stderr, flush=True,
+                )
+                traceback.print_exc()
     return _pallas_ok_cache[backend]
 
 

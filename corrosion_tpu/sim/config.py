@@ -53,6 +53,12 @@ class SimConfig:
     sync_interval: int = 8  # rounds between sync attempts per node
     sync_peers: int = 2  # peers per sync round (clamp(members/100, 3, 10) analog)
     sync_chunk: int = 32  # max versions pulled per (peer, origin) per round
+    # server-side load adaptation (agent.rs:143 serve permits = 3,
+    # rejection peer/mod.rs:1462-1479, adaptive chunk peer/mod.rs:364-368):
+    # clients of an overloaded server are shed down to ~4x the permit
+    # count and the survivors' grants shrink toward sync_min_chunk
+    serve_cap: int = 3
+    sync_min_chunk: int = 4
 
     @property
     def n_cells(self) -> int:

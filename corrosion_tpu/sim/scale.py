@@ -64,7 +64,13 @@ from corrosion_tpu.ops.dense import (
     select_cols,
 )
 from corrosion_tpu.ops.select import sample_k, sample_one
-from corrosion_tpu.sim.transport import NetModel, datagram_ok
+from corrosion_tpu.sim.transport import (
+    CARD_EXTRA,
+    NetModel,
+    card_at,
+    datagram_ok_c,
+    link_card,
+)
 
 FREE = -1  # plain int: referenced inside the pallas swim kernel, where a
 # module-level device array would be a captured constant
@@ -331,13 +337,20 @@ def scale_swim_step(
     not_self = mem_id != iarr[:, None]
     bel_alive = occupied & not_self & (mem_view >= 0) & ((mem_view & 3) == STATE_ALIVE)
 
+    # node card: every per-node scalar the round reads remotely, so each
+    # peer-index array costs ONE fast row gather instead of several
+    # per-element gathers (see transport.py "node cards")
+    card = link_card(net, alive, extra=(inc,))
+    CARD_INC = CARD_EXTRA
+
     # --- probe target: one believed-alive table entry -------------------
     probe_slot, has_slot = sample_one(bel_alive, k_tgt)
     tgt = jnp.clip(select_cols(mem_id, probe_slot[:, None])[:, 0], 0)
     has_tgt = alive & has_slot
+    tgt_card = card_at(card, tgt)  # [N, C]
 
-    leg_out = has_tgt & datagram_ok(net, k_p1, alive, iarr, tgt)
-    leg_back = datagram_ok(net, k_p2, alive, tgt, iarr)
+    leg_out = has_tgt & datagram_ok_c(net, k_p1, card, tgt_card)
+    leg_back = datagram_ok_c(net, k_p2, tgt_card, card)
     probe_ok = leg_out & leg_back
 
     # --- indirect probes through helper entries -------------------------
@@ -345,13 +358,14 @@ def scale_swim_step(
     h_slots, h_valid = sample_k(h_mask, max(1, cfg.n_indirect), k_help)
     helpers = jnp.clip(select_cols(mem_id, h_slots), 0)
     k1, k2, k3, k4 = jr.split(k_ind, 4)
-    src_b = jnp.broadcast_to(iarr[:, None], helpers.shape)
-    tgt_b = jnp.broadcast_to(tgt[:, None], helpers.shape)
+    helper_card = card_at(card, helpers)  # [N, H, C]
+    self_b = card[:, None, :]
+    tgt_b = tgt_card[:, None, :]
     ind_leg = (
-        datagram_ok(net, k1, alive, src_b, helpers)
-        & datagram_ok(net, k2, alive, helpers, tgt_b)
-        & datagram_ok(net, k3, alive, tgt_b, helpers)
-        & datagram_ok(net, k4, alive, helpers, src_b)
+        datagram_ok_c(net, k1, self_b, helper_card)
+        & datagram_ok_c(net, k2, helper_card, tgt_b)
+        & datagram_ok_c(net, k3, tgt_b, helper_card)
+        & datagram_ok_c(net, k4, helper_card, self_b)
     )
     ind_ok = jnp.any(h_valid & ind_leg, axis=1) & has_tgt
     acked = probe_ok | ind_ok
@@ -361,7 +375,9 @@ def scale_swim_step(
     # (the suspect mark itself lands inside swim_tables_update)
     cur = select_cols(mem_view, probe_slot[:, None])[:, 0]
     suspect_key = (cur >> 2) * 4 + STATE_SUSPECT
-    notify_ok = failed & datagram_ok(net, jr.fold_in(k_p1, 1), alive, iarr, tgt)
+    notify_ok = failed & datagram_ok_c(
+        net, jr.fold_in(k_p1, 1), card, tgt_card
+    )
     sus_heard = (
         jnp.full(n, -1, jnp.int32)
         .at[tgt]
@@ -375,9 +391,10 @@ def scale_swim_step(
     known = occupied & not_self
     ann_slot, has_known = sample_one(known, k_annt)
     ann_tgt = jnp.clip(select_cols(mem_id, ann_slot[:, None])[:, 0], 0)
+    ann_card = card_at(card, ann_tgt)
     announcing = announcing & has_known
-    ann_out = announcing & datagram_ok(net, k_ann1, alive, iarr, ann_tgt)
-    ann_back = ann_out & datagram_ok(net, k_ann2, alive, ann_tgt, iarr)
+    ann_out = announcing & datagram_ok_c(net, k_ann1, card, ann_card)
+    ann_back = ann_out & datagram_ok_c(net, k_ann2, ann_card, card)
 
     # down-notice: the announce receiver's (possibly stale) belief about
     # the announcer rides the reply; a non-alive belief at >= our
@@ -410,16 +427,24 @@ def scale_swim_step(
         (jnp.clip(announcer_of, 0), has_announcer),
         (ann_tgt, ann_back),
     ]
+    # sender incarnations ride the cards (one row gather per channel for
+    # the two senders whose cards aren't already gathered)
+    ch_cards = [
+        card_at(card, channels[0][0]),
+        tgt_card,
+        card_at(card, channels[2][0]),
+        ann_card,
+    ]
     ch_in_id, ch_in_view, ch_in_send, ch_valid, ch_snd, ch_snd_inc = (
         [], [], [], [], [], [],
     )
-    for src, valid in channels:
+    for (src, valid), s_card in zip(channels, ch_cards):
         ch_in_id.append(jax.lax.optimization_barrier(old_id[src]))
         ch_in_view.append(jax.lax.optimization_barrier(old_view[src]))
         ch_in_send.append(jax.lax.optimization_barrier(sendable[src]))
         ch_valid.append(valid)
         ch_snd.append(src)
-        ch_snd_inc.append(inc[src])
+        ch_snd_inc.append(s_card[:, CARD_INC])
 
     sends = (
         has_tgt.astype(jnp.int32)  # probe we sent

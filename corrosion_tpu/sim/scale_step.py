@@ -56,7 +56,12 @@ from corrosion_tpu.sim.scale import (
     scale_swim_metrics,
     scale_swim_step,
 )
-from corrosion_tpu.sim.transport import NetModel, ring_of
+from corrosion_tpu.sim.transport import (
+    NetModel,
+    card_at,
+    link_card,
+    ring_of_c,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -93,6 +98,9 @@ class ScaleSimConfig:
     sync_interval: int = 8
     sync_peers: int = 2
     sync_chunk: int = 32
+    # server-side load adaptation (see SimConfig.serve_cap)
+    serve_cap: int = 3
+    sync_min_chunk: int = 4
     # cohort scheduling: run the (dense, whole-cluster) sync phase once
     # every sync_interval rounds with every node participating, instead
     # of a 1/interval per-node draw every round — same average sync rate,
@@ -319,9 +327,9 @@ def scale_sim_step(
         cand_slots, cand_sok = sample_k(bel_alive, min(2 * p_cnt, m), k_sp)
         cand_ids = select_cols(swim.mem_id, cand_slots)
         staleness = select_cols(cst.last_sync, cand_slots)
-        rings_c = ring_of(
-            net, jnp.broadcast_to(iarr[:, None], cand_ids.shape),
-            jnp.clip(cand_ids, 0),
+        card = link_card(net, swim.alive)
+        rings_c = ring_of_c(
+            net, card[:, None, :], card_at(card, jnp.clip(cand_ids, 0))
         )
         peers, p_ok, c_idx = choose_sync_peers(
             cfg, cst.book, cand_ids, cand_sok, staleness, rings_c, p_cnt
@@ -342,7 +350,7 @@ def scale_sim_step(
             zero = jnp.int32(0)
             return cst, {
                 "syncs": zero, "cells_pulled": zero,
-                "versions_granted": zero,
+                "versions_granted": zero, "serve_rejects": zero,
             }
 
         cst, s_info = jax.lax.cond(

@@ -38,7 +38,14 @@ from corrosion_tpu.ops.partials import drop_stale_partials
 from corrosion_tpu.ops.versions import advance_heads, needs_count, raise_heads
 from corrosion_tpu.sim.broadcast import LAST_SYNC_CAP, CrdtState, hlc_fold
 from corrosion_tpu.sim.config import SimConfig
-from corrosion_tpu.sim.transport import N_RINGS, NetModel, bi_ok
+from corrosion_tpu.sim.transport import (
+    CARD_EXTRA,
+    N_RINGS,
+    NetModel,
+    bi_ok_c,
+    card_at,
+    link_card,
+)
 
 
 def choose_sync_peers(cfg, book, cand_ids, cand_ok, staleness, rings, k):
@@ -98,12 +105,50 @@ def sync_step(
         syncing = alive & (
             jr.uniform(k_go, (n,)) < 1.0 / max(1, cfg.sync_interval)
         )
-    src = jnp.broadcast_to(iarr[:, None], peers.shape)
-    ok = syncing[:, None] & p_ok & bi_ok(net, k_bi, alive, src, peers)
+    # node card: link fields + HLC, one row gather for all of them
+    # (see transport.py "node cards")
+    card = link_card(net, alive, extra=(cst.hlc,))
+    CARD_HLC = CARD_EXTRA
+    peer_card = card_at(card, peers)  # [N, P, C]
+    ok = syncing[:, None] & p_ok & bi_ok_c(
+        net, k_bi, card[:, None, :], peer_card
+    )
+
+    # --- server-side load adaptation ------------------------------------
+    # The reference caps concurrent sync serves at 3 (``agent.rs:143``;
+    # rejection ``peer/mod.rs:1462-1479``) and adapts its stream chunk
+    # 8 KiB -> 1 KiB for slow/loaded peers (``peer/mod.rs:364-368``).
+    # Dense analog: count this round's serve requests per server; clients
+    # of a server loaded past ~4x its permits are shed (they retry a later
+    # cohort round — budget-shaped degradation that sync then repairs),
+    # and the survivors' version grants shrink toward ``sync_min_chunk``
+    # so a server's expected granted work stays ~serve_cap * sync_chunk.
+    serve_cap = max(1, cfg.serve_cap)
+    load = (
+        jnp.zeros(n + 1, jnp.int32)
+        .at[jnp.where(ok, peers, n).reshape(-1)]
+        .add(1, mode="drop")[:n]
+    )
+    loadp = card_at(load[:, None], peers)[..., 0]  # [N, P]
+    k_adm = jr.fold_in(k_bi, 7)
+    admit_p = jnp.where(
+        loadp > 4 * serve_cap,
+        (4.0 * serve_cap) / jnp.maximum(loadp, 1).astype(jnp.float32),
+        1.0,
+    )
+    admitted = ok & (jr.uniform(k_adm, ok.shape) < admit_p)
+    rejects = jnp.sum(ok & ~admitted)
+    ok = admitted
+    chunk_eff = jnp.clip(
+        (cfg.sync_chunk * serve_cap)
+        // jnp.maximum(loadp, serve_cap),
+        min(cfg.sync_min_chunk, cfg.sync_chunk),
+        cfg.sync_chunk,
+    )  # [N, P]
 
     head_i = cst.book.head  # [N, O]
-    head_p = cst.book.head[peers]  # [N, P, O]
-    granted = jnp.minimum(head_p, head_i[:, None, :] + cfg.sync_chunk)
+    head_p = jax.lax.optimization_barrier(cst.book.head[peers])  # [N, P, O]
+    granted = jnp.minimum(head_p, head_i[:, None, :] + chunk_eff[:, :, None])
     granted = jnp.where(ok[:, :, None], granted, 0)  # [N, P, O]
 
     # --- transfer: masked elementwise merge per peer --------------------
@@ -150,7 +195,9 @@ def sync_step(
     # the head jump goes through raise_heads: the seen window is
     # head-relative and must be rebased alongside the jump
     new_head = jnp.maximum(head_i, jnp.max(granted, axis=1))
-    km_p = cst.book.known_max[peers]  # [N, P, O]
+    km_p = jax.lax.optimization_barrier(
+        cst.book.known_max[peers]
+    )  # [N, P, O]
     km_p = jnp.where(ok[:, :, None], km_p, 0)
     new_km = jnp.maximum(cst.book.known_max, jnp.max(km_p, axis=1))
     book = raise_heads(cst.book, new_head)
@@ -166,7 +213,7 @@ def sync_step(
 
     # sync handshake exchanges HLC clocks; BOTH sides fold, with the same
     # max-drift rejection as change ingest (peer/mod.rs:1439-1458)
-    hlc, _, _ = hlc_fold(cst.hlc, cst.now, cst.hlc[peers], ok)
+    hlc, _, _ = hlc_fold(cst.hlc, cst.now, peer_card[..., CARD_HLC], ok)
     # server side: peer p folds the client's clock (scatter-max)
     from corrosion_tpu.sim.broadcast import HLC_MAX_DRIFT_ROUNDS, HLC_ROUND_BITS
     client_ts = jnp.broadcast_to(cst.hlc[:, None], peers.shape)
@@ -185,5 +232,6 @@ def sync_step(
         "versions_granted": jnp.sum(
             jnp.maximum(jnp.max(granted, axis=1) - head_i, 0)
         ),
+        "serve_rejects": rejects,
     }
     return cst._replace(store=store, book=book), ok, info

@@ -97,6 +97,75 @@ def datagram_ok(net: NetModel, key, alive, src, dst):
 uni_ok = datagram_ok
 
 
+# --- node cards: batched per-node fields for the 100k path ---------------
+# On the target TPU backend a 1-D gather ``alive[idx]`` lowers to the
+# per-ELEMENT index class (~9 ns/element, PERF.md) while multi-column row
+# gathers run at full HBM bandwidth. The scale path therefore packs every
+# per-node scalar the round needs remotely (liveness, partition group,
+# cluster id, region, incarnation, HLC, ...) into one [N, C] int32 "node
+# card"; ONE barriered row gather per distinct peer-index array replaces
+# the ~6 element gathers each transport predicate would otherwise issue.
+# Semantics are identical to the predicate forms above (same fields, same
+# comparisons) — this is purely a lowering-shape change.
+
+CARD_ALIVE, CARD_PART, CARD_CLUSTER, CARD_REGION = 0, 1, 2, 3
+CARD_EXTRA = 4  # first caller-defined column
+
+
+def link_card(net: NetModel, alive, extra=()):
+    """Build the [N, 4+len(extra)] node card (columns CARD_*)."""
+    cols = [alive.astype(jnp.int32), net.partition, net.cluster_id,
+            net.region]
+    cols += [e.astype(jnp.int32) for e in extra]
+    return jnp.stack(cols, axis=1)
+
+
+def card_at(card, idx):
+    """Row-gather card rows for an arbitrary-shape index array.
+
+    Barriered — an unbarriered row gather gets fused into its elementwise
+    consumers and scalarized by this backend (PERF.md)."""
+    flat = jnp.clip(idx.reshape(-1), 0)
+    got = jax.lax.optimization_barrier(card[flat])
+    return got.reshape(idx.shape + (card.shape[1],))
+
+
+def _link_ok_c(a, b):
+    return (
+        (a[..., CARD_ALIVE] != 0)
+        & (b[..., CARD_ALIVE] != 0)
+        & (a[..., CARD_PART] == b[..., CARD_PART])
+        & (a[..., CARD_CLUSTER] == b[..., CARD_CLUSTER])
+    )
+
+
+def datagram_ok_c(net: NetModel, key, src_card, dst_card):
+    """Card form of :func:`datagram_ok` (src/dst pre-gathered rows,
+    broadcastable against each other)."""
+    shape = jnp.broadcast_shapes(src_card.shape[:-1], dst_card.shape[:-1])
+    drop = jr.uniform(key, shape) < net.drop_prob
+    return _link_ok_c(src_card, dst_card) & ~drop
+
+
+def bi_ok_c(net: NetModel, key, src_card, dst_card):
+    """Card form of :func:`bi_ok` (two loss draws, same link predicate)."""
+    k1, k2 = jr.split(key)
+    shape = jnp.broadcast_shapes(src_card.shape[:-1], dst_card.shape[:-1])
+    drop = (jr.uniform(k1, shape) < net.drop_prob) | (
+        jr.uniform(k2, shape) < net.drop_prob
+    )
+    return _link_ok_c(src_card, dst_card) & ~drop
+
+
+def ring_of_c(net: NetModel, a_card, b_card):
+    """Card form of :func:`ring_of` — region columns already gathered."""
+    ra, rb = a_card[..., CARD_REGION], b_card[..., CARD_REGION]
+    d = jnp.abs(ra - rb)
+    n = jnp.maximum(jnp.max(net.region) + 1, 1)
+    circ = jnp.minimum(d, n - d)
+    return jnp.minimum(circ, N_RINGS - 1).astype(jnp.int32)
+
+
 def bi_ok(net: NetModel, key, alive, src, dst):
     """Sync bi-stream availability.
 

@@ -1,0 +1,35 @@
+"""Persistent XLA compilation cache for bench/profile runs.
+
+Whole-program compiles of the 100k-node round cost ~195 s on the TPU
+tunnel (PERF.md); the tunnel itself is flaky enough that bench attempts
+get retried. The persistent cache makes every retry after the first pay
+dispatch cost only, so a tunnel that recovers minutes into the capture
+window still produces a full TPU record (the round-2 post-mortem:
+both probes timed out and the bench never re-tried TPU at all).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def default_cache_dir() -> str:
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(repo, ".jax_cache")
+
+
+def enable_compile_cache(path: str | None = None) -> str:
+    """Idempotently point JAX's persistent compilation cache at ``path``
+    (default: ``<repo>/.jax_cache``). Call before the first jit."""
+    import jax
+
+    path = path or os.environ.get("JAX_COMPILATION_CACHE_DIR") or \
+        default_cache_dir()
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    # cache everything that took meaningful compile time; the default
+    # (1 s? backend-dependent) can skip mid-sized programs
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    return path

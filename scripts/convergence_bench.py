@@ -23,8 +23,11 @@ import jax
 
 if os.environ.get("JAX_PLATFORMS"):
     jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from corrosion_tpu.utils.compile_cache import enable_compile_cache
+
+enable_compile_cache()
+
 
 import jax.numpy as jnp  # noqa: E402
 import jax.random as jr  # noqa: E402
@@ -39,12 +42,15 @@ from corrosion_tpu.sim.scale_step import (  # noqa: E402
 from corrosion_tpu.sim.transport import NetModel  # noqa: E402
 
 CHUNK = 8
-MAX_ROUNDS = 512
+MAX_ROUNDS = 1024
 BURST_ROUNDS = 6
 
 
-def run_one(n: int) -> dict:
-    cfg = scale_sim_config(n, n_origins=min(16, n))
+def run_one(n: int, faults: bool = True, n_origins: int | None = None) -> dict:
+    """Write burst (+ optional kills/partition) -> heal -> quiet rounds
+    until the convergence predicate holds."""
+    n_origins = n_origins or int(os.environ.get("CONV_ORIGINS", "16"))
+    cfg = scale_sim_config(n, n_origins=min(n_origins, n))
     net = NetModel.create(n, drop_prob=0.02)
     st = ScaleSimState.create(cfg)
     key = jr.key(0)
@@ -53,7 +59,7 @@ def run_one(n: int) -> dict:
     burst = jax.tree.map(
         lambda a: jnp.broadcast_to(a, (BURST_ROUNDS,) + a.shape), quiet
     )
-    k1, k2, k3 = jr.split(jr.key(1), 3)
+    k1, k2, k3, k4 = jr.split(jr.key(1), 4)
     w = (jr.uniform(k1, (BURST_ROUNDS, n)) < 0.5) & (
         jnp.arange(n)[None, :] < cfg.n_origins
     )
@@ -66,18 +72,40 @@ def run_one(n: int) -> dict:
             k3, (BURST_ROUNDS, n), 0, 1 << 20, dtype=jnp.int32
         ),
     )
+    net_burst = net
+    if faults:
+        # fault mix during the burst (BASELINE full-mix shape): 1% of
+        # non-origin nodes die and the cluster splits into two halves;
+        # the quiet phase heals + revives, and convergence is measured
+        # from the heal
+        killed = (jr.uniform(k4, (n,)) < 0.01) & (
+            jnp.arange(n) >= cfg.n_origins
+        )
+        kill = jnp.zeros((BURST_ROUNDS, n), bool).at[1].set(killed)
+        burst = burst._replace(kill=kill)
+        net_burst = net._replace(
+            partition=(jnp.arange(n, dtype=jnp.int32) % 2)
+        )
+
     quiet_chunk = jax.tree.map(
         lambda a: jnp.broadcast_to(a, (CHUNK,) + a.shape), quiet
     )
+    if faults:
+        revive = jnp.zeros((CHUNK, n), bool).at[0].set(killed)
+        first_chunk = quiet_chunk._replace(revive=revive)
+    else:
+        first_chunk = quiet_chunk
 
-    st, _ = scale_run_rounds(cfg, st, net, key, burst)
+    st, _ = scale_run_rounds(cfg, st, net_burst, key, burst)
     rounds = BURST_ROUNDS
     t0 = time.perf_counter()
     timed_rounds = 0
+    chunk_inp = first_chunk
     while rounds < MAX_ROUNDS:
         st, _ = scale_run_rounds(
-            cfg, st, net, jr.fold_in(key, rounds), quiet_chunk
+            cfg, st, net, jr.fold_in(key, rounds), chunk_inp
         )
+        chunk_inp = quiet_chunk
         jax.block_until_ready(st)
         rounds += CHUNK
         timed_rounds += CHUNK
@@ -87,6 +115,8 @@ def run_one(n: int) -> dict:
     dt = time.perf_counter() - t0
     return {
         "n": n,
+        "n_origins": cfg.n_origins,
+        "faults": bool(faults),
         "rounds_to_convergence": rounds,
         "converged": bool(scale_crdt_metrics(cfg, st)["converged"]),
         "ms_per_round": round(dt * 1000 / max(1, timed_rounds), 3),
@@ -95,9 +125,20 @@ def run_one(n: int) -> dict:
 
 
 def main():
-    sizes = [int(a) for a in sys.argv[1:]] or [256, 1024, 4096]
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    out_path = None
+    for a in sys.argv[1:]:
+        if a.startswith("--out="):
+            out_path = a.split("=", 1)[1]
+    sizes = [int(a) for a in args] or [256, 1024, 4096]
+    records = []
     for n in sizes:
-        print(json.dumps(run_one(n)), flush=True)
+        rec = run_one(n)
+        records.append(rec)
+        print(json.dumps(rec), flush=True)
+        if out_path:  # flush after every size — tunnel runs die mid-way
+            with open(out_path, "w") as f:
+                json.dump(records, f, indent=1)
 
 
 if __name__ == "__main__":

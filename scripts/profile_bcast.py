@@ -14,8 +14,11 @@ import jax
 
 if os.environ.get("JAX_PLATFORMS"):
     jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from corrosion_tpu.utils.compile_cache import enable_compile_cache
+
+enable_compile_cache()
+
 
 import jax.numpy as jnp  # noqa: E402
 import jax.random as jr  # noqa: E402

@@ -1,0 +1,100 @@
+"""Sync server-side load adaptation (VERDICT r2 #8).
+
+The reference caps concurrent sync serves at 3 (``corro-types/src/
+agent.rs:143``), rejects clients past the permits
+(``corro-agent/src/api/peer/mod.rs:1462-1479``), and adapts its stream
+chunk 8 KiB -> 1 KiB for slow peers (``peer/mod.rs:364-368``). The dense
+analogs (client shedding at ~4x permits + proportional grant shrink,
+``sim/sync.py``) must (a) bound an overloaded server's granted work near
+``serve_cap * sync_chunk``, (b) leave unloaded servers at full chunk,
+and (c) degrade in a way later sync rounds repair.
+"""
+
+import jax.numpy as jnp
+import jax.random as jr
+import pytest
+
+from corrosion_tpu.sim.scale_step import ScaleSimState, scale_sim_config
+from corrosion_tpu.sim.sync import sync_step
+from corrosion_tpu.sim.transport import NetModel
+
+N = 64
+SERVER_HEAD = 1 << 14  # far more than one chunk
+
+
+@pytest.fixture()
+def rig():
+    cfg = scale_sim_config(
+        N, n_origins=4, sync_chunk=32, sync_min_chunk=4, serve_cap=3
+    )
+    st = ScaleSimState.create(cfg)
+    # node 0 is far ahead on every origin; everyone else is at zero
+    book = st.crdt.book
+    head = book.head.at[0, :].set(SERVER_HEAD)
+    book = book._replace(head=head, known_max=jnp.maximum(book.known_max, head))
+    cst = st.crdt._replace(book=book)
+    net = NetModel.create(N, drop_prob=0.0)
+    return cfg, cst, net
+
+
+def overload_peers(cfg):
+    """Every node syncs to node 0 only (one lane; others invalid)."""
+    peers = jnp.zeros((N, cfg.sync_peers), jnp.int32)
+    p_ok = jnp.zeros((N, cfg.sync_peers), bool).at[:, 0].set(True)
+    p_ok = p_ok.at[0, :].set(False)  # the server itself doesn't self-sync
+    return peers, p_ok
+
+
+def test_overload_bounds_granted_work(rig):
+    cfg, cst, net = rig
+    peers, p_ok = overload_peers(cfg)
+    alive = jnp.ones(N, bool)
+    cst2, ok, info = sync_step(
+        cfg, cst, peers, p_ok, alive, net, jr.key(0), go_all=True
+    )
+    granted = int(info["versions_granted"])
+    # 63 clients of one server: without shedding + chunk shrink this
+    # would be 63 * 32 * n_origins = 8064 granted versions; the analog
+    # bounds expected work near serve_cap * sync_chunk * n_origins = 384
+    # (slack 4x for the probabilistic shed)
+    assert granted > 0
+    assert granted <= 4 * cfg.serve_cap * cfg.sync_chunk * cfg.n_origins
+    assert int(info["serve_rejects"]) > 0
+    # admitted clients progressed, shed clients did not lose anything
+    heads = cst2.book.head[1:, 0]
+    assert int(jnp.max(heads)) > 0
+    assert int(jnp.min(cst2.book.head)) >= 0
+
+
+def test_unloaded_server_grants_full_chunk(rig):
+    cfg, cst, net = rig
+    # a single client (node 1) syncs to node 0: no load, full chunk
+    peers = jnp.zeros((N, cfg.sync_peers), jnp.int32)
+    p_ok = jnp.zeros((N, cfg.sync_peers), bool).at[1, 0].set(True)
+    alive = jnp.ones(N, bool)
+    cst2, ok, info = sync_step(
+        cfg, cst, peers, p_ok, alive, net, jr.key(1), go_all=True
+    )
+    assert bool(ok[1, 0])
+    assert int(info["serve_rejects"]) == 0
+    assert int(cst2.book.head[1, 0]) == cfg.sync_chunk  # ungated grant
+
+
+def test_overload_is_repaired_by_later_rounds(rig):
+    """Shed clients retry on later cohort rounds: total client progress
+    keeps growing — degradation is budget-shaped, not starvation."""
+    cfg, cst, net = rig
+    peers, p_ok = overload_peers(cfg)
+    alive = jnp.ones(N, bool)
+    key = jr.key(2)
+    min_head_prev = 0
+    for r in range(40):
+        key, sub = jr.split(key)
+        cst, ok, info = sync_step(
+            cfg, cst, peers, p_ok, alive, net, sub, go_all=True
+        )
+    min_head = int(jnp.min(cst.book.head[1:, 0]))
+    # 40 overloaded rounds at >= sync_min_chunk each for admitted turns:
+    # every client must have been admitted at least a few times
+    assert min_head > 0
+    assert min_head >= cfg.sync_min_chunk
