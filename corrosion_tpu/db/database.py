@@ -61,7 +61,7 @@ _SELECT_RE = re.compile(r"SELECT\b", re.IGNORECASE)
 #   [LIMIT n [OFFSET m]]
 _KW_RE = re.compile(
     r"\b(FROM|LEFT\s+OUTER\s+JOIN|LEFT\s+JOIN|INNER\s+JOIN|JOIN|ON|WHERE|"
-    r"GROUP\s+BY|ORDER\s+BY|LIMIT|OFFSET)\b",
+    r"GROUP\s+BY|HAVING|ORDER\s+BY|LIMIT|OFFSET)\b",
     re.IGNORECASE,
 )
 _AGG_RE = re.compile(
@@ -76,10 +76,92 @@ _COL_AS_RE = re.compile(
 _COND_RE = re.compile(
     r"^(?P<col>[\w\".]+)\s*(?P<op>=|!=|<>|<=|>=|<|>)\s*(?P<val>.+)$", re.DOTALL
 )
+# HAVING comparisons allow an aggregate call on the left: COUNT(*) > 5
+_HAVING_COND_RE = re.compile(
+    r"^(?P<col>.+?)\s*(?P<op>=|!=|<>|<=|>=|<|>)\s*(?P<val>.+)$", re.DOTALL
+)
+_LIKE_RE = re.compile(
+    r"^(?P<col>[\w\".]+)\s+(?P<neg>NOT\s+)?(?P<fn>LIKE|GLOB)\s+(?P<val>.+)$",
+    re.IGNORECASE | re.DOTALL,
+)
+_IN_RE = re.compile(
+    r"^(?P<col>[\w\".]+)\s+(?P<neg>NOT\s+)?IN\s*\((?P<body>.*)\)$",
+    re.IGNORECASE | re.DOTALL,
+)
 _FUNC_RE = re.compile(
     r"^corro_json_contains\s*\(\s*(?P<a>[^,]+)\s*,\s*(?P<b>.+)\s*\)$",
     re.IGNORECASE | re.DOTALL,
 )
+
+
+import functools
+import string
+
+# SQLite's LIKE folds case for ASCII letters ONLY ('ä' LIKE 'Ä' is 0);
+# both operands are mapped through this table instead of re.IGNORECASE
+_ASCII_LOWER = str.maketrans(string.ascii_uppercase, string.ascii_lowercase)
+
+
+@functools.lru_cache(maxsize=512)
+def _like_to_regex(pattern: str, glob: bool) -> "re.Pattern":
+    """SQLite ``LIKE`` (%/_; caller pre-folds ASCII case) / ``GLOB``
+    (*/?/[...], case-sensitive) pattern -> anchored regex."""
+    out, i = [], 0
+    while i < len(pattern):
+        ch = pattern[i]
+        if not glob and ch == "%":
+            out.append(".*")
+        elif not glob and ch == "_":
+            out.append(".")
+        elif glob and ch == "*":
+            out.append(".*")
+        elif glob and ch == "?":
+            out.append(".")
+        elif glob and ch == "[":
+            j = pattern.find("]", i + 1)
+            if j < 0:
+                out.append(re.escape(ch))
+            else:
+                body = pattern[i + 1 : j]
+                if body.startswith("^"):
+                    body = "^" + re.sub(r"([\\\]])", r"\\\1", body[1:])
+                else:
+                    body = re.sub(r"([\\\]])", r"\\\1", body)
+                out.append("[" + body + "]")
+                i = j
+        else:
+            out.append(re.escape(ch))
+        i += 1
+    return re.compile("^" + "".join(out) + "$")
+
+
+def _split_top_and(s: str) -> List[str]:
+    """Split a WHERE/HAVING conjunction on top-level ``AND`` only —
+    ``AND`` inside parens (subqueries) or strings doesn't count."""
+    parts, start, depth, in_str = [], 0, 0, False
+    i, n = 0, len(s)
+    while i < n:
+        ch = s[i]
+        if in_str:
+            in_str = ch != "'"
+        elif ch == "'":
+            in_str = True
+        elif ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        elif depth == 0 and s[i : i + 3].upper() == "AND" and (
+            i == 0 or not (s[i - 1].isalnum() or s[i - 1] in "_\"")
+        ) and (
+            i + 3 >= n or not (s[i + 3].isalnum() or s[i + 3] in "_\"")
+        ):
+            parts.append(s[start:i])
+            i += 3
+            start = i
+            continue
+        i += 1
+    parts.append(s[start:])
+    return [p.strip() for p in parts if p.strip()]
 
 
 def _unquote(ident: str) -> str:
@@ -469,6 +551,7 @@ class Database:
         aliases: Dict[str, Any] = {}
         joins = []
         where_raw = group_raw = order_raw = limit_raw = offset_raw = None
+        having_raw = None
         i = 0
         while i < len(segs):
             kw, seg = segs[i]
@@ -499,6 +582,8 @@ class Database:
                 where_raw = seg
             elif kw == "GROUP BY":
                 group_raw = seg
+            elif kw == "HAVING":
+                having_raw = seg
             elif kw == "ORDER BY":
                 order_raw = seg
             elif kw == "LIMIT":
@@ -553,26 +638,13 @@ class Database:
             name = _unquote(cm.group("alias") or "") or key.split(".", 1)[1]
             cols.append(("col", key, name))
 
-        # WHERE
-        conds = []
-        if where_raw:
-            for clause in re.split(r"\s+AND\s+", where_raw,
-                                   flags=re.IGNORECASE):
-                clause = clause.strip()
-                fm = _FUNC_RE.match(clause)
-                if fm:
-                    key = resolve(fm.group("a"))
-                    needle = (_parse_literal(fm.group("b"), p)
-                              if check_params else None)
-                    conds.append(("json_contains", key, needle))
-                    continue
-                cm = _COND_RE.match(clause)
-                if cm is None:
-                    raise SqlError(f"unsupported WHERE clause: {clause!r}")
-                key = resolve(cm.group("col"))
-                val = (_parse_literal(cm.group("val"), p)
-                       if check_params else None)
-                conds.append((cm.group("op"), key, val))
+        # WHERE / HAVING conjunctions (shared grammar; HAVING resolves its
+        # left sides per group at execution time, so they stay raw here)
+        conds = (self._parse_conds(where_raw, p, resolve, check_params)
+                 if where_raw else [])
+        having = (self._parse_conds(having_raw, p, resolve, check_params,
+                                    defer_lhs=True)
+                  if having_raw else [])
 
         group = ([resolve(g) for g in _split_top_commas(group_raw)]
                  if group_raw else [])
@@ -598,11 +670,73 @@ class Database:
 
         return {
             "aliases": aliases, "base": base_alias, "joins": joins,
-            "cols": cols, "conds": conds, "group": group, "order": order,
+            "cols": cols, "conds": conds, "having": having, "group": group,
+            "order": order,
             "limit": int_or_param(limit_raw),
             "offset": int_or_param(offset_raw),
             "resolve": resolve,
         }
+
+    def _parse_conds(self, raw: str, p: _Params, resolve, check_params,
+                     defer_lhs: bool = False) -> List[tuple]:
+        """Parse a WHERE/HAVING conjunction into ``(op, lhs, rhs)`` tuples.
+
+        Ops: comparison operators, ``[not] like``/``[not] glob``,
+        ``[not] in`` (literal list or subquery), ``json_contains``. An rhs
+        of ``(SELECT ...)`` parses recursively into a ``("subq", ast)`` /
+        ``("subq_list", ast)`` marker resolved against the queried node at
+        execution (scalar subqueries — ``corro-pg``'s sqlparser surface,
+        ``crates/corro-pg/src/lib.rs``)."""
+        conds: List[tuple] = []
+        res = (lambda r: r.strip()) if defer_lhs else resolve
+        for clause in _split_top_and(raw):
+            fm = _FUNC_RE.match(clause)
+            if fm:
+                needle = (_parse_literal(fm.group("b"), p)
+                          if check_params else None)
+                conds.append(("json_contains", res(fm.group("a")), needle))
+                continue
+            lm = _LIKE_RE.match(clause)
+            if lm:
+                op = (("not " if lm.group("neg") else "")
+                      + lm.group("fn").lower())
+                conds.append(
+                    (op, res(lm.group("col")),
+                     self._parse_rhs(lm.group("val"), p, check_params))
+                )
+                continue
+            im = _IN_RE.match(clause)
+            if im:
+                op = "not in" if im.group("neg") else "in"
+                body = im.group("body").strip()
+                if _SELECT_RE.match(body):
+                    val = ("subq_list", self._parse_select(body, p,
+                                                           check_params))
+                else:
+                    val = [
+                        (_parse_literal(t, p) if check_params else None)
+                        for t in _split_top_commas(body)
+                    ]
+                conds.append((op, res(im.group("col")), val))
+                continue
+            cm = (_HAVING_COND_RE if defer_lhs else _COND_RE).match(clause)
+            if cm is None:
+                raise SqlError(
+                    f"unsupported WHERE/HAVING clause: {clause!r}"
+                )
+            conds.append(
+                (cm.group("op"), res(cm.group("col")),
+                 self._parse_rhs(cm.group("val"), p, check_params))
+            )
+        return conds
+
+    def _parse_rhs(self, raw: str, p: _Params, check_params):
+        raw = raw.strip()
+        if (raw.startswith("(") and raw.endswith(")")
+                and _SELECT_RE.match(raw[1:-1].strip())):
+            return ("subq", self._parse_select(raw[1:-1].strip(), p,
+                                               check_params))
+        return _parse_literal(raw, p) if check_params else None
 
     # --- SELECT execution -------------------------------------------------
     def _table_records(self, node: int, table, alias: str, vals, clps):
@@ -615,14 +749,35 @@ class Database:
             out.append({f"{alias}.{k}": v for k, v in rec.items()})
         return out
 
+    def _resolve_subqueries(self, node: int, conds: List[tuple]) -> List[tuple]:
+        """Materialize ``("subq"/"subq_list", ast)`` rhs markers against
+        ``node``'s replica: scalar = first row's first column (None when
+        empty, like SQLite), list = every row's first column."""
+        out = []
+        for op, lhs, val in conds:
+            if (isinstance(val, tuple) and len(val) == 2
+                    and val[0] in ("subq", "subq_list")):
+                rows = list(self._run_select(node, val[1]))
+                if val[0] == "subq":
+                    val = rows[0][0] if rows else None
+                else:
+                    val = [r[0] for r in rows]
+            out.append((op, lhs, val))
+        return out
+
     def _run_select(self, node: int, ast) -> Iterable[List[Any]]:
+        ast = {
+            **ast,
+            "conds": self._resolve_subqueries(node, ast["conds"]),
+            "having": self._resolve_subqueries(node, ast.get("having", [])),
+        }
         snap = self.agent.snapshot()
         vals = snap["store"][1][node]
         clps = snap["store"][4][node]
         aliases = ast["aliases"]
         has_agg = any(k == "agg" for k, _, _ in ast["cols"])
         if (not ast["joins"] and not ast["group"] and not ast["order"]
-                and not has_agg):
+                and not has_agg and not ast["having"]):
             # streaming fast path: plain filtered scan short-circuits at
             # LIMIT without materializing the table (the /v1/queries
             # NDJSON stream shape)
@@ -664,8 +819,8 @@ class Database:
             r for r in records
             if all(self._eval(c, r) for c in ast["conds"])
         ]
-        # GROUP BY / aggregates
-        if ast["group"] or has_agg:
+        # GROUP BY / aggregates / HAVING
+        if ast["group"] or has_agg or ast["having"]:
             groups: Dict[tuple, List[dict]] = {}
             for r in records:
                 gkey = tuple(r.get(g) for g in ast["group"])
@@ -680,6 +835,8 @@ class Database:
                         out[name] = grp[0].get(payload) if grp else None
                     else:
                         out[name] = self._aggregate(payload, grp)
+                if not self._having_ok(ast, out, grp):
+                    continue
                 rows.append(out)
         else:
             rows = [
@@ -745,6 +902,27 @@ class Database:
             if ast["limit"] is not None and emitted >= ast["limit"]:
                 return
 
+    def _having_ok(self, ast, out: dict, grp: List[dict]) -> bool:
+        """Evaluate HAVING conditions on one group. A left side may be an
+        aggregate expression (``COUNT(*) > 5``), an output alias, or a
+        grouped input column."""
+        for op, lhs, val in ast.get("having", []):
+            am = _AGG_RE.match(lhs)
+            if am:
+                fn = am.group("fn").upper()
+                arg = am.group("arg")
+                key = None if arg == "*" else ast["resolve"](arg)
+                v = self._aggregate((fn, key), grp)
+            else:
+                name = _unquote(lhs)
+                if name in out:
+                    v = out[name]
+                else:
+                    v = grp[0].get(ast["resolve"](lhs)) if grp else None
+            if not self._eval((op, "\x00v", val), {"\x00v": v}):
+                return False
+        return True
+
     @staticmethod
     def _aggregate(payload, grp: List[dict]):
         fn, key = payload
@@ -804,6 +982,32 @@ class Database:
                 return corro_json_contains(v, ref)
             except (TypeError, ValueError):
                 return False
+        if op in ("like", "not like", "glob", "not glob"):
+            # SQLite coerces numeric operands to text for LIKE/GLOB
+            # (SELECT 15 LIKE '1%' -> 1); NULL operands -> no match
+            if v is None or ref is None:
+                return False
+            if isinstance(v, (int, float)):
+                v = str(v)
+            if isinstance(ref, (int, float)):
+                ref = str(ref)
+            if not isinstance(v, str) or not isinstance(ref, str):
+                return False  # blobs never LIKE-match
+            glob = "glob" in op
+            if not glob:  # ASCII-only case folding, like SQLite's LIKE
+                v = v.translate(_ASCII_LOWER)
+                ref = ref.translate(_ASCII_LOWER)
+            hit = _like_to_regex(ref, glob).match(v) is not None
+            return (not hit) if op.startswith("not") else hit
+        if op in ("in", "not in"):
+            if v is None:
+                return False
+            hit = any(v == x for x in ref if x is not None)
+            if op == "not in":
+                # SQL three-valued logic: x NOT IN (..., NULL) is NULL
+                # (row excluded) unless x matched a non-NULL member
+                return False if any(x is None for x in ref) else not hit
+            return hit
         if v is None or ref is None:
             return False
         try:

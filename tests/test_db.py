@@ -333,3 +333,60 @@ def test_order_by_nulls_first(rich_db):
     # SQLite sorts NULLs first ascending
     assert list(rows)[0] == ["z", None]
     rich_db.execute(0, [("DELETE FROM players WHERE pid = 9",)])
+
+
+# --- round-3 dialect: LIKE/GLOB, HAVING, subqueries (VERDICT r2 #10) -----
+
+def test_like_and_glob(rich_db):
+    # LIKE is ASCII case-insensitive; % / _ wildcards
+    _, rows = rich_db.query(
+        0, "SELECT pname FROM players WHERE pname LIKE 'A%' ORDER BY pname")
+    assert list(rows) == [["a"]]
+    _, rows = rich_db.query(
+        0, "SELECT title FROM squads WHERE title NOT LIKE '%r%' "
+           "ORDER BY title")
+    assert list(rows) == [["blue"]]
+    # GLOB is case-sensitive with * / ? wildcards
+    _, rows = rich_db.query(
+        0, "SELECT title FROM squads WHERE title GLOB 'b*'")
+    assert list(rows) == [["blue"]]
+    _, rows = rich_db.query(
+        0, "SELECT title FROM squads WHERE title GLOB 'B*'")
+    assert list(rows) == []
+    # parametrized pattern; _ matches exactly one char
+    _, rows = rich_db.query(
+        0, "SELECT pname FROM players WHERE pname LIKE ?", ["_"])
+    assert len(list(rows)) == 5
+
+
+def test_having(rich_db):
+    _, rows = rich_db.query(
+        0, "SELECT team, COUNT(*) AS n FROM players GROUP BY team "
+           "HAVING COUNT(*) > 2 ORDER BY team")
+    assert list(rows) == [[1, 3]]
+    # HAVING on an output alias
+    _, rows = rich_db.query(
+        0, "SELECT team, SUM(score) AS total FROM players GROUP BY team "
+           "HAVING total >= 75")
+    assert list(rows) == [[1, 75]]
+
+
+def test_scalar_subquery_in_where(rich_db):
+    _, rows = rich_db.query(
+        0, "SELECT pname FROM players WHERE score = "
+           "(SELECT MAX(score) FROM players)")
+    assert list(rows) == [["d"]]
+
+
+def test_in_subquery_and_literal_list(rich_db):
+    _, rows = rich_db.query(
+        0, "SELECT pname FROM players WHERE team IN "
+           "(SELECT sid FROM squads WHERE title LIKE 'r%') ORDER BY pname")
+    assert list(rows) == [["a"], ["c"], ["e"]]
+    _, rows = rich_db.query(
+        0, "SELECT pname FROM players WHERE score IN (10, 40) "
+           "ORDER BY pname")
+    assert list(rows) == [["b"], ["d"]]
+    _, rows = rich_db.query(
+        0, "SELECT pname FROM players WHERE team NOT IN (1) AND score > 15")
+    assert list(rows) == [["d"]]
