@@ -50,6 +50,20 @@ def _dec(v: Any) -> Any:
     return v
 
 
+def _enc_key(k: Any) -> Any:
+    """Row keys are scalar pks (single-table) or composite pk tuples
+    (JOIN subscriptions) — tuples get a tag so restore re-hashes them."""
+    if isinstance(k, tuple):
+        return {"__key__": [_enc(x) for x in k]}
+    return _enc(k)
+
+
+def _dec_key(k: Any) -> Any:
+    if isinstance(k, dict) and "__key__" in k:
+        return tuple(_dec(x) for x in k["__key__"])
+    return _dec(k)
+
+
 class SubQueue(queue.Queue):
     """Per-subscriber event queue with lag semantics: the producer (the
     round thread) never blocks — an overflowing subscriber is marked
@@ -84,21 +98,28 @@ class Matcher:
         # validate the query + capture column names up front
         cols, _ = db.query(node, sql, params)
         self.columns: List[str] = list(cols)
-        self._table = self._target_table(sql)
-        self._pk_name = db.schema.table(self._table).pk.name
-        # the reference rewrites the SELECT to always expose the pks of
-        # every involved table (pubsub.rs:527+); mirror that: if the query
-        # omits the pk, run a pk-prepended variant and strip it on emit
-        if self._pk_name in self.columns:
-            self._key_sql, self._key_prepended = sql, False
-        else:
-            import re
+        # the reference rewrites the SELECT to expose the pks of EVERY
+        # table involved in the query (``pubsub.rs:527+``) so a change to
+        # either side of a JOIN re-evaluates the match. Mirror that: run
+        # a variant with every alias-qualified pk prepended and key the
+        # materialized result by the composite pk tuple, stripping the
+        # key columns on emit.
+        import re
 
-            self._key_sql = re.sub(
-                r"^\s*SELECT\s+", f"SELECT {self._pk_name}, ", sql,
-                count=1, flags=re.IGNORECASE,
+        from corrosion_tpu.db.database import SqlError, _Params
+
+        ast = db._parse_select(sql, _Params(None), check_params=False)
+        if ast["group"] or any(k == "agg" for k, _, _ in ast["cols"]):
+            raise SqlError(
+                "subscriptions require plain row queries "
+                "(no aggregates / GROUP BY)"
             )
-            self._key_prepended = True
+        pk_refs = [f"{a}.{t.pk.name}" for a, t in ast["aliases"].items()]
+        self._n_keys = len(pk_refs)
+        self._key_sql = re.sub(
+            r"^\s*SELECT\s+", f"SELECT {', '.join(pk_refs)}, ", sql,
+            count=1, flags=re.IGNORECASE,
+        )
         self._state: Dict[Any, Tuple] = {}
         self._log: List[Tuple[int, str, Any, Optional[List[Any]]]] = []
         self._log_base = 1  # change id of _log[0]
@@ -124,7 +145,7 @@ class Matcher:
                 # them against the live replica, so changes that happened
                 # while the agent was down surface as ordinary events
                 self._state = {
-                    _dec(k): tuple(_dec(v) for v in row)
+                    _dec_key(k): tuple(_dec(v) for v in row)
                     for k, row in restore["state"]
                 }
             else:
@@ -132,28 +153,13 @@ class Matcher:
         else:
             self._prime()
 
-    def _target_table(self, sql: str) -> str:
-        import re
-
-        from corrosion_tpu.db.database import SqlError
-
-        m = re.search(r"\bFROM\s+([\w\"]+)", sql, re.IGNORECASE)
-        if not m:
-            raise SqlError("subscription queries need a FROM clause")
-        name = m.group(1).strip('"')
-        if name not in self.db.schema.tables:
-            raise SqlError(
-                f"subscriptions support single-table queries over a known "
-                f"table (got FROM {name!r})"
-            )
-        return name
-
     def _current(self) -> Dict[Any, Tuple]:
-        cols, rows = self.db.query(self.node, self._key_sql, self.params)
-        if self._key_prepended:
+        _, rows = self.db.query(self.node, self._key_sql, self.params)
+        k = self._n_keys
+        if k == 1:
+            # single-table: scalar pk key (the wire shape clients expect)
             return {row[0]: tuple(row[1:]) for row in rows}
-        pk_idx = cols.index(self._pk_name)
-        return {row[pk_idx]: tuple(row) for row in rows}
+        return {tuple(row[:k]): tuple(row[k:]) for row in rows}
 
     def _prime(self) -> None:
         self._state = self._current()
@@ -239,7 +245,8 @@ class Matcher:
             # happens outside so poll()/attach() are not blocked by it
             state_items = list(self._state.items())
             last = self.last_change_id
-        state = [[_enc(k), [_enc(v) for v in row]] for k, row in state_items]
+        state = [[_enc_key(k), [_enc(v) for v in row]]
+                 for k, row in state_items]
         return {"id": self.id, "node": self.node, "sql": self.sql,
                 "params": self.params, "last_change_id": last,
                 "state": state}

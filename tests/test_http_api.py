@@ -258,3 +258,60 @@ def test_subs_restore_resumes_change_ids(tmp_path, rig):
         assert seen
     finally:
         mgr2.close()
+
+
+def test_join_subscription_tracks_both_tables(rig):
+    """VERDICT r2 #9: a subscription on a JOIN query must re-evaluate
+    when EITHER side changes — the matcher keys rows by the composite of
+    every involved table's pk (``pubsub.rs:527+`` exposes all tables'
+    pks)."""
+    agent, db, _, client = rig
+    client.schema([
+        "CREATE TABLE ep (eid INTEGER PRIMARY KEY, svc TEXT, "
+        "weight INTEGER);"
+    ])
+    client.execute([
+        ("INSERT INTO svc (name, addr, port) VALUES ('j1', 'a', 1)",),
+        ("INSERT INTO ep (eid, svc, weight) VALUES (71, 'j1', 5)",),
+    ])
+    for _ in range(100):
+        if db.read_row(0, "ep", 71) is not None:
+            break
+        agent.wait_rounds(2, timeout=60)
+    mgr = SubsManager(db)
+    try:
+        m, _ = mgr.subscribe(
+            0, "SELECT s.name, e.weight FROM svc s "
+               "JOIN ep e ON e.svc = s.name")
+        q = m.attach()
+        kind, payload = q.get(timeout=5.0)
+        assert kind == "columns" and payload == ["name", "weight"]
+        snap = {}
+        while True:
+            kind, payload = q.get(timeout=5.0)
+            if kind == "eoq":
+                break
+            assert kind == "row"
+            key, row = payload
+            snap[tuple(key)] = row
+        assert list(snap.values()) == [["j1", 5]]
+
+        # change ONLY the joined (non-base) table
+        client.execute([("UPDATE ep SET weight = 9 WHERE eid = 71",)])
+        import queue as queue_mod
+
+        got = None
+        for _ in range(200):
+            try:
+                kind, payload = q.get(timeout=1.0)
+            except queue_mod.Empty:
+                agent.wait_rounds(2, timeout=60)
+                continue
+            if kind == "change":
+                _cid, ckind, _key, row = payload
+                if row == ["j1", 9]:
+                    got = ckind
+                    break
+        assert got == "update"
+    finally:
+        mgr.close()
