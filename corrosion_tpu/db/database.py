@@ -135,6 +135,204 @@ def _like_to_regex(pattern: str, glob: bool) -> "re.Pattern":
     return re.compile("^" + "".join(out) + "$")
 
 
+# --- scalar expression engine (projection expressions) -------------------
+# The reference runs full SQLite underneath, so projections like
+# ``price * 2``, ``COALESCE(a, b)``, ``upper(name) || '!'`` just work;
+# this mirrors the commonly-exercised scalar surface with SQLite's NULL
+# semantics (NULL propagates; x/0 -> NULL; int/int truncates).
+
+_EXPR_TOKEN_RE = re.compile(
+    r"\s*(\|\||<>|<=|>=|!=|[+\-*/%(),=<>]|'(?:[^']|'')*'|[\w\".:$?]+)"
+)
+
+_NUM_PREFIX_RE = re.compile(r"^\s*[+-]?(\d+\.?\d*(?:[eE][+-]?\d+)?|\.\d+)")
+
+
+def _num(v):
+    """SQLite numeric coercion for arithmetic: text uses its numeric
+    prefix (``'3x' + 1`` is 4), non-numeric text and blobs are 0."""
+    if v is None or isinstance(v, (int, float)):
+        return v
+    if isinstance(v, str):
+        m = _NUM_PREFIX_RE.match(v)
+        if not m:
+            return 0
+        tok = m.group(0)
+        try:
+            return int(tok)
+        except ValueError:
+            return float(tok)
+    return 0
+
+
+def _sqlite_round(x: float, digits: int) -> float:
+    """SQLite rounds halves away from zero (Python rounds half-even)."""
+    import math
+
+    s = 10.0 ** digits
+    return math.copysign(math.floor(abs(x) * s + 0.5), x) / s
+
+
+class _ExprParser:
+    """Tiny recursive-descent parser -> ``rec -> value`` closure."""
+
+    FUNCS = {
+        "COALESCE": lambda args: next((a for a in args if a is not None),
+                                      None),
+        "IFNULL": lambda args: args[0] if args[0] is not None else args[1],
+        "LENGTH": lambda args: (None if args[0] is None
+                                else len(str(args[0]))),
+        "UPPER": lambda args: (None if args[0] is None
+                               else str(args[0]).upper()),
+        "LOWER": lambda args: (None if args[0] is None
+                               else str(args[0]).lower()),
+        "ABS": lambda args: (None if args[0] is None
+                             else abs(_num(args[0]))),
+        "ROUND": lambda args: (
+            None if args[0] is None
+            else _sqlite_round(float(_num(args[0])),
+                               int(args[1]) if len(args) > 1 else 0)
+        ),
+    }
+
+    def __init__(self, s: str, resolve, p: "_Params", check_params: bool):
+        self.toks: List[str] = []
+        i = 0
+        while i < len(s):
+            m = _EXPR_TOKEN_RE.match(s, i)
+            if m is None:
+                if s[i:].strip():
+                    raise SqlError(f"bad expression near {s[i:][:30]!r}")
+                break
+            self.toks.append(m.group(1))
+            i = m.end()
+        self.pos = 0
+        self.resolve = resolve
+        self.p = p
+        self.check_params = check_params
+
+    def peek(self) -> Optional[str]:
+        return self.toks[self.pos] if self.pos < len(self.toks) else None
+
+    def take(self) -> str:
+        t = self.peek()
+        if t is None:
+            raise SqlError("unexpected end of expression")
+        self.pos += 1
+        return t
+
+    def parse(self):
+        fn = self._add()
+        if self.peek() is not None:
+            raise SqlError(f"trailing tokens in expression: {self.peek()!r}")
+        return fn
+
+    def _add(self):
+        fn = self._mul()
+        while self.peek() in ("+", "-"):
+            op = self.take()
+            rhs = self._mul()
+            fn = self._arith(fn, rhs, op)
+        return fn
+
+    def _mul(self):
+        fn = self._concat()
+        while self.peek() in ("*", "/", "%"):
+            op = self.take()
+            rhs = self._concat()
+            fn = self._arith(fn, rhs, op)
+        return fn
+
+    def _concat(self):
+        fn = self._atom()
+        while self.peek() == "||":
+            self.take()
+            rhs = self._atom()
+
+            def concat(rec, a=fn, b=rhs):
+                va, vb = a(rec), b(rec)
+                if va is None or vb is None:
+                    return None
+                return str(va) + str(vb)
+
+            fn = concat
+        return fn
+
+    @staticmethod
+    def _arith(a, b, op):
+        def run(rec):
+            va, vb = _num(a(rec)), _num(b(rec))
+            if va is None or vb is None:
+                return None
+            if op == "+":
+                return va + vb
+            if op == "-":
+                return va - vb
+            if op == "*":
+                return va * vb
+            if op == "%":
+                if vb == 0:
+                    return None
+                # SQLite/C modulo: sign follows the dividend
+                r = abs(va) % abs(vb)
+                return r if va >= 0 else -r
+            if vb == 0:
+                return None  # SQLite: x / 0 is NULL
+            if isinstance(va, int) and isinstance(vb, int):
+                q = abs(va) // abs(vb)  # int/int truncates toward zero
+                return q if (va >= 0) == (vb >= 0) else -q
+            return va / vb
+
+        return run
+
+    def _atom(self):
+        t = self.take()
+        if t == "(":
+            fn = self._add()
+            if self.take() != ")":
+                raise SqlError("unbalanced parens in expression")
+            return fn
+        if t == "-":
+            inner = self._atom()
+
+            def neg(rec):
+                v = _num(inner(rec))
+                return None if v is None else -v
+
+            return neg
+        up = t.upper()
+        if up in self.FUNCS and self.peek() == "(":
+            self.take()
+            args = []
+            if self.peek() != ")":
+                args.append(self._add())
+                while self.peek() == ",":
+                    self.take()
+                    args.append(self._add())
+            if self.take() != ")":
+                raise SqlError(f"unbalanced parens in {t}()")
+            impl = self.FUNCS[up]
+            return lambda rec: impl([a(rec) for a in args])
+        if t.startswith("'") or t in ("?",) or t.startswith((":", "$")) \
+                or up in ("NULL", "TRUE", "FALSE") or t[0].isdigit() \
+                or (t[0] == "." and len(t) > 1 and t[1].isdigit()):
+            v = (_parse_literal(t, self.p) if self.check_params else None)
+            return lambda rec: v
+        key = self.resolve(t)
+        return lambda rec: rec.get(key)
+
+
+def _split_expr_alias(raw: str) -> Tuple[str, Optional[str]]:
+    """Split a projection expression from a trailing ``AS alias`` (or a
+    bare trailing identifier alias) at paren depth 0."""
+    m = re.search(r"\s+AS\s+([\w\"]+)\s*$", raw, re.IGNORECASE)
+    if m:
+        depth = raw[: m.start()].count("(") - raw[: m.start()].count(")")
+        if depth == 0:
+            return raw[: m.start()].strip(), _unquote(m.group(1))
+    return raw.strip(), None
+
+
 def _split_top_and(s: str) -> List[str]:
     """Split a WHERE/HAVING conjunction on top-level ``AND`` only —
     ``AND`` inside parens (subqueries) or strings doesn't count."""
@@ -632,11 +830,21 @@ class Database:
                 cols.append(("agg", (fn, key), name))
                 continue
             cm = _COL_AS_RE.match(raw)
-            if cm is None:
-                raise SqlError(f"unsupported select expression: {raw!r}")
-            key = resolve(cm.group("col"))
-            name = _unquote(cm.group("alias") or "") or key.split(".", 1)[1]
-            cols.append(("col", key, name))
+            if cm is not None:
+                try:
+                    key = resolve(cm.group("col"))
+                except SqlError:
+                    cm = None  # literal projection (SELECT 5, NULL, ...)
+                if cm is not None:
+                    name = (_unquote(cm.group("alias") or "")
+                            or key.split(".", 1)[1])
+                    cols.append(("col", key, name))
+                    continue
+            # scalar expression projection (price * 2, COALESCE(a, b), ...)
+            expr_raw, alias = _split_expr_alias(raw)
+            fn = _ExprParser(expr_raw, resolve, p, check_params).parse()
+            name = alias or re.sub(r"\s+", "", expr_raw)
+            cols.append(("expr", fn, name))
 
         # WHERE / HAVING conjunctions (shared grammar; HAVING resolves its
         # left sides per group at execution time, so they stay raw here)
@@ -833,6 +1041,8 @@ class Database:
                 for kind, payload, name in ast["cols"]:
                     if kind == "col":
                         out[name] = grp[0].get(payload) if grp else None
+                    elif kind == "expr":
+                        out[name] = payload(grp[0]) if grp else None
                     else:
                         out[name] = self._aggregate(payload, grp)
                 if not self._having_ok(ast, out, grp):
@@ -840,7 +1050,10 @@ class Database:
                 rows.append(out)
         else:
             rows = [
-                {name: r.get(payload) for _k, payload, name in ast["cols"]}
+                {
+                    name: (payload(r) if kind == "expr" else r.get(payload))
+                    for kind, payload, name in ast["cols"]
+                }
                 for r in records
             ]
             # keep source record reachable for ORDER BY non-projected cols
@@ -897,7 +1110,10 @@ class Database:
             if skipped < off:
                 skipped += 1
                 continue
-            yield [rec.get(payload) for _k, payload, _n in ast["cols"]]
+            yield [
+                payload(rec) if kind == "expr" else rec.get(payload)
+                for kind, payload, _n in ast["cols"]
+            ]
             emitted += 1
             if ast["limit"] is not None and emitted >= ast["limit"]:
                 return

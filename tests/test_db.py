@@ -390,3 +390,49 @@ def test_in_subquery_and_literal_list(rich_db):
     _, rows = rich_db.query(
         0, "SELECT pname FROM players WHERE team NOT IN (1) AND score > 15")
     assert list(rows) == [["d"]]
+
+
+def test_expression_projections(rich_db):
+    # arithmetic with int truncation + aliases
+    _, rows = rich_db.query(
+        0, "SELECT pname, score * 2 AS dbl, score / 7 FROM players "
+           "WHERE pid = 0")
+    assert list(rows) == [["a", 60, 4]]
+    # COALESCE / NULL propagation (pid 9 has NULL score while present)
+    rich_db.execute(0, [("INSERT INTO players (pid, pname, team) "
+                         "VALUES (8, 'y', 3)",)])
+    _, rows = rich_db.query(
+        0, "SELECT COALESCE(score, -1) AS s, score + 1 FROM players "
+           "WHERE pid = 8")
+    assert list(rows) == [[-1, None]]
+    rich_db.execute(0, [("DELETE FROM players WHERE pid = 8",)])
+    # string functions + concat
+    _, rows = rich_db.query(
+        0, "SELECT UPPER(pname) || '!' AS shout, LENGTH(pname) "
+           "FROM players WHERE pid = 1")
+    assert list(rows) == [["B!", 1]]
+    # expressions inside GROUP BY output rows
+    _, rows = rich_db.query(
+        0, "SELECT team * 10 AS t10, COUNT(*) AS n FROM players "
+           "GROUP BY team ORDER BY t10")
+    assert list(rows) == [[10, 3], [20, 2]]
+
+
+def test_expression_sqlite_semantics(rich_db):
+    """Operator semantics differentially pinned against real SQLite:
+    numeric coercion for arithmetic, C-style modulo, truncating integer
+    division, half-away-from-zero ROUND, literal projections."""
+    import sqlite3
+
+    con = sqlite3.connect(":memory:")
+    for expr in ["2 * 3 || 'x'", "-7 % 3", "7 % -3", "ROUND(2.5)",
+                 "ROUND(-2.5)", "'3x' + 1", "5 / 2", "-5 / 2",
+                 "2 + 2 * 3", "COALESCE(NULL, 4)"]:
+        want = con.execute(f"SELECT {expr}").fetchone()[0]
+        _, rows = rich_db.query(
+            0, f"SELECT {expr} AS v FROM players WHERE pid = 0")
+        assert list(rows) == [[want]], expr
+    # bare literal projections
+    _, rows = rich_db.query(
+        0, "SELECT 5, NULL AS x FROM players WHERE pid = 0")
+    assert list(rows) == [[5, None]]
