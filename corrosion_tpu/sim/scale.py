@@ -452,6 +452,37 @@ def scale_swim_step(
         + has_prober.astype(jnp.int32)  # ack we sent back to our prober
         + has_announcer.astype(jnp.int32)  # reply we sent to our announcer
     )
+    # (``sends`` above is the SWIM-layer mem_tx decrement — attempted
+    # membership-update transmissions, used by swim_tables_update.)
+
+    # delivered-packet count per sender — the piggyback layer's budget
+    # multiplicity. It must be delivery-coupled (a changeset's budget
+    # only burns when a packet actually carried it) or an unlucky writer
+    # can exhaust its budget with zero deliveries and its version never
+    # disseminates. Probe/announce deliveries are election wins (one
+    # fast card gather each); ack/reply deliveries need one [N]
+    # scatter-add each (a receiver-side count).
+    elect = jnp.stack(
+        [jnp.clip(prober_of, 0), jnp.clip(announcer_of, 0)], axis=1
+    )
+    g_tgt = card_at(elect, tgt)  # [N, 2]
+    g_ann = card_at(elect, ann_tgt)
+    probe_delivered = leg_out & (g_tgt[:, 0] == iarr)
+    ann_delivered = ann_out & (g_ann[:, 1] == iarr)
+    ack_count = (
+        jnp.zeros(n, jnp.int32).at[tgt].add(
+            probe_ok.astype(jnp.int32), mode="drop")
+    )
+    reply_count = (
+        jnp.zeros(n, jnp.int32).at[ann_tgt].add(
+            ann_back.astype(jnp.int32), mode="drop")
+    )
+    carried = (
+        probe_delivered.astype(jnp.int32)
+        + ann_delivered.astype(jnp.int32)
+        + ack_count
+        + reply_count
+    )
     consts = (
         m, int(cfg.suspicion_rounds), int(cfg.down_purge_rounds),
         int(cfg.max_transmissions),
@@ -480,8 +511,11 @@ def scale_swim_step(
         "refutes": jnp.sum(refute),
     }
     # channels: the four delivered-packet (sender, valid) pairs built
-    # above — higher layers piggyback changesets on exactly these packets
-    return st2, info, channels
+    # above — higher layers piggyback changesets on exactly these
+    # packets; ``carried`` is each sender's delivered-packet count, the
+    # piggyback layer's budget multiplicity (one transmission per
+    # delivered packet, like the reference's max_transmissions counter).
+    return st2, info, channels, carried
 
 
 def scale_swim_metrics(st: ScaleSwimState):

@@ -197,7 +197,8 @@ class ScaleRoundInput(NamedTuple):
         )
 
 
-def piggyback_bcast_step(cfg: ScaleSimConfig, cst: CrdtState, channels, key):
+def piggyback_bcast_step(cfg: ScaleSimConfig, cst: CrdtState, channels, key,
+                         carried=None):
     """Disseminate queued changesets over the SWIM packet channels.
 
     ``channels``: list of ``(src, valid)`` pairs — per-receiver-unique
@@ -205,18 +206,22 @@ def piggyback_bcast_step(cfg: ScaleSimConfig, cst: CrdtState, channels, key):
     sender's ``pig_changes`` highest-priority live queue slots; the
     receiver dedupes via the Book, applies fresh cells, and re-enqueues
     fresh changes with a decremented budget (``handlers.rs:768-779``).
+
+    ``carried`` int32 [N]: DELIVERED packets per sender this round
+    (computed elementwise + two [N] scatters in the SWIM step). The
+    budget multiplicity must be delivery-coupled: burning budget on
+    attempts lets an unlucky writer exhaust its changeset with zero
+    deliveries, and the version then never disseminates.
     """
     n, q, r = cfg.n_nodes, cfg.bcast_queue, cfg.pig_changes
     iarr = jnp.arange(n, dtype=jnp.int32)
 
-    # delivery multiplicity per sender this round: a node probed/acked by
-    # many peers sends that many packets, and every packet carries its
-    # selected changesets — the real byte cost scales with this count
-    carried = jnp.zeros(n, jnp.int32)
-    for src, valid in channels:
-        carried = carried.at[jnp.clip(src, 0)].add(
-            valid.astype(jnp.int32), mode="drop"
-        )
+    if carried is None:  # legacy callers: recompute the delivered count
+        carried = jnp.zeros(n, jnp.int32)
+        for src, valid in channels:
+            carried = carried.at[jnp.clip(src, 0)].add(
+                valid.astype(jnp.int32), mode="drop"
+            )
 
     live_slot = (cst.q_origin != NO_Q) & (cst.q_tx > 0)  # [N, Q]
     # per-round byte budget (10 MiB/s governor analog): each selected slot
@@ -289,7 +294,7 @@ def scale_sim_step(
 
     n, m = cfg.n_nodes, cfg.m_slots
     k_swim, k_pig, k_sp, k_sync = jr.split(key, 4)
-    swim, swim_info, channels = scale_swim_step(
+    swim, swim_info, channels, carried = scale_swim_step(
         cfg, st.swim, net, k_swim, kill=inp.kill, revive=inp.revive
     )
 
@@ -304,7 +309,7 @@ def scale_sim_step(
             cfg, cst, inp.tx_mask, inp.tx_cell, inp.tx_val, inp.tx_clp,
             inp.tx_len,
         )
-    cst, b_info = piggyback_bcast_step(cfg, cst, channels, k_pig)
+    cst, b_info = piggyback_bcast_step(cfg, cst, channels, k_pig, carried)
 
     # need-driven sync peer choice from a 2x sample of believed-alive
     # member-table entries: most-needed versions first, then longest since

@@ -76,7 +76,7 @@ def main():
 
     # swim only
     def swim_only(s, k):
-        swim, _, _ = scale_swim_step(cfg, s.swim, net, k)
+        swim, _, _, _ = scale_swim_step(cfg, s.swim, net, k)
         return s._replace(swim=swim)
     timed("swim only", scan_over(swim_only), st, key)
 

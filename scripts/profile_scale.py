@@ -77,19 +77,19 @@ def main():
     timed("full", lambda s, k: scale_sim_step(cfg, s, net, k, inp)[0])
 
     def swim_only(s, k):
-        swim, _, _ = scale_swim_step(cfg, s.swim, net, k)
+        swim, _, _, _ = scale_swim_step(cfg, s.swim, net, k)
         return s._replace(swim=swim)
 
     timed("swim", swim_only)
 
     def swim_bcast(s, k):
         k1, k2 = jr.split(k)
-        swim, _, channels = scale_swim_step(cfg, s.swim, net, k1)
+        swim, _, channels, carried = scale_swim_step(cfg, s.swim, net, k1)
         cst = local_write(
             cfg, s.crdt._replace(now=s.crdt.now + 1), inp.write_mask,
             inp.write_cell, inp.write_val, inp.write_clp,
         )
-        cst, _ = piggyback_bcast_step(cfg, cst, channels, k2)
+        cst, _ = piggyback_bcast_step(cfg, cst, channels, k2, carried)
         return ScaleSimState(swim, cst)
 
     timed("swim+bcast", swim_bcast)

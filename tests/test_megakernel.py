@@ -152,7 +152,7 @@ def test_fused_kernels_multi_block():
             megakernel.FORCE_FUSED = fused
             st = ScaleSwimState.create(cfg)
             for r in range(3):
-                st, info, channels = scale_swim_step(
+                st, info, channels, _sends = scale_swim_step(
                     cfg, st, net, jr.fold_in(key, r)
                 )
             outs[fused] = st

@@ -30,7 +30,7 @@ def run_rounds(cfg, st, net, key, rounds, kill=None, revive=None):
         st, key = carry
         k, r = xs
         key, sub = jr.split(key)
-        st, info, _ = scale_swim_step(cfg, st, net, sub, kill=k, revive=r)
+        st, info, _, _ = scale_swim_step(cfg, st, net, sub, kill=k, revive=r)
         return (st, key), info
 
     (st, _), infos = jax.lax.scan(body, (st, key), (kill, revive))
