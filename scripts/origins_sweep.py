@@ -1,0 +1,43 @@
+"""Writer-set cost curve (VERDICT r2 #5): run the flagship round at a
+sweep of origin-pool sizes and print one JSON line per configuration —
+the measured cost of unbounding the writer set from 16 toward
+"any node may write" (the reference books versions per observed actor,
+``crates/corro-types/src/agent.rs:1270-1604``).
+
+Usage: python scripts/origins_sweep.py [n_nodes] [origins ...]
+       (defaults: 100000, sweep 16 64 256)
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    args = sys.argv[1:]
+    n = int(args[0]) if args else 100_000
+    sweep = [int(a) for a in args[1:]] or [16, 64, 256]
+    for o in sweep:
+        env = dict(os.environ)
+        env.update(
+            BENCH_WORKER="1",
+            BENCH_NODES=str(n),
+            BENCH_ORIGINS=str(o),
+        )
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            capture_output=True, text=True, timeout=2400, env=env,
+        )
+        line = next(
+            (ln for ln in reversed(proc.stdout.strip().splitlines())
+             if ln.startswith("{")),
+            json.dumps({"error": proc.stderr.strip()[-300:], "origins": o}),
+        )
+        print(line, flush=True)
+
+
+if __name__ == "__main__":
+    main()

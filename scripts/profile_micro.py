@@ -1,0 +1,95 @@
+"""Microbenchmark the op classes the 100k round is built from, on the
+current backend — extends PERF.md's characterization table. Run this
+FIRST when the tunnel comes back: it prices each remaining op class
+(flat [N] scatters for election/notify/carried, card row gathers, 1-D
+gathers for comparison, [N*P] sync scatters, uniform draws, pallas
+probe) so the next fusion target is chosen from data, not guesses.
+
+Usage: python scripts/profile_micro.py [n_nodes]
+"""
+
+import os
+import sys
+import time
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from corrosion_tpu.utils.compile_cache import enable_compile_cache
+
+enable_compile_cache()
+
+import jax.numpy as jnp  # noqa: E402
+import jax.random as jr  # noqa: E402
+
+
+def timed(name, fn, *args, reps=20):
+    f = jax.jit(fn)
+    try:
+        t0 = time.perf_counter()
+        jax.block_until_ready(f(*args))
+        compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = f(*args)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / reps
+        print(f"{name:34s} {dt * 1e3:9.3f} ms  (compile {compile_s:.1f}s)",
+              flush=True)
+    except Exception as e:  # noqa: BLE001 — keep pricing the rest
+        print(f"{name:34s} FAILED: {type(e).__name__}: {e}", flush=True)
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    key = jr.key(0)
+    idx = jr.randint(key, (n,), 0, n, dtype=jnp.int32)
+    vals = jr.randint(jr.fold_in(key, 1), (n,), 0, 1 << 20, dtype=jnp.int32)
+    card = jr.randint(jr.fold_in(key, 2), (n, 8), 0, 1 << 20, dtype=jnp.int32)
+    wide = jr.randint(jr.fold_in(key, 3), (n, 64), 0, 1 << 20, dtype=jnp.int32)
+    idx_np = jr.randint(jr.fold_in(key, 4), (n, 10), 0, n, dtype=jnp.int32)
+    print(f"n={n} platform={jax.devices()[0].platform}", flush=True)
+
+    timed("elementwise max+mul [N,64]", lambda a: jnp.maximum(a, 3) * 2, wide)
+    timed("1-D gather x[idx] [N]", lambda v, i: v[i] + 1, vals, idx)
+    timed("card row gather [N,8]",
+          lambda c, i: jax.lax.optimization_barrier(c[i]).sum(axis=1),
+          card, idx)
+    timed("wide row gather [N,64] barriered",
+          lambda w, i: jax.lax.optimization_barrier(w[i])[:, 0],
+          wide, idx)
+    timed("flat scatter-add [N]",
+          lambda i: jnp.zeros(n, jnp.int32).at[i].add(1, mode="drop"), idx)
+    timed("flat scatter-max [N]",
+          lambda i, v: jnp.full(n, -1, jnp.int32).at[i].max(v, mode="drop"),
+          idx, vals)
+    timed("4x flat scatter-add [N] (carried)",
+          lambda i: sum(
+              jnp.zeros(n, jnp.int32).at[jnp.clip(i + k, 0, n - 1)]
+              .add(1, mode="drop")
+              for k in range(4)
+          ), idx)
+    timed("scatter-add [N,10] flat (sync load)",
+          lambda ip: jnp.zeros(n + 1, jnp.int32)
+          .at[ip.reshape(-1)].add(1, mode="drop")[:n], idx_np)
+    timed("uniform draw [N]", lambda k: jr.uniform(k, (n,)), key)
+    timed("uniform draw [N,3]", lambda k: jr.uniform(k, (n, 3)), key)
+    timed("top_k 4 of [N,32]",
+          lambda w: jax.lax.top_k(w[:, :32].astype(jnp.float32), 4)[1], wide)
+    timed("argsort [N,32]",
+          lambda w: jnp.argsort(w[:, :32], axis=1), wide)
+    timed("argmax [N,64]", lambda w: jnp.argmax(w, axis=1), wide)
+
+    # pallas availability + ingest/swim kernel probe
+    from corrosion_tpu.ops import megakernel
+
+    t0 = time.perf_counter()
+    ok = megakernel._pallas_works()
+    print(f"pallas_works: {ok}  ({time.perf_counter() - t0:.1f}s)",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()
