@@ -856,14 +856,34 @@ class Database:
 
         group = ([resolve(g) for g in _split_top_commas(group_raw)]
                  if group_raw else [])
+        out_names = {name for _k, _p, name in cols}
         order = []
         if order_raw:
             for part in _split_top_commas(order_raw):
-                toks = part.split()
-                desc = len(toks) > 1 and toks[-1].upper() == "DESC"
-                if len(toks) > 1 and toks[-1].upper() in ("ASC", "DESC"):
-                    toks = toks[:-1]
-                order.append((" ".join(toks), desc))
+                # strip a trailing ASC/DESC without re-joining tokens —
+                # whitespace inside string literals must survive intact
+                m_dir = re.search(r"\s+(ASC|DESC)\s*$", part, re.IGNORECASE)
+                desc = bool(m_dir) and m_dir.group(1).upper() == "DESC"
+                ref = (part[: m_dir.start()] if m_dir else part).strip()
+                if re.fullmatch(r"\d+", ref):
+                    # SQLite: a bare integer is an output-column ordinal
+                    k = int(ref)
+                    if not 1 <= k <= len(cols):
+                        raise SqlError(
+                            f"ORDER BY ordinal {k} out of range"
+                        )
+                    order.append((cols[k - 1][2], None, desc))
+                    continue
+                # output aliases and plain columns sort through the row
+                # lookup; anything else is an ORDER BY expression
+                fn = None
+                if _unquote(ref) not in out_names:
+                    try:
+                        resolve(ref)
+                    except SqlError:
+                        fn = _ExprParser(ref, resolve, p,
+                                         check_params).parse()
+                order.append((ref, fn, desc))
 
         def int_or_param(raw):
             if raw is None:
@@ -928,13 +948,24 @@ class Database:
                 conds.append((op, res(im.group("col")), val))
                 continue
             cm = (_HAVING_COND_RE if defer_lhs else _COND_RE).match(clause)
-            if cm is None:
-                raise SqlError(
-                    f"unsupported WHERE/HAVING clause: {clause!r}"
+            if cm is not None:
+                conds.append(
+                    (cm.group("op"), res(cm.group("col")),
+                     self._parse_rhs(cm.group("val"), p, check_params))
                 )
-            conds.append(
-                (cm.group("op"), res(cm.group("col")),
-                 self._parse_rhs(cm.group("val"), p, check_params))
+                continue
+            # expression left side: WHERE a + b > 5, LENGTH(name) = 3 ...
+            em = _HAVING_COND_RE.match(clause)
+            if em is not None and not defer_lhs:
+                fn = _ExprParser(em.group("col"), resolve, p,
+                                 check_params).parse()
+                conds.append(
+                    (em.group("op"), ("\x00expr", fn),
+                     self._parse_rhs(em.group("val"), p, check_params))
+                )
+                continue
+            raise SqlError(
+                f"unsupported WHERE/HAVING clause: {clause!r}"
             )
         return conds
 
@@ -1065,12 +1096,17 @@ class Database:
             payload: name for kind, payload, name in ast["cols"]
             if kind == "col"
         }
-        for ref, desc in reversed(ast["order"]):
+        for ref, fn, desc in reversed(ast["order"]):
             name = _unquote(ref)
 
-            def key_of(row, name=name, ref=ref):
+            def key_of(row, name=name, ref=ref, fn=fn):
                 if name in row:
                     v = row[name]
+                elif fn is not None:
+                    src = row.get("\x00src")
+                    if src is None:
+                        raise SqlError(f"cannot ORDER BY {ref!r} here")
+                    v = fn(src)
                 else:
                     key = ast["resolve"](ref)
                     if key in by_payload:
@@ -1192,7 +1228,10 @@ class Database:
     @staticmethod
     def _eval(cond, rec) -> bool:
         op, col, ref = cond
-        v = rec.get(col)
+        if isinstance(col, tuple) and col and col[0] == "\x00expr":
+            v = col[1](rec)
+        else:
+            v = rec.get(col)
         if op == "json_contains":
             try:
                 return corro_json_contains(v, ref)

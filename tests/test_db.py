@@ -436,3 +436,42 @@ def test_expression_sqlite_semantics(rich_db):
     _, rows = rich_db.query(
         0, "SELECT 5, NULL AS x FROM players WHERE pid = 0")
     assert list(rows) == [[5, None]]
+
+
+def test_order_by_expression(rich_db):
+    # sort by a computed key that matches no column or alias
+    _, rows = rich_db.query(
+        0, "SELECT pname FROM players WHERE score >= 10 "
+           "ORDER BY 0 - score LIMIT 2")
+    assert list(rows) == [["d"], ["a"]]
+    _, rows = rich_db.query(
+        0, "SELECT pname, score FROM players WHERE score >= 10 "
+           "ORDER BY score % 3, pname")
+    first = list(rows)[0]
+    assert first[1] % 3 == min(s % 3 for s in (30, 10, 20, 40, 25))
+
+
+def test_expression_where_lhs(rich_db):
+    _, rows = rich_db.query(
+        0, "SELECT pname FROM players WHERE score % 10 = 5")
+    assert list(rows) == [["e"]]
+    _, rows = rich_db.query(
+        0, "SELECT pname FROM players WHERE LENGTH(pname) = 1 "
+           "AND score + 10 > 35 ORDER BY pname")
+    assert list(rows) == [["a"], ["d"]]
+
+
+def test_order_by_ordinal(rich_db):
+    # SQLite: ORDER BY 2 sorts by the second output column
+    _, rows = rich_db.query(
+        0, "SELECT pname, score FROM players WHERE score >= 10 ORDER BY 2")
+    assert [r[1] for r in rows] == [10, 20, 25, 30, 40]
+    _, rows = rich_db.query(
+        0, "SELECT pname, score FROM players WHERE score >= 10 "
+           "ORDER BY 2 DESC LIMIT 1")
+    assert list(rows) == [["d", 40]]
+    import pytest as _pytest
+
+    from corrosion_tpu.db.database import SqlError
+    with _pytest.raises(SqlError):
+        rich_db.query(0, "SELECT pname FROM players ORDER BY 7")

@@ -82,3 +82,70 @@ def test_conflict_heavy_converges(cfg):
     m = scale_crdt_metrics(cfg, st)
     assert bool(m["converged"]), f"diverged: {int(m['n_diverged'])} nodes"
     assert int(m["total_needs"]) == 0
+
+
+def test_partition_and_cluster_gating_at_scale():
+    """The node-card link predicate must gate exactly like the
+    per-element form it replaced: no payload crosses a partition or a
+    ClusterId boundary (uni.rs:75-77, peer/mod.rs:1425-1436), and
+    healing the partition lets the cluster converge."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    from corrosion_tpu.sim.scale_step import (
+        ScaleRoundInput,
+        ScaleSimState,
+        scale_crdt_metrics,
+        scale_sim_config,
+        scale_sim_step,
+    )
+    from corrosion_tpu.sim.transport import NetModel
+
+    n = 64
+    cfg = scale_sim_config(n, n_origins=4, sync_interval=4)
+    st = ScaleSimState.create(cfg)
+    net = NetModel.create(n, drop_prob=0.0)
+    # split: evens vs odds (origins 0..3 land in both groups)
+    part = (jnp.arange(n, dtype=jnp.int32) % 2)
+    net_split = net._replace(partition=part)
+    inp = ScaleRoundInput.quiet(cfg)
+    w = inp._replace(
+        write_mask=jnp.arange(n) < 4,
+        write_cell=jnp.arange(n) % cfg.n_cells,
+        write_val=jnp.full(n, 9, jnp.int32),
+    )
+    step = jax.jit(functools.partial(scale_sim_step, cfg))
+    key = jr.key(3)
+    st, _ = step(st, net_split, key, w)
+    for i in range(30):
+        key, sub = jr.split(key)
+        st, _ = step(st, net_split, sub, inp)
+    km = st.crdt.book.known_max
+    # origin 0 (even) is invisible to every odd node; origin 1 (odd)
+    # invisible to every even node
+    odd = jnp.arange(n) % 2 == 1
+    assert int(jnp.max(jnp.where(odd, km[:, 0], 0))) == 0
+    assert int(jnp.max(jnp.where(~odd, km[:, 1], 0))) == 0
+    # heal -> converge
+    for i in range(120):
+        key, sub = jr.split(key)
+        st, _ = step(st, net, sub, inp)
+    m = scale_crdt_metrics(cfg, st)
+    assert bool(m["converged"]), int(m["n_diverged"])
+
+    # a foreign ClusterId gates everything, even without partitions
+    st2 = ScaleSimState.create(cfg)
+    net_cid = net._replace(
+        cluster_id=jnp.where(jnp.arange(n) < 32, 0, 1).astype(jnp.int32)
+    )
+    key2 = jr.key(4)
+    st2, _ = step(st2, net_cid, key2, w)
+    for i in range(20):
+        key2, sub = jr.split(key2)
+        st2, _ = step(st2, net_cid, sub, inp)
+    km2 = st2.crdt.book.known_max
+    back = jnp.arange(n) >= 32
+    assert int(jnp.max(jnp.where(back, jnp.max(km2, axis=1), 0))) == 0
