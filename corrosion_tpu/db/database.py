@@ -854,9 +854,31 @@ class Database:
                                     defer_lhs=True)
                   if having_raw else [])
 
-        group = ([resolve(g) for g in _split_top_commas(group_raw)]
-                 if group_raw else [])
+        # GROUP BY entries: plain columns resolve to record keys, output
+        # aliases group by their projected payload (SQLite allows both),
+        # anything else groups by a computed expression
         out_names = {name for _k, _p, name in cols}
+        by_name = {name: (kind, payload) for kind, payload, name in cols}
+        group = []
+        if group_raw:
+            for g in _split_top_commas(group_raw):
+                alias = _unquote(g)
+                if alias in by_name:
+                    kind, payload = by_name[alias]
+                    if kind == "agg":
+                        raise SqlError(
+                            f"cannot GROUP BY aggregate {alias!r}"
+                        )
+                    group.append(payload if kind == "col"
+                                 else ("\x00expr", payload))
+                    continue
+                try:
+                    group.append(resolve(g))
+                except SqlError:
+                    group.append(
+                        ("\x00expr",
+                         _ExprParser(g, resolve, p, check_params).parse())
+                    )
         order = []
         if order_raw:
             for part in _split_top_commas(order_raw):
@@ -1062,7 +1084,11 @@ class Database:
         if ast["group"] or has_agg or ast["having"]:
             groups: Dict[tuple, List[dict]] = {}
             for r in records:
-                gkey = tuple(r.get(g) for g in ast["group"])
+                gkey = tuple(
+                    g[1](r) if isinstance(g, tuple) and g[0] == "\x00expr"
+                    else r.get(g)
+                    for g in ast["group"]
+                )
                 groups.setdefault(gkey, []).append(r)
             if not records and not ast["group"]:
                 groups[()] = []  # aggregates over an empty table emit 1 row
@@ -1078,6 +1104,10 @@ class Database:
                         out[name] = self._aggregate(payload, grp)
                 if not self._having_ok(ast, out, grp):
                     continue
+                # representative source row: lets ORDER BY evaluate the
+                # grouping expression (constant within a group) or a
+                # grouped input column, like SQLite
+                out["\x00src"] = grp[0] if grp else None
                 rows.append(out)
         else:
             rows = [
@@ -1104,9 +1134,8 @@ class Database:
                     v = row[name]
                 elif fn is not None:
                     src = row.get("\x00src")
-                    if src is None:
-                        raise SqlError(f"cannot ORDER BY {ref!r} here")
-                    v = fn(src)
+                    # src is None only for the empty-aggregate row
+                    v = fn(src) if src is not None else None
                 else:
                     key = ast["resolve"](ref)
                     if key in by_payload:

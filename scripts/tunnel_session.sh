@@ -1,12 +1,20 @@
 #!/bin/bash
-# One-shot TPU measurement session: run the full profiling + bench
-# sequence the moment the tunnel is alive, logging to artifacts/.
+# One-shot TPU measurement session: run the measurement sequence the
+# moment the tunnel is alive, highest-value first (the tunnel has been
+# observed to flap — if it dies mid-session, the early artifacts must
+# be the ones that matter). Logs to artifacts/.
 cd /root/repo
 mkdir -p artifacts
 T=artifacts/tunnel_$(date +%m%d_%H%M)
-echo "== micro" ; timeout 1200 python scripts/profile_micro.py "${1:-100000}" 2>&1 | tee $T.micro.log
-echo "== scale" ; timeout 2400 python scripts/profile_scale.py "${1:-100000}" 8 2>&1 | tee $T.scale.log
-echo "== bcast" ; timeout 2400 python scripts/profile_bcast.py "${1:-100000}" 8 2>&1 | tee $T.bcast.log
-echo "== bench" ; BENCH_WORKER=1 timeout 2400 python bench.py 2>&1 | tee $T.bench.log
-echo "== origins sweep" ; timeout 5000 python scripts/origins_sweep.py 100000 64 256 2>&1 | tee $T.origins.log
-echo "== convergence" ; timeout 4000 python scripts/convergence_bench.py 100000 --out=artifacts/CONVERGENCE_r03_tpu.json 2>&1 | tee $T.conv.log
+echo "== micro (op-class pricing)"
+timeout 1200 python scripts/profile_micro.py "${1:-100000}" 2>&1 | tee $T.micro.log
+echo "== bench (headline number + pallas_fused)"
+BENCH_WORKER=1 timeout 2400 python bench.py 2>&1 | tee $T.bench.log
+echo "== scale (phase profile)"
+timeout 2400 python scripts/profile_scale.py "${1:-100000}" 8 2>&1 | tee $T.scale.log
+echo "== bcast (sub-phase profile)"
+timeout 2400 python scripts/profile_bcast.py "${1:-100000}" 8 2>&1 | tee $T.bcast.log
+echo "== origins sweep"
+timeout 5000 python scripts/origins_sweep.py 100000 64 256 2>&1 | tee $T.origins.log
+echo "== convergence"
+timeout 4000 python scripts/convergence_bench.py 100000 --out=artifacts/CONVERGENCE_r03_tpu.json 2>&1 | tee $T.conv.log

@@ -475,3 +475,24 @@ def test_order_by_ordinal(rich_db):
     from corrosion_tpu.db.database import SqlError
     with _pytest.raises(SqlError):
         rich_db.query(0, "SELECT pname FROM players ORDER BY 7")
+
+
+def test_group_by_expression(rich_db):
+    _, rows = rich_db.query(
+        0, "SELECT COUNT(*) AS n FROM players WHERE score >= 10 "
+           "GROUP BY score % 2 ORDER BY n")
+    # scores 30,10,20,40,25 -> parity groups {even: 4, odd: 1}
+    assert list(rows) == [[1], [4]]
+
+
+def test_group_by_alias_and_order_by_group_expr(rich_db):
+    # GROUP BY an output alias (SQLite allows it)
+    _, rows = rich_db.query(
+        0, "SELECT score % 2 AS par, COUNT(*) AS n FROM players "
+           "WHERE score >= 10 GROUP BY par ORDER BY par")
+    assert list(rows) == [[0, 4], [1, 1]]
+    # ORDER BY the grouping expression itself
+    _, rows = rich_db.query(
+        0, "SELECT COUNT(*) AS n FROM players WHERE score >= 10 "
+           "GROUP BY score % 2 ORDER BY score % 2 DESC")
+    assert list(rows) == [[1], [4]]

@@ -303,3 +303,23 @@ def test_user_query_mentioning_catalog_name_not_hijacked(pg):
     cols, rows, tag, err = c.query(
         "SELECT id FROM users WHERE name = 'pg_type'")
     assert err is None and rows == [["77"]]
+
+
+def test_extended_dialect_over_pg_wire(pg):
+    """The round-3 dialect (LIKE, HAVING, subqueries, expressions) flows
+    through the PG wire path unchanged — the reference's corro-pg
+    translates full PG SQL onto the same engine."""
+    _, _, _, c = pg
+    c.query("INSERT INTO users (id, name, score) VALUES (70, 'zed', 7)")
+    c.query("INSERT INTO users (id, name, score) VALUES (71, 'zoe', 9)")
+    _, rows, _, err = c.query(
+        "SELECT name FROM users WHERE name LIKE 'Z%' ORDER BY name")
+    assert err is None and rows == [["zed"], ["zoe"]]
+    _, rows, _, err = c.query(
+        "SELECT name, score * 10 AS s10 FROM users "
+        "WHERE score = (SELECT MAX(score) FROM users WHERE name LIKE 'z%')")
+    assert err is None and rows == [["zoe", "90"]]
+    _, rows, _, err = c.query(
+        "SELECT COUNT(*) AS n FROM users WHERE name LIKE 'z%' "
+        "GROUP BY score % 2 HAVING COUNT(*) >= 1 ORDER BY n")
+    assert err is None and len(rows) >= 1
