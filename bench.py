@@ -147,8 +147,12 @@ def _worker() -> None:
                 "n_cols": cfg.n_cols,
                 # loud fused-path visibility (VERDICT r2 weak #2): a TPU
                 # record measured on the XLA fallback is flagged, not
-                # silently reported as if it were the pallas path
-                "pallas_fused": bool(megakernel.use_fused()),
+                # silently reported as if it were the pallas path —
+                # shape-aware, so a width-lowering failure shows here too
+                "pallas_fused": bool(
+                    megakernel.use_fused_ingest(cfg, 4 * cfg.pig_changes)
+                    and megakernel.use_fused_swim(cfg.n_nodes, cfg.m_slots)
+                ),
             }
         )
     )

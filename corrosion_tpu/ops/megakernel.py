@@ -37,17 +37,53 @@ from jax.experimental import pallas as pl
 # None = fused on non-CPU backends; True/False pin (tests)
 FORCE_FUSED: Optional[bool] = None
 
-_pallas_ok_cache: dict = {}
+_pallas_ok_cache: dict = {}  # backend -> tiny differential probes passed
+_width_ok_cache: dict = {}  # (backend, kernel, shape key) -> lowers + runs
+
+
+def _warn_degrade(stage: str, detail: str = "") -> None:
+    import sys
+
+    print(
+        f"WARNING: pallas megakernel {stage} probe failed on backend "
+        f"{jax.default_backend()!r}; callers degrade to the (much "
+        f"slower) XLA form. {detail}",
+        file=sys.stderr, flush=True,
+    )
+
+
+def _swim_probe_args(n: int, m: int, key):
+    """Operand tuple for a ``swim_tables_*`` probe call (21 positional
+    args after ``consts``) — shared by the tiny differential probe and
+    the block-width probes so the two cannot drift from the signature."""
+    import jax.random as jr
+
+    iarr = jnp.arange(n, dtype=jnp.int32)
+    mem_id = jr.randint(key, (n, m), -1, n, dtype=jnp.int32)
+    mem_view = jr.randint(jr.fold_in(key, 1), (n, m), -1, 64,
+                          dtype=jnp.int32)
+    return (
+        mem_id, mem_view, mem_id, mem_view,
+        jnp.zeros((n, m), jnp.int32), jnp.ones((n, m), jnp.int32),
+        jnp.ones(n, bool), jnp.zeros(n, jnp.int32), iarr, iarr % m,
+        jnp.full(n, -1, jnp.int32), jnp.ones(n, jnp.int32),
+        iarr % m, jnp.ones(n, jnp.int32), jnp.zeros(n, bool),
+        [mem_id] * 4, [mem_view] * 4, [jnp.ones((n, m), bool)] * 4,
+        [jnp.ones(n, bool)] * 4, [(iarr + 1) % n] * 4,
+        [jnp.zeros(n, jnp.int32)] * 4,
+    )
 
 
 def _pallas_works() -> bool:
-    """Compile + run the REAL ingest kernel once per backend on tiny
-    shapes, cached — if the backend's pallas lowering can't handle it
-    (experimental tunnel plugins), every caller degrades to the XLA path
-    instead of failing the bench."""
+    """Compile + run BOTH real kernels once per backend on tiny shapes,
+    differentially against the shared XLA forms, cached — if the
+    backend's pallas lowering can't handle them (experimental tunnel
+    plugins), every caller degrades to the XLA path instead of failing
+    the bench."""
     backend = jax.default_backend()
     if backend not in _pallas_ok_cache:
         try:
+            import jax.random as jr
             import numpy as np
 
             from corrosion_tpu.sim.broadcast import CrdtState
@@ -71,84 +107,139 @@ def _pallas_works() -> bool:
             if ok:
                 from corrosion_tpu.sim.scale import swim_tables_update
 
-                import jax.random as jr
-
-                n, m = 32, 4
-                iarr = jnp.arange(n, dtype=jnp.int32)
-                key = jr.key(0)
-
-                mem_id = jr.randint(key, (n, m), -1, n, dtype=jnp.int32)
-                mem_view = jr.randint(
-                    jr.fold_in(key, 1), (n, m), -1, 64, dtype=jnp.int32
-                )
-                planes = dict(
-                    mem_id=mem_id, mem_view=mem_view, old_id=mem_id,
-                    old_view=mem_view,
-                    mem_timer=jnp.zeros((n, m), jnp.int32),
-                    mem_tx=jnp.ones((n, m), jnp.int32),
-                )
-                vecs = dict(
-                    alive=jnp.ones(n, bool),
-                    inc=jnp.zeros(n, jnp.int32),
-                    node_id=iarr,
-                    self_slot=iarr % m,
-                    sus_heard=jnp.full(n, -1, jnp.int32),
-                    sends=jnp.ones(n, jnp.int32),
-                    probe_slot=iarr % m,
-                    suspect_key=jnp.ones(n, jnp.int32),
-                    probe_failed=jnp.zeros(n, bool),
-                )
-                chans = dict(
-                    ch_in_id=[mem_id] * 4, ch_in_view=[mem_view] * 4,
-                    ch_in_send=[jnp.ones((n, m), bool)] * 4,
-                    ch_valid=[jnp.ones(n, bool)] * 4,
-                    ch_snd=[(iarr + 1) % n] * 4,
-                    ch_snd_inc=[jnp.zeros(n, jnp.int32)] * 4,
-                )
-                consts = (m, 4, 8, 6)
-                want = swim_tables_update(
-                    consts, *planes.values(), *vecs.values(),
-                    *chans.values(),
-                )
-                got = swim_tables_fused(
-                    consts, *planes.values(), *vecs.values(),
-                    *chans.values(), interpret=False,
-                )
+                consts = (4, 4, 8, 6)
+                args = _swim_probe_args(32, 4, jr.key(0))
+                want = swim_tables_update(consts, *args)
+                got = swim_tables_fused(consts, *args, interpret=False)
                 ok = all(
                     bool(jnp.array_equal(a, b))
                     for a, b in zip(want, got)
                 )
             _pallas_ok_cache[backend] = ok
             if not ok and backend != "cpu":
-                import sys
-
-                print(
-                    "WARNING: pallas megakernel probe MISMATCHED the XLA "
-                    f"path on backend {backend!r}; every caller degrades "
-                    "to the (much slower) XLA form. Investigate "
-                    "ops/megakernel.py before trusting TPU perf numbers.",
-                    file=sys.stderr, flush=True,
+                _warn_degrade(
+                    "differential",
+                    "The fused kernels MISMATCHED the XLA path at tiny "
+                    "shapes — a semantic divergence; investigate "
+                    "ops/megakernel.py before trusting TPU numbers.",
                 )
         except Exception:  # noqa: BLE001 — any lowering failure means "no"
             _pallas_ok_cache[backend] = False
             if backend != "cpu":
-                import sys
                 import traceback
 
-                print(
-                    "WARNING: pallas megakernel failed to lower/run on "
-                    f"backend {backend!r}; every caller degrades to the "
-                    "(much slower) XLA form. Traceback:",
-                    file=sys.stderr, flush=True,
-                )
+                _warn_degrade("differential", "Traceback follows.")
                 traceback.print_exc()
     return _pallas_ok_cache[backend]
 
 
+def _probe_n(blk: int) -> int:
+    """A small n whose block size equals ``blk`` (so the probe exercises
+    the caller's real block shape); 0 when no such multiple exists."""
+    for mult in (3, 2, 5):
+        if _block_size(mult * blk) == blk:
+            return mult * blk
+    return 0
+
+
+def _width_ok_ingest(cfg, msgs: int) -> bool:
+    """Lowering/VMEM probe for the ingest kernel at the caller's block
+    and plane widths — a kernel that lowers at tiny widths can still
+    fail Mosaic/VMEM at the real block shape, and this probe costs one
+    small compile instead of a full-N bench attempt."""
+    backend = jax.default_backend()
+    blk = _block_size(cfg.n_nodes)
+    seen_w = max(1, -(-cfg.buf_slots // 32))
+    key = (backend, "ingest", blk, cfg.n_origins, cfg.n_cells,
+           cfg.bcast_queue, seen_w, msgs)
+    if key not in _width_ok_cache:
+        nb = _probe_n(blk)
+        if nb == 0 or nb >= cfg.n_nodes:
+            # no cheaper representative exists — accept; a failure would
+            # surface at the caller's own compile
+            _width_ok_cache[key] = True
+            return True
+        try:
+            import dataclasses
+
+            from corrosion_tpu.sim.broadcast import CrdtState
+
+            cfgb = dataclasses.replace(cfg, n_nodes=nb)
+            cstb = CrdtState.create(cfgb)
+            zb = jnp.zeros((nb, msgs), jnp.int32)
+            liveb = jnp.zeros((nb, msgs), bool).at[0, 0].set(True)
+            _, infob = ingest_changes_fused(
+                cfgb, cstb, liveb, zb, zb + 1, zb, zb + 1, zb + 7, zb,
+                zb, zb, interpret=False,
+            )
+            _width_ok_cache[key] = int(infob["fresh"]) == 1
+        except Exception:  # noqa: BLE001
+            import traceback
+
+            _width_ok_cache[key] = False
+            _warn_degrade(
+                f"ingest width (block {blk}, widths {key[3:]})",
+                "Lowering/VMEM failure at the real block shape; "
+                "traceback follows.",
+            )
+            traceback.print_exc()
+    return _width_ok_cache[key]
+
+
+def _width_ok_swim(n_nodes: int, m_slots: int) -> bool:
+    """Same as :func:`_width_ok_ingest` for the swim kernel."""
+    backend = jax.default_backend()
+    blk = _block_size(n_nodes)
+    key = (backend, "swim", blk, m_slots)
+    if key not in _width_ok_cache:
+        nb = _probe_n(blk)
+        if nb == 0 or nb >= n_nodes:
+            _width_ok_cache[key] = True
+            return True
+        try:
+            import jax.random as jr
+
+            args = _swim_probe_args(nb, m_slots, jr.key(1))
+            outs = swim_tables_fused(
+                (m_slots, 6, 48, 10), *args, interpret=False
+            )
+            # execution (not values) is what's probed; the tiny-shape
+            # differential in _pallas_works pinned semantics
+            _width_ok_cache[key] = (
+                jax.block_until_ready(outs[0]).shape == (nb, m_slots)
+            )
+        except Exception:  # noqa: BLE001
+            import traceback
+
+            _width_ok_cache[key] = False
+            _warn_degrade(
+                f"swim width (block {blk}, m_slots {m_slots})",
+                "Lowering/VMEM failure at the real block shape; "
+                "traceback follows.",
+            )
+            traceback.print_exc()
+    return _width_ok_cache[key]
+
+
 def use_fused() -> bool:
+    """Backend-level answer (tiny differential probes only)."""
     if FORCE_FUSED is not None:
         return FORCE_FUSED
     return jax.default_backend() != "cpu" and _pallas_works()
+
+
+def use_fused_ingest(cfg, msgs: int = 16) -> bool:
+    """Shape-aware answer for the ingest kernel at ``cfg``'s widths."""
+    if FORCE_FUSED is not None:
+        return FORCE_FUSED
+    return use_fused() and _width_ok_ingest(cfg, msgs)
+
+
+def use_fused_swim(n_nodes: int, m_slots: int) -> bool:
+    """Shape-aware answer for the swim kernel at the caller's widths."""
+    if FORCE_FUSED is not None:
+        return FORCE_FUSED
+    return use_fused() and _width_ok_swim(n_nodes, m_slots)
 
 
 def _cols(table, idx, fill=0):

@@ -191,7 +191,7 @@ def local_write(cfg: SimConfig, cst: CrdtState, write_mask, cell, val, clp=None)
     if cfg.tx_max_cells <= 1:
         from corrosion_tpu.ops import megakernel
 
-        if megakernel.use_fused():
+        if megakernel.use_fused_ingest(cfg, msgs=1):
             return megakernel.local_write_fused(
                 cfg, cst, write_mask, cell, val, clp
             )
@@ -327,7 +327,7 @@ def ingest_changes(cfg, cst: CrdtState, live, m_origin, m_dbv, m_cell, m_ver,
     if cfg.tx_max_cells <= 1:
         from corrosion_tpu.ops import megakernel
 
-        if megakernel.use_fused():
+        if megakernel.use_fused_ingest(cfg, msgs=m_origin.shape[1]):
             # single-cell configs take the whole phase as one pallas
             # kernel per node block (ops/megakernel.py) — identical
             # semantics, differentially tested

@@ -495,7 +495,7 @@ def scale_swim_step(
     )
     from corrosion_tpu.ops import megakernel
 
-    if megakernel.use_fused():
+    if megakernel.use_fused_swim(cfg.n_nodes, cfg.m_slots):
         mem_id, mem_view, timer, mem_tx, inc, refute = (
             megakernel.swim_tables_fused(consts, *args)
         )

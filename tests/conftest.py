@@ -24,3 +24,9 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+# persistent compilation cache: the suite is dominated by jit compiles
+# of small-N programs that rarely change between runs
+from corrosion_tpu.utils.compile_cache import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()
