@@ -52,23 +52,34 @@ def _warn_degrade(stage: str, detail: str = "") -> None:
     )
 
 
-def _swim_probe_args(n: int, m: int, key):
+def _swim_probe_args(n: int, m: int, key, pig_k: int = 0):
     """Operand tuple for a ``swim_tables_*`` probe call (21 positional
-    args after ``consts``) — shared by the tiny differential probe and
-    the block-width probes so the two cannot drift from the signature."""
+    args after ``consts``) — shared by the tiny differential probes and
+    the block-width probes so they cannot drift from the signature.
+    ``pig_k > 0`` shapes the channel planes as packed entry lists
+    ([n, pig_k]) like the bounded-piggyback mode."""
     import jax.random as jr
 
     iarr = jnp.arange(n, dtype=jnp.int32)
     mem_id = jr.randint(key, (n, m), -1, n, dtype=jnp.int32)
     mem_view = jr.randint(jr.fold_in(key, 1), (n, m), -1, 64,
                           dtype=jnp.int32)
+    if pig_k > 0:
+        ch_id = jr.randint(jr.fold_in(key, 2), (n, pig_k), -1, n,
+                           dtype=jnp.int32)
+        ch_view = jr.randint(jr.fold_in(key, 3), (n, pig_k), 0, 64,
+                             dtype=jnp.int32)
+        ch_send = jnp.ones((n, pig_k), bool)
+    else:
+        ch_id, ch_view = mem_id, mem_view
+        ch_send = jnp.ones((n, m), bool)
     return (
         mem_id, mem_view, mem_id, mem_view,
         jnp.zeros((n, m), jnp.int32), jnp.ones((n, m), jnp.int32),
         jnp.ones(n, bool), jnp.zeros(n, jnp.int32), iarr, iarr % m,
         jnp.full(n, -1, jnp.int32), jnp.ones(n, jnp.int32),
         iarr % m, jnp.ones(n, jnp.int32), jnp.zeros(n, bool),
-        [mem_id] * 4, [mem_view] * 4, [jnp.ones((n, m), bool)] * 4,
+        [ch_id] * 4, [ch_view] * 4, [ch_send] * 4,
         [jnp.ones(n, bool)] * 4, [(iarr + 1) % n] * 4,
         [jnp.zeros(n, jnp.int32)] * 4,
     )
@@ -107,14 +118,18 @@ def _pallas_works() -> bool:
             if ok:
                 from corrosion_tpu.sim.scale import swim_tables_update
 
-                consts = (4, 4, 8, 6)
-                args = _swim_probe_args(32, 4, jr.key(0))
-                want = swim_tables_update(consts, *args)
-                got = swim_tables_fused(consts, *args, interpret=False)
-                ok = all(
-                    bool(jnp.array_equal(a, b))
-                    for a, b in zip(want, got)
-                )
+                # both channel forms: aligned rows (pig 0) and packed
+                # entries (bounded piggyback)
+                for consts in ((4, 4, 8, 6, 0), (4, 4, 8, 6, 2)):
+                    args = _swim_probe_args(32, 4, jr.key(0),
+                                            pig_k=consts[4])
+                    want = swim_tables_update(consts, *args)
+                    got = swim_tables_fused(consts, *args,
+                                            interpret=False)
+                    ok = ok and all(
+                        bool(jnp.array_equal(a, b))
+                        for a, b in zip(want, got)
+                    )
             _pallas_ok_cache[backend] = ok
             if not ok and backend != "cpu":
                 _warn_degrade(
@@ -186,11 +201,12 @@ def _width_ok_ingest(cfg, msgs: int) -> bool:
     return _width_ok_cache[key]
 
 
-def _width_ok_swim(n_nodes: int, m_slots: int) -> bool:
-    """Same as :func:`_width_ok_ingest` for the swim kernel."""
+def _width_ok_swim(n_nodes: int, m_slots: int, pig_k: int = 0) -> bool:
+    """Same as :func:`_width_ok_ingest` for the swim kernel (both the
+    aligned-row and bounded-piggyback channel forms)."""
     backend = jax.default_backend()
     blk = _block_size(n_nodes)
-    key = (backend, "swim", blk, m_slots)
+    key = (backend, "swim", blk, m_slots, pig_k)
     if key not in _width_ok_cache:
         nb = _probe_n(blk)
         if nb == 0 or nb >= n_nodes:
@@ -199,9 +215,9 @@ def _width_ok_swim(n_nodes: int, m_slots: int) -> bool:
         try:
             import jax.random as jr
 
-            args = _swim_probe_args(nb, m_slots, jr.key(1))
+            args = _swim_probe_args(nb, m_slots, jr.key(1), pig_k=pig_k)
             outs = swim_tables_fused(
-                (m_slots, 6, 48, 10), *args, interpret=False
+                (m_slots, 6, 48, 10, pig_k), *args, interpret=False
             )
             # execution (not values) is what's probed; the tiny-shape
             # differential in _pallas_works pinned semantics
@@ -213,7 +229,8 @@ def _width_ok_swim(n_nodes: int, m_slots: int) -> bool:
 
             _width_ok_cache[key] = False
             _warn_degrade(
-                f"swim width (block {blk}, m_slots {m_slots})",
+                f"swim width (block {blk}, m_slots {m_slots}, "
+                f"pig {pig_k})",
                 "Lowering/VMEM failure at the real block shape; "
                 "traceback follows.",
             )
@@ -235,11 +252,11 @@ def use_fused_ingest(cfg, msgs: int = 16) -> bool:
     return use_fused() and _width_ok_ingest(cfg, msgs)
 
 
-def use_fused_swim(n_nodes: int, m_slots: int) -> bool:
+def use_fused_swim(n_nodes: int, m_slots: int, pig_k: int = 0) -> bool:
     """Shape-aware answer for the swim kernel at the caller's widths."""
     if FORCE_FUSED is not None:
         return FORCE_FUSED
-    return use_fused() and _width_ok_swim(n_nodes, m_slots)
+    return use_fused() and _width_ok_swim(n_nodes, m_slots, pig_k)
 
 
 def _cols(table, idx, fill=0):

@@ -59,11 +59,12 @@ from corrosion_tpu.ops.lww import (
 )
 from corrosion_tpu.ops.dense import (
     lookup_cols,
+    scatter_cols_add,
     scatter_cols_max,
     scatter_cols_set,
     select_cols,
 )
-from corrosion_tpu.ops.select import sample_k, sample_one
+from corrosion_tpu.ops.select import sample_k, sample_k_biased, sample_one
 from corrosion_tpu.sim.transport import (
     CARD_EXTRA,
     NetModel,
@@ -88,12 +89,22 @@ class ScaleConfig:
     max_transmissions: int = 10
     announce_interval: int = 16
     down_purge_rounds: int = 64  # rounds a Down entry lingers (48 h analog)
+    # bounded piggyback: member-update entries per SWIM packet (foca's
+    # <=1178 B packet bound, broadcast/mod.rs:951-960). 0 = carry the
+    # full aligned member row (cheap merge, 3x[N,M] channel gathers);
+    # k > 0 = carry the k freshest sendable entries ([N,2k] gathers —
+    # ~4x less channel HBM traffic at M=64, k=16; the merge becomes
+    # per-entry hash-class scatters, VMEM-cheap under the pallas kernel)
+    pig_members: int = 0
 
     def validate(self) -> "ScaleConfig":
         assert self.m_slots > 0 and self.n_seeds >= 1
         # sender-election packs a 12-bit priority above the node id in one
         # int32 (_one_sender_per_receiver); larger clusters would overflow
         assert self.n_nodes <= 1 << 19, "max 2^19 nodes per sender-election word"
+        assert 0 <= self.pig_members <= self.m_slots, (
+            "pig_members must be 0..m_slots (top_k over the slot axis)"
+        )
         return self
 
 
@@ -212,8 +223,17 @@ def swim_tables_update(
     [N, M] planes; ``ch_valid``/``ch_snd``/``ch_snd_inc`` length-4 lists
     of [N] vectors; ``node_id`` is each row's global node id. Returns ``(mem_id, mem_view, timer, mem_tx, inc,
     refute)``.
+
+    ``consts`` may carry a 5th element ``pig_k``: when > 0 the channels
+    are BOUNDED packets — ``ch_in_id``/``ch_in_view`` are [N, pig_k]
+    *packed entry lists* (foca's <=1178 B packet bound,
+    ``broadcast/mod.rs:951-960``) instead of aligned member rows; each
+    entry routes to its hash class ``id % m`` via dense column scatters.
+    The caller then owns the mem_tx transmit decrement (only selected
+    entries were sent); the refill-on-change stays here.
     """
-    (m, suspicion_rounds, down_purge_rounds, max_transmissions) = consts
+    (m, suspicion_rounds, down_purge_rounds, max_transmissions) = consts[:4]
+    pig_k = consts[4] if len(consts) > 4 else 0
     # node_id carries each row's GLOBAL id: inside the pallas kernel a
     # block sees only its slice, so an arange here would be block-local
     # and corrupt every self-entry write beyond the first block
@@ -225,24 +245,58 @@ def swim_tables_update(
         probe_failed[:, None],
     )
 
-    # --- four dense packet merges + sender-alive assertions --------------
+    # --- four packet merges + sender-alive assertions --------------------
     sendable = mem_tx > 0
-    for in_id, in_view, in_sendable, valid in zip(
-        ch_in_id, ch_in_view, ch_in_sendable, ch_valid
-    ):
-        ok = valid[:, None] & (in_id >= 0) & in_sendable
-        same = ok & (mem_id == in_id)
-        ins = ok & (mem_id < 0)
-        take = (
-            ok
-            & (mem_id >= 0)
-            & (mem_id != in_id)
-            & ((mem_view & 3) == STATE_DOWN)
-            & ((in_view & 3) == STATE_ALIVE)
-        )
-        mem_view = jnp.where(same, jnp.maximum(mem_view, in_view), mem_view)
-        mem_view = jnp.where(ins | take, in_view, mem_view)
-        mem_id = jnp.where(ins | take, in_id, mem_id)
+    if pig_k > 0:
+        # bounded packets: k (id, view) entries per packet, each applied
+        # at its hash class; sequential application keeps same-class
+        # collisions within one packet well-defined
+        for in_id, in_view, _in_send, valid in zip(
+            ch_in_id, ch_in_view, ch_in_sendable, ch_valid
+        ):
+            for j in range(pig_k):
+                idj = in_id[:, j]
+                vwj = in_view[:, j]
+                okj = valid & (idj >= 0)
+                slotj = (idj % m)[:, None]
+                curid = lookup_cols(mem_id, slotj)[:, 0]
+                curvw = lookup_cols(mem_view, slotj, fill=-1)[:, 0]
+                same = okj & (curid == idj)
+                ins = okj & (curid < 0)
+                take = (
+                    okj
+                    & (curid >= 0)
+                    & (curid != idj)
+                    & ((curvw & 3) == STATE_DOWN)
+                    & ((vwj & 3) == STATE_ALIVE)
+                )
+                new_vw = jnp.where(same, jnp.maximum(curvw, vwj), vwj)
+                wmask = (same | ins | take)[:, None]
+                mem_view = scatter_cols_set(
+                    mem_view, slotj, new_vw[:, None], wmask
+                )
+                mem_id = scatter_cols_set(
+                    mem_id, slotj, idj[:, None], (ins | take)[:, None]
+                )
+    else:
+        for in_id, in_view, in_sendable, valid in zip(
+            ch_in_id, ch_in_view, ch_in_sendable, ch_valid
+        ):
+            ok = valid[:, None] & (in_id >= 0) & in_sendable
+            same = ok & (mem_id == in_id)
+            ins = ok & (mem_id < 0)
+            take = (
+                ok
+                & (mem_id >= 0)
+                & (mem_id != in_id)
+                & ((mem_view & 3) == STATE_DOWN)
+                & ((in_view & 3) == STATE_ALIVE)
+            )
+            mem_view = jnp.where(
+                same, jnp.maximum(mem_view, in_view), mem_view
+            )
+            mem_view = jnp.where(ins | take, in_view, mem_view)
+            mem_id = jnp.where(ins | take, in_id, mem_id)
 
     for snd, valid, s_inc in zip(ch_snd, ch_valid, ch_snd_inc):
         s_key = pack_inc_state(s_inc, jnp.int32(STATE_ALIVE))
@@ -258,9 +312,12 @@ def swim_tables_update(
         )
 
     # --- budget decrement for attempted sends ---------------------------
-    mem_tx = jnp.maximum(
-        jnp.where(sendable, mem_tx - sends[:, None], mem_tx), 0
-    )
+    # (bounded-packet mode decrements only the SELECTED entries, at the
+    # caller, before this function runs)
+    if pig_k == 0:
+        mem_tx = jnp.maximum(
+            jnp.where(sendable, mem_tx - sends[:, None], mem_tx), 0
+        )
 
     # --- suspicion timers / down conversion / purge ----------------------
     occupied = mem_id >= 0
@@ -312,7 +369,7 @@ def scale_swim_step(
     n, m = cfg.n_nodes, cfg.m_slots
     iarr = jnp.arange(n, dtype=jnp.int32)
     (k_tgt, k_p1, k_p2, k_help, k_ind, k_ann, k_annt, k_ann1, k_ann2,
-     k_cp, k_ca) = jr.split(key, 11)
+     k_cp, k_ca, k_upd) = jr.split(key, 12)
 
     # --- churn ----------------------------------------------------------
     kill = jnp.zeros(n, bool) if kill is None else kill
@@ -418,6 +475,14 @@ def scale_swim_step(
     # scalarization); the table transforms run either as plain XLA or as
     # one pallas kernel per node block (ops/megakernel.py)
     sendable = st.mem_tx > 0
+    sends = (
+        has_tgt.astype(jnp.int32)  # probe we sent
+        + announcing.astype(jnp.int32)  # announce we sent
+        + has_prober.astype(jnp.int32)  # ack we sent back to our prober
+        + has_announcer.astype(jnp.int32)  # reply we sent to our announcer
+    )
+    # (``sends`` is the SWIM-layer mem_tx decrement — attempted
+    # membership-update transmissions.)
     # the one channel list: consumed here for the table update AND
     # returned for the piggyback layer (scale_step.py) — a single source
     # so membership packets and the changesets riding them cannot drift
@@ -438,22 +503,48 @@ def scale_swim_step(
     ch_in_id, ch_in_view, ch_in_send, ch_valid, ch_snd, ch_snd_inc = (
         [], [], [], [], [], [],
     )
-    for (src, valid), s_card in zip(channels, ch_cards):
-        ch_in_id.append(jax.lax.optimization_barrier(old_id[src]))
-        ch_in_view.append(jax.lax.optimization_barrier(old_view[src]))
-        ch_in_send.append(jax.lax.optimization_barrier(sendable[src]))
-        ch_valid.append(valid)
-        ch_snd.append(src)
-        ch_snd_inc.append(s_card[:, CARD_INC])
-
-    sends = (
-        has_tgt.astype(jnp.int32)  # probe we sent
-        + announcing.astype(jnp.int32)  # announce we sent
-        + has_prober.astype(jnp.int32)  # ack we sent back to our prober
-        + has_announcer.astype(jnp.int32)  # reply we sent to our announcer
-    )
-    # (``sends`` above is the SWIM-layer mem_tx decrement — attempted
-    # membership-update transmissions, used by swim_tables_update.)
+    pig_k = int(getattr(cfg, "pig_members", 0) or 0)
+    mem_tx_in = st.mem_tx
+    if pig_k > 0:
+        # bounded packets: every packet a node sends this round carries
+        # its pig_k freshest sendable entries (highest remaining budget
+        # first, random tiebreak — foca flushes its least-sent updates
+        # first); one [N, 2k] gather per channel replaces three [N, M]
+        # row gathers
+        occ_sendable = sendable & (old_id >= 0)
+        upd_slots, upd_ok = sample_k_biased(
+            occ_sendable, st.mem_tx.astype(jnp.float32), pig_k, k_upd
+        )
+        upd_id = jnp.where(
+            upd_ok, select_cols(old_id, upd_slots), jnp.int32(FREE)
+        )
+        upd_view = select_cols(old_view, upd_slots)
+        pig_pack = jnp.concatenate([upd_id, upd_view], axis=1)  # [N, 2k]
+        ones_k = jnp.ones((n, pig_k), bool)
+        for (src, valid), s_card in zip(channels, ch_cards):
+            got = jax.lax.optimization_barrier(pig_pack[src])
+            ch_in_id.append(got[:, :pig_k])
+            ch_in_view.append(got[:, pig_k:])
+            ch_in_send.append(ones_k)  # selection already applied it
+            ch_valid.append(valid)
+            ch_snd.append(src)
+            ch_snd_inc.append(s_card[:, CARD_INC])
+        # transmit-budget decrement for the SELECTED entries only (the
+        # table-update function skips its full-row decrement in this
+        # mode); refill-on-change still happens inside it
+        dec = scatter_cols_add(
+            jnp.zeros((n, m), jnp.int32), upd_slots,
+            jnp.broadcast_to(sends[:, None], upd_slots.shape), upd_ok,
+        )
+        mem_tx_in = jnp.maximum(st.mem_tx - dec, 0)
+    else:
+        for (src, valid), s_card in zip(channels, ch_cards):
+            ch_in_id.append(jax.lax.optimization_barrier(old_id[src]))
+            ch_in_view.append(jax.lax.optimization_barrier(old_view[src]))
+            ch_in_send.append(jax.lax.optimization_barrier(sendable[src]))
+            ch_valid.append(valid)
+            ch_snd.append(src)
+            ch_snd_inc.append(s_card[:, CARD_INC])
 
     # delivered-packet count per sender — the piggyback layer's budget
     # multiplicity. It must be delivery-coupled (a changeset's budget
@@ -485,17 +576,17 @@ def scale_swim_step(
     )
     consts = (
         m, int(cfg.suspicion_rounds), int(cfg.down_purge_rounds),
-        int(cfg.max_transmissions),
+        int(cfg.max_transmissions), pig_k,
     )
     args = (
-        mem_id, mem_view, old_id, old_view, st.mem_timer, st.mem_tx,
+        mem_id, mem_view, old_id, old_view, st.mem_timer, mem_tx_in,
         alive, inc, iarr, self_slot, sus_heard, sends,
         probe_slot, suspect_key, failed,
         ch_in_id, ch_in_view, ch_in_send, ch_valid, ch_snd, ch_snd_inc,
     )
     from corrosion_tpu.ops import megakernel
 
-    if megakernel.use_fused_swim(cfg.n_nodes, cfg.m_slots):
+    if megakernel.use_fused_swim(cfg.n_nodes, cfg.m_slots, pig_k):
         mem_id, mem_view, timer, mem_tx, inc, refute = (
             megakernel.swim_tables_fused(consts, *args)
         )

@@ -77,6 +77,7 @@ class ScaleSimConfig:
     max_transmissions: int = 10
     announce_interval: int = 16
     down_purge_rounds: int = 64
+    pig_members: int = 0  # bounded piggyback (see ScaleConfig.pig_members)
     # --- CRDT store ------------------------------------------------------
     n_origins: int = 16
     n_rows: int = 16
@@ -124,6 +125,9 @@ class ScaleSimConfig:
         assert 1 <= self.tx_max_cells <= 30, "seq bitmask lives in an int32"
         # shares the sender-election int32 packing (see ScaleConfig.validate)
         assert self.n_nodes <= 1 << 19, "max 2^19 nodes per sender-election word"
+        assert 0 <= self.pig_members <= self.m_slots, (
+            "pig_members must be 0..m_slots (top_k over the slot axis)"
+        )
         return self
 
 

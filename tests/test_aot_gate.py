@@ -177,3 +177,20 @@ def test_flagship_scanned_form_compiles_within_budget(flagship_cfg):
             - ma.alias_size_in_bytes
         )
         assert peak < HBM_BUDGET, f"estimated peak {peak/2**30:.2f} GiB"
+
+
+def test_fused_path_lowers_at_flagship_shapes_bounded_pig():
+    """Bounded-piggyback mode at flagship N: the packed-entry swim
+    kernel must trace + lower with FORCE_FUSED at 100k block shapes."""
+    from corrosion_tpu.ops import megakernel
+
+    cfg = scale_sim_config(N_FLAGSHIP, n_origins=16, pig_members=16)
+    old = megakernel.FORCE_FUSED
+    megakernel.FORCE_FUSED = True
+    try:
+        st, net, key, inp = _abstract_inputs(cfg)
+        jax.jit(functools.partial(scale_sim_step, cfg)).lower(
+            st, net, key, inp
+        )
+    finally:
+        megakernel.FORCE_FUSED = old

@@ -165,3 +165,34 @@ def test_fused_kernels_multi_block():
     iarr = np.arange(n)
     self_ids = np.asarray(st.mem_id)[iarr, iarr % cfg.m_slots]
     assert (self_ids == iarr).all()
+
+
+def test_fused_swim_matches_unfused_bounded_piggyback():
+    """Packed-entry mode (pig_members > 0): the pallas kernel's
+    hash-class scatter merge must match the XLA form bit-for-bit, across
+    blocks."""
+    from corrosion_tpu.sim.scale import (
+        ScaleSwimState,
+        scale_config,
+        scale_swim_step,
+    )
+    from corrosion_tpu.sim.transport import NetModel
+
+    n = 2048
+    cfg = scale_config(n, pig_members=8)
+    net = NetModel.create(n, drop_prob=0.05)
+    key = jr.key(17)
+    outs = {}
+    for fused in (False, True):
+        try:
+            megakernel.FORCE_FUSED = fused
+            st = ScaleSwimState.create(cfg)
+            for r in range(3):
+                st, info, channels, _c = scale_swim_step(
+                    cfg, st, net, jr.fold_in(key, r)
+                )
+            outs[fused] = st
+        finally:
+            megakernel.FORCE_FUSED = None
+    for a, b in zip(jax.tree.leaves(outs[False]), jax.tree.leaves(outs[True])):
+        assert np.array_equal(np.asarray(a), np.asarray(b))

@@ -149,3 +149,49 @@ def test_partition_and_cluster_gating_at_scale():
     km2 = st2.crdt.book.known_max
     back = jnp.arange(n) >= 32
     assert int(jnp.max(jnp.where(back, jnp.max(km2, axis=1), 0))) == 0
+
+
+def test_bounded_piggyback_detects_churn_and_converges():
+    """pig_members > 0 bounds member updates per packet (foca's packet
+    bound). Detection, down-conversion, and CRDT convergence must still
+    work — fresh rumors have refilled budgets, so they win the bounded
+    slots first."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    from corrosion_tpu.sim.scale import scale_swim_metrics
+    from corrosion_tpu.sim.scale_step import (
+        ScaleRoundInput,
+        ScaleSimState,
+        scale_crdt_metrics,
+        scale_sim_config,
+        scale_sim_step,
+    )
+    from corrosion_tpu.sim.transport import NetModel
+
+    n = 96
+    cfg = scale_sim_config(n, n_origins=8, sync_interval=4, pig_members=8)
+    st = ScaleSimState.create(cfg)
+    net = NetModel.create(n, drop_prob=0.01)
+    inp = ScaleRoundInput.quiet(cfg)
+    step = jax.jit(functools.partial(scale_sim_step, cfg))
+    key = jr.key(5)
+    # writes + a kill burst
+    w = inp._replace(
+        write_mask=jnp.arange(n) < 8,
+        write_cell=jnp.arange(n) % cfg.n_cells,
+        write_val=jnp.full(n, 3, jnp.int32),
+        kill=(jnp.arange(n) >= n - 4),
+    )
+    st, _ = step(st, net, key, w)
+    for i in range(140):
+        key, sub = jr.split(key)
+        st, _ = step(st, net, sub, inp)
+    sm = scale_swim_metrics(st.swim)
+    # dead nodes detected (accuracy counts them only as Down/purged)
+    assert float(sm["accuracy"]) > 0.95
+    m = scale_crdt_metrics(cfg, st)
+    assert bool(m["converged"]), int(m["n_diverged"])
