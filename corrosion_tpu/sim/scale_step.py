@@ -98,6 +98,12 @@ class ScaleSimConfig:
     # --- anti-entropy sync -----------------------------------------------
     sync_interval: int = 8
     sync_peers: int = 2
+    # peers actually PULLED from per cohort round: the reference scores
+    # clamp(members/100, 3, 10) candidates but requests each version
+    # range from ONE peer (parallel_sync dedupes ranges across servers,
+    # peer/mod.rs:1186-1317) — pulling whole stores from all 10 is the
+    # sync phase's dominant HBM cost at 100k (5 planes x P gathers)
+    sync_pull_peers: int = 3
     sync_chunk: int = 32
     # server-side load adaptation (see SimConfig.serve_cap)
     serve_cap: int = 3
@@ -326,14 +332,18 @@ def scale_sim_step(
         & (swim.mem_view >= 0)
         & ((swim.mem_view & 3) == STATE_ALIVE)
     )
-    p_cnt = cfg.sync_peers
+    p_cnt = min(cfg.sync_peers, max(1, cfg.sync_pull_peers))
     # staleness ages every round, synced tracks reset inside the branch
     cst = cst._replace(
         last_sync=jnp.minimum(cst.last_sync + 1, LAST_SYNC_CAP)
     )
 
     def run_sync(cst):
-        cand_slots, cand_sok = sample_k(bel_alive, min(2 * p_cnt, m), k_sp)
+        # the SCORING pool stays at the reference's fanout (2x oversample
+        # of sync_peers candidates); only the top-p_cnt get pulled from
+        cand_slots, cand_sok = sample_k(
+            bel_alive, min(2 * cfg.sync_peers, m), k_sp
+        )
         cand_ids = select_cols(swim.mem_id, cand_slots)
         staleness = select_cols(cst.last_sync, cand_slots)
         card = link_card(net, swim.alive)

@@ -89,15 +89,17 @@ def sync_step(
     key: jax.Array,
     go_all: bool = False,
 ):
-    """One sync round: a random subset of nodes each pulls from up to
-    ``sync_peers`` peers (``go_all``: every alive node syncs — the
-    cohort-scheduled caller already rate-limited the rounds). Returns
-    (state, ok, info) where ``ok`` [N, P] marks pairs that actually
-    exchanged (drives last-sync bookkeeping)."""
-    n, p_cnt, n_org = cfg.n_nodes, cfg.sync_peers, cfg.n_origins
+    """One sync round: a random subset of nodes each pulls from the
+    caller-chosen ``peers`` lanes (the scale path scores ``sync_peers``
+    candidates and passes the top ``sync_pull_peers``; ``go_all``: every
+    alive node syncs — the cohort-scheduled caller already rate-limited
+    the rounds). Returns (state, ok, info) where ``ok`` [N, P] marks
+    pairs that actually exchanged (drives last-sync bookkeeping)."""
+    n, n_org = cfg.n_nodes, cfg.n_origins
+    p_cnt = peers.shape[1]
     iarr = jnp.arange(n, dtype=jnp.int32)
     k_go, k_bi = jr.split(key)
-    assert peers.shape == (n, p_cnt)
+    assert peers.shape[0] == n and p_ok.shape == peers.shape
 
     if go_all:
         syncing = alive
