@@ -157,16 +157,18 @@ def _probe_n(blk: int) -> int:
     return 0
 
 
-def _width_ok_ingest(cfg, msgs: int) -> bool:
+def _width_ok_ingest(cfg, msgs: int, emit: bool = False) -> bool:
     """Lowering/VMEM probe for the ingest kernel at the caller's block
     and plane widths — a kernel that lowers at tiny widths can still
     fail Mosaic/VMEM at the real block shape, and this probe costs one
-    small compile instead of a full-N bench attempt."""
+    small compile instead of a full-N bench attempt. ``emit`` probes the
+    payload-emitting variant (extra outputs + selection loops) so the
+    probed kernel matches the kernel actually run."""
     backend = jax.default_backend()
     blk = _block_size(cfg.n_nodes)
     seen_w = max(1, -(-cfg.buf_slots // 32))
     key = (backend, "ingest", blk, cfg.n_origins, cfg.n_cells,
-           cfg.bcast_queue, seen_w, msgs)
+           cfg.bcast_queue, seen_w, msgs, emit)
     if key not in _width_ok_cache:
         nb = _probe_n(blk)
         if nb == 0 or nb >= cfg.n_nodes:
@@ -183,10 +185,17 @@ def _width_ok_ingest(cfg, msgs: int) -> bool:
             cstb = CrdtState.create(cfgb)
             zb = jnp.zeros((nb, msgs), jnp.int32)
             liveb = jnp.zeros((nb, msgs), bool).at[0, 0].set(True)
-            _, infob = ingest_changes_fused(
+            kw = {}
+            if emit:
+                kw = dict(
+                    rand=jnp.zeros((nb, cfgb.bcast_queue), jnp.float32),
+                    carried=jnp.ones(nb, jnp.int32),
+                )
+            out = ingest_changes_fused(
                 cfgb, cstb, liveb, zb, zb + 1, zb, zb + 1, zb + 7, zb,
-                zb, zb, interpret=False,
+                zb, zb, interpret=False, **kw,
             )
+            infob = out[1]
             _width_ok_cache[key] = int(infob["fresh"]) == 1
         except Exception:  # noqa: BLE001
             import traceback
@@ -245,11 +254,11 @@ def use_fused() -> bool:
     return jax.default_backend() != "cpu" and _pallas_works()
 
 
-def use_fused_ingest(cfg, msgs: int = 16) -> bool:
+def use_fused_ingest(cfg, msgs: int = 16, emit: bool = False) -> bool:
     """Shape-aware answer for the ingest kernel at ``cfg``'s widths."""
     if FORCE_FUSED is not None:
         return FORCE_FUSED
-    return use_fused() and _width_ok_ingest(cfg, msgs)
+    return use_fused() and _width_ok_ingest(cfg, msgs, emit)
 
 
 def use_fused_swim(n_nodes: int, m_slots: int, pig_k: int = 0) -> bool:
@@ -268,25 +277,28 @@ def _cols(table, idx, fill=0):
     return out
 
 
-def _ingest_kernel(
-    cfg_tuple,
-    # inputs (VMEM refs)
-    live_ref, origin_ref, dbv_ref, cell_ref, ver_ref, val_ref, site_ref,
-    clp_ref, ts_ref, budget_ref,
-    s_ver_ref, s_val_ref, s_site_ref, s_dbv_ref, s_clp_ref,
-    head_ref, km_ref, seen_ref,
-    q_origin_ref, q_dbv_ref, q_cell_ref, q_ver_ref, q_val_ref, q_site_ref,
-    q_clp_ref, q_ts_ref, q_tx_ref,
-    hlc_ref, now_ref,
-    # outputs
-    o_s_ver, o_s_val, o_s_site, o_s_dbv, o_s_clp,
-    o_head, o_km, o_seen,
-    o_q_origin, o_q_dbv, o_q_cell, o_q_ver, o_q_val, o_q_site, o_q_clp,
-    o_q_ts, o_q_tx,
-    o_hlc, o_fresh, o_drift,
-):
+def _ingest_kernel(cfg_tuple, *refs):
     (n_origins, n_cells, q_slots, seen_words, hlc_round_bits,
-     hlc_max_drift, no_q) = cfg_tuple
+     hlc_max_drift, no_q, pig_r, budget_bytes, wire_bytes) = cfg_tuple
+    # ref layout: 29 base inputs (+2 with payload emission), then the
+    # 20 base outputs (+3 with emission)
+    n_in = 29 + (2 if pig_r else 0)
+    (live_ref, origin_ref, dbv_ref, cell_ref, ver_ref, val_ref, site_ref,
+     clp_ref, ts_ref, budget_ref,
+     s_ver_ref, s_val_ref, s_site_ref, s_dbv_ref, s_clp_ref,
+     head_ref, km_ref, seen_ref,
+     q_origin_ref, q_dbv_ref, q_cell_ref, q_ver_ref, q_val_ref,
+     q_site_ref, q_clp_ref, q_ts_ref, q_tx_ref,
+     hlc_ref, now_ref) = refs[:29]
+    if pig_r:
+        rand_ref, carried_ref = refs[29:31]
+    (o_s_ver, o_s_val, o_s_site, o_s_dbv, o_s_clp,
+     o_head, o_km, o_seen,
+     o_q_origin, o_q_dbv, o_q_cell, o_q_ver, o_q_val, o_q_site, o_q_clp,
+     o_q_ts, o_q_tx,
+     o_hlc, o_fresh, o_drift) = refs[n_in:n_in + 20]
+    if pig_r:
+        o_payload, o_sel, o_selok = refs[n_in + 20:]
 
     imin = jnp.int32(-2147483648)
     imax = jnp.int32(2147483647)
@@ -466,6 +478,61 @@ def _ingest_kernel(
     ):
         ref[:] = pair[0]
 
+    # --- piggyback payload selection (emitted for THIS round's packets) --
+    # identical semantics to the XLA selection in piggyback_bcast_step:
+    # budget_mask keeps the `allowed` highest-q_tx live slots (stable by
+    # column), then the pig_r largest pre-drawn uniforms win; the q
+    # planes are already in VMEM, so this costs no extra HBM traffic.
+    if pig_r:
+        q_origin_new = planes[0][0]
+        q_tx_new = planes[8][0]
+        rand = rand_ref[:]  # [B, Q] float32
+        carried = carried_ref[:][:, 0]
+        allowed = jnp.maximum(
+            budget_bytes // (wire_bytes * jnp.maximum(carried, 1)), 1
+        ).astype(jnp.int32)
+        live_slot = (q_origin_new != no_q) & (q_tx_new > 0)
+        # budget mask: iteratively take the max-q_tx live slot
+        # (first-column ties, like the stable argsort rank form)
+        bkey = jnp.where(live_slot, q_tx_new, imin)
+        keep = jnp.zeros_like(live_slot)
+        cnt = jnp.zeros((b,), jnp.int32)
+        for _ in range(q_slots):
+            kmax = jnp.max(bkey, axis=1)
+            slot = jnp.argmax(bkey, axis=1).astype(jnp.int32)
+            sel = (kmax > imin) & (cnt < allowed)
+            wcol = col_iota == slot[:, None]
+            keep = keep | (wcol & sel[:, None])
+            cnt = cnt + sel.astype(jnp.int32)
+            bkey = jnp.where(wcol & sel[:, None], imin, bkey)
+            bkey = jnp.where(wcol & ~sel[:, None], imin, bkey)
+        # sample pig_r slots by the pre-drawn uniforms (top_k analog)
+        rkey = jnp.where(keep, rand, jnp.float32(-1.0))
+        sel_cols, sel_oks = [], []
+        for _ in range(pig_r):
+            rmax = jnp.max(rkey, axis=1)
+            slot = jnp.argmax(rkey, axis=1).astype(jnp.int32)
+            sel_cols.append(slot)
+            sel_oks.append(rmax >= 0)
+            rkey = jnp.where(col_iota == slot[:, None],
+                             jnp.float32(-2.0), rkey)
+        sel_slots = jnp.stack(sel_cols, axis=1)  # [B, R]
+        sel_ok = jnp.stack(sel_oks, axis=1)
+        fields = [planes[i][0] for i in (0, 1, 2, 3, 4, 5, 6)]
+        # q_seq/q_nseq stay at their single-cell constants (0 / 1) on
+        # this path — synthesize them so the payload layout matches the
+        # unfused 11-group form exactly
+        zeros_r = jnp.zeros((b, pig_r), jnp.int32)
+        payload_groups = (
+            [_cols(f, sel_slots) for f in fields[:7]]
+            + [zeros_r, zeros_r + 1]
+            + [_cols(planes[7][0], sel_slots)]  # q_ts
+            + [sel_ok.astype(jnp.int32)]
+        )
+        o_payload[:] = jnp.concatenate(payload_groups, axis=1)
+        o_sel[:] = sel_slots
+        o_selok[:] = sel_ok.astype(jnp.int32)
+
 
 def _block_size(n: int) -> int:
     for b in (1024, 800, 640, 512, 400, 256, 200, 128, 100, 64, 50, 32):
@@ -477,6 +544,7 @@ def _block_size(n: int) -> int:
 def ingest_changes_fused(cfg, cst, live, m_origin, m_dbv, m_cell, m_ver,
                          m_val, m_site, m_clp, m_ts, *, m_budget=None,
                          drift_rounds: Optional[int] = None,
+                         rand=None, carried=None,
                          interpret: Optional[bool] = None):
     """Drop-in fused form of the single-cell ``ingest_changes`` path.
 
@@ -484,8 +552,15 @@ def ingest_changes_fused(cfg, cst, live, m_origin, m_dbv, m_cell, m_ver,
     chunking fields — callers use this path only when
     ``cfg.tx_max_cells == 1``, where every version is single-cell (the
     queue's seq/nseq planes stay at their constant 0/1 values).
+
+    When ``rand`` ([N, Q] uniforms) and ``carried`` ([N] delivery
+    multiplicities) are given, the kernel ALSO emits this round's
+    piggyback payload selection from the post-update queue planes it
+    already holds in VMEM (returning ``(cst, info, (payload, sel_slots,
+    sel_ok))``) — the XLA selection phase then disappears.
     """
     from corrosion_tpu.sim.broadcast import (
+        CHANGE_WIRE_BYTES as _CHANGE_WIRE_BYTES,
         HLC_MAX_DRIFT_ROUNDS,
         HLC_ROUND_BITS,
         NO_Q,
@@ -501,11 +576,16 @@ def ingest_changes_fused(cfg, cst, live, m_origin, m_dbv, m_cell, m_ver,
     c_cnt = cst.store[0].shape[1]
     blk = _block_size(n)
 
+    emit = rand is not None and carried is not None
+    pig_r = int(getattr(cfg, "pig_changes", 0)) if emit else 0
     cfg_tuple = (
         o_cnt, c_cnt, q, w,
         HLC_ROUND_BITS,
         HLC_MAX_DRIFT_ROUNDS if drift_rounds is None else drift_rounds,
         int(NO_Q),
+        pig_r,
+        int(getattr(cfg, "bcast_budget_bytes", 0)),
+        _CHANGE_WIRE_BYTES,
     )
 
     def spec(width):
@@ -532,6 +612,11 @@ def ingest_changes_fused(cfg, cst, live, m_origin, m_dbv, m_cell, m_ver,
     now_arr = jnp.asarray(cst.now, jnp.int32)[None]
     in_arrays.append(now_arr)
     in_specs.append(pl.BlockSpec((1,), lambda i: (0,)))
+    if pig_r:
+        in_arrays.append(rand.astype(jnp.float32))
+        in_specs.append(spec(q))
+        in_arrays.append(jnp.asarray(carried, jnp.int32)[:, None])
+        in_specs.append(spec(1))
 
     m = m_origin.shape[1]
     out_shapes = (
@@ -548,6 +633,12 @@ def ingest_changes_fused(cfg, cst, live, m_origin, m_dbv, m_cell, m_ver,
             jax.ShapeDtypeStruct((n, 1), jnp.int32),  # drift rejects
         ]
     )
+    if pig_r:
+        out_shapes = list(out_shapes) + [
+            jax.ShapeDtypeStruct((n, 11 * pig_r), jnp.int32),  # payload
+            jax.ShapeDtypeStruct((n, pig_r), jnp.int32),  # sel slots
+            jax.ShapeDtypeStruct((n, pig_r), jnp.int32),  # sel ok
+        ]
     out_specs = [spec(s.shape[1]) for s in out_shapes]
 
     outs = pl.pallas_call(
@@ -561,7 +652,10 @@ def ingest_changes_fused(cfg, cst, live, m_origin, m_dbv, m_cell, m_ver,
 
     (s_ver, s_val, s_site, s_dbv, s_clp, head, km, seen_flat,
      q_origin, q_dbv, q_cell, q_ver, q_val, q_site, q_clp, q_ts, q_tx,
-     hlc, fresh, drift) = outs
+     hlc, fresh, drift) = outs[:20]
+    emitted = None
+    if pig_r:
+        emitted = (outs[20], outs[21], outs[22] != 0)
 
     book = cst.book._replace(
         head=head, known_max=km, seen=seen_flat.reshape(n, o_cnt, w)
@@ -582,10 +676,13 @@ def ingest_changes_fused(cfg, cst, live, m_origin, m_dbv, m_cell, m_ver,
         "clock_drift_rejects": jnp.sum(drift),
         "queued": jnp.sum(q_origin != NO_Q),
     }
+    if emitted is not None:
+        return cst, info, emitted
     return cst, info
 
 
 def local_write_fused(cfg, cst, write_mask, cell, val, clp=None, *,
+                      rand=None, carried=None,
                       interpret: Optional[bool] = None):
     """Fused form of ``sim.broadcast.local_write`` — a local commit is one
     self-addressed message (origin = site = self, dbv = next_dbv,
@@ -607,7 +704,7 @@ def local_write_fused(cfg, cst, write_mask, cell, val, clp=None, *,
     ts, _ = hlc_tick(cst.hlc, cst.now, w)
     # the kernel's HLC fold lands the same stamp: max(hlc, ts) == ts for
     # writers (hlc_tick is strictly ahead), untouched for others
-    cst2, _ = ingest_changes_fused(
+    out = ingest_changes_fused(
         cfg, cst,
         w[:, None],
         iarr[:, None],
@@ -623,9 +720,21 @@ def local_write_fused(cfg, cst, write_mask, cell, val, clp=None, *,
         # a node never drift-rejects its own stamp (the unfused
         # local_write commits unconditionally) — disable rejection here
         drift_rounds=1 << 20,
+        rand=rand,
+        carried=carried,
         interpret=interpret,
     )
-    return cst2._replace(next_dbv=jnp.where(w, dbv + 1, cst.next_dbv))
+    # emission only happens when pig_changes > 0 too — match the callee's
+    # condition by unpacking on the actual return arity
+    emitted = None
+    if len(out) == 3:
+        cst2, _, emitted = out
+    else:
+        cst2, _ = out
+    cst2 = cst2._replace(next_dbv=jnp.where(w, dbv + 1, cst.next_dbv))
+    if emitted is not None:
+        return cst2, emitted
+    return cst2
 
 
 def _swim_kernel(consts, *refs):

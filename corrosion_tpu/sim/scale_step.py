@@ -208,7 +208,7 @@ class ScaleRoundInput(NamedTuple):
 
 
 def piggyback_bcast_step(cfg: ScaleSimConfig, cst: CrdtState, channels, key,
-                         carried=None):
+                         carried=None, emitted=None):
     """Disseminate queued changesets over the SWIM packet channels.
 
     ``channels``: list of ``(src, valid)`` pairs — per-receiver-unique
@@ -222,6 +222,10 @@ def piggyback_bcast_step(cfg: ScaleSimConfig, cst: CrdtState, channels, key,
     budget multiplicity must be delivery-coupled: burning budget on
     attempts lets an unlucky writer exhaust its changeset with zero
     deliveries, and the version then never disseminates.
+
+    ``emitted``: optional ``(payload, sel_slots, sel_ok)`` produced by
+    the local-write ingest kernel (which already holds the queue planes
+    in VMEM) — when given, the whole selection below is skipped.
     """
     n, q, r = cfg.n_nodes, cfg.bcast_queue, cfg.pig_changes
     iarr = jnp.arange(n, dtype=jnp.int32)
@@ -233,33 +237,37 @@ def piggyback_bcast_step(cfg: ScaleSimConfig, cst: CrdtState, channels, key,
                 valid.astype(jnp.int32), mode="drop"
             )
 
-    live_slot = (cst.q_origin != NO_Q) & (cst.q_tx > 0)  # [N, Q]
-    # per-round byte budget (10 MiB/s governor analog): each selected slot
-    # costs CHANGE_WIRE_BYTES per delivered packet; least-sent changesets
-    # get the budget first, the rest wait for a later round
-    allowed = jnp.maximum(
-        cfg.bcast_budget_bytes
-        // (CHANGE_WIRE_BYTES * jnp.maximum(carried, 1)),
-        1,
-    ).astype(jnp.int32)
-    live_slot = budget_mask(live_slot, cst.q_tx, allowed)
-    sel_slots, sel_ok = sample_k(live_slot, r, key)  # [N, R] per sender
+    if emitted is not None:
+        payload, sel_slots, sel_ok = emitted
+    else:
+        live_slot = (cst.q_origin != NO_Q) & (cst.q_tx > 0)  # [N, Q]
+        # per-round byte budget (10 MiB/s governor analog): each selected
+        # slot costs CHANGE_WIRE_BYTES per delivered packet; least-sent
+        # changesets get the budget first, the rest wait
+        allowed = jnp.maximum(
+            cfg.bcast_budget_bytes
+            // (CHANGE_WIRE_BYTES * jnp.maximum(carried, 1)),
+            1,
+        ).astype(jnp.int32)
+        live_slot = budget_mask(live_slot, cst.q_tx, allowed)
+        sel_slots, sel_ok = sample_k(live_slot, r, key)  # [N, R]
 
-    # --- sender-side payload, packed once --------------------------------
-    # every channel carries the SAME selected slots of its sender, so the
-    # field selection happens once per sender (not once per receiver):
-    # pack the 10 payload lanes plus an ok lane into one [N, 11*R] plane; each
-    # channel is ONE fast row gather of that small plane (barriered — a
-    # fused row gather scalarizes on this backend, see PERF.md)
-    fields = (
-        cst.q_origin, cst.q_dbv, cst.q_cell, cst.q_ver, cst.q_val,
-        cst.q_site, cst.q_clp, cst.q_seq, cst.q_nseq, cst.q_ts,
-    )
-    payload = jnp.concatenate(
-        [select_cols(f, sel_slots) for f in fields]
-        + [sel_ok.astype(jnp.int32)],
-        axis=1,
-    )  # [N, 11*R]
+        # --- sender-side payload, packed once ----------------------------
+        # every channel carries the SAME selected slots of its sender, so
+        # the field selection happens once per sender (not once per
+        # receiver): pack the 10 payload lanes plus an ok lane into one
+        # [N, 11*R] plane; each channel is ONE fast row gather of that
+        # small plane (barriered — a fused row gather scalarizes on this
+        # backend, see PERF.md)
+        fields = (
+            cst.q_origin, cst.q_dbv, cst.q_cell, cst.q_ver, cst.q_val,
+            cst.q_site, cst.q_clp, cst.q_seq, cst.q_nseq, cst.q_ts,
+        )
+        payload = jnp.concatenate(
+            [select_cols(f, sel_slots) for f in fields]
+            + [sel_ok.astype(jnp.int32)],
+            axis=1,
+        )  # [N, 11*R]
 
     # --- gather each channel's payload; [N, n_channels*R] messages ------
     parts, valids = [], []
@@ -310,16 +318,34 @@ def scale_sim_step(
 
     # tick the round counter — the HLC's physical time axis
     cst = st.crdt._replace(now=st.crdt.now + 1)
-    cst = local_write(
-        cfg, cst, inp.write_mask, inp.write_cell, inp.write_val,
-        inp.write_clp,
-    )
-    if cfg.tx_max_cells > 1:
-        cst = local_write_tx(
-            cfg, cst, inp.tx_mask, inp.tx_cell, inp.tx_val, inp.tx_clp,
-            inp.tx_len,
+    from corrosion_tpu.ops import megakernel
+
+    emitted = None
+    if (cfg.tx_max_cells <= 1 and cfg.pig_changes > 0
+            and megakernel.use_fused_ingest(cfg, msgs=1, emit=True)):
+        # the local-write ingest kernel also emits this round's
+        # piggyback payload selection from the queue planes it already
+        # holds in VMEM — the XLA selection phase below is skipped.
+        # ``rand`` is the same draw sample_k would make from k_pig, so
+        # fused and unfused selections are bit-identical.
+        rand = jr.uniform(k_pig, (n, cfg.bcast_queue))
+        cst, emitted = megakernel.local_write_fused(
+            cfg, cst, inp.write_mask, inp.write_cell, inp.write_val,
+            inp.write_clp, rand=rand, carried=carried,
         )
-    cst, b_info = piggyback_bcast_step(cfg, cst, channels, k_pig, carried)
+    else:
+        cst = local_write(
+            cfg, cst, inp.write_mask, inp.write_cell, inp.write_val,
+            inp.write_clp,
+        )
+        if cfg.tx_max_cells > 1:
+            cst = local_write_tx(
+                cfg, cst, inp.tx_mask, inp.tx_cell, inp.tx_val,
+                inp.tx_clp, inp.tx_len,
+            )
+    cst, b_info = piggyback_bcast_step(
+        cfg, cst, channels, k_pig, carried, emitted=emitted
+    )
 
     # need-driven sync peer choice from a 2x sample of believed-alive
     # member-table entries: most-needed versions first, then longest since

@@ -196,3 +196,44 @@ def test_fused_swim_matches_unfused_bounded_piggyback():
             megakernel.FORCE_FUSED = None
     for a, b in zip(jax.tree.leaves(outs[False]), jax.tree.leaves(outs[True])):
         assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fused_round_with_payload_emission_matches_unfused():
+    """The local-write ingest kernel emits the round's piggyback payload
+    selection in-kernel (rand is the same draw sample_k makes from the
+    same key) — the whole round must stay bit-identical to the XLA
+    path."""
+    import functools
+
+    from corrosion_tpu.sim.scale_step import (
+        ScaleRoundInput,
+        ScaleSimState,
+        scale_sim_config,
+        scale_sim_step,
+    )
+    from corrosion_tpu.sim.transport import NetModel
+
+    n = 256
+    cfg = scale_sim_config(n, n_origins=8, sync_interval=4)
+    net = NetModel.create(n, drop_prob=0.02)
+    inp0 = ScaleRoundInput.quiet(cfg)
+    w = inp0._replace(
+        write_mask=jnp.arange(n) < 8,
+        write_cell=jnp.arange(n) % cfg.n_cells,
+        write_val=jnp.full(n, 7, jnp.int32),
+    )
+    key = jr.key(9)
+    outs = {}
+    for fused in (False, True):
+        try:
+            megakernel.FORCE_FUSED = fused
+            step = jax.jit(functools.partial(scale_sim_step, cfg))
+            st = ScaleSimState.create(cfg)
+            st, _ = step(st, net, key, w)
+            for r in range(5):
+                st, _ = step(st, net, jr.fold_in(key, r), inp0)
+            outs[fused] = jax.block_until_ready(st)
+        finally:
+            megakernel.FORCE_FUSED = None
+    for a, b in zip(jax.tree.leaves(outs[False]), jax.tree.leaves(outs[True])):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
