@@ -22,7 +22,7 @@ def pg_config():
     cfg.sim.n_nodes = 16
     cfg.sim.m_slots = 8
     cfg.sim.n_origins = 4
-    cfg.sim.n_rows = 8
+    cfg.sim.n_rows = 16  # the module's tests allocate ~10 distinct pks
     cfg.sim.n_cols = 4
     cfg.perf.sync_interval = 4
     cfg.gossip.drop_prob = 0.0
@@ -309,12 +309,17 @@ def test_extended_dialect_over_pg_wire(pg):
     """The round-3 dialect (LIKE, HAVING, subqueries, expressions) flows
     through the PG wire path unchanged — the reference's corro-pg
     translates full PG SQL onto the same engine."""
-    _, _, _, c = pg
+    agent, _, _, c = pg
     c.query("INSERT INTO users (id, name, score) VALUES (70, 'zed', 7)")
     c.query("INSERT INTO users (id, name, score) VALUES (71, 'zoe', 9)")
-    _, rows, _, err = c.query(
-        "SELECT name FROM users WHERE name LIKE 'Z%' ORDER BY name")
-    assert err is None and rows == [["zed"], ["zoe"]]
+    rows = []
+    for _ in range(100):  # writes apply over rounds; poll like the rest
+        _, rows, _, err = c.query(
+            "SELECT name FROM users WHERE name LIKE 'Z%' ORDER BY name")
+        if err is None and rows == [["zed"], ["zoe"]]:
+            break
+        agent.wait_rounds(2, timeout=60)
+    assert err is None and rows == [["zed"], ["zoe"]], (err, rows)
     _, rows, _, err = c.query(
         "SELECT name, score * 10 AS s10 FROM users "
         "WHERE score = (SELECT MAX(score) FROM users WHERE name LIKE 'z%')")
