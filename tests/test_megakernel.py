@@ -198,11 +198,12 @@ def test_fused_swim_matches_unfused_bounded_piggyback():
         assert np.array_equal(np.asarray(a), np.asarray(b))
 
 
-def test_fused_round_with_payload_emission_matches_unfused():
-    """The local-write ingest kernel emits the round's piggyback payload
-    selection in-kernel (rand is the same draw sample_k makes from the
-    same key) — the whole round must stay bit-identical to the XLA
-    path."""
+@pytest.mark.parametrize("pig_members", [0, 8])
+def test_fused_round_matches_unfused_with_kernel_features(pig_members):
+    """The round-3 kernel features — in-kernel payload emission (always
+    on the fused path) and bounded packed-entry piggyback (pig_members >
+    0) — must keep the full round bit-identical to the XLA path (the
+    selection rand is the same draw sample_k makes from the same key)."""
     import functools
 
     from corrosion_tpu.sim.scale_step import (
@@ -214,7 +215,9 @@ def test_fused_round_with_payload_emission_matches_unfused():
     from corrosion_tpu.sim.transport import NetModel
 
     n = 256
-    cfg = scale_sim_config(n, n_origins=8, sync_interval=4)
+    cfg = scale_sim_config(
+        n, n_origins=8, sync_interval=4, pig_members=pig_members
+    )
     net = NetModel.create(n, drop_prob=0.02)
     inp0 = ScaleRoundInput.quiet(cfg)
     w = inp0._replace(
