@@ -10,6 +10,8 @@ echo "== micro (op-class pricing)"
 timeout 1200 python scripts/profile_micro.py "${1:-100000}" 2>&1 | tee $T.micro.log
 echo "== bench (headline number + pallas_fused)"
 BENCH_WORKER=1 timeout 2400 python bench.py 2>&1 | tee $T.bench.log
+echo "== bench A/B: bounded piggyback"
+BENCH_WORKER=1 BENCH_PIG_MEMBERS=16 timeout 2400 python bench.py 2>&1 | tee $T.bench_pig.log
 echo "== scale (phase profile)"
 timeout 2400 python scripts/profile_scale.py "${1:-100000}" 8 2>&1 | tee $T.scale.log
 echo "== bcast (sub-phase profile)"
