@@ -158,40 +158,55 @@ def sync_step(
     pulled = jnp.int32(0)
     for j in range(p_cnt):
         pj = peers[:, j]  # [N]
-        # row gathers are fast on TPU; the per-cell head lookups below
-        # loop over the small origin axis instead of element-gathering
-        # (ops/dense.py)
-        p_ver, p_val, p_site, p_dbv, p_clp = jax.lax.optimization_barrier(
-            tuple(pl[pj] for pl in cst.store)
-        )  # [N, C]
-        # range check per cell: head_i[site] < dbv <= granted[j, site]
-        lo = lookup_cols(head_i, p_site)
-        hi = lookup_cols(granted[:, j, :], p_site)
-        sel = (
-            ok[:, j : j + 1]
-            & (p_site >= 0)
-            & (p_site < n_org)
-            & (p_dbv > lo)
-            & (p_dbv <= hi)
-            & (p_ver > 0)
+
+        def merge_lane(store, pj=pj, j=j):
+            # row gathers are fast on TPU; the per-cell head lookups
+            # below loop over the small origin axis instead of
+            # element-gathering (ops/dense.py)
+            p_ver, p_val, p_site, p_dbv, p_clp = (
+                jax.lax.optimization_barrier(
+                    tuple(pl[pj] for pl in cst.store)
+                )
+            )  # [N, C]
+            # range check per cell: head_i[site] < dbv <= granted[j, site]
+            lo = lookup_cols(head_i, p_site)
+            hi = lookup_cols(granted[:, j, :], p_site)
+            sel = (
+                ok[:, j : j + 1]
+                & (p_site >= 0)
+                & (p_site < n_org)
+                & (p_dbv > lo)
+                & (p_dbv <= hi)
+                & (p_ver > 0)
+            )
+            # merge key (clp, ver, val, site) — causal-length lifetime
+            # dominates, then the LWW clock (ops/lww.py merge_store)
+            b = (
+                jnp.where(sel, p_clp, INT32_MIN),
+                jnp.where(sel, p_ver, INT32_MIN),
+                jnp.where(sel, p_val, INT32_MIN),
+                jnp.where(sel, p_site, INT32_MIN),
+            )
+            m_clp, m_ver, m_val, m_site, m_dbv = lex_max(
+                (store[4], store[0], store[1], store[2]), b,
+                (store[3], p_dbv),
+            )
+            merged = (m_ver, m_val, m_site, m_dbv, m_clp)
+            new_store = tuple(
+                jnp.where(sel, m, s) for m, s in zip(merged, store)
+            )
+            return new_store, jnp.sum(sel, dtype=jnp.int32)
+
+        # steady state grants nothing: skip the lane's 5 store gathers +
+        # merge entirely when no node was granted anything from it (the
+        # reference's sync_loop similarly no-ops when needs are empty)
+        any_grant = jnp.any(granted[:, j, :] > head_i)
+        store, cnt = jax.lax.cond(
+            any_grant, merge_lane,
+            lambda s: (s, jnp.int32(0)),
+            store,
         )
-        # merge key (clp, ver, val, site) — causal-length lifetime
-        # dominates, then the LWW clock (ops/lww.py merge_store)
-        b = (
-            jnp.where(sel, p_clp, INT32_MIN),
-            jnp.where(sel, p_ver, INT32_MIN),
-            jnp.where(sel, p_val, INT32_MIN),
-            jnp.where(sel, p_site, INT32_MIN),
-        )
-        m_clp, m_ver, m_val, m_site, m_dbv = lex_max(
-            (store[4], store[0], store[1], store[2]), b, (store[3], p_dbv)
-        )
-        merged = (m_ver, m_val, m_site, m_dbv, m_clp)
-        touched = sel  # only selected cells may change
-        store = tuple(
-            jnp.where(touched, m, s) for m, s in zip(merged, store)
-        )
-        pulled = pulled + jnp.sum(sel)
+        pulled = pulled + cnt
 
     # --- head jump + known_max exchange ---------------------------------
     # the head jump goes through raise_heads: the seen window is
