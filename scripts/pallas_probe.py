@@ -1,0 +1,101 @@
+"""Prove (or disprove) that the pallas megakernels lower and run on the
+real backend, at the flagship block shapes — VERDICT r3 weak #6: every
+fused==unfused differential has only ever run in interpret mode on CPU;
+``_pallas_works()`` has never returned on a real axon/TPU backend.
+
+Writes ONE json line to stdout and to ``artifacts/PALLAS_PROBE_r04.json``
+recording, per kernel, whether the tiny differential and the real-block-
+shape width probes passed, so the round has a committed artifact either
+way (a lowering failure is a result, not a missing measurement).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    from corrosion_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+
+    from corrosion_tpu.ops import megakernel
+    from corrosion_tpu.sim.scale_step import scale_sim_config
+
+    backend = jax.default_backend()
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    cfg = scale_sim_config(n)
+    rec: dict = {
+        "metric": "pallas_probe",
+        "backend": backend,
+        "n_nodes": n,
+        "block": megakernel._block_size(n),
+        "complete": False,
+    }
+
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "artifacts", "PALLAS_PROBE_r04.json")
+
+    def checkpoint() -> None:
+        """Write after every probe step: backend init / a probe hang +
+        the session timeout's SIGKILL must still leave the partial
+        results on disk (the round-3 tunnel hung >9 min routinely)."""
+        if backend == "cpu":
+            # a CPU sanity run must not masquerade as the round's answer
+            # to "does pallas lower on the target backend"
+            return
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        tmp = out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(rec, f)
+        os.replace(tmp, out)
+
+    checkpoint()
+    t0 = time.time()
+    rec["differential_ok"] = bool(megakernel._pallas_works())
+    rec["differential_s"] = round(time.time() - t0, 1)
+    checkpoint()
+
+    # msgs must match the live round's ingest width (4 channels x
+    # pig_changes messages) — a narrower probe can pass where the real
+    # kernel fails Mosaic/VMEM
+    msgs = 4 * cfg.pig_changes
+    for name, fn in (
+        ("ingest", lambda: megakernel._width_ok_ingest(cfg, msgs=msgs)),
+        ("ingest_emit",
+         lambda: megakernel._width_ok_ingest(cfg, msgs=1, emit=True)),
+        ("swim", lambda: megakernel._width_ok_swim(cfg.n_nodes,
+                                                   cfg.m_slots, 0)),
+        ("swim_pig16", lambda: megakernel._width_ok_swim(cfg.n_nodes,
+                                                         cfg.m_slots, 16)),
+    ):
+        t0 = time.time()
+        try:
+            rec[f"{name}_ok"] = bool(fn())
+        except Exception as exc:  # noqa: BLE001 — a crash is a result too
+            rec[f"{name}_ok"] = False
+            rec[f"{name}_error"] = repr(exc)[:300]
+        rec[f"{name}_s"] = round(time.time() - t0, 1)
+        checkpoint()
+
+    rec["value"] = 1.0 if all(
+        rec.get(k) for k in
+        ("differential_ok", "ingest_ok", "ingest_emit_ok", "swim_ok",
+         "swim_pig16_ok")
+    ) else 0.0
+    rec["complete"] = True
+    checkpoint()
+    print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()

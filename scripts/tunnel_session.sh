@@ -3,20 +3,33 @@
 # moment the tunnel is alive, highest-value first (the tunnel has been
 # observed to flap — if it dies mid-session, the early artifacts must
 # be the ones that matter). Logs to artifacts/.
+#
+# Round-4 order (VERDICT r3): pallas-lowering proof first (weak #6),
+# then the headline bench (its success seeds artifacts/bench_last.json,
+# which bench.py now prints write-first so the driver's end-of-round
+# capture lands a TPU number even if the tunnel has died again), then
+# the A/B arms of the three landed traffic cuts, then profiles,
+# convergence, and the origins sweep.
 cd /root/repo
 mkdir -p artifacts
 T=artifacts/tunnel_$(date +%m%d_%H%M)
+echo "== pallas probe (does pallas lower on the real backend?)"
+timeout 1800 python scripts/pallas_probe.py 2>&1 | tee $T.pallas.log
 echo "== micro (op-class pricing)"
 timeout 1200 python scripts/profile_micro.py "${1:-100000}" 2>&1 | tee $T.micro.log
-echo "== bench (headline number + pallas_fused)"
+echo "== bench (headline number + pallas_fused; seeds bench_last.json)"
 BENCH_WORKER=1 timeout 2400 python bench.py 2>&1 | tee $T.bench.log
 echo "== bench A/B: bounded piggyback"
 BENCH_WORKER=1 BENCH_PIG_MEMBERS=16 timeout 2400 python bench.py 2>&1 | tee $T.bench_pig.log
+echo "== bench A/B: sync pulls (10 = score-pool width, off) vs default 3"
+BENCH_WORKER=1 BENCH_SYNC_PULL=10 timeout 2400 python bench.py 2>&1 | tee $T.bench_pull.log
+echo "== bench A/B: narrow dtypes off (wide int32 planes)"
+BENCH_WORKER=1 BENCH_NARROW=0 timeout 2400 python bench.py 2>&1 | tee $T.bench_wide.log
 echo "== scale (phase profile)"
 timeout 2400 python scripts/profile_scale.py "${1:-100000}" 8 2>&1 | tee $T.scale.log
 echo "== bcast (sub-phase profile)"
 timeout 2400 python scripts/profile_bcast.py "${1:-100000}" 8 2>&1 | tee $T.bcast.log
+echo "== convergence (tracked metric at 100k, kill+partition mix)"
+timeout 4000 python scripts/convergence_bench.py 100000 --out=artifacts/CONVERGENCE_r04_tpu.json 2>&1 | tee $T.conv.log
 echo "== origins sweep"
 timeout 5000 python scripts/origins_sweep.py 100000 64 256 2>&1 | tee $T.origins.log
-echo "== convergence"
-timeout 4000 python scripts/convergence_bench.py 100000 --out=artifacts/CONVERGENCE_r03_tpu.json 2>&1 | tee $T.conv.log
