@@ -31,8 +31,13 @@ from typing import Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-INT32_MIN = jnp.int32(-2147483648)
+# a numpy scalar, NOT a jnp array: module-level device arrays referenced
+# inside a pallas kernel body become captured jaxpr constants, which the
+# TPU lowering rejects ("captures constants [i32[]]"); np scalars inline
+# as literals on every path
+INT32_MIN = np.int32(-2147483648)
 
 # SWIM member states, ordered by same-incarnation precedence
 # (Down > Suspect > Alive), matching foca's update semantics.

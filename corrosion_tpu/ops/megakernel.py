@@ -41,6 +41,44 @@ _pallas_ok_cache: dict = {}  # backend -> tiny differential probes passed
 _width_ok_cache: dict = {}  # (backend, kernel, shape key) -> lowers + runs
 
 
+def _eager(fn):
+    """Run ``fn`` outside any ambient jax trace.
+
+    The probe functions below execute real pallas calls and ``int()``
+    their results; callers invoke them from INSIDE jit traces (the scale
+    step chooses fused-vs-XLA while being traced), where the probe ops
+    would become tracers and the int() would raise
+    ConcretizationTypeError — permanently caching "pallas broken".
+    ``jax.ensure_compile_time_eval`` is not usable here: it leaks into
+    the pallas kernel's own tracing and turns every kernel-internal
+    array creation into a captured constant. Trace state is
+    thread-local, so a fresh thread gives a genuinely clean context."""
+    try:
+        from jax._src import core as _core
+
+        clean = _core.trace_state_clean()
+    except Exception:  # noqa: BLE001 — private API; the thread path is
+        clean = False  # always correct, so assume dirty if it's gone
+    if clean:
+        return fn()
+    import threading
+
+    box: dict = {}
+
+    def run() -> None:
+        try:
+            box["v"] = fn()
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            box["e"] = e
+
+    t = threading.Thread(target=run, name="pallas-probe")
+    t.start()
+    t.join()
+    if "e" in box:
+        raise box["e"]
+    return box["v"]
+
+
 def _warn_degrade(stage: str, detail: str = "") -> None:
     import sys
 
@@ -93,7 +131,7 @@ def _pallas_works() -> bool:
     the bench."""
     backend = jax.default_backend()
     if backend not in _pallas_ok_cache:
-        try:
+        def _run_probe() -> bool:
             import jax.random as jr
             import numpy as np
 
@@ -130,6 +168,12 @@ def _pallas_works() -> bool:
                         bool(jnp.array_equal(a, b))
                         for a, b in zip(want, got)
                     )
+            return ok
+
+        try:
+            # probes run from inside jit traces (the scale step chooses
+            # its path while being traced) — _eager escapes the trace
+            ok = _eager(_run_probe)
             _pallas_ok_cache[backend] = ok
             if not ok and backend != "cpu":
                 _warn_degrade(
@@ -176,7 +220,7 @@ def _width_ok_ingest(cfg, msgs: int, emit: bool = False) -> bool:
             # surface at the caller's own compile
             _width_ok_cache[key] = True
             return True
-        try:
+        def _run_width_probe() -> bool:
             import dataclasses
 
             from corrosion_tpu.sim.broadcast import CrdtState
@@ -195,8 +239,11 @@ def _width_ok_ingest(cfg, msgs: int, emit: bool = False) -> bool:
                 cfgb, cstb, liveb, zb, zb + 1, zb, zb + 1, zb + 7, zb,
                 zb, zb, interpret=False, **kw,
             )
-            infob = out[1]
-            _width_ok_cache[key] = int(infob["fresh"]) == 1
+            return int(out[1]["fresh"]) == 1
+
+        try:
+            # eager escape: see _pallas_works (probes run inside traces)
+            _width_ok_cache[key] = _eager(_run_width_probe)
         except Exception:  # noqa: BLE001
             import traceback
 
@@ -221,7 +268,7 @@ def _width_ok_swim(n_nodes: int, m_slots: int, pig_k: int = 0) -> bool:
         if nb == 0 or nb >= n_nodes:
             _width_ok_cache[key] = True
             return True
-        try:
+        def _run_width_probe() -> bool:
             import jax.random as jr
 
             args = _swim_probe_args(nb, m_slots, jr.key(1), pig_k=pig_k)
@@ -230,9 +277,11 @@ def _width_ok_swim(n_nodes: int, m_slots: int, pig_k: int = 0) -> bool:
             )
             # execution (not values) is what's probed; the tiny-shape
             # differential in _pallas_works pinned semantics
-            _width_ok_cache[key] = (
-                jax.block_until_ready(outs[0]).shape == (nb, m_slots)
-            )
+            return jax.block_until_ready(outs[0]).shape == (nb, m_slots)
+
+        try:
+            # eager escape: see _pallas_works (probes run inside traces)
+            _width_ok_cache[key] = _eager(_run_width_probe)
         except Exception:  # noqa: BLE001
             import traceback
 
@@ -346,7 +395,13 @@ def _ingest_kernel(cfg_tuple, *refs):
         & (dbv[:, :, None] == dbv[:, None, :])
         & live[:, None, :]
     )
-    earlier = jnp.tril(jnp.ones((m, m), bool), k=-1)
+    # iota compare, not tril-of-ones: a dense bool constant lowers to an
+    # i8 constant + trunci-to-i1, which Mosaic rejects ("Unsupported
+    # target bitwidth for truncation")
+    earlier = (
+        jax.lax.broadcasted_iota(jnp.int32, (m, m), 1)
+        < jax.lax.broadcasted_iota(jnp.int32, (m, m), 0)
+    )
     dup = jnp.any(same & earlier[None, :, :], axis=2)
     fresh = live & ~seen_b & ~dup
     o_fresh[:] = fresh.astype(jnp.int32)
@@ -462,9 +517,13 @@ def _ingest_kernel(cfg_tuple, *refs):
         [q_tx_now, rebudget],
     ]
     col_iota = jax.lax.broadcasted_iota(jnp.int32, evict_key.shape, 1)
+    # arg-reductions over int operands don't lower on Mosaic (only f32);
+    # min/argmin == min-reduce + lowest matching column, two passes
     for j in range(m):
         kmin = jnp.min(evict_key, axis=1)
-        slot = jnp.argmin(evict_key, axis=1).astype(jnp.int32)
+        slot = jnp.min(
+            jnp.where(evict_key == kmin[:, None], col_iota, q_slots), axis=1
+        )
         write = (fresh[:, j] & (kmin < imax))[:, None] & (
             col_iota == slot[:, None]
         )
@@ -495,17 +554,20 @@ def _ingest_kernel(cfg_tuple, *refs):
         # budget mask: iteratively take the max-q_tx live slot
         # (first-column ties, like the stable argsort rank form)
         bkey = jnp.where(live_slot, q_tx_new, imin)
-        keep = jnp.zeros_like(live_slot)
+        keep = col_iota < 0  # all-False without a bool constant (Mosaic)
         cnt = jnp.zeros((b,), jnp.int32)
         for _ in range(q_slots):
             kmax = jnp.max(bkey, axis=1)
-            slot = jnp.argmax(bkey, axis=1).astype(jnp.int32)
+            # int argmax doesn't lower on Mosaic: lowest matching column
+            slot = jnp.min(
+                jnp.where(bkey == kmax[:, None], col_iota, q_slots), axis=1
+            )
             sel = (kmax > imin) & (cnt < allowed)
             wcol = col_iota == slot[:, None]
             keep = keep | (wcol & sel[:, None])
             cnt = cnt + sel.astype(jnp.int32)
-            bkey = jnp.where(wcol & sel[:, None], imin, bkey)
-            bkey = jnp.where(wcol & ~sel[:, None], imin, bkey)
+            # the selected column retires unconditionally (sel or not)
+            bkey = jnp.where(wcol, imin, bkey)
         # sample pig_r slots by the pre-drawn uniforms (top_k analog)
         rkey = jnp.where(keep, rand, jnp.float32(-1.0))
         sel_cols, sel_oks = [], []

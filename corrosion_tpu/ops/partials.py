@@ -28,10 +28,11 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from corrosion_tpu.ops.slots import alloc_slots, scatter_rows
 
-NO_SLOT = jnp.int32(-1)
+NO_SLOT = np.int32(-1)  # np scalar: safe to close over in pallas kernels
 
 
 class Partials(NamedTuple):

@@ -37,6 +37,7 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from corrosion_tpu.ops.dense import (
@@ -45,7 +46,7 @@ from corrosion_tpu.ops.dense import (
     scatter_cols_or,
 )
 
-_ONES = jnp.uint32(0xFFFFFFFF)
+_ONES = np.uint32(0xFFFFFFFF)  # np scalar: safe to close over in pallas kernels
 
 
 class Book(NamedTuple):

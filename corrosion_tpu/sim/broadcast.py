@@ -35,6 +35,7 @@ from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import jax.random as jr
 
 from corrosion_tpu.ops.dense import apply_changes, lookup_cols
@@ -59,7 +60,7 @@ from corrosion_tpu.ops.versions import (
 from corrosion_tpu.sim.config import SimConfig
 from corrosion_tpu.sim.transport import NetModel, uni_ok
 
-NO_Q = jnp.int32(-1)
+NO_Q = np.int32(-1)  # np scalar: safe to close over in pallas kernels
 LAST_SYNC_CAP = 4095  # staleness saturates (never-synced == very stale)
 
 # --- hybrid logical clock, in sim units ---------------------------------

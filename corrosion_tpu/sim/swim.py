@@ -36,12 +36,13 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 import jax.random as jr
+import numpy as np
 
 from corrosion_tpu.ops.lww import STATE_ALIVE, STATE_DOWN, STATE_SUSPECT, pack_inc_state
 from corrosion_tpu.sim.config import SimConfig
 from corrosion_tpu.sim.transport import NetModel, datagram_ok
 
-UNKNOWN = jnp.int32(-1)
+UNKNOWN = np.int32(-1)  # np scalar: safe to close over in pallas kernels
 
 
 class SwimState(NamedTuple):

@@ -1,0 +1,145 @@
+"""Interleaved A/B bench for the scale-round traffic cuts.
+
+One-shot sequential A/B runs are invalid on the axon tunnel: the first
+(cold) run of round 4 measured 25 rounds/s and the fourth 407 at the
+SAME config — the warmup drift dwarfs any cut's effect. This bench
+compiles every arm in ONE process, warms them all, then interleaves
+timed reps round-robin so drift hits every arm equally; per-arm medians
+of per-rep throughput are robust to one-off stalls.
+
+Usage: python scripts/ab_bench.py [n_nodes] [reps]
+Arms: default, pig16 (bounded piggyback), pull10 (pull = score pool,
+i.e. the pre-cut sync width), and narrow (when the config grows
+``narrow_dtypes``). Writes one JSON line per arm plus a summary line to
+stdout and ``artifacts/AB_BENCH_r04.jsonl``.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    from corrosion_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+    import dataclasses
+
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    from corrosion_tpu.sim.scale_step import (
+        ScaleRoundInput,
+        ScaleSimState,
+        scale_run_rounds,
+        scale_sim_config,
+    )
+    from corrosion_tpu.sim.transport import NetModel
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    reps = int(sys.argv[2]) if len(sys.argv) > 2 else 10
+    rounds = 8
+    platform = jax.devices()[0].platform
+
+    base = scale_sim_config(n, n_origins=min(16, n))
+    arm_cfgs = {"default": base}
+    arm_cfgs["pig16"] = dataclasses.replace(base, pig_members=16)
+    arm_cfgs["pull10"] = dataclasses.replace(
+        base, sync_pull_peers=base.sync_peers
+    )
+    if any(f.name == "narrow_dtypes"
+           for f in dataclasses.fields(type(base))):
+        arm_cfgs["narrow"] = dataclasses.replace(base, narrow_dtypes=True)
+
+    out_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "artifacts", "AB_BENCH_r04.jsonl",
+    )
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    sink = open(out_path, "a")
+
+    def emit(rec):
+        line = json.dumps(rec)
+        print(line, flush=True)
+        sink.write(line + "\n")
+        sink.flush()
+
+    key = jr.key(0)
+    k1, k2, k3 = jr.split(jr.key(1), 3)
+
+    arms = {}
+    for name, cfg in arm_cfgs.items():
+        st = ScaleSimState.create(cfg)
+        net = NetModel.create(n, drop_prob=0.01)
+        quiet = ScaleRoundInput.quiet(cfg)
+        inputs = jax.tree.map(
+            lambda a: jnp.broadcast_to(a, (rounds,) + a.shape), quiet
+        )
+        w = (jr.uniform(k1, (rounds, n)) < 0.25) & (
+            jnp.arange(n)[None, :] < cfg.n_origins
+        )
+        inputs = inputs._replace(
+            write_mask=w,
+            write_cell=jr.randint(k2, (rounds, n), 0, cfg.n_cells,
+                                  dtype=jnp.int32),
+            write_val=jr.randint(k3, (rounds, n), 0, 1 << 20,
+                                 dtype=jnp.int32),
+        )
+        t0 = time.perf_counter()
+        run = jax.jit(functools.partial(scale_run_rounds, cfg))
+        st2 = jax.block_until_ready(run(st, net, key, inputs))[0]
+        emit({"arm": name, "event": "compiled",
+              "compile_s": round(time.perf_counter() - t0, 1)})
+        arms[name] = dict(run=run, st=st2, net=net, inputs=inputs,
+                          times=[])
+
+    # extra warm lap for every arm before any timing
+    for a in arms.values():
+        a["st"] = jax.block_until_ready(
+            a["run"](a["st"], a["net"], key, a["inputs"])
+        )[0]
+
+    from corrosion_tpu.ops import megakernel
+
+    for i in range(reps):
+        for name, a in arms.items():
+            t0 = time.perf_counter()
+            a["st"], _ = a["run"](a["st"], a["net"], jr.fold_in(key, i),
+                                  a["inputs"])
+            jax.block_until_ready(a["st"])
+            a["times"].append(time.perf_counter() - t0)
+
+    for name, a in arms.items():
+        rps = [rounds / t for t in a["times"]]
+        cfg = arm_cfgs[name]
+        emit({
+            "metric": f"ab_rounds_per_sec_n{n}_{platform}",
+            "arm": name,
+            "value": round(statistics.median(rps), 2),
+            "best": round(max(rps), 2),
+            "worst": round(min(rps), 2),
+            "unit": "rounds/s",
+            "reps": reps,
+            "pig_members": cfg.pig_members,
+            "sync_pull_peers": cfg.sync_pull_peers,
+            "pallas_fused": bool(
+                megakernel.use_fused_ingest(cfg, 4 * cfg.pig_changes)
+                and megakernel.use_fused_swim(
+                    cfg.n_nodes, cfg.m_slots, cfg.pig_members)
+            ),
+        })
+
+
+if __name__ == "__main__":
+    main()

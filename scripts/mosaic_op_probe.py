@@ -1,0 +1,147 @@
+"""Bisect which op patterns inside the megakernels fail Mosaic TPU
+lowering ("Unsupported target bitwidth for truncation", int arg-reduce,
+...). Each pattern is a tiny standalone pallas kernel compiled on the
+real backend; one JSON line per pattern. Patterns mirror the exact op
+mix of ``ops/megakernel.py``'s ingest + swim kernels so a pass here
+means the big kernels' op classes all lower.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    from corrosion_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import pallas as pl
+
+    B, W = 32, 8
+
+    def run(name, kernel, n_in=1, out_dtype=jnp.int32):
+        x = jnp.arange(B * W, dtype=jnp.int32).reshape(B, W) % 7
+        args = [x] * n_in
+        try:
+            out = pl.pallas_call(
+                kernel,
+                grid=(1,),
+                in_specs=[pl.BlockSpec((B, W), lambda i: (0, 0))] * n_in,
+                out_specs=pl.BlockSpec((B, W), lambda i: (0, 0)),
+                out_shape=jax.ShapeDtypeStruct((B, W), out_dtype),
+                interpret=False,
+            )(*args)
+            jax.block_until_ready(out)
+            print(json.dumps({"pattern": name, "ok": True}), flush=True)
+            return True
+        except Exception as e:  # noqa: BLE001
+            msg = str(e).splitlines()
+            key = next((l for l in msg if "Mosaic" in l or "Unsupported" in l
+                        or "NotImplemented" in l), msg[0] if msg else "?")
+            print(json.dumps({"pattern": name, "ok": False,
+                              "err": key[:160]}), flush=True)
+            return False
+
+    iota = jax.lax.broadcasted_iota(jnp.int32, (B, W), 1)
+
+    def k_bool_store(x_ref, o_ref):
+        b = x_ref[:] != 0
+        o_ref[:] = b.astype(jnp.int32)
+
+    def k_bool_and_reduce3(x_ref, o_ref):
+        x = x_ref[:]
+        same = (x[:, :, None] == x[:, None, :])
+        tri = jnp.tril(jnp.ones((W, W), bool), k=-1)
+        dup = jnp.any(same & tri[None, :, :], axis=2)
+        o_ref[:] = dup.astype(jnp.int32)
+
+    def k_shift_vec(x_ref, o_ref):
+        x = x_ref[:].astype(jnp.uint32)
+        bit = (x & 31).astype(jnp.uint32)
+        o_ref[:] = ((jnp.uint32(1) << bit) | (x >> bit)).astype(jnp.int32)
+
+    def k_popcount(x_ref, o_ref):
+        x = x_ref[:].astype(jnp.uint32)
+        o_ref[:] = jax.lax.population_count(x).astype(jnp.int32)
+
+    def k_np_scalar_where(x_ref, o_ref):
+        x = x_ref[:]
+        o_ref[:] = jnp.where(x > 3, np.int32(-2147483648), x)
+
+    def k_min_iota_select(x_ref, o_ref):
+        x = x_ref[:]
+        kmin = jnp.min(x, axis=1)
+        slot = jnp.min(jnp.where(x == kmin[:, None], iota, W), axis=1)
+        o_ref[:] = jnp.broadcast_to(slot[:, None], (B, W))
+
+    def k_argmax_f32(x_ref, o_ref):
+        x = x_ref[:].astype(jnp.float32)
+        o_ref[:] = jnp.broadcast_to(
+            jnp.argmax(x, axis=1).astype(jnp.int32)[:, None], (B, W)
+        )
+
+    def k_cols_select(x_ref, o_ref):
+        x = x_ref[:]
+        out = jnp.zeros_like(x)
+        for c in range(W):
+            out = jnp.where(x == c, x[:, c:c + 1], out)
+        o_ref[:] = out
+
+    def k_mod(x_ref, o_ref):
+        o_ref[:] = (x_ref[:] % W) * 4 + 1
+
+    def k_div_pyint(x_ref, o_ref):
+        o_ref[:] = (10 * 1024 * 1024 // (183 * jnp.maximum(x_ref[:], 1)))
+
+    def k_bool_or_acc(x_ref, o_ref):
+        x = x_ref[:]
+        keep = jnp.zeros((B, W), bool)
+        sel = x > 3
+        keep = keep | (sel & (iota == 2))
+        o_ref[:] = keep.astype(jnp.int32)
+
+    def k_row_bcast(x_ref, o_ref):
+        x = x_ref[:]
+        o_ref[:] = jnp.broadcast_to(jnp.max(x, axis=1)[:, None], (B, W))
+
+    def k_scalar_ref(x_ref, o_ref):
+        # [B,1]-style scalar lanes: x[:, 0] broadcast ops
+        v = x_ref[:][:, 0]
+        o_ref[:] = jnp.broadcast_to(v[:, None], (B, W)) + 1
+
+    results = {}
+    for name, k in [
+        ("bool_store", k_bool_store),
+        ("bool_and_reduce3", k_bool_and_reduce3),
+        ("shift_vec", k_shift_vec),
+        ("popcount", k_popcount),
+        ("np_scalar_where", k_np_scalar_where),
+        ("min_iota_select", k_min_iota_select),
+        ("argmax_f32", k_argmax_f32),
+        ("cols_select", k_cols_select),
+        ("mod", k_mod),
+        ("div_pyint", k_div_pyint),
+        ("bool_or_acc", k_bool_or_acc),
+        ("row_bcast", k_row_bcast),
+        ("scalar_ref", k_scalar_ref),
+    ]:
+        results[name] = run(name, k)
+    print(json.dumps({"metric": "mosaic_op_probe",
+                      "backend": jax.default_backend(),
+                      "failed": [k for k, v in results.items() if not v]}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

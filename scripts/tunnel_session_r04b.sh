@@ -1,0 +1,23 @@
+#!/bin/bash
+# Round-4b TPU session: pallas-proof with the FIXED kernels first, then
+# interleaved A/B, then phase profiles, convergence, origins sweep.
+cd /root/repo
+mkdir -p artifacts
+T=artifacts/tunnel_$(date +%m%d_%H%M)
+echo "== pallas probe (fixed kernels: np scalars, iota masks, no int argmin)"
+timeout 2400 python scripts/pallas_probe.py 2>&1 | tee $T.pallas2.log
+echo "== mosaic op-pattern probe"
+timeout 1200 python scripts/mosaic_op_probe.py 2>&1 | tee $T.opprobe.log
+echo "== interleaved A/B bench (default / pig16 / pull10 / narrow?)"
+timeout 3600 python scripts/ab_bench.py 100000 10 2>&1 | tee $T.ab.log
+echo "== bench (headline; seeds bench_last.json write-first record)"
+BENCH_WORKER=1 timeout 2400 python bench.py 2>&1 | tee $T.bench2.log
+echo "== scale (phase profile)"
+timeout 2400 python scripts/profile_scale.py 100000 8 2>&1 | tee $T.scale2.log
+echo "== bcast (sub-phase profile)"
+timeout 2400 python scripts/profile_bcast.py 100000 8 2>&1 | tee $T.bcast2.log
+echo "== convergence (tracked metric at 100k, kill+partition mix)"
+timeout 4000 python scripts/convergence_bench.py 100000 --out=artifacts/CONVERGENCE_r04_tpu.json 2>&1 | tee $T.conv2.log
+echo "== origins sweep"
+timeout 5000 python scripts/origins_sweep.py 100000 64 256 2>&1 | tee $T.origins2.log
+echo "== session r04b done"
