@@ -92,6 +92,10 @@ _FUNC_RE = re.compile(
     r"^corro_json_contains\s*\(\s*(?P<a>[^,]+)\s*,\s*(?P<b>.+)\s*\)$",
     re.IGNORECASE | re.DOTALL,
 )
+_ISNULL_RE = re.compile(
+    r"^(?P<col>[\w\".]+)\s+IS\s+(?P<neg>NOT\s+)?NULL$",
+    re.IGNORECASE | re.DOTALL,
+)
 
 
 import functools
@@ -333,11 +337,11 @@ def _split_expr_alias(raw: str) -> Tuple[str, Optional[str]]:
     return raw.strip(), None
 
 
-def _split_top_and(s: str) -> List[str]:
-    """Split a WHERE/HAVING conjunction on top-level ``AND`` only —
-    ``AND`` inside parens (subqueries) or strings doesn't count."""
+def _split_top_kw(s: str, kw: str) -> List[str]:
+    """Split on a top-level keyword (``AND``/``OR``) only — occurrences
+    inside parens (subqueries, groups) or strings don't count."""
     parts, start, depth, in_str = [], 0, 0, False
-    i, n = 0, len(s)
+    i, n, k = 0, len(s), len(kw)
     while i < n:
         ch = s[i]
         if in_str:
@@ -348,18 +352,43 @@ def _split_top_and(s: str) -> List[str]:
             depth += 1
         elif ch == ")":
             depth -= 1
-        elif depth == 0 and s[i : i + 3].upper() == "AND" and (
+        elif depth == 0 and s[i : i + k].upper() == kw and (
             i == 0 or not (s[i - 1].isalnum() or s[i - 1] in "_\"")
         ) and (
-            i + 3 >= n or not (s[i + 3].isalnum() or s[i + 3] in "_\"")
+            i + k >= n or not (s[i + k].isalnum() or s[i + k] in "_\"")
         ):
             parts.append(s[start:i])
-            i += 3
+            i += k
             start = i
             continue
         i += 1
     parts.append(s[start:])
     return [p.strip() for p in parts if p.strip()]
+
+
+def _split_top_and(s: str) -> List[str]:
+    return _split_top_kw(s, "AND")
+
+
+def _is_paren_group(s: str) -> bool:
+    """Whole string is one balanced ``( ... )`` group (so the parens are
+    grouping, not part of an expression like ``(a + b) > 5``)."""
+    s = s.strip()
+    if not (s.startswith("(") and s.endswith(")")):
+        return False
+    depth, in_str = 0, False
+    for i, ch in enumerate(s):
+        if in_str:
+            in_str = ch != "'"
+        elif ch == "'":
+            in_str = True
+        elif ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+            if depth == 0:
+                return i == len(s) - 1
+    return False
 
 
 def _unquote(ident: str) -> str:
@@ -929,17 +958,53 @@ class Database:
 
     def _parse_conds(self, raw: str, p: _Params, resolve, check_params,
                      defer_lhs: bool = False) -> List[tuple]:
-        """Parse a WHERE/HAVING conjunction into ``(op, lhs, rhs)`` tuples.
+        """Parse a WHERE/HAVING boolean expression into a cond list.
 
-        Ops: comparison operators, ``[not] like``/``[not] glob``,
-        ``[not] in`` (literal list or subquery), ``json_contains``. An rhs
-        of ``(SELECT ...)`` parses recursively into a ``("subq", ast)`` /
-        ``("subq_list", ast)`` marker resolved against the queried node at
-        execution (scalar subqueries — ``corro-pg``'s sqlparser surface,
-        ``crates/corro-pg/src/lib.rs``)."""
+        Leaves are ``(op, lhs, rhs)`` tuples — comparison operators,
+        ``[not] like``/``[not] glob``, ``[not] in`` (literal list or
+        subquery), ``json_contains``; an rhs of ``(SELECT ...)`` parses
+        into a ``("subq"/"subq_list", ast)`` marker resolved against the
+        queried node at execution (``corro-pg``'s sqlparser surface,
+        ``crates/corro-pg/src/lib.rs``). The boolean structure rides the
+        same shape: a list is an AND-conjunction whose entries may also
+        be ``("or", [branch-conds...], None)`` / ``("not", conds, None)``
+        nodes, evaluated with SQLite's three-valued logic (NULL-involved
+        comparisons are UNKNOWN, excluded at the top level, and NOT
+        preserves UNKNOWN rather than flipping it to true)."""
+        or_parts = _split_top_kw(raw, "OR")
+        if len(or_parts) > 1:
+            return [(
+                "or",
+                [self._parse_conds(part, p, resolve, check_params,
+                                   defer_lhs)
+                 for part in or_parts],
+                None,
+            )]
         conds: List[tuple] = []
         res = (lambda r: r.strip()) if defer_lhs else resolve
         for clause in _split_top_and(raw):
+            # NOT <group-or-clause> (but not the NOT of "NOT LIKE"/
+            # "NOT IN", which the leaf regexes own)
+            nm = re.match(r"NOT\s+(?=\()|NOT\s+(?!LIKE\b|GLOB\b|IN\b)",
+                          clause, re.IGNORECASE)
+            if nm and not _LIKE_RE.match(clause) and not _IN_RE.match(
+                    clause):
+                conds.append((
+                    "not",
+                    self._parse_conds(clause[nm.end():], p, resolve,
+                                      check_params, defer_lhs),
+                    None,
+                ))
+                continue
+            # a grouping paren (never a subquery: those appear only as
+            # rhs / IN bodies, which the leaf paths below handle)
+            if _is_paren_group(clause) and not _SELECT_RE.match(
+                    clause[1:-1].strip()):
+                conds.extend(
+                    self._parse_conds(clause[1:-1], p, resolve,
+                                      check_params, defer_lhs)
+                )
+                continue
             fm = _FUNC_RE.match(clause)
             if fm:
                 needle = (_parse_literal(fm.group("b"), p)
@@ -954,6 +1019,13 @@ class Database:
                     (op, res(lm.group("col")),
                      self._parse_rhs(lm.group("val"), p, check_params))
                 )
+                continue
+            km = _ISNULL_RE.match(clause)
+            if km:
+                conds.append((
+                    "is not null" if km.group("neg") else "is null",
+                    res(km.group("col")), None,
+                ))
                 continue
             im = _IN_RE.match(clause)
             if im:
@@ -1016,7 +1088,11 @@ class Database:
         empty, like SQLite), list = every row's first column."""
         out = []
         for op, lhs, val in conds:
-            if (isinstance(val, tuple) and len(val) == 2
+            if op == "or":
+                lhs = [self._resolve_subqueries(node, b) for b in lhs]
+            elif op == "not":
+                lhs = self._resolve_subqueries(node, lhs)
+            elif (isinstance(val, tuple) and len(val) == 2
                     and val[0] in ("subq", "subq_list")):
                 rows = list(self._run_select(node, val[1]))
                 if val[0] == "subq":
@@ -1186,8 +1262,24 @@ class Database:
     def _having_ok(self, ast, out: dict, grp: List[dict]) -> bool:
         """Evaluate HAVING conditions on one group. A left side may be an
         aggregate expression (``COUNT(*) > 5``), an output alias, or a
-        grouped input column."""
-        for op, lhs, val in ast.get("having", []):
+        grouped input column; the boolean structure (AND lists with
+        or/not nodes) evaluates with the same three-valued logic as
+        WHERE."""
+
+        def eval_one(cond):
+            op, lhs, val = cond
+            if op == "or":
+                acc = False
+                for branch in lhs:
+                    r = eval_conj(branch)
+                    if r is True:
+                        return True
+                    if r is None:
+                        acc = None
+                return acc
+            if op == "not":
+                r = eval_conj(lhs)
+                return None if r is None else not r
             am = _AGG_RE.match(lhs)
             if am:
                 fn = am.group("fn").upper()
@@ -1200,9 +1292,19 @@ class Database:
                     v = out[name]
                 else:
                     v = grp[0].get(ast["resolve"](lhs)) if grp else None
-            if not self._eval((op, "\x00v", val), {"\x00v": v}):
-                return False
-        return True
+            return self._eval((op, "\x00v", val), {"\x00v": v})
+
+        def eval_conj(conds):
+            acc = True
+            for c in conds:
+                r = eval_one(c)
+                if r is False:
+                    return False
+                if r is None:
+                    acc = None
+            return acc
+
+        return eval_conj(ast.get("having", [])) is True
 
     @staticmethod
     def _aggregate(payload, grp: List[dict]):
@@ -1254,13 +1356,47 @@ class Database:
             return None
         return self._materialize(table, pk, vals, clps, row)
 
-    @staticmethod
-    def _eval(cond, rec) -> bool:
+    @classmethod
+    def _eval_conj(cls, conds, rec):
+        """Three-valued AND over a cond list: False dominates, then
+        UNKNOWN (None), then True. ``all(_eval(...))`` at the callers
+        treats UNKNOWN as falsy — SQL's WHERE-excludes-unknown."""
+        out = True
+        for c in conds:
+            r = cls._eval(c, rec)
+            if r is False:
+                return False
+            if r is None:
+                out = None
+        return out
+
+    @classmethod
+    def _eval(cls, cond, rec):
+        """Evaluate one cond to SQLite's three-valued logic:
+        True / False / None (UNKNOWN — a NULL-involved comparison).
+        Callers gate rows on ``is True``-like truthiness, so UNKNOWN
+        excludes; NOT preserves UNKNOWN instead of flipping it."""
         op, col, ref = cond
+        if op == "or":
+            out = False
+            for branch in col:
+                r = cls._eval_conj(branch, rec)
+                if r is True:
+                    return True
+                if r is None:
+                    out = None
+            return out
+        if op == "not":
+            r = cls._eval_conj(col, rec)
+            return None if r is None else not r
         if isinstance(col, tuple) and col and col[0] == "\x00expr":
             v = col[1](rec)
         else:
             v = rec.get(col)
+        if op == "is null":
+            return v is None  # never UNKNOWN: IS is a 2-valued test
+        if op == "is not null":
+            return v is not None
         if op == "json_contains":
             try:
                 return corro_json_contains(v, ref)
@@ -1268,9 +1404,9 @@ class Database:
                 return False
         if op in ("like", "not like", "glob", "not glob"):
             # SQLite coerces numeric operands to text for LIKE/GLOB
-            # (SELECT 15 LIKE '1%' -> 1); NULL operands -> no match
+            # (SELECT 15 LIKE '1%' -> 1); NULL operands -> UNKNOWN
             if v is None or ref is None:
-                return False
+                return None
             if isinstance(v, (int, float)):
                 v = str(v)
             if isinstance(ref, (int, float)):
@@ -1285,15 +1421,19 @@ class Database:
             return (not hit) if op.startswith("not") else hit
         if op in ("in", "not in"):
             if v is None:
-                return False
+                return None
             hit = any(v == x for x in ref if x is not None)
             if op == "not in":
-                # SQL three-valued logic: x NOT IN (..., NULL) is NULL
-                # (row excluded) unless x matched a non-NULL member
-                return False if any(x is None for x in ref) else not hit
+                # x NOT IN (..., NULL) is UNKNOWN unless x matched a
+                # non-NULL member
+                if hit:
+                    return False
+                return None if any(x is None for x in ref) else True
+            if not hit and any(x is None for x in ref):
+                return None  # x IN (..., NULL) with no match is UNKNOWN
             return hit
         if v is None or ref is None:
-            return False
+            return None
         try:
             if op == "=":
                 return v == ref

@@ -496,3 +496,101 @@ def test_group_by_alias_and_order_by_group_expr(rich_db):
         0, "SELECT COUNT(*) AS n FROM players WHERE score >= 10 "
            "GROUP BY score % 2 ORDER BY score % 2 DESC")
     assert list(rows) == [[1], [4]]
+
+
+# --- round-4 dialect: OR / NOT / parens / IS NULL (VERDICT r3 #7) --------
+# expected rows pinned against real SQLite (sqlite3 stdlib) on the same
+# dataset; `z` (score NULL, team 3) exercises three-valued logic
+
+def test_where_or_and_parens(rich_db):
+    rich_db.execute(0, [("INSERT INTO players (pid, pname, team) "
+                         "VALUES (9, 'z', 3)",)])
+    try:
+        _, rows = rich_db.query(
+            0, "SELECT pname FROM players WHERE score < 15 OR score > 35 "
+               "ORDER BY pname")
+        assert list(rows) == [["b"], ["d"]]
+        _, rows = rich_db.query(
+            0, "SELECT pname FROM players WHERE (team = 1 AND score > 20) "
+               "OR (team = 2 AND score < 15) ORDER BY pname")
+        assert list(rows) == [["a"], ["b"], ["e"]]
+        # UNKNOWN (NULL score) propagates through OR: z matches only via
+        # the pname arm
+        _, rows = rich_db.query(
+            0, "SELECT pname FROM players WHERE score > 35 OR pname = 'z' "
+               "ORDER BY pname")
+        assert list(rows) == [["d"], ["z"]]
+    finally:
+        rich_db.execute(0, [("DELETE FROM players WHERE pid = 9",)])
+
+
+def test_where_not_three_valued(rich_db):
+    rich_db.execute(0, [("INSERT INTO players (pid, pname, team) "
+                         "VALUES (9, 'z', 3)",)])
+    try:
+        _, rows = rich_db.query(
+            0, "SELECT pname FROM players WHERE NOT (team = 1 OR score > 35) "
+               "ORDER BY pname")
+        assert list(rows) == [["b"]]
+        # SQLite: NOT (NULL > 5) is NULL, not true — z stays excluded
+        _, rows = rich_db.query(
+            0, "SELECT pname FROM players WHERE NOT (score > 5) "
+               "ORDER BY pname")
+        assert list(rows) == []
+        # bare NOT on a single comparison
+        _, rows = rich_db.query(
+            0, "SELECT pname FROM players WHERE NOT score = 30 "
+               "ORDER BY pname")
+        assert list(rows) == [["b"], ["c"], ["d"], ["e"]]
+        # NOT IN with a NULL member is never true (pinned: empty)
+        _, rows = rich_db.query(
+            0, "SELECT pname FROM players WHERE score NOT IN (10, NULL) "
+               "ORDER BY pname")
+        assert list(rows) == []
+    finally:
+        rich_db.execute(0, [("DELETE FROM players WHERE pid = 9",)])
+
+
+def test_is_null_and_mixed_boolean(rich_db):
+    rich_db.execute(0, [("INSERT INTO players (pid, pname, team) "
+                         "VALUES (9, 'z', 3)",)])
+    try:
+        _, rows = rich_db.query(
+            0, "SELECT pname FROM players WHERE score IS NULL")
+        assert list(rows) == [["z"]]
+        _, rows = rich_db.query(
+            0, "SELECT COUNT(*) FROM players WHERE score IS NOT NULL")
+        assert list(rows) == [[5]]
+        _, rows = rich_db.query(
+            0, "SELECT pname FROM players WHERE pname NOT LIKE '%a%' AND "
+               "(team = 2 OR score IS NULL) ORDER BY pname")
+        assert list(rows) == [["b"], ["d"], ["z"]]
+    finally:
+        rich_db.execute(0, [("DELETE FROM players WHERE pid = 9",)])
+
+
+def test_having_or(rich_db):
+    _, rows = rich_db.query(
+        0, "SELECT team, COUNT(*) AS n FROM players "
+           "WHERE score IS NOT NULL GROUP BY team "
+           "HAVING COUNT(*) > 2 OR SUM(score) < 60 ORDER BY team")
+    assert list(rows) == [[1, 3], [2, 2]]
+    _, rows = rich_db.query(
+        0, "SELECT team FROM players GROUP BY team "
+           "HAVING NOT (COUNT(*) > 2) ORDER BY team")
+    assert list(rows) == [[2]]
+
+
+def test_or_in_join_and_subquery(rich_db):
+    # consul/template-style service query through the relational surface
+    _, rows = rich_db.query(
+        0, "SELECT p.pname, s.title FROM players p "
+           "JOIN squads s ON p.team = s.sid "
+           "WHERE s.title = 'red' OR p.score > 35 ORDER BY p.pname")
+    assert list(rows) == [["a", "red"], ["c", "red"], ["d", "blue"],
+                          ["e", "red"]]
+    _, rows = rich_db.query(
+        0, "SELECT pname FROM players WHERE team IN "
+           "(SELECT sid FROM squads WHERE title = 'gray') "
+           "OR score = (SELECT MIN(score) FROM players) ORDER BY pname")
+    assert list(rows) == [["b"]]

@@ -328,3 +328,24 @@ def test_extended_dialect_over_pg_wire(pg):
         "SELECT COUNT(*) AS n FROM users WHERE name LIKE 'z%' "
         "GROUP BY score % 2 HAVING COUNT(*) >= 1 ORDER BY n")
     assert err is None and len(rows) >= 1
+
+
+def test_or_not_through_pg_wire(pg):
+    """Round-4 dialect (VERDICT r3 #7): boolean disjunctions reach the
+    PG surface too — a consul/template-style services query."""
+    _, _, _, c = pg
+    for sql in (
+        "INSERT INTO users (id, name, score) VALUES (7, 'svc-a', 90)",
+        "INSERT INTO users (id, name, score) VALUES (8, 'svc-b', 15)",
+    ):
+        _, _, _, err = c.query(sql)
+        assert err is None
+    cols, rows, tag, err = c.query(
+        "SELECT name FROM users WHERE (score > 80 AND name LIKE 'svc-%') "
+        "OR id = 8 ORDER BY name")
+    assert err is None
+    assert rows == [["svc-a"], ["svc-b"]]
+    _, rows, _, err = c.extended(
+        "SELECT name FROM users WHERE NOT (score < $1) AND id IN (7, 8)",
+        [80])
+    assert err is None and rows == [["svc-a"]]
