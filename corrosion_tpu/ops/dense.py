@@ -26,7 +26,6 @@ import jax
 import jax.numpy as jnp
 
 from corrosion_tpu.ops.lww import (
-    INT32_MIN,
     apply_changes_cols,
     apply_changes_to_store,
 )
@@ -68,6 +67,7 @@ def lookup_cols(table, idx, fill=0):
 
 def scatter_cols_max(dest, idx, vals, valid):
     """``dest[n, idx[n, m]] = max(dest, vals[n, m])`` where valid."""
+    vals = vals.astype(dest.dtype)  # dtype-preserving (narrowed planes)
     n, w = dest.shape
     if not _dense():
         flat = _flat(idx, valid, n, w)
@@ -80,13 +80,14 @@ def scatter_cols_max(dest, idx, vals, valid):
     cols = []
     for c in range(w):
         m = valid & (idx == c)
-        upd = jnp.max(jnp.where(m, vals, INT32_MIN.astype(vals.dtype)), axis=1)
+        upd = jnp.max(jnp.where(m, vals, jnp.iinfo(vals.dtype).min), axis=1)
         cols.append(jnp.maximum(dest[:, c], upd))
     return jnp.stack(cols, axis=1)
 
 
 def scatter_cols_add(dest, idx, vals, valid):
     """``dest[n, idx[n, m]] += vals[n, m]`` where valid."""
+    vals = vals.astype(dest.dtype)  # dtype-preserving (narrowed planes)
     n, w = dest.shape
     if not _dense():
         flat = _flat(idx, valid, n, w)
@@ -108,6 +109,7 @@ def scatter_cols_set(dest, idx, vals, valid):
     writer per (row, column) — the unique-slot scatter (queue placement,
     slot tables). With duplicate writers the max value wins on the dense
     path (deterministic) while the element path keeps the last."""
+    vals = vals.astype(dest.dtype)  # dtype-preserving (narrowed planes)
     n, w = dest.shape
     if not _dense():
         flat = _flat(idx, valid, n, w)
@@ -121,7 +123,7 @@ def scatter_cols_set(dest, idx, vals, valid):
     for c in range(w):
         m = valid & (idx == c)
         has = jnp.any(m, axis=1)
-        v = jnp.max(jnp.where(m, vals, INT32_MIN.astype(vals.dtype)), axis=1)
+        v = jnp.max(jnp.where(m, vals, jnp.iinfo(vals.dtype).min), axis=1)
         cols.append(jnp.where(has, v, dest[:, c]))
     return jnp.stack(cols, axis=1)
 

@@ -90,14 +90,17 @@ def _warn_degrade(stage: str, detail: str = "") -> None:
     )
 
 
-def _swim_probe_args(n: int, m: int, key, pig_k: int = 0):
+def _swim_probe_args(n: int, m: int, key, pig_k: int = 0,
+                     narrow: bool = False):
     """Operand tuple for a ``swim_tables_*`` probe call (21 positional
     args after ``consts``) — shared by the tiny differential probes and
     the block-width probes so they cannot drift from the signature.
     ``pig_k > 0`` shapes the channel planes as packed entry lists
-    ([n, pig_k]) like the bounded-piggyback mode."""
+    ([n, pig_k]) like the bounded-piggyback mode; ``narrow`` carries the
+    timer/budget planes as int16 like ``narrow_dtypes`` configs."""
     import jax.random as jr
 
+    tdt = jnp.int16 if narrow else jnp.int32
     iarr = jnp.arange(n, dtype=jnp.int32)
     mem_id = jr.randint(key, (n, m), -1, n, dtype=jnp.int32)
     mem_view = jr.randint(jr.fold_in(key, 1), (n, m), -1, 64,
@@ -113,7 +116,7 @@ def _swim_probe_args(n: int, m: int, key, pig_k: int = 0):
         ch_send = jnp.ones((n, m), bool)
     return (
         mem_id, mem_view, mem_id, mem_view,
-        jnp.zeros((n, m), jnp.int32), jnp.ones((n, m), jnp.int32),
+        jnp.zeros((n, m), tdt), jnp.ones((n, m), tdt),
         jnp.ones(n, bool), jnp.zeros(n, jnp.int32), iarr, iarr % m,
         jnp.full(n, -1, jnp.int32), jnp.ones(n, jnp.int32),
         iarr % m, jnp.ones(n, jnp.int32), jnp.zeros(n, bool),
@@ -211,8 +214,11 @@ def _width_ok_ingest(cfg, msgs: int, emit: bool = False) -> bool:
     backend = jax.default_backend()
     blk = _block_size(cfg.n_nodes)
     seen_w = max(1, -(-cfg.buf_slots // 32))
+    # narrow_dtypes changes the probed kernel's lowering (int16 q
+    # planes), so it must key the cache like the swim probe's `narrow`
     key = (backend, "ingest", blk, cfg.n_origins, cfg.n_cells,
-           cfg.bcast_queue, seen_w, msgs, emit)
+           cfg.bcast_queue, seen_w, msgs, emit,
+           bool(getattr(cfg, "narrow_dtypes", False)))
     if key not in _width_ok_cache:
         nb = _probe_n(blk)
         if nb == 0 or nb >= cfg.n_nodes:
@@ -257,12 +263,15 @@ def _width_ok_ingest(cfg, msgs: int, emit: bool = False) -> bool:
     return _width_ok_cache[key]
 
 
-def _width_ok_swim(n_nodes: int, m_slots: int, pig_k: int = 0) -> bool:
+def _width_ok_swim(n_nodes: int, m_slots: int, pig_k: int = 0,
+                   narrow: bool = False) -> bool:
     """Same as :func:`_width_ok_ingest` for the swim kernel (both the
-    aligned-row and bounded-piggyback channel forms)."""
+    aligned-row and bounded-piggyback channel forms). ``narrow`` probes
+    with int16 timer/budget planes so the probed kernel matches a
+    ``narrow_dtypes`` caller's lowering."""
     backend = jax.default_backend()
     blk = _block_size(n_nodes)
-    key = (backend, "swim", blk, m_slots, pig_k)
+    key = (backend, "swim", blk, m_slots, pig_k, narrow)
     if key not in _width_ok_cache:
         nb = _probe_n(blk)
         if nb == 0 or nb >= n_nodes:
@@ -271,7 +280,8 @@ def _width_ok_swim(n_nodes: int, m_slots: int, pig_k: int = 0) -> bool:
         def _run_width_probe() -> bool:
             import jax.random as jr
 
-            args = _swim_probe_args(nb, m_slots, jr.key(1), pig_k=pig_k)
+            args = _swim_probe_args(nb, m_slots, jr.key(1), pig_k=pig_k,
+                                    narrow=narrow)
             outs = swim_tables_fused(
                 (m_slots, 6, 48, 10, pig_k), *args, interpret=False
             )
@@ -310,11 +320,12 @@ def use_fused_ingest(cfg, msgs: int = 16, emit: bool = False) -> bool:
     return use_fused() and _width_ok_ingest(cfg, msgs, emit)
 
 
-def use_fused_swim(n_nodes: int, m_slots: int, pig_k: int = 0) -> bool:
+def use_fused_swim(n_nodes: int, m_slots: int, pig_k: int = 0,
+                   narrow: bool = False) -> bool:
     """Shape-aware answer for the swim kernel at the caller's widths."""
     if FORCE_FUSED is not None:
         return FORCE_FUSED
-    return use_fused() and _width_ok_swim(n_nodes, m_slots, pig_k)
+    return use_fused() and _width_ok_swim(n_nodes, m_slots, pig_k, narrow)
 
 
 def _cols(table, idx, fill=0):
@@ -535,7 +546,8 @@ def _ingest_kernel(cfg_tuple, *refs):
          o_q_clp, o_q_ts, o_q_tx),
         planes,
     ):
-        ref[:] = pair[0]
+        # narrowed planes promote to int32 mid-kernel; store re-narrows
+        ref[:] = pair[0].astype(ref.dtype)
 
     # --- piggyback payload selection (emitted for THIS round's packets) --
     # identical semantics to the XLA selection in piggyback_bcast_step:
@@ -688,7 +700,10 @@ def ingest_changes_fused(cfg, cst, live, m_origin, m_dbv, m_cell, m_ver,
             jax.ShapeDtypeStruct((n, o_cnt), jnp.int32),
             jax.ShapeDtypeStruct((n, o_cnt * w), jnp.uint32),
         ]
-        + [jax.ShapeDtypeStruct((n, q), jnp.int32)] * 9
+        + [jax.ShapeDtypeStruct((n, q), p.dtype) for p in (
+            cst.q_origin, cst.q_dbv, cst.q_cell, cst.q_ver, cst.q_val,
+            cst.q_site, cst.q_clp, cst.q_ts, cst.q_tx,
+        )]
         + [
             jax.ShapeDtypeStruct((n, 1), jnp.int32),  # hlc
             jax.ShapeDtypeStruct((n, m), jnp.int32),  # fresh
@@ -830,8 +845,10 @@ def _swim_kernel(consts, *refs):
     )
     o_id[:] = mem_id
     o_view[:] = mem_view
-    o_timer[:] = timer
-    o_tx[:] = tx
+    # narrowed configs store timer/budget planes int16: mid-kernel
+    # promotion is free, the store casts back to the plane dtype
+    o_timer[:] = timer.astype(o_timer.dtype)
+    o_tx[:] = tx.astype(o_tx.dtype)
     o_inc[:] = inc[:, None]
     o_refute[:] = refute.astype(jnp.int32)[:, None]
 
@@ -872,7 +889,9 @@ def swim_tables_fused(
 
     in_specs = [spec(a.shape[1]) for a in in_arrays]
     out_shapes = (
-        [jax.ShapeDtypeStruct((n, m), jnp.int32)] * 4
+        [jax.ShapeDtypeStruct((n, m), jnp.int32)] * 2
+        + [jax.ShapeDtypeStruct((n, m), mem_timer.dtype),
+           jax.ShapeDtypeStruct((n, m), mem_tx.dtype)]
         + [jax.ShapeDtypeStruct((n, 1), jnp.int32)] * 2
     )
     out_specs = [spec(s.shape[1]) for s in out_shapes]

@@ -129,28 +129,33 @@ class CrdtState(NamedTuple):
     def create(cfg: SimConfig) -> "CrdtState":
         n, q, c = cfg.n_nodes, cfg.bcast_queue, cfg.n_cells
         z = lambda *s: jnp.zeros(s, jnp.int32)  # noqa: E731
+        # narrowed planes (PERF.md cut #4): small-range bookkeeping lives
+        # as int16 in HBM when the config asks; compute widens freely and
+        # the scale round-step re-narrows on carry-out
+        ndt = (jnp.int16 if getattr(cfg, "narrow_dtypes", False)
+               else jnp.int32)
         return CrdtState(
             store=(z(n, c), z(n, c), z(n, c), z(n, c), z(n, c)),
             book=Book.create(n, cfg.n_origins, cfg.buf_slots),
             next_dbv=jnp.ones(n, jnp.int32),
             q_origin=jnp.full((n, q), NO_Q, jnp.int32),
             q_dbv=z(n, q),
-            q_cell=z(n, q),
+            q_cell=jnp.zeros((n, q), ndt),
             q_ver=z(n, q),
             q_val=z(n, q),
             q_site=z(n, q),
             q_clp=z(n, q),
-            q_seq=z(n, q),
-            q_nseq=jnp.ones((n, q), jnp.int32),
+            q_seq=jnp.zeros((n, q), ndt),
+            q_nseq=jnp.ones((n, q), ndt),
             q_ts=z(n, q),
-            q_tx=z(n, q),
+            q_tx=jnp.zeros((n, q), ndt),
             hlc=z(n),
             now=jnp.int32(0),
             partials=Partials.create(
                 n, cfg.partial_slots if cfg.tx_max_cells > 1 else 1,
                 max(1, cfg.tx_max_cells),
             ),
-            last_sync=jnp.full((n, cfg.sync_tracks), LAST_SYNC_CAP, jnp.int32),
+            last_sync=jnp.full((n, cfg.sync_tracks), LAST_SYNC_CAP, ndt),
         )
 
 

@@ -96,6 +96,12 @@ class ScaleConfig:
     # ~4x less channel HBM traffic at M=64, k=16; the merge becomes
     # per-entry hash-class scatters, VMEM-cheap under the pallas kernel)
     pig_members: int = 0
+    # dtype narrowing (PERF.md cut #4): store small-range planes
+    # (mem_timer, mem_tx — and the CRDT queue/staleness planes at the
+    # scale-sim level) as int16 in HBM; compute widens them and the
+    # round-step narrows once on carry-out, halving those planes' HBM
+    # read+write traffic
+    narrow_dtypes: bool = False
 
     def validate(self) -> "ScaleConfig":
         assert self.m_slots > 0 and self.n_seeds >= 1
@@ -105,7 +111,16 @@ class ScaleConfig:
         assert 0 <= self.pig_members <= self.m_slots, (
             "pig_members must be 0..m_slots (top_k over the slot axis)"
         )
+        if self.narrow_dtypes:
+            assert max(self.max_transmissions, self.suspicion_rounds,
+                       self.down_purge_rounds) < (1 << 15), (
+                "narrow_dtypes stores timers/budgets as int16"
+            )
         return self
+
+    @property
+    def timer_dtype(self):
+        return jnp.int16 if self.narrow_dtypes else jnp.int32
 
 
 def scale_config(n_nodes: int, **overrides) -> ScaleConfig:
@@ -148,8 +163,9 @@ class ScaleSwimState(NamedTuple):
             inc=jnp.zeros(n, jnp.int32),
             mem_id=mem_id,
             mem_view=mem_view,
-            mem_timer=jnp.zeros((n, m), jnp.int32),
-            mem_tx=jnp.full((n, m), cfg.max_transmissions, jnp.int32),
+            mem_timer=jnp.zeros((n, m), cfg.timer_dtype),
+            mem_tx=jnp.full((n, m), cfg.max_transmissions,
+                            cfg.timer_dtype),
         )
 
 
@@ -532,8 +548,11 @@ def scale_swim_step(
         # transmit-budget decrement for the SELECTED entries only (the
         # table-update function skips its full-row decrement in this
         # mode); refill-on-change still happens inside it
+        # accumulate in the plane's own dtype: the fused swim kernel is
+        # probed at the plane dtypes, so a promotion here would lower a
+        # DIFFERENT (unprobed) kernel under narrow_dtypes
         dec = scatter_cols_add(
-            jnp.zeros((n, m), jnp.int32), upd_slots,
+            jnp.zeros((n, m), st.mem_tx.dtype), upd_slots,
             jnp.broadcast_to(sends[:, None], upd_slots.shape), upd_ok,
         )
         mem_tx_in = jnp.maximum(st.mem_tx - dec, 0)
@@ -586,7 +605,9 @@ def scale_swim_step(
     )
     from corrosion_tpu.ops import megakernel
 
-    if megakernel.use_fused_swim(cfg.n_nodes, cfg.m_slots, pig_k):
+    if megakernel.use_fused_swim(
+            cfg.n_nodes, cfg.m_slots, pig_k,
+            narrow=bool(getattr(cfg, "narrow_dtypes", False))):
         mem_id, mem_view, timer, mem_tx, inc, refute = (
             megakernel.swim_tables_fused(consts, *args)
         )

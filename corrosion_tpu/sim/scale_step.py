@@ -115,6 +115,12 @@ class ScaleSimConfig:
     # the other rounds (the reference's per-node jittered timers are a
     # wall-clock spread the round model abstracts anyway)
     sync_cohort: bool = True
+    # dtype narrowing (PERF.md cut #4): small-range planes (mem_timer,
+    # mem_tx, q_cell, q_seq, q_nseq, q_tx, last_sync) live as int16 in
+    # HBM; compute widens freely (XLA fuses the converts) and the round
+    # step re-narrows once on carry-out — the scan carry (the HBM
+    # working set between rounds) halves for those planes
+    narrow_dtypes: bool = False
 
     @property
     def n_cells(self) -> int:
@@ -134,7 +140,21 @@ class ScaleSimConfig:
         assert 0 <= self.pig_members <= self.m_slots, (
             "pig_members must be 0..m_slots (top_k over the slot axis)"
         )
+        if self.narrow_dtypes:
+            from corrosion_tpu.sim.broadcast import LAST_SYNC_CAP
+
+            assert max(self.n_cells, self.tx_max_cells + 1,
+                       self.bcast_max_transmissions + 1,
+                       self.max_transmissions, self.suspicion_rounds,
+                       self.down_purge_rounds, LAST_SYNC_CAP) < (1 << 15), (
+                "narrow_dtypes stores these planes as int16"
+            )
         return self
+
+    @property
+    def timer_dtype(self):
+        """Dtype of the narrowed planes (see ``ScaleConfig.timer_dtype``)."""
+        return jnp.int16 if self.narrow_dtypes else jnp.int32
 
 
 def scale_sim_config(n_nodes: int, **overrides) -> ScaleSimConfig:
@@ -283,8 +303,11 @@ def piggyback_bcast_step(cfg: ScaleSimConfig, cst: CrdtState, channels, key,
     live = jnp.concatenate(valids, axis=1)
 
     # --- sender budget decrement: one per delivered packet ---------------
+    # plane-dtype accumulation: keeps q_tx at its (possibly narrowed)
+    # dtype so the fused ingest kernel lowered next round matches the
+    # dtype set the width probe validated
     dec = scatter_cols_add(
-        jnp.zeros((n, q), jnp.int32), sel_slots,
+        jnp.zeros((n, q), cst.q_tx.dtype), sel_slots,
         jnp.broadcast_to(carried[:, None], sel_slots.shape), sel_ok,
     )
     q_tx = jnp.maximum(cst.q_tx - dec, 0)
@@ -384,9 +407,11 @@ def scale_sim_step(
             go_all=cfg.sync_cohort,
         )
         synced_slots = select_cols(cand_slots, c_idx)
+        # zeros in the plane's own dtype: both lax.cond branches must
+        # carry last_sync at the same (possibly narrowed) dtype
         ls = scatter_cols_set(
             cst.last_sync, synced_slots,
-            jnp.zeros(synced_slots.shape, jnp.int32), s_ok,
+            jnp.zeros(synced_slots.shape, cst.last_sync.dtype), s_ok,
         )
         return cst._replace(last_sync=ls), s_info
 
@@ -405,7 +430,31 @@ def scale_sim_step(
         cst, s_info = run_sync(cst)
 
     info = {**swim_info, **b_info, **s_info}
-    return ScaleSimState(swim, cst), info
+    return _narrow_carry(cfg, ScaleSimState(swim, cst)), info
+
+
+def _narrow_carry(cfg: ScaleSimConfig, st: ScaleSimState) -> ScaleSimState:
+    """Re-narrow the int16 HBM planes on round carry-out.
+
+    Mid-step compute promotes them to int32 wherever convenient (XLA
+    fuses the converts); one cast here keeps the scan carry — the HBM
+    working set between rounds — at the narrow dtype, which is where
+    the traffic saving lives (PERF.md cut #4)."""
+    if not cfg.narrow_dtypes:
+        return st
+    dt = cfg.timer_dtype
+    swim = st.swim._replace(
+        mem_timer=st.swim.mem_timer.astype(dt),
+        mem_tx=st.swim.mem_tx.astype(dt),
+    )
+    crdt = st.crdt._replace(
+        q_cell=st.crdt.q_cell.astype(dt),
+        q_seq=st.crdt.q_seq.astype(dt),
+        q_nseq=st.crdt.q_nseq.astype(dt),
+        q_tx=st.crdt.q_tx.astype(dt),
+        last_sync=st.crdt.last_sync.astype(dt),
+    )
+    return ScaleSimState(swim, crdt)
 
 
 def scale_run_rounds(cfg: ScaleSimConfig, st, net: NetModel, key, inputs):

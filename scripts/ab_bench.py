@@ -136,7 +136,8 @@ def main() -> None:
             "pallas_fused": bool(
                 megakernel.use_fused_ingest(cfg, 4 * cfg.pig_changes)
                 and megakernel.use_fused_swim(
-                    cfg.n_nodes, cfg.m_slots, cfg.pig_members)
+                    cfg.n_nodes, cfg.m_slots, cfg.pig_members,
+                    narrow=cfg.narrow_dtypes)
             ),
         })
 

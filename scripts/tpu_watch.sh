@@ -6,7 +6,7 @@
 # init, so probes run with generous timeouts and never block the
 # foreground build.
 cd /root/repo
-LOG=/tmp/tpu_watch_r04.log
+LOG=/root/repo/artifacts/tpu_watch_r04.log
 echo "== watcher start $(date +%F_%T)" >> "$LOG"
 while true; do
   echo "-- probe $(date +%T)" >> "$LOG"

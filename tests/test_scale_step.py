@@ -195,3 +195,98 @@ def test_bounded_piggyback_detects_churn_and_converges():
     assert float(sm["accuracy"]) > 0.95
     m = scale_crdt_metrics(cfg, st)
     assert bool(m["converged"]), int(m["n_diverged"])
+
+
+def test_narrow_dtypes_matches_wide_exactly():
+    """PERF.md cut #4: int16 HBM planes must be a pure layout change —
+    every round's full state (widened for comparison) and every info
+    stream must equal the wide-config run bit-for-bit."""
+    import dataclasses
+
+    base = scale_sim_config(
+        48, m_slots=16, n_origins=4, n_rows=4, n_cols=2, sync_interval=4,
+        pig_members=4,
+    )
+    narrow = dataclasses.replace(base, narrow_dtypes=True).validate()
+    assert narrow.timer_dtype == jnp.int16
+
+    net = NetModel.create(base.n_nodes, drop_prob=0.02)
+    rounds = 48
+    key = jr.key(3)
+    inp = quiet_inputs(base, rounds)
+    n = base.n_nodes
+    k1, k2, k3, k4 = jr.split(jr.key(4), 4)
+    w = (jr.uniform(k1, (rounds, n)) < 0.3) & (
+        jnp.arange(n)[None, :] < base.n_origins
+    )
+    kills = jnp.zeros((rounds, n), bool).at[10, 5].set(True)
+    revs = jnp.zeros((rounds, n), bool).at[30, 5].set(True)
+    inp = inp._replace(
+        write_mask=w,
+        write_cell=jr.randint(k2, (rounds, n), 0, base.n_cells,
+                              dtype=jnp.int32),
+        write_val=jr.randint(k3, (rounds, n), 1, 1 << 15, dtype=jnp.int32),
+        kill=kills, revive=revs,
+    )
+
+    st_w, info_w = run(base, ScaleSimState.create(base), net, key, inp)
+    st_n, info_n = run(narrow, ScaleSimState.create(narrow), net, key, inp)
+
+    # state planes equal after widening; dtypes actually narrowed
+    assert st_n.swim.mem_tx.dtype == jnp.int16
+    assert st_n.crdt.q_tx.dtype == jnp.int16
+    assert st_n.crdt.last_sync.dtype == jnp.int16
+    for a, b in zip(jax.tree.leaves(st_w), jax.tree.leaves(st_n)):
+        assert jnp.array_equal(
+            jnp.asarray(a, jnp.int32) if a.dtype != bool else a,
+            jnp.asarray(b, jnp.int32) if b.dtype != bool else b,
+        ), "narrow state diverged from wide"
+    for k in info_w:
+        assert jnp.array_equal(info_w[k], info_n[k]), f"info {k} diverged"
+
+    # same convergence behavior under churn
+    st_n, _ = run(narrow, st_n, net, jr.key(5), quiet_inputs(narrow, 150))
+    m = scale_crdt_metrics(narrow, st_n)
+    assert bool(m["converged"])
+
+
+def test_narrow_dtypes_fused_matches_unfused():
+    """The pallas kernels must honor the narrow planes (widen on load,
+    re-narrow on store) with identical results."""
+    import dataclasses
+
+    from corrosion_tpu.ops import megakernel
+
+    base = scale_sim_config(
+        32, m_slots=8, n_origins=4, n_rows=4, n_cols=2, sync_interval=4,
+        pig_members=4,
+    )
+    narrow = dataclasses.replace(base, narrow_dtypes=True).validate()
+    net = NetModel.create(base.n_nodes, drop_prob=0.02)
+    rounds = 24
+    inp = quiet_inputs(narrow, rounds)
+    n = base.n_nodes
+    k1, k2, k3 = jr.split(jr.key(6), 3)
+    w = (jr.uniform(k1, (rounds, n)) < 0.3) & (
+        jnp.arange(n)[None, :] < base.n_origins
+    )
+    inp = inp._replace(
+        write_mask=w,
+        write_cell=jr.randint(k2, (rounds, n), 0, base.n_cells,
+                              dtype=jnp.int32),
+        write_val=jr.randint(k3, (rounds, n), 1, 1 << 15, dtype=jnp.int32),
+    )
+    old = megakernel.FORCE_FUSED
+    try:
+        megakernel.FORCE_FUSED = True
+        st_f, info_f = run(narrow, ScaleSimState.create(narrow), net,
+                           jr.key(7), inp)
+        megakernel.FORCE_FUSED = False
+        st_u, info_u = run(narrow, ScaleSimState.create(narrow), net,
+                           jr.key(7), inp)
+    finally:
+        megakernel.FORCE_FUSED = old
+    for a, b in zip(jax.tree.leaves(st_f), jax.tree.leaves(st_u)):
+        assert jnp.array_equal(a, b), "fused narrow state diverged"
+    for k in info_f:
+        assert jnp.array_equal(info_f[k], info_u[k]), f"info {k} diverged"
