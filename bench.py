@@ -197,15 +197,25 @@ def _worker() -> None:
     st = ScaleSimState.create(cfg)
     net = NetModel.create(n_nodes, drop_prob=0.01)
 
-    # conflict-heavy inputs: origins write hot cells at random rounds
-    k1, k2, k3 = jr.split(jr.key(1), 3)
+    # conflict-heavy inputs: writers hit hot cells at random rounds.
+    # BENCH_WRITERS (round 4, unbounded writer set): how many ACTIVE
+    # writers, spread across the whole id space — distinct from
+    # n_origins, which now sizes the per-node bookkeeping slot table.
+    # Default: the legacy shape (first n_origins nodes write).
+    k1, k2, k3, k4 = jr.split(jr.key(1), 4)
     quiet = ScaleRoundInput.quiet(cfg)
     inputs = jax.tree.map(
         lambda a: jnp.broadcast_to(a, (rounds,) + a.shape), quiet
     )
-    w = (jr.uniform(k1, (rounds, n_nodes)) < 0.25) & (
-        jnp.arange(n_nodes)[None, :] < cfg.n_origins
-    )
+    n_writers = int(os.environ.get("BENCH_WRITERS", "0"))
+    if n_writers > 0 and getattr(cfg, "any_writer", False):
+        writer_ids = jr.choice(
+            k4, n_nodes, (min(n_writers, n_nodes),), replace=False
+        )
+        is_writer = jnp.zeros(n_nodes, bool).at[writer_ids].set(True)
+    else:
+        is_writer = jnp.arange(n_nodes) < cfg.n_origins
+    w = (jr.uniform(k1, (rounds, n_nodes)) < 0.25) & is_writer[None, :]
     inputs = inputs._replace(
         write_mask=w,
         write_cell=jr.randint(k2, (rounds, n_nodes), 0, cfg.n_cells, dtype=jnp.int32),
@@ -234,6 +244,7 @@ def _worker() -> None:
                 "vs_baseline": round(rps / TARGET_RPS, 4),
                 "platform": platform,
                 "n_origins": cfg.n_origins,
+                "n_writers": int(jnp.sum(is_writer)),
                 "n_rows": cfg.n_rows,
                 "n_cols": cfg.n_cols,
                 "pig_members": cfg.pig_members,

@@ -339,26 +339,27 @@ def _cols(table, idx, fill=0):
 
 def _ingest_kernel(cfg_tuple, *refs):
     (n_origins, n_cells, q_slots, seen_words, hlc_round_bits,
-     hlc_max_drift, no_q, pig_r, budget_bytes, wire_bytes) = cfg_tuple
-    # ref layout: 29 base inputs (+2 with payload emission), then the
-    # 20 base outputs (+3 with emission)
-    n_in = 29 + (2 if pig_r else 0)
+     hlc_max_drift, no_q, pig_r, budget_bytes, wire_bytes,
+     keep_rounds) = cfg_tuple
+    # ref layout: 31 base inputs (+2 with payload emission), then the
+    # 22 base outputs (+3 with emission)
+    n_in = 31 + (2 if pig_r else 0)
     (live_ref, origin_ref, dbv_ref, cell_ref, ver_ref, val_ref, site_ref,
      clp_ref, ts_ref, budget_ref,
      s_ver_ref, s_val_ref, s_site_ref, s_dbv_ref, s_clp_ref,
-     head_ref, km_ref, seen_ref,
+     head_ref, km_ref, seen_ref, org_id_ref, org_last_ref,
      q_origin_ref, q_dbv_ref, q_cell_ref, q_ver_ref, q_val_ref,
      q_site_ref, q_clp_ref, q_ts_ref, q_tx_ref,
-     hlc_ref, now_ref) = refs[:29]
+     hlc_ref, now_ref) = refs[:31]
     if pig_r:
-        rand_ref, carried_ref = refs[29:31]
+        rand_ref, carried_ref = refs[31:33]
     (o_s_ver, o_s_val, o_s_site, o_s_dbv, o_s_clp,
-     o_head, o_km, o_seen,
+     o_head, o_km, o_seen, o_org_id, o_org_last,
      o_q_origin, o_q_dbv, o_q_cell, o_q_ver, o_q_val, o_q_site, o_q_clp,
      o_q_ts, o_q_tx,
-     o_hlc, o_fresh, o_drift) = refs[n_in:n_in + 20]
+     o_hlc, o_fresh, o_drift) = refs[n_in:n_in + 22]
     if pig_r:
-        o_payload, o_sel, o_selok = refs[n_in + 20:]
+        o_payload, o_sel, o_selok = refs[n_in + 22:]
 
     imin = jnp.int32(-2147483648)
     imax = jnp.int32(2147483647)
@@ -388,18 +389,26 @@ def _ingest_kernel(cfg_tuple, *refs):
     live = ts_ok
 
     # --- seen-check + in-batch dedupe (versions.record_versions) --------
+    # round 4: bookkeeping lives at the origin's hash SLOT (origin % O)
+    # and counts only while the slot tracks that exact actor
+    # (versions.Book org table; unbounded writer set)
     head = head_ref[:]
     km = km_ref[:]
     flat_seen = seen_ref[:]  # [B, O*W]
-    h_at = _cols(head, origin)
+    org_id = org_id_ref[:]
+    org_last = org_last_ref[:]
+    slot = jnp.where(origin >= 0, origin % n_origins, 0)
+    owner_at = _cols(org_id, slot, fill=-1)
+    owned_pre = (origin >= 0) & (owner_at == origin)
+    h_at = _cols(head, slot)
     off = dbv - h_at - 1
     in_win = (off >= 0) & (off < 32 * seen_words)
-    word_idx = origin * seen_words + jnp.where(off >= 0, off >> 5, 0)
+    word_idx = slot * seen_words + jnp.where(off >= 0, off >> 5, 0)
     bit = (jnp.clip(off, 0, None) & 31).astype(jnp.uint32)
     bitval = jnp.uint32(1) << bit
     word_val = _cols(flat_seen, word_idx)
     hit = ((word_val >> bit) & 1) == 1
-    seen_b = live & ((dbv <= h_at) | (in_win & hit))
+    seen_b = live & owned_pre & ((dbv <= h_at) | (in_win & hit))
 
     same = (
         (origin[:, :, None] == origin[:, None, :])
@@ -417,8 +426,28 @@ def _ingest_kernel(cfg_tuple, *refs):
     fresh = live & ~seen_b & ~dup
     o_fresh[:] = fresh.astype(jnp.int32)
 
+    # --- slot claim/evict: literally the shared XLA function ------------
+    from corrosion_tpu.ops.versions import claim_slots_arrays
+
+    head, km, flat_seen, org_id, org_last = claim_slots_arrays(
+        head, km, flat_seen, org_id, org_last, origin, fresh, now,
+        keep_rounds, seen_words,
+    )
+    o_org_id[:] = org_id
+    o_org_last[:] = org_last
+
+    # --- record (post-claim ownership + rebased offsets) ----------------
+    owned = (origin >= 0) & (_cols(org_id, slot, fill=-1) == origin)
+    rec = fresh & owned
+    h_at = _cols(head, slot)
+    off = dbv - h_at - 1
+    in_win = (off >= 0) & (off < 32 * seen_words)
+    word_idx = slot * seen_words + jnp.where(off >= 0, off >> 5, 0)
+    bit = (jnp.clip(off, 0, None) & 31).astype(jnp.uint32)
+    bitval = jnp.uint32(1) << bit
+
     # --- seen-bit OR + known_max scatter-max + head advance -------------
-    set_mask = fresh & in_win
+    set_mask = rec & in_win
     new_cols = []
     for c in range(n_origins * seen_words):
         sel = set_mask & (word_idx == c)
@@ -430,7 +459,7 @@ def _ingest_kernel(cfg_tuple, *refs):
 
     km_cols = []
     for c in range(n_origins):
-        sel = live & (origin == c)
+        sel = live & owned & (slot == c)
         km_cols.append(
             jnp.maximum(
                 km[:, c], jnp.max(jnp.where(sel, dbv, imin), axis=1)
@@ -660,6 +689,7 @@ def ingest_changes_fused(cfg, cst, live, m_origin, m_dbv, m_cell, m_ver,
         pig_r,
         int(getattr(cfg, "bcast_budget_bytes", 0)),
         _CHANGE_WIRE_BYTES,
+        int(getattr(cfg, "org_keep_rounds", 16)),
     )
 
     def spec(width):
@@ -678,6 +708,7 @@ def ingest_changes_fused(cfg, cst, live, m_origin, m_dbv, m_cell, m_ver,
         m_site, m_clp, m_ts, m_budget,
         s_ver, s_val, s_site, s_dbv, s_clp,
         cst.book.head, cst.book.known_max, seen_flat,
+        cst.book.org_id, cst.book.org_last,
         cst.q_origin, cst.q_dbv, cst.q_cell, cst.q_ver, cst.q_val,
         cst.q_site, cst.q_clp, cst.q_ts, cst.q_tx,
         cst.hlc[:, None],
@@ -699,6 +730,8 @@ def ingest_changes_fused(cfg, cst, live, m_origin, m_dbv, m_cell, m_ver,
             jax.ShapeDtypeStruct((n, o_cnt), jnp.int32),
             jax.ShapeDtypeStruct((n, o_cnt), jnp.int32),
             jax.ShapeDtypeStruct((n, o_cnt * w), jnp.uint32),
+            jax.ShapeDtypeStruct((n, o_cnt), jnp.int32),  # org_id
+            jax.ShapeDtypeStruct((n, o_cnt), jnp.int32),  # org_last
         ]
         + [jax.ShapeDtypeStruct((n, q), p.dtype) for p in (
             cst.q_origin, cst.q_dbv, cst.q_cell, cst.q_ver, cst.q_val,
@@ -728,14 +761,16 @@ def ingest_changes_fused(cfg, cst, live, m_origin, m_dbv, m_cell, m_ver,
     )(*in_arrays)
 
     (s_ver, s_val, s_site, s_dbv, s_clp, head, km, seen_flat,
+     org_id, org_last,
      q_origin, q_dbv, q_cell, q_ver, q_val, q_site, q_clp, q_ts, q_tx,
-     hlc, fresh, drift) = outs[:20]
+     hlc, fresh, drift) = outs[:22]
     emitted = None
     if pig_r:
-        emitted = (outs[20], outs[21], outs[22] != 0)
+        emitted = (outs[22], outs[23], outs[24] != 0)
 
     book = cst.book._replace(
-        head=head, known_max=km, seen=seen_flat.reshape(n, o_cnt, w)
+        head=head, known_max=km, seen=seen_flat.reshape(n, o_cnt, w),
+        org_id=org_id, org_last=org_last,
     )
     cst = cst._replace(
         store=(s_ver, s_val, s_site, s_dbv, s_clp),
@@ -772,7 +807,10 @@ def local_write_fused(cfg, cst, write_mask, cell, val, clp=None, *,
 
     n = cfg.n_nodes
     iarr = jnp.arange(n, dtype=jnp.int32)
-    w = write_mask & (iarr < cfg.n_origins)
+    if getattr(cfg, "any_writer", False):
+        w = write_mask
+    else:
+        w = write_mask & (iarr < cfg.n_origins)
     if clp is None:
         clp = jnp.zeros(n, jnp.int32)
 

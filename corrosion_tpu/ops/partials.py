@@ -174,14 +174,18 @@ def free_slots(par: Partials, drop):
     )
 
 
-def drop_stale_partials(par: Partials, head):
+def drop_stale_partials(par: Partials, book):
     """Free slots whose version is already at/below the node's head for
     that origin — the version arrived whole via sync (store merge + head
     jump), so the buffered fragments are garbage (the reference's
     buffered-meta GC, ``clear_buffered_meta_loop``, ``util.rs:430-490``).
-    ``head`` int32 [N, O]."""
-    n_origins = head.shape[1]
+    The origin's head lives at its hash slot and counts only while the
+    slot tracks that actor (round 4, ``versions.Book``)."""
+    from corrosion_tpu.ops.versions import org_slot
+
     live = par.origin != NO_SLOT
-    in_range = live & (par.origin >= 0) & (par.origin < n_origins)
-    h = jnp.take_along_axis(head, jnp.clip(par.origin, 0, n_origins - 1), axis=1)
-    return free_slots(par, in_range & (par.dbv <= h))
+    slot, owned = org_slot(book, par.origin)
+    h = jnp.take_along_axis(
+        book.head, jnp.clip(slot, 0, book.head.shape[1] - 1), axis=1
+    )
+    return free_slots(par, live & owned & (par.dbv <= h))

@@ -50,11 +50,28 @@ _ONES = np.uint32(0xFFFFFFFF)  # np scalar: safe to close over in pallas kernels
 
 
 class Book(NamedTuple):
-    """Version bookkeeping for all N simulated nodes over O origins."""
+    """Version bookkeeping for all N simulated nodes over O origin SLOTS.
+
+    Round 4 (unbounded writer set): the reference books versions for
+    every *observed* actor (``agent.rs:1270-1604`` keeps a
+    ``BookedVersions`` per actor id in a map) — ANY node may write. The
+    array analog is a bounded hash-slotted origin table, the same trick
+    as the SWIM member table: origin ``x`` hashes to slot ``x % O``;
+    ``org_id`` records which actor a slot currently tracks. A write
+    from an untracked actor claims a free slot, or evicts an *idle*
+    occupant (no fresh activity for ``org_keep_rounds``) — the evicted
+    actor's dedupe/gap state is lost and anti-entropy sync rebuilds it,
+    exactly the bounded-resource degradation the member table accepts.
+    Initialization identity-claims slot ``s`` for actor ``s``, which
+    reproduces the legacy fixed-pool semantics bit-for-bit while every
+    writer id stays below O (no collisions ⇒ no evictions).
+    """
 
     head: jax.Array  # int32 [N, O]
     known_max: jax.Array  # int32 [N, O]
     seen: jax.Array  # uint32 [N, O, W] — head-relative seen-bit window
+    org_id: jax.Array  # int32 [N, O] — actor tracked per slot (-1 free)
+    org_last: jax.Array  # int32 [N, O] — round of last fresh activity
 
     @staticmethod
     def create(n_nodes: int, n_origins: int, buf_slots: int) -> "Book":
@@ -66,6 +83,11 @@ class Book(NamedTuple):
             head=jnp.zeros((n_nodes, n_origins), jnp.int32),
             known_max=jnp.zeros((n_nodes, n_origins), jnp.int32),
             seen=jnp.zeros((n_nodes, n_origins, words), jnp.uint32),
+            org_id=jnp.broadcast_to(
+                jnp.arange(n_origins, dtype=jnp.int32)[None, :],
+                (n_nodes, n_origins),
+            ),
+            org_last=jnp.zeros((n_nodes, n_origins), jnp.int32),
         )
 
     @property
@@ -73,31 +95,102 @@ class Book(NamedTuple):
         return 32 * self.seen.shape[2]
 
 
-def _window_offsets(book: Book, origin, ver):
-    """Per-message window coordinates: (head-at-origin, bit offset,
+def org_slot(book: Book, origin):
+    """Hash-slot coordinates for message origins: ``(slot, owned)`` —
+    ``slot`` int32 [N, M] is each origin's hash class (``origin % O``),
+    ``owned`` marks slots currently tracking that exact actor."""
+    o = book.head.shape[1]
+    slot = jnp.where(origin >= 0, origin % o, 0)
+    owned = (origin >= 0) & (lookup_cols(book.org_id, slot) == origin)
+    return slot, owned
+
+
+def _window_offsets(book: Book, slot, ver):
+    """Per-message window coordinates: (head-at-slot, bit offset,
     flat word index into ``seen.reshape(N, O*W)``, in-window mask)."""
     w = book.seen.shape[2]
-    h = lookup_cols(book.head, origin)
+    h = lookup_cols(book.head, slot)
     off = ver - h - 1
     in_win = (off >= 0) & (off < 32 * w)
-    word_idx = origin * w + jnp.where(off >= 0, off >> 5, 0)
+    word_idx = slot * w + jnp.where(off >= 0, off >> 5, 0)
     return h, off, word_idx, in_win
 
 
 def seen_versions(book: Book, origin, ver, valid):
     """Has this node already seen each (origin, version)? bool [N, M] —
-    true when the version is at/below the contiguous head or recorded in
-    the out-of-order window (the seen-cache + bookie check of
-    ``handle_changes``, ``handlers.rs:548-786``)."""
+    true when the origin's slot tracks it AND the version is at/below
+    the contiguous head or recorded in the out-of-order window (the
+    seen-cache + bookie check of ``handle_changes``,
+    ``handlers.rs:548-786``). Untracked origins are never seen — their
+    changes apply (LWW is idempotent) and a slot claim may follow."""
     n, o, w = book.seen.shape
-    h, off, word_idx, in_win = _window_offsets(book, origin, ver)
+    slot, owned = org_slot(book, origin)
+    h, off, word_idx, in_win = _window_offsets(book, slot, ver)
     word = lookup_cols(book.seen.reshape(n, o * w), word_idx, fill=0)
     bit = (jnp.clip(off, 0, None) & 31).astype(jnp.uint32)
     hit = ((word >> bit) & 1) == 1
-    return valid & ((ver <= h) | (in_win & hit))
+    return valid & owned & ((ver <= h) | (in_win & hit))
 
 
-def record_versions(book: Book, origin, ver, valid):
+def claim_slots_arrays(head, km, seen_flat, org_id, org_last, origin,
+                       fresh, now, keep_rounds: int, seen_words: int):
+    """Claim/evict origin slots for fresh foreign-actor messages —
+    the SHARED form, plain [B, O] / [B, O*W] arrays and column-loop ops
+    only, executed verbatim by both the XLA path (:func:`claim_slots`)
+    and the pallas ingest kernel so the two cannot drift (the
+    ``swim_tables_update`` convention).
+
+    Per slot column: if any fresh message's origin hashes there but the
+    slot tracks a different actor, the largest such origin takes the
+    slot — but only when the slot is free or its occupant has been idle
+    for ``keep_rounds`` (an active tracked actor is never evicted, so
+    the legacy fixed-pool regime — all writers < O, identity claims —
+    never churns). Eviction resets the slot's head/known_max/window;
+    sync rebuilds them (the bounded-table analog of the reference's
+    per-observed-actor map, ``agent.rs:1270-1604``).
+
+    Returns ``(head, km, seen_flat, org_id, org_last)``."""
+    b, o = head.shape
+    slot = jnp.where(origin >= 0, origin % o, 0)
+    id_cols, last_cols, reset_cols = [], [], []
+    for c in range(o):
+        owner = org_id[:, c]
+        cand = fresh & (slot == c) & (origin >= 0)
+        foreign = cand & (origin != owner[:, None])
+        any_f = jnp.any(foreign, axis=1)
+        new_owner = jnp.max(jnp.where(foreign, origin, -1), axis=1)
+        evictable = (owner < 0) | (org_last[:, c] + keep_rounds < now)
+        take = any_f & evictable
+        id_cols.append(jnp.where(take, new_owner, owner))
+        # activity: the (possibly new) owner had a fresh message now
+        active = jnp.any(cand & (origin == id_cols[-1][:, None]), axis=1)
+        last_cols.append(jnp.where(take | active, now, org_last[:, c]))
+        reset_cols.append(take)
+    reset = jnp.stack(reset_cols, axis=1)  # [B, O]
+    reset_w = jnp.broadcast_to(
+        reset[:, :, None], (b, o, seen_words)
+    ).reshape(b, o * seen_words)
+    return (
+        jnp.where(reset, 0, head),
+        jnp.where(reset, 0, km),
+        jnp.where(reset_w, jnp.uint32(0), seen_flat),
+        jnp.stack(id_cols, axis=1),
+        jnp.stack(last_cols, axis=1),
+    )
+
+
+def claim_slots(book: Book, origin, fresh, now, keep_rounds: int) -> Book:
+    """Book-level wrapper of :func:`claim_slots_arrays`."""
+    n, o, w = book.seen.shape
+    head, km, seen_flat, org_id, org_last = claim_slots_arrays(
+        book.head, book.known_max, book.seen.reshape(n, o * w),
+        book.org_id, book.org_last, origin, fresh, now, keep_rounds, w,
+    )
+    return Book(head, km, seen_flat.reshape(n, o, w), org_id, org_last)
+
+
+def record_versions(book: Book, origin, ver, valid, now=None,
+                    keep_rounds: int = 16):
     """Record a per-node batch of incoming (origin, version) pairs.
 
     ``origin``/``ver``: int32 [N, M] — up to M messages per node this round;
@@ -106,9 +199,15 @@ def record_versions(book: Book, origin, ver, valid):
     ``handle_changes``, reference ``handlers.rs:548-786`` — fresh changes
     get applied and re-broadcast, stale ones dropped).
 
-    Fresh in-window versions set their seen bit (beyond-window → dropped,
-    like the bounded processing queue, ``config.rs:15-27``; sync repairs),
-    then heads advance over any newly-closed gaps.
+    Fresh messages from untracked actors first claim/evict their hash
+    slot (:func:`claim_slots`; ``now`` = the round counter — omitted
+    means "no claims", the pre-round-4 fixed-pool behavior). Only the
+    slot owner's messages are then RECORDED; foreign messages that lost
+    the claim still report fresh (apply + re-broadcast, budget-bounded)
+    but leave no bookkeeping. Fresh in-window versions set their seen
+    bit (beyond-window → dropped, like the bounded processing queue,
+    ``config.rs:15-27``; sync repairs), then heads advance over any
+    newly-closed gaps.
     """
     n, o, w = book.seen.shape
     seen = seen_versions(book, origin, ver, valid)
@@ -127,13 +226,20 @@ def record_versions(book: Book, origin, ver, valid):
 
     fresh = valid & ~seen & ~dup_in_batch
 
-    _, off, word_idx, in_win = _window_offsets(book, origin, ver)
+    if now is not None:
+        book = claim_slots(book, origin, fresh, now, keep_rounds)
+    slot, owned = org_slot(book, origin)
+    rec = fresh & owned
+
+    _, off, word_idx, in_win = _window_offsets(book, slot, ver)
     bitval = jnp.uint32(1) << (jnp.clip(off, 0, None) & 31).astype(jnp.uint32)
     flat = scatter_cols_or(
-        book.seen.reshape(n, o * w), word_idx, bitval, fresh & in_win
+        book.seen.reshape(n, o * w), word_idx, bitval, rec & in_win
     )
-    known_max = scatter_cols_max(book.known_max, origin, ver, valid)
-    book = Book(book.head, known_max, flat.reshape(n, o, w))
+    known_max = scatter_cols_max(
+        book.known_max, slot, ver, valid & owned
+    )
+    book = book._replace(known_max=known_max, seen=flat.reshape(n, o, w))
     return advance_heads(book), fresh
 
 
@@ -143,9 +249,11 @@ def bump_known_max(book: Book, origin, ver, valid) -> Book:
     still teaches a node the version exists (drives need computation and
     sync peer choice) even though the version is not applied until its
     seq range completes (``partial_need`` in ``SyncStateV1``, reference
-    ``crates/corro-types/src/sync.rs:80``)."""
+    ``crates/corro-types/src/sync.rs:80``). Only tracked actors book."""
+    slot, owned = org_slot(book, origin)
     return book._replace(
-        known_max=scatter_cols_max(book.known_max, origin, ver, valid)
+        known_max=scatter_cols_max(book.known_max, slot, ver,
+                                   valid & owned)
     )
 
 
@@ -207,7 +315,9 @@ def advance_heads(book: Book) -> Book:
     t = _trailing_ones(book.seen)
     head = book.head + t
     seen = _shift_right(book.seen, t)
-    return Book(head, jnp.maximum(book.known_max, head), seen)
+    return book._replace(
+        head=head, known_max=jnp.maximum(book.known_max, head), seen=seen
+    )
 
 
 def raise_heads(book: Book, new_head) -> Book:
@@ -217,7 +327,11 @@ def raise_heads(book: Book, new_head) -> Book:
     Follow with :func:`advance_heads` to absorb bits now adjacent."""
     new_head = jnp.maximum(book.head, new_head)
     seen = _shift_right(book.seen, new_head - book.head)
-    return Book(new_head, jnp.maximum(book.known_max, new_head), seen)
+    return book._replace(
+        head=new_head,
+        known_max=jnp.maximum(book.known_max, new_head),
+        seen=seen,
+    )
 
 
 def needs_count(book: Book) -> jax.Array:

@@ -202,8 +202,12 @@ def local_write(cfg: SimConfig, cst: CrdtState, write_mask, cell, val, clp=None)
                 cfg, cst, write_mask, cell, val, clp
             )
     iarr = jnp.arange(n, dtype=jnp.int32)
-    is_origin = iarr < cfg.n_origins
-    w = write_mask & is_origin
+    # any_writer (round 4): every node commits; bookkeeping rides the
+    # hash-slotted origin table. Legacy: fixed pool of n_origins writers
+    if getattr(cfg, "any_writer", False):
+        w = write_mask
+    else:
+        w = write_mask & (iarr < cfg.n_origins)
     if clp is None:
         clp = jnp.zeros(n, jnp.int32)
 
@@ -224,7 +228,8 @@ def local_write(cfg: SimConfig, cst: CrdtState, write_mask, cell, val, clp=None)
     # record own version in own bookkeeping (a writer has trivially seen
     # its own db_versions; its head over itself == next_dbv - 1)
     book, _ = record_versions(
-        cst.book, site[:, None], dbv[:, None], w[:, None]
+        cst.book, site[:, None], dbv[:, None], w[:, None],
+        now=cst.now, keep_rounds=getattr(cfg, "org_keep_rounds", 16),
     )
 
     cst = cst._replace(
@@ -264,8 +269,10 @@ def local_write_tx(cfg: SimConfig, cst: CrdtState, tx_mask, tx_cell, tx_val,
     n, k = cfg.n_nodes, tx_cell.shape[1]
     assert k <= max(1, cfg.tx_max_cells)
     iarr = jnp.arange(n, dtype=jnp.int32)
-    is_origin = iarr < cfg.n_origins
-    w = tx_mask & is_origin
+    if getattr(cfg, "any_writer", False):
+        w = tx_mask
+    else:
+        w = tx_mask & (iarr < cfg.n_origins)
     lane = jnp.arange(k, dtype=jnp.int32)[None, :]
     lane_ok = w[:, None] & (lane < tx_len[:, None])  # [N, K]
 
@@ -282,7 +289,10 @@ def local_write_tx(cfg: SimConfig, cst: CrdtState, tx_mask, tx_cell, tx_val,
         jnp.broadcast_to(dbv[:, None], (n, k)), tx_clp, lane_ok,
     )
 
-    book, _ = record_versions(cst.book, iarr[:, None], dbv[:, None], w[:, None])
+    book, _ = record_versions(
+        cst.book, iarr[:, None], dbv[:, None], w[:, None],
+        now=cst.now, keep_rounds=getattr(cfg, "org_keep_rounds", 16),
+    )
     cst = cst._replace(
         store=store, book=book, next_dbv=jnp.where(w, dbv + 1, cst.next_dbv)
     )
@@ -353,7 +363,10 @@ def ingest_changes(cfg, cst: CrdtState, live, m_origin, m_dbv, m_cell, m_ver,
 
     # --- complete (single-cell) versions: record + apply on arrival -----
     single = live & (m_nseq <= 1)
-    book, fresh1 = record_versions(cst.book, m_origin, m_dbv, single)
+    book, fresh1 = record_versions(
+        cst.book, m_origin, m_dbv, single,
+        now=cst.now, keep_rounds=getattr(cfg, "org_keep_rounds", 16),
+    )
 
     store = apply_changes(
         cst.store, m_cell, m_ver, m_val, m_site, m_dbv, m_clp, fresh1
@@ -386,7 +399,10 @@ def ingest_changes(cfg, cst: CrdtState, live, m_origin, m_dbv, m_cell, m_ver,
             par.clp.reshape(n, pk),
             lane_ok.reshape(n, pk),
         )
-        book, _ = record_versions(book, par.origin, par.dbv, full)
+        book, _ = record_versions(
+            book, par.origin, par.dbv, full,
+            now=cst.now, keep_rounds=getattr(cfg, "org_keep_rounds", 16),
+        )
         par = free_slots(par, full)
         cst = cst._replace(store=store, book=book, partials=par)
         fresh = fresh1 | fresh_m

@@ -30,7 +30,18 @@ class SimConfig:
     max_transmissions: int = 10  # per-update re-send budget before it goes quiet
     announce_interval: int = 16  # mean rounds between announces (ANNOUNCE_INTERVAL)
     # --- CRDT store ------------------------------------------------------
-    n_origins: int = 4  # writer nodes (nodes 0..n_origins-1 may write)
+    # bookkeeping SLOTS per node (round 4): with any_writer, n_origins
+    # bounds how many distinct actors a node can TRACK, not who may
+    # write (hash-slotted origin table, ops/versions.py Book); without
+    # it, the legacy fixed pool — only nodes 0..n_origins-1 write
+    n_origins: int = 4
+    # ANY node may write (the reference's semantics — BookedVersions is
+    # per observed actor, agent.rs:1270-1604); off = legacy fixed pool
+    any_writer: bool = False
+    # slot-eviction idle threshold: a tracked actor with no fresh
+    # activity for this many rounds can lose its slot to a colliding
+    # foreign writer (sync rebuilds the evicted bookkeeping)
+    org_keep_rounds: int = 16
     n_rows: int = 16  # LWW rows per table
     n_cols: int = 4  # LWW columns per row
     buf_slots: int = 64  # out-of-order version buffer per node
@@ -59,6 +70,11 @@ class SimConfig:
     # count and the survivors' grants shrink toward sync_min_chunk
     serve_cap: int = 3
     sync_min_chunk: int = 4
+    # every k-th cohort/sync period, lane 0 merges its peer's FULL
+    # store (ignores grants/ownership; LWW join is idempotent) — the
+    # convergence backstop when bookkeeping slots are contended
+    # (round 4 unbounded writers); 0 disables
+    sync_sweep_every: int = 4
 
     @property
     def n_cells(self) -> int:

@@ -79,7 +79,13 @@ class ScaleSimConfig:
     down_purge_rounds: int = 64
     pig_members: int = 0  # bounded piggyback (see ScaleConfig.pig_members)
     # --- CRDT store ------------------------------------------------------
+    # bookkeeping slots per node; with any_writer (the flagship default)
+    # this bounds TRACKED actors, not writers — see config.SimConfig
     n_origins: int = 16
+    # unbounded writer set (reference semantics): any node may write;
+    # per-actor bookkeeping rides the hash-slotted origin table
+    any_writer: bool = True
+    org_keep_rounds: int = 16
     n_rows: int = 16
     n_cols: int = 4
     buf_slots: int = 32
@@ -108,6 +114,11 @@ class ScaleSimConfig:
     # server-side load adaptation (see SimConfig.serve_cap)
     serve_cap: int = 3
     sync_min_chunk: int = 4
+    # every k-th cohort/sync period, lane 0 merges its peer's FULL
+    # store (ignores grants/ownership; LWW join is idempotent) — the
+    # convergence backstop when bookkeeping slots are contended
+    # (round 4 unbounded writers); 0 disables
+    sync_sweep_every: int = 4
     # cohort scheduling: run the (dense, whole-cluster) sync phase once
     # every sync_interval rounds with every node participating, instead
     # of a 1/interval per-node draw every round — same average sync rate,
@@ -402,9 +413,15 @@ def scale_sim_step(
         peers, p_ok, c_idx = choose_sync_peers(
             cfg, cst.book, cand_ids, cand_sok, staleness, rings_c, p_cnt
         )
+        sweep = None
+        if cfg.sync_sweep_every > 0:
+            sweep = (
+                cst.now % (max(1, cfg.sync_interval)
+                           * cfg.sync_sweep_every) == 0
+            )
         cst, s_ok, s_info = sync_step(
             cfg, cst, peers, p_ok, swim.alive, net, k_sync,
-            go_all=cfg.sync_cohort,
+            go_all=cfg.sync_cohort, sweep=sweep,
         )
         synced_slots = select_cols(cand_slots, c_idx)
         # zeros in the plane's own dtype: both lax.cond branches must
@@ -471,14 +488,24 @@ def scale_run_rounds(cfg: ScaleSimConfig, st, net: NetModel, key, inputs):
 
 
 def scale_crdt_metrics(cfg: ScaleSimConfig, st: ScaleSimState):
-    """Convergence predicate at scale (same as ``crdt_metrics``)."""
+    """Convergence predicate at scale (same as ``crdt_metrics``).
+
+    With the unbounded writer set, bookkeeping convergence is
+    per-tracked-actor: a node's head must equal the reference node's
+    wherever both track the SAME actor in a slot (hash-colliding actor
+    sets may legitimately leave different nodes tracking different
+    actors; store equality is still required everywhere)."""
     alive = st.swim.alive
     ref = jnp.argmax(alive)
     same_store = jnp.stack(
         [jnp.all(p == p[ref], axis=1) for p in st.crdt.store]
     ).all(axis=0)
-    same_head = jnp.all(st.crdt.book.head == st.crdt.book.head[ref], axis=1)
-    needs = needs_count(st.crdt.book)
+    book = st.crdt.book
+    aligned = book.org_id == book.org_id[ref]
+    same_head = jnp.all(
+        jnp.where(aligned, book.head == book.head[ref], True), axis=1
+    )
+    needs = needs_count(book)
     no_needs = jnp.all(needs <= 0, axis=1)
     ok = (~alive) | (same_store & same_head & no_needs)
     swim_m = {f"swim_{k}": v for k, v in scale_swim_metrics(st.swim).items()}

@@ -122,7 +122,15 @@ def sim_step(cfg: SimConfig, st: SimState, net: NetModel, key, inp: RoundInput):
     peers, p_ok, _ = choose_sync_peers(
         cfg, cst.book, cand_ids, cand_sok, staleness, rings_c, p_cnt
     )
-    cst, s_ok, s_info = sync_step(cfg, cst, peers, p_ok, swim.alive, net, k_sync)
+    sweep = None
+    if getattr(cfg, "sync_sweep_every", 0) > 0:
+        sweep = (
+            cst.now % (max(1, cfg.sync_interval)
+                       * cfg.sync_sweep_every) == 0
+        )
+    cst, s_ok, s_info = sync_step(
+        cfg, cst, peers, p_ok, swim.alive, net, k_sync, sweep=sweep
+    )
     ls = jnp.minimum(cst.last_sync + 1, LAST_SYNC_CAP)
     flat = jnp.where(s_ok, iarr[:, None] * n + peers, n * n)
     ls = (
@@ -161,7 +169,14 @@ def crdt_metrics(cfg: SimConfig, st: SimState):
     same_store = jnp.stack(
         [jnp.all(p == p[ref], axis=1) for p in st.crdt.store]
     ).all(axis=0)
-    same_head = jnp.all(st.crdt.book.head == st.crdt.book.head[ref], axis=1)
+    book = st.crdt.book
+    # heads compare only on slots tracking the SAME actor (round 4:
+    # hash-slotted origin table; identity claims make this the plain
+    # equality check whenever all writers are < n_origins)
+    aligned = book.org_id == book.org_id[ref]
+    same_head = jnp.all(
+        jnp.where(aligned, book.head == book.head[ref], True), axis=1
+    )
     needs = needs_count(st.crdt.book)
     no_needs = jnp.all(needs <= 0, axis=1)
     ok = (~alive) | (same_store & same_head & no_needs)

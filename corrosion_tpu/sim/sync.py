@@ -66,8 +66,12 @@ def choose_sync_peers(cfg, book, cand_ids, cand_ok, staleness, rings, k):
     """
     n_org = cfg.n_origins
     needs = jnp.maximum(needs_count(book), 0)  # [N, O]
-    in_pool = (cand_ids >= 0) & (cand_ids < n_org)
-    need = jnp.where(in_pool, lookup_cols(needs, cand_ids), 0)
+    # a candidate's need-as-origin lives at its hash slot, and only
+    # counts while the slot actually tracks that actor (round 4:
+    # unbounded writer set, ops/versions.py Book)
+    slot = jnp.where(cand_ids >= 0, cand_ids % n_org, 0)
+    owned = (cand_ids >= 0) & (lookup_cols(book.org_id, slot) == cand_ids)
+    need = jnp.where(owned, lookup_cols(needs, slot), 0)
     score = (
         (jnp.minimum(need, 4095) << 15)
         + (jnp.minimum(staleness, LAST_SYNC_CAP) << 3)
@@ -88,13 +92,24 @@ def sync_step(
     net: NetModel,
     key: jax.Array,
     go_all: bool = False,
+    sweep=None,
 ):
     """One sync round: a random subset of nodes each pulls from the
     caller-chosen ``peers`` lanes (the scale path scores ``sync_peers``
     candidates and passes the top ``sync_pull_peers``; ``go_all``: every
     alive node syncs — the cohort-scheduled caller already rate-limited
     the rounds). Returns (state, ok, info) where ``ok`` [N, P] marks
-    pairs that actually exchanged (drives last-sync bookkeeping)."""
+    pairs that actually exchanged (drives last-sync bookkeeping).
+
+    ``sweep`` (traced bool or None): a FULL-STORE sweep round — lane 0
+    merges its peer's entire store elementwise, ignoring range grants
+    and slot ownership. The LWW join is idempotent/commutative, so this
+    is always safe; it is the convergence backstop for actors whose
+    hash slot is held by a *different continuously-active* actor
+    (bounded bookkeeping cannot range-track them, and gossip budgets
+    are finite). Callers schedule it every ``sync_sweep_every``-th
+    cohort round — amortized, one extra granted-lane's worth of
+    traffic."""
     n, n_org = cfg.n_nodes, cfg.n_origins
     p_cnt = peers.shape[1]
     iarr = jnp.arange(n, dtype=jnp.int32)
@@ -148,10 +163,50 @@ def sync_step(
         cfg.sync_chunk,
     )  # [N, P]
 
-    head_i = cst.book.head  # [N, O]
     head_p = jax.lax.optimization_barrier(cst.book.head[peers])  # [N, P, O]
+    # slot-aligned org agreement (round 4): a peer's slot grants to me
+    # when we track the SAME actor there. Anti-entropy must also be the
+    # backstop for actors I never heard gossip from (budgets are finite),
+    # so an idle/free slot of mine CLAIMS the actor my top-scored peer
+    # (lane 0 — one lane, so claims are deterministic) tracks there:
+    # bookkeeping resets to zero and the granted range rebuilds it, the
+    # same repair path as an ingest-side eviction.
+    org_p = jax.lax.optimization_barrier(
+        cst.book.org_id[peers]
+    )  # [N, P, O]
+    now = cst.now
+    keep = getattr(cfg, "org_keep_rounds", 16)
+    evictable = (cst.book.org_id < 0) | (
+        cst.book.org_last + keep < now
+    )  # [N, O]
+    claim0 = (
+        ok[:, 0, None]
+        & evictable
+        & (org_p[:, 0, :] >= 0)
+        & (org_p[:, 0, :] != cst.book.org_id)
+        # never trade real (idle) bookkeeping for a peer slot with
+        # nothing to grant — an empty claim resets dedupe state for
+        # zero data
+        & (head_p[:, 0, :] > 0)
+    )  # [N, O]
+    org_id2 = jnp.where(claim0, org_p[:, 0, :], cst.book.org_id)
+    head_i = jnp.where(claim0, 0, cst.book.head)  # [N, O]
+    book0 = cst.book._replace(
+        head=head_i,
+        known_max=jnp.where(claim0, 0, cst.book.known_max),
+        seen=jnp.where(
+            claim0[:, :, None], jnp.zeros((), jnp.uint32), cst.book.seen
+        ),
+        org_id=org_id2,
+        org_last=jnp.where(claim0, jnp.int32(now), cst.book.org_last),
+    )
+    match = (
+        ok[:, :, None]
+        & (org_p == org_id2[:, None, :])
+        & (org_id2[:, None, :] >= 0)
+    )
     granted = jnp.minimum(head_p, head_i[:, None, :] + chunk_eff[:, :, None])
-    granted = jnp.where(ok[:, :, None], granted, 0)  # [N, P, O]
+    granted = jnp.where(match, granted, 0)  # [N, P, O]
 
     # --- transfer: masked elementwise merge per peer --------------------
     store = tuple(p.astype(jnp.int32) for p in cst.store)
@@ -168,17 +223,24 @@ def sync_step(
                     tuple(pl[pj] for pl in cst.store)
                 )
             )  # [N, C]
-            # range check per cell: head_i[site] < dbv <= granted[j, site]
-            lo = lookup_cols(head_i, p_site)
-            hi = lookup_cols(granted[:, j, :], p_site)
+            # range check per cell, at the site's hash slot (which must
+            # track that exact actor): head_i[slot] < dbv <= granted
+            slot_c = jnp.where(p_site >= 0, p_site % n_org, 0)
+            owned_c = (p_site >= 0) & (
+                lookup_cols(org_id2, slot_c) == p_site
+            )
+            lo = lookup_cols(head_i, slot_c)
+            hi = lookup_cols(granted[:, j, :], slot_c)
             sel = (
                 ok[:, j : j + 1]
-                & (p_site >= 0)
-                & (p_site < n_org)
+                & owned_c
                 & (p_dbv > lo)
                 & (p_dbv <= hi)
                 & (p_ver > 0)
             )
+            if sweep is not None and j == 0:
+                # full-store sweep: every live peer cell merges
+                sel = sel | (sweep & ok[:, 0:1] & (p_ver > 0))
             # merge key (clp, ver, val, site) — causal-length lifetime
             # dominates, then the LWW clock (ops/lww.py merge_store)
             b = (
@@ -201,6 +263,8 @@ def sync_step(
         # merge entirely when no node was granted anything from it (the
         # reference's sync_loop similarly no-ops when needs are empty)
         any_grant = jnp.any(granted[:, j, :] > head_i)
+        if sweep is not None and j == 0:
+            any_grant = any_grant | sweep
         store, cnt = jax.lax.cond(
             any_grant, merge_lane,
             lambda s: (s, jnp.int32(0)),
@@ -215,9 +279,10 @@ def sync_step(
     km_p = jax.lax.optimization_barrier(
         cst.book.known_max[peers]
     )  # [N, P, O]
-    km_p = jnp.where(ok[:, :, None], km_p, 0)
-    new_km = jnp.maximum(cst.book.known_max, jnp.max(km_p, axis=1))
-    book = raise_heads(cst.book, new_head)
+    # known_max is per-slot bookkeeping: only org-matched slots teach
+    km_p = jnp.where(match, km_p, 0)
+    new_km = jnp.maximum(book0.known_max, jnp.max(km_p, axis=1))
+    book = raise_heads(book0, new_head)
     book = advance_heads(
         book._replace(known_max=jnp.maximum(book.known_max, new_km))
     )
@@ -225,7 +290,7 @@ def sync_step(
     # fragments (the buffered-meta GC analog, util.rs:430-490)
     if cst.partials.origin.shape[1] > 1 or cst.partials.cell.shape[2] > 1:
         cst = cst._replace(
-            partials=drop_stale_partials(cst.partials, book.head)
+            partials=drop_stale_partials(cst.partials, book)
         )
 
     # sync handshake exchanges HLC clocks; BOTH sides fold, with the same

@@ -30,3 +30,17 @@ jax.config.update("jax_platforms", "cpu")
 from corrosion_tpu.utils.compile_cache import enable_compile_cache  # noqa: E402
 
 enable_compile_cache()
+
+
+# The full suite accumulates hundreds of compiled executables in one
+# process; past ~225 tests the NEXT big XLA/LLVM compile segfaults
+# (observed twice at the same index, in backend_compile_and_load).
+# Dropping the in-memory jit caches between modules caps the
+# accumulation; the persistent disk cache makes the recompiles cheap.
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    yield
+    jax.clear_caches()

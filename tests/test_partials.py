@@ -101,11 +101,16 @@ def test_slot_overflow_drops():
 
 
 def test_drop_stale_partials_frees_synced_versions():
+    from corrosion_tpu.ops.versions import Book
+
     par = Partials.create(1, 4, 4)
     live, f = _msgs([[(0, 5, 0, 2, 10, 1, 1, 0, 0)]])
     par, _ = ingest_partials(par, live, *f)
-    head = jnp.asarray([[5, 0]], jnp.int32)  # origin 0's head reached 5
-    par = drop_stale_partials(par, head)
+    book = Book.create(1, 2, 32)
+    book = book._replace(
+        head=jnp.asarray([[5, 0]], jnp.int32)  # origin 0's head reached 5
+    )
+    par = drop_stale_partials(par, book)
     assert not (np.asarray(par.origin) >= 0).any()
 
 
