@@ -12,10 +12,12 @@ Supported dialect (the write/read surface the reference's API exercises):
 cr-sqlite rewrites inserts), ``UPDATE t SET c=? WHERE pk=?``,
 ``DELETE FROM t WHERE pk=?`` (causal-length tombstone), and
 ``SELECT`` with projection aliases, aggregates (COUNT/SUM/MIN/MAX/AVG/
-TOTAL), ``[LEFT] JOIN ... ON a.x = b.y`` equi-joins, ``WHERE``
-conjunctions (incl. the ``corro_json_contains`` function from
-``sqlite-functions``), ``GROUP BY``, ``ORDER BY ... [ASC|DESC]``, and
-``LIMIT n [OFFSET m]``.
+TOTAL), ``[LEFT] JOIN ... ON a.x = b.y`` equi-joins, boolean ``WHERE``/
+``HAVING`` (AND/OR/NOT with parens, SQLite three-valued logic,
+``IS [NOT] NULL``, ``[NOT] LIKE/GLOB/IN``, scalar subqueries, the
+``corro_json_contains`` function from ``sqlite-functions``),
+non-recursive ``WITH`` CTEs, ``GROUP BY``, ``ORDER BY ... [ASC|DESC]``,
+and ``LIMIT n [OFFSET m]``.
 """
 
 from __future__ import annotations
@@ -96,6 +98,40 @@ _ISNULL_RE = re.compile(
     r"^(?P<col>[\w\".]+)\s+IS\s+(?P<neg>NOT\s+)?NULL$",
     re.IGNORECASE | re.DOTALL,
 )
+_WITH_RE = re.compile(r"^\s*WITH\s+", re.IGNORECASE)
+_CTE_HEAD_RE = re.compile(r"^\s*([\w\"]+)\s+AS\s*\(", re.IGNORECASE)
+
+
+class _CteColumn:
+    """Duck-typed column of a CTE's result (``Table.columns`` shape)."""
+
+    __slots__ = ("name", "primary_key")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.primary_key = False
+
+
+class _CteTable:
+    """Duck-typed ``Table`` for a WITH common-table-expression: the
+    parser resolves columns against the sub-select's projection names,
+    and execution materializes the sub-select per node
+    (``corro-pg``'s surface is full SQLite, which includes
+    non-recursive CTEs; ``crates/corro-pg/src/lib.rs``)."""
+
+    def __init__(self, name: str, col_names: List[str], ast):
+        self.name = name
+        self.columns = [_CteColumn(c) for c in col_names]
+        self.ast = ast
+
+    def column(self, name: str):
+        for c in self.columns:
+            if c.name == name:
+                return c
+        raise SqlError(f"no such column: {self.name}.{name}")
+
+    def has_column(self, name: str) -> bool:
+        return any(c.name == name for c in self.columns)
 
 
 import functools
@@ -745,8 +781,47 @@ class Database:
                 mask.append(depth == 0)
         return mask
 
-    def _parse_select(self, sql: str, p: _Params, check_params: bool = True):
+    def _parse_cte_prefix(self, sql: str, p: _Params, check_params: bool,
+                          ctes: Optional[Dict[str, "_CteTable"]]):
+        """Strip a leading ``WITH name AS (...), ...`` prefix, parsing
+        each CTE body (earlier CTEs are visible to later ones and to the
+        main select, like SQLite's non-recursive WITH)."""
+        out: Dict[str, _CteTable] = dict(ctes or {})
+        rest = sql[_WITH_RE.match(sql).end():]
+        while True:
+            hm = _CTE_HEAD_RE.match(rest)
+            if hm is None:
+                raise SqlError(f"malformed WITH clause near {rest[:60]!r}")
+            name = _unquote(hm.group(1))
+            # find the balanced close of the body paren
+            depth, in_str, i = 1, False, hm.end()
+            while i < len(rest) and depth:
+                ch = rest[i]
+                if in_str:
+                    in_str = ch != "'"
+                elif ch == "'":
+                    in_str = True
+                elif ch == "(":
+                    depth += 1
+                elif ch == ")":
+                    depth -= 1
+                i += 1
+            if depth:
+                raise SqlError(f"unbalanced parens in WITH {name!r}")
+            body = rest[hm.end():i - 1].strip()
+            sub = self._parse_select(body, p, check_params, ctes=out)
+            out[name] = _CteTable(name, [c[2] for c in sub["cols"]], sub)
+            rest = rest[i:].lstrip()
+            if rest.startswith(","):
+                rest = rest[1:].lstrip()
+                continue
+            return out, rest
+
+    def _parse_select(self, sql: str, p: _Params, check_params: bool = True,
+                      ctes: Optional[Dict[str, "_CteTable"]] = None):
         sql = sql.strip().rstrip(";").strip()
+        if _WITH_RE.match(sql):
+            ctes, sql = self._parse_cte_prefix(sql, p, check_params, ctes)
         if not _SELECT_RE.match(sql):
             raise SqlError(f"only SELECT is allowed on the query path: "
                            f"{sql[:80]!r}")
@@ -766,13 +841,15 @@ class Database:
             segs.append((kw, sql[e:end].strip()))
         cols_raw = sql[len("SELECT"):from_marks[0][0]].strip()
 
-        # FROM + JOINs
+        # FROM + JOINs (CTE names shadow schema tables, like SQLite)
         def table_spec(raw):
             parts = raw.split()
             name = _unquote(parts[0])
             alias = _unquote(parts[-1]) if (
                 len(parts) > 1 and parts[-1].upper() != "AS"
             ) else name
+            if ctes and name in ctes:
+                return ctes[name], alias
             return self.schema.table(name), alias
 
         aliases: Dict[str, Any] = {}
@@ -877,10 +954,11 @@ class Database:
 
         # WHERE / HAVING conjunctions (shared grammar; HAVING resolves its
         # left sides per group at execution time, so they stay raw here)
-        conds = (self._parse_conds(where_raw, p, resolve, check_params)
+        conds = (self._parse_conds(where_raw, p, resolve, check_params,
+                                   ctes=ctes)
                  if where_raw else [])
         having = (self._parse_conds(having_raw, p, resolve, check_params,
-                                    defer_lhs=True)
+                                    defer_lhs=True, ctes=ctes)
                   if having_raw else [])
 
         # GROUP BY entries: plain columns resolve to record keys, output
@@ -957,7 +1035,7 @@ class Database:
         }
 
     def _parse_conds(self, raw: str, p: _Params, resolve, check_params,
-                     defer_lhs: bool = False) -> List[tuple]:
+                     defer_lhs: bool = False, ctes=None) -> List[tuple]:
         """Parse a WHERE/HAVING boolean expression into a cond list.
 
         Leaves are ``(op, lhs, rhs)`` tuples — comparison operators,
@@ -976,7 +1054,7 @@ class Database:
             return [(
                 "or",
                 [self._parse_conds(part, p, resolve, check_params,
-                                   defer_lhs)
+                                   defer_lhs, ctes)
                  for part in or_parts],
                 None,
             )]
@@ -992,7 +1070,7 @@ class Database:
                 conds.append((
                     "not",
                     self._parse_conds(clause[nm.end():], p, resolve,
-                                      check_params, defer_lhs),
+                                      check_params, defer_lhs, ctes),
                     None,
                 ))
                 continue
@@ -1002,7 +1080,7 @@ class Database:
                     clause[1:-1].strip()):
                 conds.extend(
                     self._parse_conds(clause[1:-1], p, resolve,
-                                      check_params, defer_lhs)
+                                      check_params, defer_lhs, ctes)
                 )
                 continue
             fm = _FUNC_RE.match(clause)
@@ -1017,7 +1095,8 @@ class Database:
                       + lm.group("fn").lower())
                 conds.append(
                     (op, res(lm.group("col")),
-                     self._parse_rhs(lm.group("val"), p, check_params))
+                     self._parse_rhs(lm.group("val"), p, check_params,
+                                     ctes))
                 )
                 continue
             km = _ISNULL_RE.match(clause)
@@ -1032,8 +1111,8 @@ class Database:
                 op = "not in" if im.group("neg") else "in"
                 body = im.group("body").strip()
                 if _SELECT_RE.match(body):
-                    val = ("subq_list", self._parse_select(body, p,
-                                                           check_params))
+                    val = ("subq_list", self._parse_select(
+                        body, p, check_params, ctes=ctes))
                 else:
                     val = [
                         (_parse_literal(t, p) if check_params else None)
@@ -1045,7 +1124,8 @@ class Database:
             if cm is not None:
                 conds.append(
                     (cm.group("op"), res(cm.group("col")),
-                     self._parse_rhs(cm.group("val"), p, check_params))
+                     self._parse_rhs(cm.group("val"), p, check_params,
+                                     ctes))
                 )
                 continue
             # expression left side: WHERE a + b > 5, LENGTH(name) = 3 ...
@@ -1055,7 +1135,8 @@ class Database:
                                  check_params).parse()
                 conds.append(
                     (em.group("op"), ("\x00expr", fn),
-                     self._parse_rhs(em.group("val"), p, check_params))
+                     self._parse_rhs(em.group("val"), p, check_params,
+                                     ctes))
                 )
                 continue
             raise SqlError(
@@ -1063,17 +1144,33 @@ class Database:
             )
         return conds
 
-    def _parse_rhs(self, raw: str, p: _Params, check_params):
+    def _parse_rhs(self, raw: str, p: _Params, check_params, ctes=None):
         raw = raw.strip()
         if (raw.startswith("(") and raw.endswith(")")
                 and _SELECT_RE.match(raw[1:-1].strip())):
-            return ("subq", self._parse_select(raw[1:-1].strip(), p,
-                                               check_params))
+            return ("subq", self._parse_select(
+                raw[1:-1].strip(), p, check_params, ctes=ctes))
         return _parse_literal(raw, p) if check_params else None
 
     # --- SELECT execution -------------------------------------------------
-    def _table_records(self, node: int, table, alias: str, vals, clps):
-        """All live rows of one table as {'alias.col': value} dicts."""
+    def _table_records(self, node: int, table, alias: str, vals, clps,
+                       cte_memo=None):
+        """All live rows of one table as {'alias.col': value} dicts.
+        A CTE materializes its sub-select against the same node ONCE
+        per top-level execution (``cte_memo``): chained/self-joined CTE
+        references reuse the rows, matching SQLite's materialization."""
+        if isinstance(table, _CteTable):
+            names = [c.name for c in table.columns]
+            memo = cte_memo if cte_memo is not None else {}
+            key = (node, id(table.ast))
+            if key not in memo:
+                memo[key] = list(
+                    self._run_select(node, table.ast, cte_memo=memo)
+                )
+            return [
+                {f"{alias}.{k}": v for k, v in zip(names, row)}
+                for row in memo[key]
+            ]
         out = []
         for pk, row in self.rows.rows_of(table.name):
             if int(vals[self._cell(row, CL_COL)]) % 2 == 0:
@@ -1102,7 +1199,10 @@ class Database:
             out.append((op, lhs, val))
         return out
 
-    def _run_select(self, node: int, ast) -> Iterable[List[Any]]:
+    def _run_select(self, node: int, ast,
+                    cte_memo=None) -> Iterable[List[Any]]:
+        if cte_memo is None:
+            cte_memo = {}
         ast = {
             **ast,
             "conds": self._resolve_subqueries(node, ast["conds"]),
@@ -1114,20 +1214,23 @@ class Database:
         aliases = ast["aliases"]
         has_agg = any(k == "agg" for k, _, _ in ast["cols"])
         if (not ast["joins"] and not ast["group"] and not ast["order"]
-                and not has_agg and not ast["having"]):
+                and not has_agg and not ast["having"]
+                and not isinstance(aliases[ast["base"]], _CteTable)):
             # streaming fast path: plain filtered scan short-circuits at
             # LIMIT without materializing the table (the /v1/queries
-            # NDJSON stream shape)
+            # NDJSON stream shape); CTE bases always materialize
             yield from self._stream_select(node, ast, vals, clps)
             return
         records = self._table_records(
-            node, aliases[ast["base"]], ast["base"], vals, clps
+            node, aliases[ast["base"]], ast["base"], vals, clps,
+            cte_memo=cte_memo,
         )
         # hash equi-joins, in declaration order
         for jtype, a, lref, rref in ast["joins"]:
             lkey, rkey = ast["resolve"](lref), ast["resolve"](rref)
             # probe side = the newly joined table's rows
-            right = self._table_records(node, aliases[a], a, vals, clps)
+            right = self._table_records(node, aliases[a], a, vals, clps,
+                                        cte_memo=cte_memo)
             probe_key = rkey if rkey.startswith(f"{a}.") else lkey
             build_key = lkey if probe_key == rkey else rkey
             if not probe_key.startswith(f"{a}."):

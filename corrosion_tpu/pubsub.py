@@ -114,6 +114,13 @@ class Matcher:
                 "subscriptions require plain row queries "
                 "(no aggregates / GROUP BY)"
             )
+        from corrosion_tpu.db.database import _CteTable
+
+        if any(isinstance(t, _CteTable) for t in ast["aliases"].values()):
+            # CTE results have no pk to track matches by; the reference
+            # likewise restricts subscription queries to its supported
+            # matcher surface (pubsub.rs:527+)
+            raise SqlError("subscriptions do not support WITH (CTEs)")
         pk_refs = [f"{a}.{t.pk.name}" for a, t in ast["aliases"].items()]
         self._n_keys = len(pk_refs)
         self._key_sql = re.sub(

@@ -594,3 +594,49 @@ def test_or_in_join_and_subquery(rich_db):
            "(SELECT sid FROM squads WHERE title = 'gray') "
            "OR score = (SELECT MIN(score) FROM players) ORDER BY pname")
     assert list(rows) == [["b"]]
+
+
+# --- round-4 dialect: non-recursive CTEs (WITH ... AS) -------------------
+# pinned against stdlib sqlite3 on the same dataset
+
+def test_cte_basic_and_chained(rich_db):
+    _, rows = rich_db.query(
+        0, "WITH hi AS (SELECT pname, score FROM players "
+           "WHERE score >= 25) SELECT pname FROM hi ORDER BY pname")
+    assert list(rows) == [["a"], ["d"], ["e"]]
+    # a later CTE sees an earlier one
+    _, rows = rich_db.query(
+        0, "WITH hi AS (SELECT pname, score, team FROM players "
+           "WHERE score > 15), "
+           "reds AS (SELECT pname FROM hi WHERE team = 1) "
+           "SELECT COUNT(*) FROM reds")
+    assert list(rows) == [[3]]
+
+
+def test_cte_join_and_aggregate(rich_db):
+    _, rows = rich_db.query(
+        0, "WITH t AS (SELECT team, SUM(score) AS total FROM players "
+           "GROUP BY team) "
+           "SELECT s.title, t.total FROM t JOIN squads s "
+           "ON t.team = s.sid ORDER BY s.title")
+    assert list(rows) == [["blue", 50], ["red", 75]]
+
+
+def test_cte_in_subquery(rich_db):
+    _, rows = rich_db.query(
+        0, "WITH m AS (SELECT MAX(score) AS top FROM players) "
+           "SELECT pname FROM players WHERE score = "
+           "(SELECT top FROM m)")
+    assert list(rows) == [["d"]]
+
+
+def test_cte_errors(rich_db):
+    import pytest as _pytest
+
+    from corrosion_tpu.db.database import SqlError
+
+    with _pytest.raises(SqlError):
+        rich_db.query(0, "WITH x AS SELECT 1 SELECT * FROM x")
+    with _pytest.raises(SqlError):
+        rich_db.query(0, "WITH x AS (SELECT pname FROM players "
+                         "SELECT pname FROM x")
