@@ -26,10 +26,18 @@ def enable_compile_cache(path: str | None = None) -> str:
 
     path = path or os.environ.get("JAX_COMPILATION_CACHE_DIR") or \
         default_cache_dir()
-    # one subdir per requested platform: CPU AOT entries written by a
-    # process with different tuning flags trigger load warnings when
-    # shared, and TPU/CPU entries never cross-hit anyway
-    path = os.path.join(path, os.environ.get("JAX_PLATFORMS") or "auto")
+    # one subdir per (platform, jaxlib): CPU AOT entries written by a
+    # DIFFERENT jaxlib/LLVM (the tunnel terminal's env) carry target
+    # features the local host rejects ("+prefer-no-scatter ... could
+    # lead to SIGILL") and poison local runs; TPU/CPU entries never
+    # cross-hit anyway
+    import jaxlib
+
+    path = os.path.join(
+        path,
+        (os.environ.get("JAX_PLATFORMS") or "auto")
+        + "-" + getattr(jaxlib, "__version__", "unknown"),
+    )
     os.makedirs(path, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", path)
     # cache everything that took meaningful compile time; the default
