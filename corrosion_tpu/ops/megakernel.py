@@ -340,7 +340,7 @@ def _cols(table, idx, fill=0):
 def _ingest_kernel(cfg_tuple, *refs):
     (n_origins, n_cells, q_slots, seen_words, hlc_round_bits,
      hlc_max_drift, no_q, pig_r, budget_bytes, wire_bytes,
-     keep_rounds) = cfg_tuple
+     keep_rounds, enqueue_all) = cfg_tuple
     # ref layout: 31 base inputs (+2 with payload emission), then the
     # 22 base outputs (+3 with emission)
     n_in = 31 + (2 if pig_r else 0)
@@ -537,10 +537,15 @@ def _ingest_kernel(cfg_tuple, *refs):
     o_s_clp[:] = jnp.stack(out_cols[4], axis=1)
 
     # --- re-broadcast enqueue with evict-most-sent ----------------------
+    # only RECORDED changes re-enqueue (see versions.record_versions:
+    # unrecorded fresh messages would circulate forever) — except the
+    # local-write path (enqueue_all), where the writer is the source of
+    # truth and must disseminate even when its own slot is contended.
     # sequential argmin over the batch == the batch rank assignment of
     # slots.alloc_slots_evict (the r-th fresh item takes the r-th
     # smallest evict key; ties resolve to the lowest slot on both forms;
     # items beyond the slot count drop on both forms)
+    enq = fresh if enqueue_all else rec
     q_origin = q_origin_ref[:]
     q_tx_now = q_tx_ref[:]
     evict_key = jnp.where(q_origin == no_q, imin, q_tx_now)
@@ -564,7 +569,7 @@ def _ingest_kernel(cfg_tuple, *refs):
         slot = jnp.min(
             jnp.where(evict_key == kmin[:, None], col_iota, q_slots), axis=1
         )
-        write = (fresh[:, j] & (kmin < imax))[:, None] & (
+        write = (enq[:, j] & (kmin < imax))[:, None] & (
             col_iota == slot[:, None]
         )
         for pair in planes:
@@ -648,6 +653,7 @@ def ingest_changes_fused(cfg, cst, live, m_origin, m_dbv, m_cell, m_ver,
                          m_val, m_site, m_clp, m_ts, *, m_budget=None,
                          drift_rounds: Optional[int] = None,
                          rand=None, carried=None,
+                         enqueue_all: bool = False,
                          interpret: Optional[bool] = None):
     """Drop-in fused form of the single-cell ``ingest_changes`` path.
 
@@ -690,6 +696,7 @@ def ingest_changes_fused(cfg, cst, live, m_origin, m_dbv, m_cell, m_ver,
         int(getattr(cfg, "bcast_budget_bytes", 0)),
         _CHANGE_WIRE_BYTES,
         int(getattr(cfg, "org_keep_rounds", 16)),
+        bool(enqueue_all),
     )
 
     def spec(width):
@@ -837,6 +844,9 @@ def local_write_fused(cfg, cst, write_mask, cell, val, clp=None, *,
         drift_rounds=1 << 20,
         rand=rand,
         carried=carried,
+        # the writer is the source of truth: its commit disseminates
+        # even when its own bookkeeping slot is contended
+        enqueue_all=True,
         interpret=interpret,
     )
     # emission only happens when pig_changes > 0 too — match the callee's

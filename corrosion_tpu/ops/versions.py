@@ -194,8 +194,8 @@ def record_versions(book: Book, origin, ver, valid, now=None,
     """Record a per-node batch of incoming (origin, version) pairs.
 
     ``origin``/``ver``: int32 [N, M] — up to M messages per node this round;
-    ``valid``: bool [N, M]. Returns ``(book, fresh)`` where ``fresh`` [N, M]
-    marks messages not seen before by that node (the seen-cache check of
+    ``valid``: bool [N, M]. Returns ``(book, fresh, rec)`` where
+    ``fresh`` [N, M] marks messages not seen before by that node (the seen-cache check of
     ``handle_changes``, reference ``handlers.rs:548-786`` — fresh changes
     get applied and re-broadcast, stale ones dropped).
 
@@ -203,11 +203,16 @@ def record_versions(book: Book, origin, ver, valid, now=None,
     slot (:func:`claim_slots`; ``now`` = the round counter — omitted
     means "no claims", the pre-round-4 fixed-pool behavior). Only the
     slot owner's messages are then RECORDED; foreign messages that lost
-    the claim still report fresh (apply + re-broadcast, budget-bounded)
-    but leave no bookkeeping. Fresh in-window versions set their seen
-    bit (beyond-window → dropped, like the bounded processing queue,
-    ``config.rs:15-27``; sync repairs), then heads advance over any
-    newly-closed gaps.
+    the claim still report fresh (they apply — LWW is idempotent) but
+    leave no bookkeeping. Returns ``(book, fresh, rec)``; callers must
+    re-broadcast only ``rec`` (recorded) messages — an unrecorded
+    message reported fresh on EVERY arrival, so re-enqueueing it (with
+    a fresh budget each time) would circulate forever between nodes
+    with mismatched slot ownership (the reference likewise re-sends
+    only changes its bookie accepted, ``handlers.rs:768-779``). Fresh
+    in-window versions set their seen bit (beyond-window → dropped,
+    like the bounded processing queue, ``config.rs:15-27``; sync
+    repairs), then heads advance over any newly-closed gaps.
     """
     n, o, w = book.seen.shape
     seen = seen_versions(book, origin, ver, valid)
@@ -240,7 +245,7 @@ def record_versions(book: Book, origin, ver, valid, now=None,
         book.known_max, slot, ver, valid & owned
     )
     book = book._replace(known_max=known_max, seen=flat.reshape(n, o, w))
-    return advance_heads(book), fresh
+    return advance_heads(book), fresh, rec
 
 
 def bump_known_max(book: Book, origin, ver, valid) -> Book:

@@ -227,7 +227,7 @@ def local_write(cfg: SimConfig, cst: CrdtState, write_mask, cell, val, clp=None)
 
     # record own version in own bookkeeping (a writer has trivially seen
     # its own db_versions; its head over itself == next_dbv - 1)
-    book, _ = record_versions(
+    book, _, _ = record_versions(
         cst.book, site[:, None], dbv[:, None], w[:, None],
         now=cst.now, keep_rounds=getattr(cfg, "org_keep_rounds", 16),
     )
@@ -289,7 +289,7 @@ def local_write_tx(cfg: SimConfig, cst: CrdtState, tx_mask, tx_cell, tx_val,
         jnp.broadcast_to(dbv[:, None], (n, k)), tx_clp, lane_ok,
     )
 
-    book, _ = record_versions(
+    book, _, _ = record_versions(
         cst.book, iarr[:, None], dbv[:, None], w[:, None],
         now=cst.now, keep_rounds=getattr(cfg, "org_keep_rounds", 16),
     )
@@ -363,7 +363,7 @@ def ingest_changes(cfg, cst: CrdtState, live, m_origin, m_dbv, m_cell, m_ver,
 
     # --- complete (single-cell) versions: record + apply on arrival -----
     single = live & (m_nseq <= 1)
-    book, fresh1 = record_versions(
+    book, fresh1, rec1 = record_versions(
         cst.book, m_origin, m_dbv, single,
         now=cst.now, keep_rounds=getattr(cfg, "org_keep_rounds", 16),
     )
@@ -374,6 +374,7 @@ def ingest_changes(cfg, cst: CrdtState, live, m_origin, m_dbv, m_cell, m_ver,
     cst = cst._replace(store=store, book=book)
 
     fresh = fresh1
+    enq = rec1
     completed = jnp.int32(0)
     if cfg.tx_max_cells > 1:
         # --- chunked versions: buffer, complete, then apply atomically --
@@ -399,18 +400,32 @@ def ingest_changes(cfg, cst: CrdtState, live, m_origin, m_dbv, m_cell, m_ver,
             par.clp.reshape(n, pk),
             lane_ok.reshape(n, pk),
         )
-        book, _ = record_versions(
+        book, _, _ = record_versions(
             book, par.origin, par.dbv, full,
             now=cst.now, keep_rounds=getattr(cfg, "org_keep_rounds", 16),
         )
         par = free_slots(par, full)
         cst = cst._replace(store=store, book=book, partials=par)
         fresh = fresh1 | fresh_m
+        # fragments of chunked versions re-broadcast only from nodes
+        # whose slot tracks the fragment's actor — an unowned fragment
+        # re-buffers and re-reports fresh on every arrival (the freed
+        # partial slot forgets it), so re-enqueueing it would circulate
+        # forever, the same loop the single-cell path gates via ``rec``
+        from corrosion_tpu.ops.versions import org_slot
+
+        _, owned_m = org_slot(book, m_origin)
+        enq = rec1 | (fresh_m & owned_m)
         completed = jnp.sum(full)
 
+    # re-broadcast only RECORDED changes (+ buffered fresh chunks):
+    # unrecorded fresh messages re-report fresh on every arrival, so
+    # re-enqueueing them with a fresh budget would circulate forever
+    # between nodes with mismatched slot ownership (see
+    # versions.record_versions)
     cst = _enqueue(
         cst,
-        fresh,
+        enq,
         m_origin,
         m_dbv,
         m_cell,

@@ -419,10 +419,35 @@ def scale_sim_step(
                 cst.now % (max(1, cfg.sync_interval)
                            * cfg.sync_sweep_every) == 0
             )
+            # the sweep lane pairs UNIFORMLY over the whole id space:
+            # need-driven scoring herds every needy node onto the same
+            # (often unservable) peer where serve-shedding can starve
+            # the backstop, and even a random MEMBER-TABLE draw mixes
+            # only along the frozen partial-view digraph, which can
+            # strand a minority org assignment unreachably. Anti-entropy
+            # may dial any known member (at this scale the reference
+            # effectively knows everyone); uniform pairing gives the
+            # lattice join global mixing. Dead/partitioned peers fail
+            # the link check inside sync_step like any other pair.
+            r_peer = jr.randint(
+                jr.fold_in(k_sp, 1), (n,), 0, n, dtype=jnp.int32
+            )
+            r_valid = r_peer != iarr
+            peers = peers.at[:, 0].set(
+                jnp.where(sweep, r_peer, peers[:, 0])
+            )
+            p_ok = p_ok.at[:, 0].set(
+                jnp.where(sweep, r_valid, p_ok[:, 0])
+            )
         cst, s_ok, s_info = sync_step(
             cfg, cst, peers, p_ok, swim.alive, net, k_sync,
             go_all=cfg.sync_cohort, sweep=sweep,
         )
+        if sweep is not None:
+            # lane 0 synced the RANDOM sweep peer on sweep rounds, not
+            # the scored candidate synced_slots maps back to — don't
+            # reset the displaced candidate's staleness
+            s_ok = s_ok.at[:, 0].set(s_ok[:, 0] & ~sweep)
         synced_slots = select_cols(cand_slots, c_idx)
         # zeros in the plane's own dtype: both lax.cond branches must
         # carry last_sync at the same (possibly narrowed) dtype

@@ -179,7 +179,7 @@ def sync_step(
     evictable = (cst.book.org_id < 0) | (
         cst.book.org_last + keep < now
     )  # [N, O]
-    claim0 = (
+    claim_plain = (
         ok[:, 0, None]
         & evictable
         & (org_p[:, 0, :] >= 0)
@@ -189,6 +189,24 @@ def sync_step(
         # zero data
         & (head_p[:, 0, :] > 0)
     )  # [N, O]
+    if sweep is not None:
+        # sweep rounds: idle slots take a deterministic LATTICE JOIN
+        # with the peer's entry — the larger actor id wins the class
+        # (same rule on every node ⇒ org assignments converge
+        # epidemically during quiescence), and the adopted head rides
+        # the full-head grant below, backed by the full-store merge.
+        # Without this, a cluster whose distinct active actors exceed
+        # the slot table can never align its books: every node tracks
+        # whichever actors it heard last, and needs stay positive
+        # forever even though stores are long equal.
+        claim_sweep = (
+            ok[:, 0, None]
+            & evictable
+            & (org_p[:, 0, :] > cst.book.org_id)
+        )
+        claim0 = jnp.where(sweep, claim_sweep, claim_plain)
+    else:
+        claim0 = claim_plain
     org_id2 = jnp.where(claim0, org_p[:, 0, :], cst.book.org_id)
     head_i = jnp.where(claim0, 0, cst.book.head)  # [N, O]
     book0 = cst.book._replace(
@@ -207,6 +225,19 @@ def sync_step(
     )
     granted = jnp.minimum(head_p, head_i[:, None, :] + chunk_eff[:, :, None])
     granted = jnp.where(match, granted, 0)  # [N, P, O]
+    if sweep is not None:
+        # a sweep round's lane-0 FULL-store merge reflects every effect
+        # of every version the peer has seen, so adopting the peer's
+        # whole head for org-matched slots is safe (a re-delivery of a
+        # version <= that head is either already reflected or loses the
+        # LWW compare) — and it is what un-wedges bookkeeping after
+        # evictions: versions whose changesets expired from every queue
+        # can never close head gaps by re-delivery, only by this
+        # head adoption (the reference's SyncStateV1 head exchange)
+        g0 = jnp.where(
+            match[:, 0, :] & sweep, head_p[:, 0, :], granted[:, 0, :]
+        )
+        granted = granted.at[:, 0, :].set(g0)
 
     # --- transfer: masked elementwise merge per peer --------------------
     store = tuple(p.astype(jnp.int32) for p in cst.store)
@@ -276,16 +307,29 @@ def sync_step(
     # the head jump goes through raise_heads: the seen window is
     # head-relative and must be rebased alongside the jump
     new_head = jnp.maximum(head_i, jnp.max(granted, axis=1))
-    km_p = jax.lax.optimization_barrier(
-        cst.book.known_max[peers]
-    )  # [N, P, O]
-    # known_max is per-slot bookkeeping: only org-matched slots teach
-    km_p = jnp.where(match, km_p, 0)
-    new_km = jnp.maximum(book0.known_max, jnp.max(km_p, axis=1))
+    # NO known_max exchange here (round 4): km is hearsay, and a
+    # max-exchange ratchets it through the population faster than the
+    # sweep's collapse can drain it — with bounded books, versions whose
+    # bookkeeping was evicted everywhere would then show as needs
+    # forever. km stays what this node actually observed (message dbvs
+    # on owned slots + its own writes + the sweep frontier); grants
+    # never used peer km anyway (they clamp against head_p), and sync
+    # peer scoring still ranks by the locally-known need.
     book = raise_heads(book0, new_head)
-    book = advance_heads(
-        book._replace(known_max=jnp.maximum(book.known_max, new_km))
-    )
+    book = advance_heads(book)
+    if sweep is not None:
+        # sweep collapses hearsay: after adopting the peer's full head
+        # (backed by the full-store merge), known_max above it is
+        # unverifiable rumor — in the over-capacity regime the books
+        # that actually saw those versions were evicted, so no head can
+        # ever reach the rumored max and needs would stay positive
+        # forever. Collapse to the verifiable frontier (the advanced
+        # head); real circulating changesets re-teach km if the
+        # versions still exist anywhere.
+        km_collapse = sweep & ok[:, 0, None] & match[:, 0, :]
+        book = book._replace(
+            known_max=jnp.where(km_collapse, book.head, book.known_max)
+        )
     # versions that arrived whole through sync obsolete their buffered
     # fragments (the buffered-meta GC analog, util.rs:430-490)
     if cst.partials.origin.shape[1] > 1 or cst.partials.cell.shape[2] > 1:

@@ -62,7 +62,7 @@ def test_native_matches_jax_book():
         ver = rng.integers(1, 30, 8).astype(np.int32)
         for o, v in zip(origin, ver):
             nat.record(int(o), int(v))
-        book, _ = record_versions(
+        book, _, _ = record_versions(
             book, jnp.asarray(origin)[None, :], jnp.asarray(ver)[None, :],
             jnp.ones((1, 8), bool),
         )

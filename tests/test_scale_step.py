@@ -438,3 +438,44 @@ def test_colliding_active_writers_store_converges_via_sweep():
     assert bool(m["converged"]), f"diverged: {int(m['n_diverged'])}"
     assert int(st.crdt.store[1][20, 1]) == 1029
     assert int(st.crdt.store[1][20, 2]) == 2029
+
+
+def test_flagship_combination_narrow_pig_anywriter_fused():
+    """The full bench configuration in one: narrow dtypes + bounded
+    piggyback + unbounded writers, fused == unfused, and converges."""
+    from corrosion_tpu.ops import megakernel
+
+    cfg = scale_sim_config(
+        32, m_slots=8, n_origins=4, n_rows=4, n_cols=2, sync_interval=4,
+        pig_members=4, narrow_dtypes=True, org_keep_rounds=4,
+    )
+    assert cfg.any_writer and cfg.narrow_dtypes and cfg.pig_members
+    net = NetModel.create(cfg.n_nodes, drop_prob=0.02)
+    rounds = 24
+    inp = quiet_inputs(cfg, rounds)
+    n = cfg.n_nodes
+    k1, k2, k3 = jr.split(jr.key(10), 3)
+    w = jr.uniform(k1, (rounds, n)) < 0.2  # writers across the id space
+    inp = inp._replace(
+        write_mask=w,
+        write_cell=jr.randint(k2, (rounds, n), 0, cfg.n_cells,
+                              dtype=jnp.int32),
+        write_val=jr.randint(k3, (rounds, n), 1, 1 << 15, dtype=jnp.int32),
+    )
+    old = megakernel.FORCE_FUSED
+    try:
+        megakernel.FORCE_FUSED = True
+        st_f, _ = run(cfg, ScaleSimState.create(cfg), net, jr.key(11), inp)
+        megakernel.FORCE_FUSED = False
+        st_u, _ = run(cfg, ScaleSimState.create(cfg), net, jr.key(11), inp)
+    finally:
+        megakernel.FORCE_FUSED = old
+    for a, b in zip(jax.tree.leaves(st_f), jax.tree.leaves(st_u)):
+        assert jnp.array_equal(a, b), "flagship-combination fused diverged"
+    # drain and converge (on the unfused state; they are equal anyway).
+    # 300 rounds: the over-capacity regime converges its books through
+    # sweep-lane lattice joins, whose uniform pairing mixes in O(N)
+    # sweeps — slower than range-grant sync but unconditional
+    st_u, _ = run(cfg, st_u, net, jr.key(12), quiet_inputs(cfg, 300))
+    m = scale_crdt_metrics(cfg, st_u)
+    assert bool(m["converged"]), f"diverged: {int(m['n_diverged'])}"

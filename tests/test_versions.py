@@ -20,7 +20,7 @@ def run_rounds(rng, n_nodes, n_origins, slots, batch, rounds, max_ver=20):
         origin = rng.integers(0, n_origins, (n_nodes, batch))
         ver = rng.integers(1, max_ver, (n_nodes, batch))
         valid = rng.random((n_nodes, batch)) < 0.7
-        book, fresh = record_versions(
+        book, fresh, _ = record_versions(
             book,
             jnp.asarray(origin, jnp.int32),
             jnp.asarray(ver, jnp.int32),
@@ -61,7 +61,7 @@ def test_contiguous_delivery_keeps_buffer_empty():
         origin = jnp.zeros((n_nodes, 2), jnp.int32)
         ver = jnp.full((n_nodes, 2), v, jnp.int32)
         valid = jnp.asarray([[True, True]] * n_nodes)  # duplicate in batch
-        book, fresh = record_versions(book, origin, ver, valid)
+        book, fresh, _ = record_versions(book, origin, ver, valid)
         assert np.asarray(fresh)[:, 0].all() and not np.asarray(fresh)[:, 1].any()
     assert (np.asarray(book.head)[:, 0] == 5).all()
     assert (np.asarray(book.seen) == 0).all()
@@ -72,13 +72,13 @@ def test_gap_then_close_advances_head_in_one_pass():
     o = jnp.zeros((1, 4), jnp.int32)
     # versions 2,3,5 arrive first: head stays 0, needs = 3 (1,2,3 missing? no:
     # known_max=5, seen={2,3,5} → missing {1,4} → needs 2)
-    book, _ = record_versions(
+    book, _, _ = record_versions(
         book, o[:, :3], jnp.asarray([[2, 3, 5]], jnp.int32), jnp.ones((1, 3), bool)
     )
     assert int(book.head[0, 0]) == 0
     assert int(needs_count(book)[0, 0]) == 2
     # 1 and 4 arrive: whole chain 1..5 must collapse in one record call
-    book, _ = record_versions(
+    book, _, _ = record_versions(
         book, o[:, :2], jnp.asarray([[4, 1]], jnp.int32), jnp.ones((1, 2), bool)
     )
     assert int(book.head[0, 0]) == 5
