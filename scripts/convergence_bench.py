@@ -134,6 +134,11 @@ def main():
     records = []
     for n in sizes:
         rec = run_one(n)
+        # one process compiles several whole-cluster programs; without
+        # dropping the in-memory executables between sizes the next
+        # LLVM compile can die with "Cannot allocate memory" (observed
+        # at the 4096 compile after 256+1024)
+        jax.clear_caches()
         records.append(rec)
         print(json.dumps(rec), flush=True)
         if out_path:  # flush after every size — tunnel runs die mid-way
