@@ -130,8 +130,11 @@ class ScaleSimConfig:
     # mem_tx, q_cell, q_seq, q_nseq, q_tx, last_sync) live as int16 in
     # HBM; compute widens freely (XLA fuses the converts) and the round
     # step re-narrows once on carry-out — the scan carry (the HBM
-    # working set between rounds) halves for those planes
-    narrow_dtypes: bool = False
+    # working set between rounds) halves for those planes. Default ON
+    # (round 4): narrow == wide is pinned bit-for-bit, the CPU A/B
+    # favors it slightly, and the TPU traffic model halves those
+    # planes' HBM bytes; BENCH_NARROW=0 measures the wide arm
+    narrow_dtypes: bool = True
 
     @property
     def n_cells(self) -> int:

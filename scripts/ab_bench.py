@@ -8,9 +8,9 @@ timed reps round-robin so drift hits every arm equally; per-arm medians
 of per-rep throughput are robust to one-off stalls.
 
 Usage: python scripts/ab_bench.py [n_nodes] [reps]
-Arms: default, pig16 (bounded piggyback), pull10 (pull = score pool,
-i.e. the pre-cut sync width), and narrow (when the config grows
-``narrow_dtypes``). Writes one JSON line per arm plus a summary line to
+Arms: default (narrow int16 planes since round 4), pig16 (bounded
+piggyback), pull10 (pull = score pool, i.e. the pre-cut sync width),
+and wide (int32 planes — the pre-narrowing baseline). Writes one JSON line per arm plus a summary line to
 stdout and ``artifacts/AB_BENCH_r04.jsonl``.
 """
 
@@ -60,7 +60,9 @@ def main() -> None:
     )
     if any(f.name == "narrow_dtypes"
            for f in dataclasses.fields(type(base))):
-        arm_cfgs["narrow"] = dataclasses.replace(base, narrow_dtypes=True)
+        # narrow is the default since round 4 — the experiment arm is
+        # the WIDE int32 baseline
+        arm_cfgs["wide"] = dataclasses.replace(base, narrow_dtypes=False)
 
     out_path = os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),

@@ -205,7 +205,7 @@ def test_narrow_dtypes_matches_wide_exactly():
 
     base = scale_sim_config(
         48, m_slots=16, n_origins=4, n_rows=4, n_cols=2, sync_interval=4,
-        pig_members=4,
+        pig_members=4, narrow_dtypes=False,  # pin the wide arm
     )
     narrow = dataclasses.replace(base, narrow_dtypes=True).validate()
     assert narrow.timer_dtype == jnp.int16
@@ -259,7 +259,7 @@ def test_narrow_dtypes_fused_matches_unfused():
 
     base = scale_sim_config(
         32, m_slots=8, n_origins=4, n_rows=4, n_cols=2, sync_interval=4,
-        pig_members=4,
+        pig_members=4, narrow_dtypes=False,  # pin the wide arm
     )
     narrow = dataclasses.replace(base, narrow_dtypes=True).validate()
     net = NetModel.create(base.n_nodes, drop_prob=0.02)
