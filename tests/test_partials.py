@@ -232,3 +232,61 @@ def test_transaction_parity_under_drop():
     assert taken > 0, "sim failed to converge under drop"
     problems = check_bitwise_parity(oc, planes, alive)
     assert not problems, "\n".join(problems)
+
+
+def test_unowned_chunked_fragments_apply_but_do_not_rebroadcast():
+    """Round-4 circulation gate for chunked versions: fragments from an
+    actor whose hash slot is held by a DIFFERENT active actor still
+    apply (after completion) but must not re-enqueue — the freed
+    partial slot forgets them, so re-enqueueing with a fresh budget
+    would circulate them forever (review r4)."""
+    import jax.numpy as jnp
+
+    from corrosion_tpu.sim.broadcast import (
+        NO_Q,
+        CrdtState,
+        ingest_changes,
+        local_write,
+    )
+    from corrosion_tpu.sim.config import SimConfig
+
+    cfg = SimConfig(
+        n_nodes=4, n_origins=2, any_writer=True, org_keep_rounds=1000,
+        n_rows=4, n_cols=2, tx_max_cells=2, partial_slots=4,
+        bcast_queue=8,
+    ).validate()
+    cst = CrdtState.create(cfg)
+    # keep slot 0 of every node ACTIVE for actor 0 so actor 2 (2 % 2 ==
+    # 0, same class) can never claim it: a write from node 0 this round
+    w = jnp.asarray([True, False, False, False])
+    cst = cst._replace(now=cst.now + 1)
+    cst = local_write(cfg, cst, w, jnp.zeros(4, jnp.int32),
+                      jnp.full(4, 7, jnp.int32))
+    queued_before = int(jnp.sum(cst.q_origin != NO_Q))
+
+    # two fragments of actor 2's chunked version (dbv 1, seq 0/1 of 2)
+    # delivered to node 1 — origin 2 hashes to the (actively held) slot 0
+    live = jnp.zeros((4, 2), bool).at[1, :].set(True)
+    f = lambda a, b: jnp.zeros((4, 2), jnp.int32).at[1, 0].set(a).at[1, 1].set(b)  # noqa: E731
+    cst2, info = ingest_changes(
+        cfg, cst, live,
+        m_origin=f(2, 2), m_dbv=f(1, 1), m_cell=f(2, 3), m_ver=f(1, 1),
+        m_val=f(41, 42), m_site=f(2, 2), m_clp=f(1, 1),
+        m_seq=f(0, 1), m_nseq=f(2, 2), m_ts=f(0, 0),
+    )
+    # the completed transaction applied to node 1's store...
+    assert int(cst2.store[1][1, 2]) == 41
+    assert int(cst2.store[1][1, 3]) == 42
+    # ...but nothing new entered node 1's broadcast queue
+    assert int(jnp.sum(cst2.q_origin[1] != NO_Q)) == 0
+    # and the bookkeeping slot still tracks actor 0
+    assert int(cst2.book.org_id[1, 0]) == 0
+
+    # control: the same fragments from the OWNED actor 0 do re-enqueue
+    cst3, _ = ingest_changes(
+        cfg, cst, live,
+        m_origin=f(0, 0), m_dbv=f(1, 1), m_cell=f(2, 3), m_ver=f(1, 1),
+        m_val=f(41, 42), m_site=f(0, 0), m_clp=f(1, 1),
+        m_seq=f(0, 1), m_nseq=f(2, 2), m_ts=f(0, 0),
+    )
+    assert int(jnp.sum(cst3.q_origin[1] != NO_Q)) == 2
