@@ -375,15 +375,17 @@ def _split_expr_alias(raw: str) -> Tuple[str, Optional[str]]:
 
 def _split_top_kw(s: str, kw: str) -> List[str]:
     """Split on a top-level keyword (``AND``/``OR``) only — occurrences
-    inside parens (subqueries, groups) or strings don't count."""
-    parts, start, depth, in_str = [], 0, 0, False
+    inside parens (subqueries, groups), strings, or quoted identifiers
+    don't count (ADVICE r4: ``"a or b"`` must not split)."""
+    parts, start, depth, in_str = [], 0, 0, ""
     i, n, k = 0, len(s), len(kw)
     while i < n:
         ch = s[i]
         if in_str:
-            in_str = ch != "'"
-        elif ch == "'":
-            in_str = True
+            if ch == in_str:
+                in_str = ""
+        elif ch in ("'", '"'):
+            in_str = ch
         elif ch == "(":
             depth += 1
         elif ch == ")":
@@ -412,12 +414,13 @@ def _is_paren_group(s: str) -> bool:
     s = s.strip()
     if not (s.startswith("(") and s.endswith(")")):
         return False
-    depth, in_str = 0, False
+    depth, in_str = 0, ""
     for i, ch in enumerate(s):
         if in_str:
-            in_str = ch != "'"
-        elif ch == "'":
-            in_str = True
+            if ch == in_str:
+                in_str = ""
+        elif ch in ("'", '"'):
+            in_str = ch
         elif ch == "(":
             depth += 1
         elif ch == ")":
@@ -1383,6 +1386,11 @@ class Database:
             if op == "not":
                 r = eval_conj(lhs)
                 return None if r is None else not r
+            if not isinstance(lhs, str):
+                # a parsed expression node (('\x00expr', fn) tuple) —
+                # arbitrary expressions aren't supported on a HAVING
+                # left side; fail as a SqlError, not a TypeError
+                raise SqlError("unsupported HAVING left side (expression)")
             am = _AGG_RE.match(lhs)
             if am:
                 fn = am.group("fn").upper()

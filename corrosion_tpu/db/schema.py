@@ -265,6 +265,7 @@ class RowMap:
         self.n_rows = n_rows
         self._rows: Dict[Tuple[str, object], int] = {}
         self._by_table: Dict[str, List[Tuple[object, int]]] = {}
+        self._by_row: Dict[int, Tuple[str, object]] = {}
         self._next = 0
         self._mu = threading.Lock()
 
@@ -284,7 +285,16 @@ class RowMap:
                 self._next += 1
                 self._rows[(table, pk)] = row
                 self._by_table.setdefault(table, []).append((pk, row))
+                self._by_row[row] = (table, pk)
             return row
+
+    def table_pk_of(self, row: int) -> Optional[Tuple[str, object]]:
+        """Reverse lookup: grid row -> (table, pk). Used by the
+        incremental subscription matcher to turn applied cell deltas
+        into candidate pks (the ``match_changes`` seam,
+        ``pubsub.rs:527-1100``)."""
+        with self._mu:
+            return self._by_row.get(row)
 
     def rows_of(self, table: str) -> List[Tuple[object, int]]:
         with self._mu:

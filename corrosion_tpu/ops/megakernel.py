@@ -39,6 +39,9 @@ FORCE_FUSED: Optional[bool] = None
 
 _pallas_ok_cache: dict = {}  # backend -> tiny differential probes passed
 _width_ok_cache: dict = {}  # (backend, kernel, shape key) -> lowers + runs
+# jax._src.core.trace_state_clean, resolved once on first use; False
+# once the private API is found missing (thread path used from then on)
+_trace_state_clean = None
 
 
 def _eager(fn):
@@ -53,12 +56,21 @@ def _eager(fn):
     the pallas kernel's own tracing and turns every kernel-internal
     array creation into a captured constant. Trace state is
     thread-local, so a fresh thread gives a genuinely clean context."""
-    try:
-        from jax._src import core as _core
+    global _trace_state_clean
+    if _trace_state_clean is None:
+        # resolve the private helper ONCE (ADVICE r4): if JAX removes or
+        # renames it, we record the miss and every probe call takes the
+        # (correct, slightly slower) thread path without re-importing
+        try:
+            from jax._src import core as _core
 
-        clean = _core.trace_state_clean()
-    except Exception:  # noqa: BLE001 — private API; the thread path is
-        clean = False  # always correct, so assume dirty if it's gone
+            _trace_state_clean = _core.trace_state_clean
+        except Exception:  # noqa: BLE001 — private API gone
+            _trace_state_clean = False
+    try:
+        clean = bool(_trace_state_clean and _trace_state_clean())
+    except Exception:  # noqa: BLE001 — behave as if dirty
+        clean = False
     if clean:
         return fn()
     import threading

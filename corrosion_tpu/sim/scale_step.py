@@ -537,9 +537,18 @@ def scale_crdt_metrics(cfg: ScaleSimConfig, st: ScaleSimState):
     no_needs = jnp.all(needs <= 0, axis=1)
     ok = (~alive) | (same_store & same_head & no_needs)
     swim_m = {f"swim_{k}": v for k, v in scale_swim_metrics(st.swim).items()}
+    # observability for the slots the head comparison skips (ADVICE r4):
+    # misaligned slots still must have needs==0 (no_needs covers every
+    # slot), but a persistently low alignment fraction would mean books
+    # silently tracking different actors — surface it in the metrics
+    alive_slots = jnp.sum(alive.astype(jnp.float32)) * aligned.shape[1]
+    org_aligned_frac = jnp.sum(
+        (aligned & alive[:, None]).astype(jnp.float32)
+    ) / jnp.maximum(alive_slots, 1.0)
     return {
         "converged": jnp.all(ok),
         "n_diverged": jnp.sum(~ok),
         "total_needs": jnp.sum(jnp.where(alive[:, None], jnp.maximum(needs, 0), 0)),
+        "org_aligned_frac": org_aligned_frac,
         **swim_m,
     }

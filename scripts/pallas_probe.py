@@ -3,7 +3,7 @@ real backend, at the flagship block shapes — VERDICT r3 weak #6: every
 fused==unfused differential has only ever run in interpret mode on CPU;
 ``_pallas_works()`` has never returned on a real axon/TPU backend.
 
-Writes ONE json line to stdout and to ``artifacts/PALLAS_PROBE_r04.json``
+Writes ONE json line to stdout and to ``artifacts/PALLAS_PROBE_r05.json``
 recording, per kernel, whether the tiny differential and the real-block-
 shape width probes passed, so the round has a committed artifact either
 way (a lowering failure is a result, not a missing measurement).
@@ -43,7 +43,7 @@ def main() -> None:
     }
 
     out = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "artifacts", "PALLAS_PROBE_r04.json")
+        os.path.abspath(__file__))), "artifacts", "PALLAS_PROBE_r05.json")
 
     def checkpoint() -> None:
         """Write after every probe step: backend init / a probe hang +

@@ -581,6 +581,29 @@ def test_having_or(rich_db):
     assert list(rows) == [[2]]
 
 
+def test_quoted_identifier_with_keyword(rich_db):
+    # ADVICE r4: a double-quoted identifier containing ' OR '/' AND '
+    # must not mis-split the WHERE clause (sqlite3 resolves unknown
+    # double-quoted identifiers as strings; we require the split to stay
+    # whole — "pname" is a real column here, so this is pure splitting)
+    _, rows = rich_db.query(
+        0, 'SELECT pname FROM players WHERE "pname" = \'a\' OR '
+           '"pname" = \'b\' ORDER BY pname')
+    assert list(rows) == [["a"], ["b"]]
+
+
+def test_having_expression_lhs_is_sql_error(rich_db):
+    # ADVICE r4: an expression left side in HAVING raises SqlError, not
+    # TypeError
+    from corrosion_tpu.db.database import SqlError
+
+    with pytest.raises(SqlError):
+        _, rows = rich_db.query(
+            0, "SELECT team FROM players GROUP BY team "
+               "HAVING score + 1 > 5")
+        list(rows)  # rows are lazy; evaluation raises on consumption
+
+
 def test_or_in_join_and_subquery(rich_db):
     # consul/template-style service query through the relational surface
     _, rows = rich_db.query(
