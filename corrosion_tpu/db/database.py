@@ -487,13 +487,13 @@ def _parse_literal(tok: str, params: _Params) -> Any:
 
 def _split_top_commas(s: str) -> List[str]:
     parts, depth, start = [], 0, 0
-    in_str = False
+    in_str = ""
     for i, ch in enumerate(s):
         if in_str:
-            if ch == "'":
-                in_str = False
-        elif ch == "'":
-            in_str = True
+            if ch == in_str:
+                in_str = ""
+        elif ch in ("'", '"'):
+            in_str = ch
         elif ch == "(":
             depth += 1
         elif ch == ")":
@@ -756,6 +756,24 @@ class Database:
         names = [c[2] for c in ast["cols"]]
         return names, self._run_select(node, ast)
 
+    def query_filtered(self, node: int, sql: str, params: Any,
+                       extra_in: Sequence[Tuple[str, list]]
+                       ) -> Iterable[List[Any]]:
+        """Run ``sql`` with extra top-level ``alias.col IN (...)``
+        conjuncts injected after parsing — the incremental subscription
+        matcher's candidate-pk restriction (the analog of the
+        reference's per-changeset candidate queries against the
+        subscription DB, ``pubsub.rs:527-1100``). ``extra_in`` holds
+        ``("alias.col", [values...])`` pairs; rows are returned without
+        column names (the caller knows the projection)."""
+        ast = self._parse_select(sql, _Params(params))
+        ast = {
+            **ast,
+            "conds": list(ast["conds"])
+            + [("in", key, list(vals)) for key, vals in extra_in],
+        }
+        return self._run_select(node, ast)
+
     def query_columns(self, sql: str) -> List[str]:
         """The column names a SELECT would produce — schema-only, no
         scan (used by the PG Describe phase)."""
@@ -765,14 +783,15 @@ class Database:
     # --- SELECT parsing ---------------------------------------------------
     @staticmethod
     def _top_level_mask(sql: str) -> List[bool]:
-        """True where a char sits outside quotes and parens."""
-        mask, depth, in_str = [], 0, False
+        """True where a char sits outside quotes (both kinds) and parens."""
+        mask, depth, in_str = [], 0, ""
         for ch in sql:
             if in_str:
                 mask.append(False)
-                in_str = ch != "'"
-            elif ch == "'":
-                in_str = True
+                if ch == in_str:
+                    in_str = ""
+            elif ch in ("'", '"'):
+                in_str = ch
                 mask.append(False)
             elif ch == "(":
                 depth += 1

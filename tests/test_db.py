@@ -592,6 +592,17 @@ def test_quoted_identifier_with_keyword(rich_db):
     assert list(rows) == [["a"], ["b"]]
 
 
+def test_quoted_identifier_in_projection_and_clauses(rich_db):
+    # code review r5: comma/keyword inside a double-quoted identifier
+    # must not split the projection or start a clause
+    _, rows = rich_db.query(
+        0, 'SELECT "pname" FROM players WHERE "pname" = \'a\'')
+    assert list(rows) == [["a"]]
+    from corrosion_tpu.db.database import _split_top_commas, _split_top_kw
+    assert _split_top_commas('"a, b", c') == ['"a, b"', "c"]
+    assert _split_top_kw('"a where b" = 1', "WHERE") == ['"a where b" = 1']
+
+
 def test_having_expression_lhs_is_sql_error(rich_db):
     # ADVICE r4: an expression left side in HAVING raises SqlError, not
     # TypeError
