@@ -520,6 +520,23 @@ class Database:
         self.n_cols = agent.cfg.n_cols
         self._mu = threading.Lock()
         self._write_hooks: List = []  # pubsub/updates change hooks
+        # open StagedTxs (weak: an abandoned tx drops out on GC) — their
+        # planned value ids are pinned against heap compaction
+        import weakref
+
+        self._open_txs = weakref.WeakSet()
+        self._delta_tracker = None  # shared per-round delta cache
+
+    def delta_tracker(self):
+        """The shared :class:`~corrosion_tpu.pubsub.DeltaTracker` for
+        this database — one plane baseline + one per-round delta
+        computation, shared by subscriptions and updates feeds."""
+        with self._mu:
+            if self._delta_tracker is None:
+                from corrosion_tpu.pubsub import DeltaTracker
+
+                self._delta_tracker = DeltaTracker(self)
+            return self._delta_tracker
 
     # --- schema ----------------------------------------------------------
     def apply_schema_sql(self, sql: str) -> List[Tuple[str, str]]:
@@ -601,6 +618,58 @@ class Database:
             for hook in self._write_hooks:
                 hook(node, *note)
         return results
+
+    # --- heap compaction (vacuum_db analog, handlers.rs:398-452) ---------
+    def referenced_value_ids(self) -> set:
+        """Every heap id referenced by device state anywhere: the store
+        value planes of all nodes, in-flight broadcast queue payloads,
+        and buffered partial-version payloads. The union is the live set
+        a heap compaction must preserve."""
+        import numpy as np
+
+        st = self.agent.device_state()
+        crdt = getattr(st, "crdt", st)
+        refs: set = set()
+        arrays = [np.asarray(crdt.store[1])]
+        q_val = getattr(crdt, "q_val", None)
+        if q_val is not None:
+            # freed queue slots (origin -1) keep stale payload bytes —
+            # mask them to NULL or old ids would stay referenced forever
+            live = np.asarray(crdt.q_origin) >= 0
+            arrays.append(np.where(live, np.asarray(q_val), 0))
+        partials = getattr(crdt, "partials", None)
+        if partials is not None:
+            live = (np.asarray(partials.origin) >= 0)[..., None]
+            arrays.append(np.where(live, np.asarray(partials.val), 0))
+        for a in arrays:
+            refs.update(int(x) for x in np.unique(a))
+        # ids planned inside open (uncommitted) StagedTxs live only on
+        # the host until COMMIT — pin them (code review r5: an idle PG
+        # BEGIN block outliving the grace window must not lose values)
+        for tx in list(self._open_txs):
+            if not tx._done:
+                # snapshot: the PG handler thread mutates _merged
+                # concurrently with this maintenance-thread scan
+                refs.update(v for v, _l in list(tx._merged.values()))
+        return refs
+
+    def compact_heap(self, grace_seconds: float = 60.0) -> int:
+        """One heap-compaction pass: free ids referenced nowhere in
+        device state (ids are stable — unreferenced ones go to a free
+        list for reuse, device planes are never rewritten). The grace
+        window protects writes planned on the host but not yet applied
+        on device. Returns the number of ids freed."""
+        return self.heap.compact(self.referenced_value_ids(),
+                                 grace_seconds=grace_seconds)
+
+    def begin(self, node: int) -> "StagedTx":
+        """Open a multi-statement staged transaction at ``node`` — the
+        PG-wire BEGIN/COMMIT surface (``corro-pg/src/lib.rs`` runs real
+        SQLite transactions; here statements are planned eagerly against
+        a shared overlay, so later statements read earlier writes and
+        per-statement row counts are exact, and nothing reaches the
+        round loop until :meth:`StagedTx.commit`)."""
+        return StagedTx(self, node)
 
     def _order_tx_cells(self, merged: Dict[int, Tuple[int, int]]
                         ) -> List[Tuple[int, int, int]]:
@@ -757,21 +826,19 @@ class Database:
         return names, self._run_select(node, ast)
 
     def query_filtered(self, node: int, sql: str, params: Any,
-                       extra_in: Sequence[Tuple[str, list]]
+                       extra_conds: Sequence[tuple]
                        ) -> Iterable[List[Any]]:
-        """Run ``sql`` with extra top-level ``alias.col IN (...)``
-        conjuncts injected after parsing — the incremental subscription
-        matcher's candidate-pk restriction (the analog of the
-        reference's per-changeset candidate queries against the
-        subscription DB, ``pubsub.rs:527-1100``). ``extra_in`` holds
-        ``("alias.col", [values...])`` pairs; rows are returned without
-        column names (the caller knows the projection)."""
+        """Run ``sql`` with extra top-level cond tuples injected after
+        parsing — the incremental subscription matcher's candidate-pk
+        restriction (the analog of the reference's per-changeset
+        candidate queries against the subscription DB,
+        ``pubsub.rs:527-1100``). ``extra_conds`` holds evaluator cond
+        tuples over resolved record keys, e.g. ``("in", "a.pk", [...])``
+        or an ``("or", [branches...], None)`` disjunction of them; rows
+        are returned without column names (the caller knows the
+        projection)."""
         ast = self._parse_select(sql, _Params(params))
-        ast = {
-            **ast,
-            "conds": list(ast["conds"])
-            + [("in", key, list(vals)) for key, vals in extra_in],
-        }
+        ast = {**ast, "conds": list(ast["conds"]) + list(extra_conds)}
         return self._run_select(node, ast)
 
     def query_columns(self, sql: str) -> List[str]:
@@ -1626,3 +1693,61 @@ class Database:
             self.schema = parse_schema_sql(state["schema_sql"])
             self.heap = ValueHeap.from_state_dict(state["heap"])
             self.rows = RowMap.from_state_dict(state["rows"])
+
+
+class StagedTx:
+    """A buffered multi-statement transaction (PG ``BEGIN``/``COMMIT``).
+
+    Statements are planned eagerly — ``execute()`` runs the same
+    ``_plan_write`` path as :meth:`Database.execute`, against a
+    transaction-local overlay, so each statement's row count is exact
+    and later statements observe earlier writes. Nothing is visible to
+    the cluster (or to reads outside the tx) until :meth:`commit`
+    stages the net cell writes into one round-loop transaction;
+    :meth:`rollback` discards everything. Mirrors the reference's PG
+    server running real SQLite txs over the corrosion write path
+    (``corro-pg/src/lib.rs``)."""
+
+    def __init__(self, db: Database, node: int):
+        self.db = db
+        self.node = node
+        self._merged: Dict[int, Tuple[int, int]] = {}
+        self._notes: List[tuple] = []
+        self._results: List[ExecResult] = []
+        self._done = False
+        db._open_txs.add(self)  # pin planned value ids vs compaction
+
+    def execute(self, sql: str, params: Any = None) -> ExecResult:
+        if self._done:
+            raise SqlError("transaction already finished")
+        t0 = time.perf_counter()
+        affected, cells, notes = self.db._plan_write(
+            self.node, sql, params, self._merged
+        )
+        self._merged.update({c: (v, l) for c, v, l in cells})
+        self._notes.extend(notes)
+        res = ExecResult(rows_affected=affected,
+                         time=time.perf_counter() - t0)
+        self._results.append(res)
+        return res
+
+    def commit(self, wait: bool = True, timeout: float = 30.0
+               ) -> List[ExecResult]:
+        if self._done:
+            raise SqlError("transaction already finished")
+        self._done = True
+        self.db._open_txs.discard(self)
+        cells = self.db._order_tx_cells(self._merged)
+        if cells:
+            self.db.agent.write_many(self.node, cells, wait=wait,
+                                     timeout=timeout)
+        for note in self._notes:
+            for hook in self.db._write_hooks:
+                hook(self.node, *note)
+        return self._results
+
+    def rollback(self) -> None:
+        self._done = True
+        self.db._open_txs.discard(self)
+        self._merged.clear()
+        self._notes.clear()

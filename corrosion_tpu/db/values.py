@@ -19,12 +19,26 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from typing import Any, Optional
 
 NULL_ID = 0
 
 # type tags for serialization
 _T_NULL, _T_INT, _T_REAL, _T_TEXT, _T_BLOB = "n", "i", "r", "t", "b"
+_T_FREE = "f"  # compacted hole (id awaiting reuse)
+
+
+class _Free:
+    """Sentinel marking a compacted heap slot."""
+
+    __slots__ = ()
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return "<freed>"
+
+
+_FREE = _Free()
 
 
 def _key(value: Any):
@@ -45,11 +59,20 @@ def _key(value: Any):
 
 
 class ValueHeap:
-    """Thread-safe append-only value interning table. Id 0 is NULL."""
+    """Thread-safe value interning table with compaction. Id 0 is NULL.
+
+    Ids are STABLE for the lifetime of their value: :meth:`compact`
+    (the ``vacuum_db`` analog, ``handlers.rs:398-452``) never remaps —
+    it frees ids no longer referenced anywhere (device store planes,
+    in-flight queue/partial buffers) onto a free list that later
+    :meth:`intern` calls reuse, so device state is never rewritten."""
 
     def __init__(self):
         self._values: list = [None]
         self._ids: dict = {(_T_NULL,): NULL_ID}
+        self._free: list = []  # compacted ids awaiting reuse (LIFO)
+        self._touch: dict = {}  # vid -> monotonic ts of last intern()
+        self._freed_total = 0
         self._mu = threading.Lock()
 
     def intern(self, value: Any) -> int:
@@ -57,28 +80,78 @@ class ValueHeap:
         with self._mu:
             vid = self._ids.get(k)
             if vid is None:
-                vid = len(self._values)
-                if vid >= (1 << 31):
-                    raise OverflowError("value heap exceeded int32 id space")
-                self._values.append(
-                    bytes(value) if isinstance(value, bytearray) else value
-                )
+                if self._free:
+                    vid = self._free.pop()
+                    self._values[vid] = (
+                        bytes(value) if isinstance(value, bytearray)
+                        else value
+                    )
+                else:
+                    vid = len(self._values)
+                    if vid >= (1 << 31):
+                        raise OverflowError(
+                            "value heap exceeded int32 id space")
+                    self._values.append(
+                        bytes(value) if isinstance(value, bytearray)
+                        else value
+                    )
                 self._ids[k] = vid
+            self._touch[vid] = time.monotonic()
             return vid
 
     def lookup(self, vid: int) -> Any:
         if vid == NULL_ID:
             return None
-        return self._values[vid]
+        v = self._values[vid]
+        if v is _FREE:
+            raise LookupError(
+                f"value id {vid} was compacted away (heap corruption or "
+                f"a reference the compaction scan missed)"
+            )
+        return v
 
     def __len__(self) -> int:
         return len(self._values)
+
+    @property
+    def live_count(self) -> int:
+        """Interned values currently reachable (len minus free slots)."""
+        with self._mu:
+            return len(self._values) - len(self._free)
+
+    @property
+    def freed_total(self) -> int:
+        return self._freed_total
+
+    def compact(self, referenced, grace_seconds: float = 60.0) -> int:
+        """Free every id not in ``referenced`` and not interned within
+        the last ``grace_seconds`` (a write planned on the host may not
+        have reached device state yet — the grace window keeps its id
+        alive until it does). Returns the number of ids freed."""
+        cutoff = time.monotonic() - grace_seconds
+        referenced = set(int(r) for r in referenced)
+        freed = 0
+        with self._mu:
+            for k, vid in list(self._ids.items()):
+                if vid == NULL_ID or vid in referenced:
+                    continue
+                if self._touch.get(vid, 0.0) > cutoff:
+                    continue
+                del self._ids[k]
+                self._values[vid] = _FREE
+                self._free.append(vid)
+                self._touch.pop(vid, None)
+                freed += 1
+            self._freed_total += freed
+        return freed
 
     # --- checkpoint support ----------------------------------------------
     def state_dict(self) -> dict:
         out = []
         for v in self._values[1:]:
-            if isinstance(v, bytes):
+            if v is _FREE:
+                out.append([_T_FREE])
+            elif isinstance(v, bytes):
                 out.append([_T_BLOB, v.hex()])
             elif isinstance(v, str):
                 out.append([_T_TEXT, v])
@@ -91,15 +164,24 @@ class ValueHeap:
     @classmethod
     def from_state_dict(cls, state: dict) -> "ValueHeap":
         heap = cls()
-        for tag, raw in state["values"]:
+        for entry in state["values"]:
+            tag, raw = entry[0], (entry[1] if len(entry) > 1 else None)
+            vid = len(heap._values)
+            if tag == _T_FREE:
+                # preserve the hole: ids position-encode device state
+                heap._values.append(_FREE)
+                heap._free.append(vid)
+                continue
             if tag == _T_BLOB:
-                heap.intern(bytes.fromhex(raw))
+                value = bytes.fromhex(raw)
             elif tag == _T_REAL:
-                heap.intern(float(raw))
+                value = float(raw)
             elif tag == _T_INT:
-                heap.intern(int(raw))
+                value = int(raw)
             else:
-                heap.intern(raw)
+                value = raw
+            heap._values.append(value)
+            heap._ids[_key(value)] = vid
         return heap
 
 

@@ -13,8 +13,12 @@ TPU reframing — the durable artifact is the checkpoint directory, so:
   advanced, write a full checkpoint (the WAL-checkpoint analog: bounded
   recovery replay). Rotated: ``<path>/auto-{a,b}`` alternate so a crash
   mid-write never corrupts the only copy.
-- **heap watch**: the interned value heap is append-only (SQLite freelist
-  analog); warn past a soft limit so operators raise it consciously.
+- **heap compaction** (round 5, the ``vacuum_db`` analog,
+  ``handlers.rs:398-452``): every ``heap_compact_rounds`` rounds — or
+  immediately past the soft limit — free heap ids referenced nowhere in
+  device state (stable ids, free-list reuse). The warn fires only if the
+  heap is STILL past the soft limit after compacting (genuinely that
+  many live values).
 - **matcher-log GC** runs inline in the pubsub layer (``max_log``); this
   loop reports its sizes as metrics.
 """
@@ -33,6 +37,8 @@ class MaintenanceLoop:
                  checkpoint_path: Optional[str] = None,
                  checkpoint_rounds: int = 512,
                  heap_soft_limit: int = 1_000_000,
+                 heap_compact_rounds: int = 256,
+                 heap_grace_seconds: float = 60.0,
                  interval_seconds: float = 2.0):
         self.agent = agent
         self.db = db
@@ -40,6 +46,11 @@ class MaintenanceLoop:
         self.checkpoint_path = checkpoint_path
         self.checkpoint_rounds = checkpoint_rounds
         self.heap_soft_limit = heap_soft_limit
+        self.heap_compact_rounds = heap_compact_rounds
+        self.heap_grace_seconds = heap_grace_seconds
+        # first tick is immediately "due": boot-time compaction settles
+        # the post-restore heap before the cadence takes over
+        self._last_compact_round = agent.round_no - heap_compact_rounds
         self.interval = interval_seconds
         self._last_ckpt_round = agent.round_no
         # seed rotation AWAY from the newest complete side, so the first
@@ -94,15 +105,36 @@ class MaintenanceLoop:
             self.agent.persist_members(members_path)
             self._last_members_round = rounds
         if self.db is not None:
-            heap_len = len(self.db.heap)
-            self.agent.metrics.gauge("corro.db.value_heap.len", heap_len)
-            if heap_len > self.heap_soft_limit and not self._warned_heap:
-                self._warned_heap = True
-                logger.warning(
-                    "value heap has %d entries (soft limit %d) — the heap "
-                    "is append-only; consider a fresh checkpoint+restart "
-                    "cycle to compact", heap_len, self.heap_soft_limit,
-                )
+            heap = self.db.heap
+            live = heap.live_count
+            self.agent.metrics.gauge("corro.db.value_heap.len", len(heap))
+            self.agent.metrics.gauge("corro.db.value_heap.live", live)
+            due = rounds - self._last_compact_round >= self.heap_compact_rounds
+            # over-limit triggers an early pass, but spaced — a workload
+            # whose LIVE set legitimately exceeds the limit must not pay
+            # a full device-state scan every 2 s tick for ~0 freed ids
+            spacing = max(1, self.heap_compact_rounds // 8)
+            over = (live > self.heap_soft_limit
+                    and rounds - self._last_compact_round >= spacing)
+            if due or over:
+                freed = self.db.compact_heap(
+                    grace_seconds=self.heap_grace_seconds)
+                self._last_compact_round = rounds
+                if freed:
+                    self.agent.metrics.counter(
+                        "corro.db.value_heap.compacted", freed)
+                    logger.info("heap compaction freed %d value ids "
+                                "(%d live)", freed, heap.live_count)
+                if (heap.live_count > self.heap_soft_limit
+                        and not self._warned_heap):
+                    # still over AFTER compacting: genuinely that many
+                    # live values — the operator must raise the limit
+                    self._warned_heap = True
+                    logger.warning(
+                        "value heap holds %d LIVE values after compaction "
+                        "(soft limit %d) — raise the limit or shrink the "
+                        "working set", heap.live_count, self.heap_soft_limit,
+                    )
         if self.subs is not None:
             for mid in self.subs.ids():
                 m = self.subs.get(mid)

@@ -10,15 +10,23 @@ path as the HTTP API (the reference routes them through
 ``insert_local_changes``/``broadcast_changes``); reads observe one
 node's replica.
 
-Simplifications vs the reference: values are returned in text format
-with a minimal OID mapping (int8/float8/text/bytea); ``pg_catalog`` /
-``information_schema`` introspection is answered from the live schema
-for the common shapes (``pg_class``/``pg_attribute``/``pg_type``/
-``pg_namespace``/``pg_database``, ``information_schema.{tables,columns}``
-— the reference fakes these with vtabs, ``src/vtab/pg_*.rs``);
-unrecognized catalog queries degrade to empty result sets; transactions
-are statement-local (``BEGIN``/``COMMIT``/``ROLLBACK`` are accepted
-no-ops), matching the eventual-consistency write model.
+Values travel in text format by default; portals bound with binary
+result-format codes get PG binary encodings for the supported OIDs
+(int8/float8/bytea/text — the declared column oid drives the wire
+bytes). ``BEGIN``/``COMMIT``/``ROLLBACK`` are REAL buffered
+transactions since round 5: statements between BEGIN and COMMIT plan
+eagerly against a shared overlay (exact row counts, read-your-writes
+for later statements in the block) and stage into ONE round-loop
+transaction at COMMIT; an error aborts the block (SQLSTATE 25P02 until
+COMMIT/ROLLBACK, COMMIT of an aborted block reports ROLLBACK), and
+ReadyForQuery carries the true I/T/E status. Reads inside an open block
+observe the pre-transaction replica (the eventually-consistent read
+model). ``pg_catalog`` / ``information_schema`` introspection is
+answered from the live schema for the common shapes
+(``pg_class``/``pg_attribute``/``pg_type``/``pg_namespace``/
+``pg_database``, ``information_schema.{tables,columns}`` — the
+reference fakes these with vtabs, ``src/vtab/pg_*.rs``); unrecognized
+catalog queries degrade to empty result sets.
 """
 
 from __future__ import annotations
@@ -51,6 +59,7 @@ OID_BYTEA = 17
 SQLSTATE_SYNTAX = "42601"
 SQLSTATE_UNDEFINED_TABLE = "42P01"
 SQLSTATE_INTERNAL = "XX000"
+SQLSTATE_IN_FAILED_TX = "25P02"
 
 
 def _col_oid(sql_type: str) -> int:
@@ -281,6 +290,37 @@ def _text_value(v: Any) -> Optional[bytes]:
     return str(v).encode()
 
 
+def _binary_value(v: Any, oid: int) -> Optional[bytes]:
+    """PG binary result encoding for the supported OIDs
+    (``corro-pg`` answers binary-format portals the same way). The
+    declared column oid drives the coercion so the wire bytes always
+    match the RowDescription the client planned against."""
+    if v is None:
+        return None
+    if oid == OID_FLOAT8:
+        return struct.pack("!d", float(v))
+    if oid in OID_INTS:
+        return struct.pack("!q", int(v))
+    if oid == OID_BYTEA:
+        return v if isinstance(v, bytes) else str(v).encode()
+    if isinstance(v, bool):  # bool as text-ish byte for OID_TEXT
+        return b"\x01" if v else b"\x00"
+    # text binary format is the utf8 bytes themselves
+    if isinstance(v, bytes):
+        return v
+    return str(v).encode()
+
+
+def _fmt_for(i: int, fmts: Optional[List[int]]) -> int:
+    """Bind result-format codes: [] = all text, [f] = all f, else per
+    column (PG protocol)."""
+    if not fmts:
+        return 0
+    if len(fmts) == 1:
+        return fmts[0]
+    return fmts[i] if i < len(fmts) else 0
+
+
 def _iter_sql_segments(sql: str):
     """Yield ``(is_literal, segment)`` pairs, where literal segments are
     single-quoted strings (``''`` escapes stay inside one literal). The
@@ -396,9 +436,13 @@ class _PreparedStatement:
 
 
 class _Portal:
-    def __init__(self, stmt: _PreparedStatement, params: List[Any]):
+    def __init__(self, stmt: _PreparedStatement, params: List[Any],
+                 result_fmts: Optional[List[int]] = None):
         self.stmt = stmt
         self.params = params
+        # Bind's result-format codes: [] all-text, [1] all-binary, or
+        # per-column
+        self.result_fmts = result_fmts or []
         # True once Describe(portal) emitted a RowDescription; Execute
         # then must NOT send a second one (protocol), but when Describe
         # answered NoData (synthetic results: SHOW, constant SELECT,
@@ -453,6 +497,8 @@ def _make_handler(server: PgServer):
             self.stmts: Dict[str, _PreparedStatement] = {}
             self.portals: Dict[str, _Portal] = {}
             self.node = server.default_node
+            self.tx = None  # open StagedTx between BEGIN and COMMIT
+            self.tx_failed = False  # aborted: reject until COMMIT/ROLLBACK
 
         # --- low-level reads ---------------------------------------------
         def _read_exact(self, n: int) -> bytes:
@@ -489,22 +535,29 @@ def _make_handler(server: PgServer):
 
         # --- backend responses -------------------------------------------
         def _send_ready(self):
-            self.out.add(b"Z", b"I").flush()
+            # ReadyForQuery carries the real transaction status: I idle,
+            # T in transaction, E failed transaction (pg protocol)
+            status = (b"E" if self.tx_failed
+                      else b"T" if self.tx is not None else b"I")
+            self.out.add(b"Z", status).flush()
 
         def _send_error(self, message: str, code: str = SQLSTATE_INTERNAL):
             fields = (b"S" + _cstr("ERROR") + b"C" + _cstr(code)
                       + b"M" + _cstr(message) + b"\x00")
             self.out.add(b"E", fields)
 
-        def _row_description(self, cols: List[str],
-                             table_name: Optional[str] = None):
-            payload = struct.pack("!H", len(cols))
+        def _col_oids(self, cols: List[str],
+                      table_name: Optional[str] = None) -> List[int]:
+            """Deterministic per-column OIDs (schema-driven, else TEXT) —
+            shared by RowDescription and the binary row encoder so the
+            wire bytes always match the declared description."""
             table = None
             if table_name is not None:
                 try:
                     table = server.db.schema.table(table_name)
                 except SchemaError:
                     table = None
+            oids = []
             for name in cols:
                 oid = OID_TEXT
                 if table is not None:
@@ -512,14 +565,31 @@ def _make_handler(server: PgServer):
                         oid = _col_oid(table.column(name).sql_type)
                     except SchemaError:
                         pass
+                oids.append(oid)
+            return oids
+
+        def _row_description(self, cols: List[str],
+                             table_name: Optional[str] = None,
+                             fmts: Optional[List[int]] = None):
+            payload = struct.pack("!H", len(cols))
+            oids = self._col_oids(cols, table_name)
+            for i, name in enumerate(cols):
                 payload += _cstr(name)
-                payload += struct.pack("!IhIhih", 0, 0, oid, -1, -1, 0)
+                payload += struct.pack("!IhIhih", 0, 0, oids[i], -1, -1,
+                                       _fmt_for(i, fmts))
             self.out.add(b"T", payload)
 
-        def _data_row(self, row: List[Any]):
+        def _data_row(self, row: List[Any],
+                      fmts: Optional[List[int]] = None,
+                      oids: Optional[List[int]] = None):
             payload = struct.pack("!H", len(row))
-            for v in row:
-                tv = _text_value(v)
+            for i, v in enumerate(row):
+                if _fmt_for(i, fmts) == 1:
+                    tv = _binary_value(
+                        v, oids[i] if oids and i < len(oids) else OID_TEXT
+                    )
+                else:
+                    tv = _text_value(v)
                 if tv is None:
                     payload += struct.pack("!i", -1)
                 else:
@@ -538,18 +608,49 @@ def _make_handler(server: PgServer):
             return m.group(1).strip('"') if m else None
 
         def _run_sql(self, sql: str, params: Any = None,
-                     send_desc: bool = True) -> None:
+                     send_desc: bool = True,
+                     fmts: Optional[List[int]] = None) -> None:
             """``send_desc``: simple query includes RowDescription;
             extended Execute must NOT (the client learned the shape from
-            Describe — a second 'T' is a protocol violation)."""
+            Describe — a second 'T' is a protocol violation). ``fmts``:
+            the portal's Bind result-format codes (binary results)."""
             orig_sql = sql  # pre-translation (keeps ::regclass casts)
             sql = _translate_sql(sql)
             if not sql or sql.rstrip(";") == "":
                 self.out.add(b"I", b"")  # EmptyQueryResponse
                 return
             upper = sql.upper().rstrip(";")
-            if upper in ("BEGIN", "COMMIT", "ROLLBACK", "END"):
-                self._command_complete(upper.split()[0])
+            verb = upper.split()[0] if upper.split() else ""
+            # transaction control (real BEGIN/COMMIT since round 5: the
+            # reference's PG server runs genuine txs, corro-pg/src/lib.rs)
+            if verb == "BEGIN" or upper.startswith("START TRANSACTION"):
+                if self.tx is None:
+                    self.tx = server.db.begin(self.node)
+                self._command_complete("BEGIN")
+                return
+            if verb in ("COMMIT", "END"):
+                tx, failed = self.tx, self.tx_failed
+                self.tx, self.tx_failed = None, False
+                if failed or tx is None:
+                    if tx is not None:
+                        tx.rollback()
+                    # committing an aborted tx rolls back (pg semantics)
+                    self._command_complete(
+                        "ROLLBACK" if failed else "COMMIT")
+                    return
+                tx.commit()
+                self._command_complete("COMMIT")
+                return
+            if verb == "ROLLBACK":
+                if self.tx is not None:
+                    self.tx.rollback()
+                self.tx, self.tx_failed = None, False
+                self._command_complete("ROLLBACK")
+                return
+            if self.tx_failed:
+                self._send_error(
+                    "current transaction is aborted, commands ignored "
+                    "until end of transaction block", SQLSTATE_IN_FAILED_TX)
                 return
             if upper.startswith(("SET ", "RESET ", "DISCARD ")):
                 self._command_complete("SET")
@@ -557,8 +658,8 @@ def _make_handler(server: PgServer):
             if upper.startswith("SHOW "):
                 name = sql.split(None, 1)[1].rstrip(";")
                 if send_desc:
-                    self._row_description([name.lower()])
-                self._data_row([""])
+                    self._row_description([name.lower()], fmts=fmts)
+                self._data_row([""], fmts)
                 self._command_complete("SHOW")
                 return
             if _CATALOG_FROM_RE.search(upper):
@@ -567,26 +668,26 @@ def _make_handler(server: PgServer):
                 answer = _answer_catalog(server.db, orig_sql, params)
                 if answer is None:
                     if send_desc:
-                        self._row_description(["?column?"])
+                        self._row_description(["?column?"], fmts=fmts)
                     self._command_complete("SELECT 0")
                     return
                 cols, rows = answer
                 if send_desc:
-                    self._row_description(cols)
+                    self._row_description(cols, fmts=fmts)
                 for row in rows:
-                    self._data_row(row)
+                    self._data_row(row, fmts)
                 self._command_complete(f"SELECT {len(rows)}")
                 return
             if upper.startswith("SELECT"):
-                self._run_select(sql, params, send_desc)
+                self._run_select(sql, params, send_desc, fmts)
                 return
             n = self._run_write(sql, params)
-            verb = upper.split()[0]
             tag = f"INSERT 0 {n}" if verb == "INSERT" else f"{verb} {n}"
             self._command_complete(tag)
 
         def _run_select(self, sql: str, params: Any,
-                        send_desc: bool = True) -> None:
+                        send_desc: bool = True,
+                        fmts: Optional[List[int]] = None) -> None:
             import re
 
             # constant selects like SELECT 1 / SELECT version()
@@ -602,20 +703,26 @@ def _make_handler(server: PgServer):
                     except ValueError:
                         val = expr.strip("'")
                 if send_desc:
-                    self._row_description(["?column?"])
-                self._data_row([val])
+                    self._row_description(["?column?"], fmts=fmts)
+                self._data_row([val], fmts)
                 self._command_complete("SELECT 1")
                 return
             cols, rows = server.db.query(self.node, sql, params)
+            table = self._table_of(sql)
             if send_desc:
-                self._row_description(cols, self._table_of(sql))
+                self._row_description(cols, table, fmts)
+            oids = self._col_oids(cols, table) if fmts else None
             n = 0
             for row in rows:
-                self._data_row(row)
+                self._data_row(row, fmts, oids)
                 n += 1
             self._command_complete(f"SELECT {n}")
 
         def _run_write(self, sql: str, params: Any) -> int:
+            if self.tx is not None:
+                # buffered inside the open BEGIN block; visible to the
+                # cluster only at COMMIT
+                return self.tx.execute(sql, params)["rows_affected"]
             results = server.db.execute(self.node, [(sql, params)])
             return results[0]["rows_affected"]
 
@@ -679,10 +786,14 @@ def _make_handler(server: PgServer):
                 for part in parts or [""]:
                     self._run_sql(part)
             except (SqlError, SchemaError) as e:
+                if self.tx is not None:
+                    self.tx_failed = True  # abort the open BEGIN block
                 code = (SQLSTATE_UNDEFINED_TABLE if "no such table" in str(e)
                         else SQLSTATE_SYNTAX)
                 self._send_error(str(e), code)
             except Exception as e:  # noqa: BLE001
+                if self.tx is not None:
+                    self.tx_failed = True
                 logger.exception("pg simple query failed")
                 self._send_error(str(e))
             self._send_ready()
@@ -726,7 +837,18 @@ def _make_handler(server: PgServer):
                 self._send_error(f"no such prepared statement "
                                  f"{stmt_name.decode()!r}", SQLSTATE_SYNTAX)
                 return
-            self.portals[portal.decode()] = _Portal(stmt, stmt.reorder(params))
+            # result-format codes (binary results, corro-pg parity)
+            result_fmts: List[int] = []
+            if off + 2 <= len(rest):
+                (n_rfmt,) = struct.unpack("!H", rest[off:off + 2])
+                off += 2
+                if off + 2 * n_rfmt <= len(rest):
+                    result_fmts = list(
+                        struct.unpack(f"!{n_rfmt}H",
+                                      rest[off:off + 2 * n_rfmt])
+                    )
+            self.portals[portal.decode()] = _Portal(
+                stmt, stmt.reorder(params), result_fmts)
             self.out.add(b"2", b"")  # BindComplete
 
         def _decode_param(self, raw: bytes, fmt: int,
@@ -773,11 +895,13 @@ def _make_handler(server: PgServer):
                     return
                 sql = portal.stmt.sql
             described = False
+            pfmts = (self.portals[name].result_fmts
+                     if kind == b"P" and name in self.portals else None)
             if sql.upper().lstrip().startswith("SELECT"):
                 try:
                     # schema-only plan: no table scan on the Describe phase
                     cols = server.db.query_columns(_translate_sql(sql))
-                    self._row_description(cols, self._table_of(sql))
+                    self._row_description(cols, self._table_of(sql), pfmts)
                     described = True
                 except Exception:  # noqa: BLE001 — constant SELECTs etc.
                     self.out.add(b"n", b"")  # NoData
@@ -797,12 +921,17 @@ def _make_handler(server: PgServer):
                 # produced a RowDescription; synthetic results (NoData
                 # from Describe) still need theirs here
                 self._run_sql(portal.stmt.sql, portal.params or None,
-                              send_desc=not portal.described)
+                              send_desc=not portal.described,
+                              fmts=portal.result_fmts)
             except (SqlError, SchemaError) as e:
+                if self.tx is not None:
+                    self.tx_failed = True  # abort the open BEGIN block
                 code = (SQLSTATE_UNDEFINED_TABLE if "no such table" in str(e)
                         else SQLSTATE_SYNTAX)
                 self._send_error(str(e), code)
             except Exception as e:  # noqa: BLE001
+                if self.tx is not None:
+                    self.tx_failed = True
                 logger.exception("pg execute failed")
                 self._send_error(str(e))
 

@@ -98,29 +98,43 @@ class DeltaTracker:
     def __init__(self, db):
         self.db = db
         self._planes: Dict[int, tuple] = {}
+        # (round, delta) per node: consumers arriving within the same
+        # round share one computation instead of each advancing the
+        # baseline (which would hand the second caller an empty delta)
+        self._cache: Dict[int, tuple] = {}
+        self._mu = threading.Lock()
 
     def changed(self, node: int) -> Optional[Dict[str, set]]:
         import numpy as np
 
         snap = self.db.agent.snapshot()
-        store = snap["store"]  # (ver, val, site, dbv, clp) planes
-        ver = np.asarray(store[0][node])
-        val = np.asarray(store[1][node])
-        clp = np.asarray(store[4][node])
-        prev = self._planes.get(node)
-        self._planes[node] = (ver.copy(), val.copy(), clp.copy())
-        if prev is None:
-            return None
-        ch = (prev[0] != ver) | (prev[1] != val) | (prev[2] != clp)
-        if not ch.any():
-            return {}
-        out: Dict[str, set] = {}
-        n_cols = self.db.n_cols
-        for row in {int(c) // n_cols for c in np.nonzero(ch)[0]}:
-            tp = self.db.rows.table_pk_of(row)
-            if tp is not None:
-                out.setdefault(tp[0], set()).add(tp[1])
-        return out
+        rnd = snap.get("round", -1)
+        with self._mu:
+            cached = self._cache.get(node)
+            if cached is not None and cached[0] == rnd:
+                return cached[1]
+            store = snap["store"]  # (ver, val, site, dbv, clp) planes
+            ver = np.asarray(store[0][node])
+            val = np.asarray(store[1][node])
+            clp = np.asarray(store[4][node])
+            prev = self._planes.get(node)
+            self._planes[node] = (ver.copy(), val.copy(), clp.copy())
+            if prev is None:
+                out = None
+            else:
+                ch = (prev[0] != ver) | (prev[1] != val) | (prev[2] != clp)
+                if not ch.any():
+                    out = {}
+                else:
+                    out = {}
+                    n_cols = self.db.n_cols
+                    for row in {int(c) // n_cols
+                                for c in np.nonzero(ch)[0]}:
+                        tp = self.db.rows.table_pk_of(row)
+                        if tp is not None:
+                            out.setdefault(tp[0], set()).add(tp[1])
+            self._cache[node] = (rnd, out)
+            return out
 
 
 class Matcher:
@@ -188,6 +202,10 @@ class Matcher:
             or any(j[0] == "left" for j in ast.get("joins", ()))
         )
         self.n_queries = 0  # full + filtered executions (tests/metrics)
+        # a restored matcher's state predates any delta baseline (the
+        # persisted manifest may be a whole downtime old): its first
+        # poll MUST be a full re-diff or down-window changes are lost
+        self._force_full = restore is not None
         self._state: Dict[Any, Tuple] = {}
         self._log: List[Tuple[int, str, Any, Optional[List[Any]]]] = []
         self._log_base = 1  # change id of _log[0]
@@ -267,78 +285,77 @@ class Matcher:
         diffing, ``pubsub.rs:527-1100``). ``None`` = unknown delta:
         full re-query."""
         if candidates is not None and (
-            not self._can_increment
+            self._force_full
+            or not self._can_increment
             or any(t in candidates for t in self._subq_tables)
         ):
             candidates = None
         if candidates is None:
+            self._force_full = False
             fresh = self._current()
-            events = []
             with self._mu:
-                for key, row in fresh.items():
-                    old = self._state.get(key)
-                    if old is None:
-                        events.append((INSERT, key, list(row)))
-                    elif old != row:
-                        events.append((UPSERT, key, list(row)))
+                events = self._diff_upserts(fresh)
                 for key in self._state:
                     if key not in fresh:
                         events.append((DELETE, key, None))
                 self._state = fresh
                 out, subs = self._log_events(events)
             return self._fanout(out, subs)
+        # incremental: ONE re-query restricted to the candidate pks — a
+        # disjunction of per-alias IN conds, so a delta touching both
+        # sides of a JOIN still costs a single scan
+        k = self._n_keys
+        pk_sets: Dict[int, set] = {}
+        for i, (alias, tname, pk_key) in enumerate(self._aliases):
+            pks = candidates.get(tname)
+            if pks:
+                pk_sets[i] = set(pks)
+        if not pk_sets:
+            return 0  # nothing this matcher watches changed
+        in_conds = [
+            ("in", self._aliases[i][2], sorted(s, key=repr))
+            for i, s in pk_sets.items()
+        ]
+        extra = (in_conds if len(in_conds) == 1
+                 else [("or", [[c] for c in in_conds], None)])
+        self.n_queries += 1
+        rows = self.db.query_filtered(
+            self.node, self._key_sql, self.params, extra)
+        if k == 1:
+            fresh_part = {row[0]: tuple(row[1:]) for row in rows}
         else:
-            # incremental: re-query only the aliases whose table has
-            # candidate pks, restricted to those pks
-            k = self._n_keys
-            pk_sets: Dict[int, set] = {}
-            for i, (alias, tname, pk_key) in enumerate(self._aliases):
-                pks = candidates.get(tname)
-                if pks:
-                    pk_sets[i] = set(pks)
-            if not pk_sets:
-                return 0  # nothing this matcher watches changed
-            fresh_part: Dict[Any, Tuple] = {}
-            for i, s in pk_sets.items():
-                _, _, pk_key = self._aliases[i]
-                self.n_queries += 1
-                rows = self.db.query_filtered(
-                    self.node, self._key_sql, self.params,
-                    [(pk_key, sorted(s, key=repr))],
-                )
+            fresh_part = {tuple(row[:k]): tuple(row[k:]) for row in rows}
+        with self._mu:
+            events = self._diff_upserts(fresh_part)
+            for key in list(self._state):
+                if key in fresh_part:
+                    continue
+                # affected = some component pk was a candidate
                 if k == 1:
-                    fresh_part.update(
-                        {row[0]: tuple(row[1:]) for row in rows}
-                    )
+                    hit = any(key in s for s in pk_sets.values())
                 else:
-                    fresh_part.update(
-                        {tuple(row[:k]): tuple(row[k:]) for row in rows}
-                    )
-            events = []
-            with self._mu:
-                for key, row in fresh_part.items():
-                    old = self._state.get(key)
-                    if old is None:
-                        events.append((INSERT, key, list(row)))
-                    elif old != row:
-                        events.append((UPSERT, key, list(row)))
-                for key in list(self._state):
-                    if key in fresh_part:
-                        continue
-                    # affected = some component pk was a candidate
-                    if k == 1:
-                        hit = any(key in s for s in pk_sets.values())
-                    else:
-                        hit = any(key[i] in s for i, s in pk_sets.items())
-                    if hit:
-                        events.append((DELETE, key, None))
-                for kind, key, row in events:
-                    if kind == DELETE:
-                        self._state.pop(key, None)
-                    else:
-                        self._state[key] = tuple(row)
-                out, subs = self._log_events(events)
-            return self._fanout(out, subs)
+                    hit = any(key[i] in s for i, s in pk_sets.items())
+                if hit:
+                    events.append((DELETE, key, None))
+            for kind, key, row in events:
+                if kind == DELETE:
+                    self._state.pop(key, None)
+                else:
+                    self._state[key] = tuple(row)
+            out, subs = self._log_events(events)
+        return self._fanout(out, subs)
+
+    def _diff_upserts(self, fresh: Dict[Any, Tuple]) -> list:
+        """INSERT/UPSERT events for ``fresh`` vs the materialized state
+        (``self._mu`` held). Deletes differ per path — callers append."""
+        events = []
+        for key, row in fresh.items():
+            old = self._state.get(key)
+            if old is None:
+                events.append((INSERT, key, list(row)))
+            elif old != row:
+                events.append((UPSERT, key, list(row)))
+        return events
 
     def _log_events(self, events):
         """Assign change ids + append to the log; ``self._mu`` must be
@@ -424,7 +441,7 @@ class SubsManager:
     def __init__(self, db, persist_dir: Optional[str] = None):
         self.db = db
         self.persist_dir = persist_dir
-        self._tracker = DeltaTracker(db)
+        self._tracker = db.delta_tracker()  # shared, per-round cached
         self._matchers: Dict[str, Matcher] = {}
         self._by_query: Dict[Tuple, str] = {}
         self._dirty: set = set()
@@ -570,7 +587,7 @@ class UpdatesManager:
     def __init__(self, db, node: int = 0):
         self.db = db
         self.node = node
-        self._tracker = DeltaTracker(db)
+        self._tracker = db.delta_tracker()  # shared, per-round cached
         self._feeds: Dict[str, List[queue.Queue]] = {}
         self._state: Dict[str, Dict[Any, Tuple]] = {}
         self._mu = threading.Lock()

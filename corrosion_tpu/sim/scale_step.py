@@ -241,6 +241,39 @@ class ScaleRoundInput(NamedTuple):
         )
 
 
+def make_write_inputs(cfg: ScaleSimConfig, key, rounds: int, write_mask):
+    """Stacked per-round :class:`ScaleRoundInput` with conflict-heavy
+    random writes for the nodes in ``write_mask`` (bool [rounds, N]).
+    Routes through K-cell chunked transactions (the partial-buffer
+    path, ``change.rs:66-178`` + ``util.rs:1061-1194``) when
+    ``cfg.tx_max_cells > 1`` — the ONE construction shared by bench.py,
+    ab_bench, and convergence_bench so the arms can't drift."""
+    k_cell, k_val, k_len = jr.split(key, 3)
+    n = cfg.n_nodes
+    quiet = ScaleRoundInput.quiet(cfg)
+    inputs = jax.tree.map(
+        lambda a: jnp.broadcast_to(a, (rounds,) + a.shape), quiet
+    )
+    if cfg.tx_max_cells > 1:
+        k_lanes = cfg.tx_max_cells
+        return inputs._replace(
+            tx_mask=write_mask,
+            tx_len=jr.randint(k_len, (rounds, n), 1, k_lanes + 1,
+                              dtype=jnp.int32),
+            tx_cell=jr.randint(k_cell, (rounds, n, k_lanes), 0,
+                               cfg.n_cells, dtype=jnp.int32),
+            tx_val=jr.randint(k_val, (rounds, n, k_lanes), 0, 1 << 20,
+                              dtype=jnp.int32),
+        )
+    return inputs._replace(
+        write_mask=write_mask,
+        write_cell=jr.randint(k_cell, (rounds, n), 0, cfg.n_cells,
+                              dtype=jnp.int32),
+        write_val=jr.randint(k_val, (rounds, n), 0, 1 << 20,
+                             dtype=jnp.int32),
+    )
+
+
 def piggyback_bcast_step(cfg: ScaleSimConfig, cst: CrdtState, channels, key,
                          carried=None, emitted=None):
     """Disseminate queued changesets over the SWIM packet channels.

@@ -7,11 +7,21 @@ compiles every arm in ONE process, warms them all, then interleaves
 timed reps round-robin so drift hits every arm equally; per-arm medians
 of per-rep throughput are robust to one-off stalls.
 
+Round-5 harness fixes (VERDICT r4 weak #3): the ``pull10`` arm pins
+``sync_pull_peers`` to a LITERAL 10 (round 4 set it to ``sync_peers``,
+which equals the default's pull width at small N — a no-op arm that
+"measured" a 46% delta of pure noise); a ``control`` arm duplicates the
+default config so every run prints its own noise floor; the summary
+marks an arm's delta significant only when it exceeds that floor.
+
+Arms: default (narrow int16 planes since round 4), control (=default),
+wide (int32 planes), pig16 (bounded piggyback), pull10 (literal pull
+width 10), tx4 (4-cell chunked transactions through the partial-buffer
+path — VERDICT r4 next #5).
+
 Usage: python scripts/ab_bench.py [n_nodes] [reps]
-Arms: default (narrow int16 planes since round 4), pig16 (bounded
-piggyback), pull10 (pull = score pool, i.e. the pre-cut sync width),
-and wide (int32 planes — the pre-narrowing baseline). Writes one JSON line per arm plus a summary line to
-stdout and ``artifacts/AB_BENCH_r04.jsonl``.
+Writes one JSON line per arm plus a summary to stdout and
+``artifacts/AB_BENCH_r05.jsonl``.
 """
 
 from __future__ import annotations
@@ -40,33 +50,36 @@ def main() -> None:
     import jax.random as jr
 
     from corrosion_tpu.sim.scale_step import (
-        ScaleRoundInput,
         ScaleSimState,
+        make_write_inputs,
         scale_run_rounds,
         scale_sim_config,
     )
     from corrosion_tpu.sim.transport import NetModel
 
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
-    reps = int(sys.argv[2]) if len(sys.argv) > 2 else 10
+    reps = int(sys.argv[2]) if len(sys.argv) > 2 else 20
     rounds = 8
     platform = jax.devices()[0].platform
 
     base = scale_sim_config(n, n_origins=min(16, n))
-    arm_cfgs = {"default": base}
+    arm_cfgs = {"default": base, "control": base}
     arm_cfgs["pig16"] = dataclasses.replace(base, pig_members=16)
-    arm_cfgs["pull10"] = dataclasses.replace(
-        base, sync_pull_peers=base.sync_peers
-    )
+    # literal 10 (the reference's max sync fanout, handlers.rs:838) —
+    # NOT base.sync_peers, which made round 4's arm config-identical to
+    # default at small N
+    arm_cfgs["pull10"] = dataclasses.replace(base, sync_pull_peers=10)
     if any(f.name == "narrow_dtypes"
            for f in dataclasses.fields(type(base))):
         # narrow is the default since round 4 — the experiment arm is
         # the WIDE int32 baseline
         arm_cfgs["wide"] = dataclasses.replace(base, narrow_dtypes=False)
+    arm_cfgs["tx4"] = scale_sim_config(n, n_origins=min(16, n),
+                                       tx_max_cells=4)
 
     out_path = os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "artifacts", "AB_BENCH_r04.jsonl",
+        "artifacts", "AB_BENCH_r05.jsonl",
     )
     os.makedirs(os.path.dirname(out_path), exist_ok=True)
     sink = open(out_path, "a")
@@ -78,26 +91,19 @@ def main() -> None:
         sink.flush()
 
     key = jr.key(0)
-    k1, k2, k3 = jr.split(jr.key(1), 3)
+    k1, k2 = jr.split(jr.key(1), 2)
+
+    def build_inputs(cfg):
+        w = (jr.uniform(k1, (rounds, n)) < 0.25) & (
+            jnp.arange(n)[None, :] < cfg.n_origins
+        )
+        return make_write_inputs(cfg, k2, rounds, w)
 
     arms = {}
     for name, cfg in arm_cfgs.items():
         st = ScaleSimState.create(cfg)
         net = NetModel.create(n, drop_prob=0.01)
-        quiet = ScaleRoundInput.quiet(cfg)
-        inputs = jax.tree.map(
-            lambda a: jnp.broadcast_to(a, (rounds,) + a.shape), quiet
-        )
-        w = (jr.uniform(k1, (rounds, n)) < 0.25) & (
-            jnp.arange(n)[None, :] < cfg.n_origins
-        )
-        inputs = inputs._replace(
-            write_mask=w,
-            write_cell=jr.randint(k2, (rounds, n), 0, cfg.n_cells,
-                                  dtype=jnp.int32),
-            write_val=jr.randint(k3, (rounds, n), 0, 1 << 20,
-                                 dtype=jnp.int32),
-        )
+        inputs = build_inputs(cfg)
         t0 = time.perf_counter()
         run = jax.jit(functools.partial(scale_run_rounds, cfg))
         st2 = jax.block_until_ready(run(st, net, key, inputs))[0]
@@ -122,19 +128,22 @@ def main() -> None:
             jax.block_until_ready(a["st"])
             a["times"].append(time.perf_counter() - t0)
 
+    medians = {}
     for name, a in arms.items():
         rps = [rounds / t for t in a["times"]]
         cfg = arm_cfgs[name]
+        medians[name] = statistics.median(rps)
         emit({
             "metric": f"ab_rounds_per_sec_n{n}_{platform}",
             "arm": name,
-            "value": round(statistics.median(rps), 2),
+            "value": round(medians[name], 2),
             "best": round(max(rps), 2),
             "worst": round(min(rps), 2),
             "unit": "rounds/s",
             "reps": reps,
             "pig_members": cfg.pig_members,
             "sync_pull_peers": cfg.sync_pull_peers,
+            "tx_max_cells": cfg.tx_max_cells,
             "pallas_fused": bool(
                 megakernel.use_fused_ingest(cfg, 4 * cfg.pig_changes)
                 and megakernel.use_fused_swim(
@@ -142,6 +151,27 @@ def main() -> None:
                     narrow=cfg.narrow_dtypes)
             ),
         })
+
+    # the control arm runs an IDENTICAL config to default: their spread
+    # is the measurement noise floor, and no other arm's delta counts
+    # unless it clears that floor
+    noise = abs(medians["control"] - medians["default"])
+    summary = {
+        "metric": f"ab_summary_n{n}_{platform}",
+        "reps": reps,
+        "noise_floor_rps": round(noise, 2),
+        "noise_floor_pct": round(
+            100.0 * noise / max(medians["default"], 1e-9), 2),
+        "deltas_vs_default": {
+            name: {
+                "delta_rps": round(m - medians["default"], 2),
+                "significant": abs(m - medians["default"]) > noise,
+            }
+            for name, m in medians.items()
+            if name not in ("default", "control")
+        },
+    }
+    emit(summary)
 
 
 if __name__ == "__main__":

@@ -35,6 +35,7 @@ import jax.random as jr  # noqa: E402
 from corrosion_tpu.sim.scale_step import (  # noqa: E402
     ScaleRoundInput,
     ScaleSimState,
+    make_write_inputs,
     scale_crdt_metrics,
     scale_run_rounds,
     scale_sim_config,
@@ -46,32 +47,25 @@ MAX_ROUNDS = 1024
 BURST_ROUNDS = 6
 
 
-def run_one(n: int, faults: bool = True, n_origins: int | None = None) -> dict:
+def run_one(n: int, faults: bool = True, n_origins: int | None = None,
+            tx_cells: int = 1) -> dict:
     """Write burst (+ optional kills/partition) -> heal -> quiet rounds
-    until the convergence predicate holds."""
+    until the convergence predicate holds. ``tx_cells > 1`` routes the
+    burst through K-cell chunked transactions (the partial-buffer path,
+    ``change.rs:66-178`` + ``util.rs:1061-1194`` — VERDICT r4 next #5)."""
     n_origins = n_origins or int(os.environ.get("CONV_ORIGINS", "16"))
-    cfg = scale_sim_config(n, n_origins=min(n_origins, n))
+    cfg = scale_sim_config(n, n_origins=min(n_origins, n),
+                           tx_max_cells=tx_cells)
     net = NetModel.create(n, drop_prob=0.02)
     st = ScaleSimState.create(cfg)
     key = jr.key(0)
     quiet = ScaleRoundInput.quiet(cfg)
 
-    burst = jax.tree.map(
-        lambda a: jnp.broadcast_to(a, (BURST_ROUNDS,) + a.shape), quiet
-    )
-    k1, k2, k3, k4 = jr.split(jr.key(1), 4)
+    k1, k2, k4 = jr.split(jr.key(1), 3)
     w = (jr.uniform(k1, (BURST_ROUNDS, n)) < 0.5) & (
         jnp.arange(n)[None, :] < cfg.n_origins
     )
-    burst = burst._replace(
-        write_mask=w,
-        write_cell=jr.randint(
-            k2, (BURST_ROUNDS, n), 0, cfg.n_cells, dtype=jnp.int32
-        ),
-        write_val=jr.randint(
-            k3, (BURST_ROUNDS, n), 0, 1 << 20, dtype=jnp.int32
-        ),
-    )
+    burst = make_write_inputs(cfg, k2, BURST_ROUNDS, w)
     net_burst = net
     if faults:
         # fault mix during the burst (BASELINE full-mix shape): 1% of
@@ -113,12 +107,15 @@ def run_one(n: int, faults: bool = True, n_origins: int | None = None) -> dict:
         if bool(m["converged"]):
             break
     dt = time.perf_counter() - t0
+    m = scale_crdt_metrics(cfg, st)
     return {
         "n": n,
         "n_origins": cfg.n_origins,
         "faults": bool(faults),
+        "tx_max_cells": cfg.tx_max_cells,
         "rounds_to_convergence": rounds,
-        "converged": bool(scale_crdt_metrics(cfg, st)["converged"]),
+        "converged": bool(m["converged"]),
+        "org_aligned_frac": round(float(m["org_aligned_frac"]), 4),
         "ms_per_round": round(dt * 1000 / max(1, timed_rounds), 3),
         "platform": jax.devices()[0].platform,
     }
@@ -126,14 +123,16 @@ def run_one(n: int, faults: bool = True, n_origins: int | None = None) -> dict:
 
 def main():
     args = [a for a in sys.argv[1:] if not a.startswith("--")]
-    out_path = None
+    out_path, tx_cells = None, 1
     for a in sys.argv[1:]:
         if a.startswith("--out="):
             out_path = a.split("=", 1)[1]
+        if a.startswith("--tx="):
+            tx_cells = int(a.split("=", 1)[1])
     sizes = [int(a) for a in args] or [256, 1024, 4096]
     records = []
     for n in sizes:
-        rec = run_one(n)
+        rec = run_one(n, tx_cells=tx_cells)
         # one process compiles several whole-cluster programs; without
         # dropping the in-memory executables between sizes the next
         # LLVM compile can die with "Cannot allocate memory" (observed

@@ -1,0 +1,51 @@
+#!/bin/bash
+# One tunnel-window TPU session: bank the round's artifacts in value
+# order, tolerating a tunnel death at any point (every step writes its
+# artifact independently; later steps reuse the persistent compile
+# cache, utils/compile_cache.py).
+#
+#   1. pallas kernel probe on the real backend  -> PALLAS_PROBE_r05.json
+#   2. fresh flagship bench at HEAD (100k)      -> artifacts/bench_last.json
+#      (the driver's capture re-prints this cache AND reuses the warm
+#      compile cache for its own fresh attempt)
+#   3. interleaved A/B with control arm         -> AB_BENCH_r05.jsonl
+#   4. 100k convergence under the fault mix     -> CONVERGENCE_r05_tpu.json
+#   5. 100k chunked-tx (tx4) convergence        -> CONVERGENCE_r05_tpu_tx4.json
+#   6. chunked-tx flagship bench cost           -> stdout (tx4 record)
+#
+# Usage: scripts/tpu_session.sh [logfile]
+set -u
+cd "$(dirname "$0")/.."
+LOG="${1:-artifacts/tpu_session_r05.log}"
+mkdir -p artifacts
+exec >>"$LOG" 2>&1
+
+step() {
+  echo "=== [$(date -u +%H:%M:%S)] $1 (timeout ${2}s)"
+  shift 2
+  timeout "$TO" "$@"
+  echo "=== rc=$?"
+}
+
+echo "=== session start $(date -u) commit $(git rev-parse --short HEAD)"
+
+TO=1800 step "pallas probe" 1800 python scripts/pallas_probe.py 100000
+
+TO=1800 step "fresh flagship bench" 1800 \
+  env BENCH_WORKER=1 python bench.py
+
+TO=2400 step "A/B with control arm" 2400 \
+  python scripts/ab_bench.py 100000 20
+
+TO=2400 step "convergence 100k" 2400 \
+  python scripts/convergence_bench.py 100000 \
+  --out=artifacts/CONVERGENCE_r05_tpu.json
+
+TO=2400 step "convergence 100k tx4" 2400 \
+  python scripts/convergence_bench.py 100000 --tx=4 \
+  --out=artifacts/CONVERGENCE_r05_tpu_tx4.json
+
+TO=1800 step "chunked-tx bench" 1800 \
+  env BENCH_WORKER=1 BENCH_TX_CELLS=4 python bench.py
+
+echo "=== session end $(date -u)"

@@ -66,10 +66,12 @@ class MiniPg:
             kind, payload = self._read_msg()
             msgs.append((kind, payload))
             if kind == b"Z":
+                # transaction status byte: I idle, T in tx, E failed
+                self.last_status = payload.decode()
                 return msgs
 
     @staticmethod
-    def _parse_rows(msgs):
+    def _parse_rows(msgs, decode=True):
         cols, rows, tag, err = [], [], None, None
         for kind, payload in msgs:
             if kind == b"T":
@@ -89,7 +91,8 @@ class MiniPg:
                     if ln == -1:
                         row.append(None)
                     else:
-                        row.append(payload[off:off + ln].decode())
+                        raw = payload[off:off + ln]
+                        row.append(raw.decode() if decode else raw)
                         off += ln
                 rows.append(row)
             elif kind == b"C":
@@ -103,7 +106,7 @@ class MiniPg:
         self.sock.sendall(b"Q" + struct.pack("!I", len(payload) + 4) + payload)
         return self._parse_rows(self._drain_until_ready())
 
-    def extended(self, sql, params=()):
+    def extended(self, sql, params=(), result_fmts=(), decode=True):
         def msg(kind, payload):
             return kind + struct.pack("!I", len(payload) + 4) + payload
 
@@ -116,13 +119,15 @@ class MiniPg:
             else:
                 raw = str(p).encode()
                 bind += struct.pack("!I", len(raw)) + raw
-        bind += struct.pack("!H", 0)
+        bind += struct.pack("!H", len(result_fmts))
+        for f in result_fmts:
+            bind += struct.pack("!H", f)
         out += msg(b"B", bind)
         out += msg(b"D", b"P\x00")
         out += msg(b"E", b"\x00" + struct.pack("!I", 0))
         out += msg(b"S", b"")
         self.sock.sendall(out)
-        return self._parse_rows(self._drain_until_ready())
+        return self._parse_rows(self._drain_until_ready(), decode=decode)
 
 
 @pytest.fixture(scope="module")
@@ -162,6 +167,70 @@ def test_transaction_noops_and_set(pg):
                         ("SET search_path TO public", "SET")):
         _, _, tag, err = c.query(sql)
         assert err is None and tag == expect
+
+
+# --- round-5 PG depth: real transactions + binary results ----------------
+# (corro-pg runs genuine SQLite txs and answers binary portals,
+#  corro-pg/src/lib.rs)
+
+def test_real_transaction_commit_is_atomic(pg):
+    _, db, _, c = pg
+    _, _, tag, err = c.query("BEGIN")
+    assert err is None and c.last_status == "T"
+    _, _, tag, err = c.query(
+        "INSERT INTO users (id, name, score) VALUES (20, 'tx', 1)")
+    assert err is None and tag == "INSERT 0 1"
+    # buffered: not visible to reads outside the tx yet
+    _, rows = db.query(0, "SELECT id FROM users WHERE id = 20")
+    assert list(rows) == []
+    # read-your-writes for later statements in the block (exact counts)
+    _, _, tag, err = c.query("UPDATE users SET score = 2 WHERE id = 20")
+    assert err is None and tag == "UPDATE 1"
+    _, _, tag, err = c.query("COMMIT")
+    assert err is None and tag == "COMMIT" and c.last_status == "I"
+    _, rows = db.query(0, "SELECT score FROM users WHERE id = 20")
+    assert list(rows) == [[2]]
+
+
+def test_transaction_rollback_discards(pg):
+    _, db, _, c = pg
+    c.query("BEGIN")
+    c.query("INSERT INTO users (id, name, score) VALUES (21, 'gone', 0)")
+    _, _, tag, err = c.query("ROLLBACK")
+    assert err is None and tag == "ROLLBACK" and c.last_status == "I"
+    _, rows = db.query(0, "SELECT id FROM users WHERE id = 21")
+    assert list(rows) == []
+
+
+def test_aborted_transaction_semantics(pg):
+    _, db, _, c = pg
+    c.query("BEGIN")
+    _, _, _, err = c.query("INSERT INTO nope (id) VALUES (1)")
+    assert err is not None and c.last_status == "E"
+    # statements in an aborted block are rejected with 25P02
+    _, _, _, err = c.query(
+        "INSERT INTO users (id, name, score) VALUES (22, 'x', 0)")
+    assert err is not None and b"25P02" in err
+    # COMMIT of an aborted block reports ROLLBACK and applies nothing
+    _, _, tag, err = c.query("COMMIT")
+    assert err is None and tag == "ROLLBACK" and c.last_status == "I"
+    _, rows = db.query(0, "SELECT id FROM users WHERE id = 22")
+    assert list(rows) == []
+
+
+def test_binary_result_format(pg):
+    _, _, _, c = pg
+    c.query("INSERT INTO users (id, name, score) VALUES (23, 'bin', 77)")
+    cols, rows, tag, err = c.extended(
+        "SELECT id, name, score FROM users WHERE id = $1", [23],
+        result_fmts=[1], decode=False)
+    assert err is None and tag == "SELECT 1"
+    (idv, name, score), = rows
+    # INTEGER columns travel as 8-byte big-endian int8
+    assert struct.unpack("!q", idv)[0] == 23
+    assert struct.unpack("!q", score)[0] == 77
+    # text binary format is the utf8 bytes
+    assert name == b"bin"
 
 
 def test_extended_protocol(pg):
