@@ -80,11 +80,17 @@ class AdminServer:
             # peer/mod.rs:1017-1020,1414-1416)
             from corrosion_tpu.utils.tracing import span
 
+            from corrosion_tpu.utils.tracing import inject_traceparent
+
             node = cmd.get("node")
             with span("admin.sync_state", traceparent=cmd.get("traceparent"),
                       node=node if node is not None else "all"):
                 if node is not None:
-                    return {"ok": agent.sync_state(int(node))}
+                    state = agent.sync_state(int(node))
+                    # return the serving span so the caller can link
+                    # both sides (SyncTraceContextV1 round-trip)
+                    state["traceparent"] = inject_traceparent()
+                    return {"ok": state}
                 return {
                     "ok": [agent.sync_state(i) for i in range(agent.n_nodes)]
                 }

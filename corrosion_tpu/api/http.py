@@ -207,7 +207,20 @@ def _make_handler(server: ApiServer):
                     self._reply_json(200, server.agent.members())
                 elif path == "/v1/sync":
                     node = self._node(q)
-                    self._reply_json(200, server.agent.sync_state(node))
+                    # serve_sync extracts the client's traceparent and
+                    # answers inside a joined span (sync.rs:33-67 +
+                    # peer/mod.rs:1414-1416); the server span id is
+                    # returned so the caller can link both sides
+                    from corrosion_tpu.utils.tracing import (
+                        inject_traceparent,
+                        span,
+                    )
+
+                    with span("sync.serve",
+                              traceparent=self.headers.get("traceparent")):
+                        state = server.agent.sync_state(node)
+                        state["traceparent"] = inject_traceparent()
+                    self._reply_json(200, state)
                 elif path == "/metrics":
                     data = server.agent.metrics.render().encode()
                     self.send_response(200)

@@ -104,8 +104,17 @@ class CorrosionApiClient:
         conn = self._connect()
         try:
             payload = None if body is None else json.dumps(body)
-            conn.request(method, path, body=payload,
-                         headers={"Content-Type": "application/json"})
+            headers = {"Content-Type": "application/json"}
+            # cross-process trace propagation (the reference injects
+            # SyncTraceContextV1 into sync handshakes, sync.rs:33-67 +
+            # peer/mod.rs:1017-1020); any active client span rides the
+            # standard W3C header
+            from corrosion_tpu.utils.tracing import inject_traceparent
+
+            tp = inject_traceparent()
+            if tp:
+                headers["traceparent"] = tp
+            conn.request(method, path, body=payload, headers=headers)
             resp = conn.getresponse()
             data = resp.read()
             obj = json.loads(data) if data else None

@@ -584,6 +584,27 @@ class Database:
                   overlay: Optional[Dict[int, Tuple[int, int]]] = None) -> bool:
         return self._read_plane(node, row, CL_COL, overlay) % 2 == 1
 
+    def _row_record(self, node: int, table, pk, row: int,
+                    overlay: Optional[Dict[int, Tuple[int, int]]] = None
+                    ) -> Dict[str, Any]:
+        """The row's visible values keyed by plain column name,
+        overlay-aware (UPDATE expressions read the pre-update row as
+        later statements in the same tx left it)."""
+        snap = self.agent.snapshot()
+        vals, clps = snap["store"][1], snap["store"][4]
+
+        def read(cell: int) -> Tuple[int, int]:
+            if overlay is not None and cell in overlay:
+                return overlay[cell]
+            return int(vals[node, cell]), int(clps[node, cell])
+
+        row_cl, _ = read(self._cell(row, CL_COL))
+        rec: Dict[str, Any] = {table.pk.name: pk}
+        for c in table.value_columns:
+            v, clp = read(self._cell(row, table.col_index(c.name)))
+            rec[c.name] = self.heap.lookup(v) if clp == row_cl else None
+        return rec
+
     # --- writes ----------------------------------------------------------
     def execute(self, node: int, statements: Sequence,
                 wait: bool = True, timeout: float = 30.0) -> List[ExecResult]:
@@ -646,7 +667,9 @@ class Database:
         # ids planned inside open (uncommitted) StagedTxs live only on
         # the host until COMMIT — pin them (code review r5: an idle PG
         # BEGIN block outliving the grace window must not lose values)
-        for tx in list(self._open_txs):
+        with self._mu:  # WeakSet iteration races concurrent BEGIN adds
+            open_txs = list(self._open_txs)
+        for tx in open_txs:
             if not tx._done:
                 # snapshot: the PG handler thread mutates _merged
                 # concurrently with this maintenance-thread scan
@@ -733,9 +756,60 @@ class Database:
         cl = self._read_plane(node, row, CL_COL, overlay)
         live = cl % 2 == 1
         or_clause = (m.group("or") or "").upper()
-        conflict = (m.group("conflict") or "").upper().strip()
+        conflict_raw = (m.group("conflict") or "").strip()
+        conflict = conflict_raw.upper()
         if live and (or_clause == "IGNORE" or "DO NOTHING" in conflict):
             return 0, [], []
+        if live and "DO UPDATE" in conflict:
+            # ON CONFLICT DO UPDATE SET ... (upsert with expressions;
+            # the reference gets this free from SQLite). `excluded.col`
+            # refers to the proposed insert values, a bare column to the
+            # existing row — standard SQLite semantics.
+            du = re.search(r"DO\s+UPDATE\s+SET\s+(?P<sets>.*)$",
+                           conflict_raw, re.IGNORECASE | re.DOTALL)
+            if du is None:
+                raise SqlError(
+                    f"unsupported ON CONFLICT clause: {conflict_raw!r}")
+            excluded = {**by_col, pk_name: pk}
+
+            def res(ref: str) -> str:
+                ref = ref.strip()
+                if "." in ref:
+                    q, _, c = ref.partition(".")
+                    if _unquote(q).lower() != "excluded":
+                        raise SqlError(
+                            f"unknown qualifier {q!r} in DO UPDATE")
+                    c = _unquote(c)
+                    table.column(c)
+                    return f"excluded.{c}"
+                c = _unquote(ref)
+                table.column(c)
+                return c
+
+            rec = self._row_record(node, table, pk, row, overlay)
+            rec.update({f"excluded.{k}": v for k, v in excluded.items()})
+            sets: Dict[str, Any] = {}
+            for part in _split_top_commas(du.group("sets")):
+                if "=" not in part:
+                    raise SqlError(f"bad DO UPDATE SET clause: {part!r}")
+                name, _, raw = part.partition("=")
+                name = _unquote(name)
+                if table.column(name).primary_key:
+                    raise SqlError("cannot DO UPDATE the primary key")
+                try:
+                    sets[name] = _parse_literal(raw, p)
+                except SqlError:
+                    sets[name] = _ExprParser(raw, res, p, True).parse()(rec)
+            for name, value in sets.items():
+                if value is None and table.column(name).not_null:
+                    raise SqlError(
+                        f"NOT NULL violation: {table.name}.{name}")
+            cells = [
+                (self._cell(row, table.col_index(name)),
+                 self.heap.intern(value), cl)
+                for name, value in sets.items()
+            ]
+            return 1, cells, [(table.name, pk, dict(sets), False)]
         # lifetime the write belongs to: the current one for a live-row
         # upsert, the NEXT odd causal length for an insert/resurrect —
         # value cells from a previous lifetime must not leak through
@@ -771,6 +845,13 @@ class Database:
                      overlay: Optional[Dict[int, int]] = None):
         table = self.schema.table(_unquote(m.group("table")))
         sets: Dict[str, Any] = {}
+        exprs: Dict[str, Any] = {}  # SET col = <expression over the row>
+
+        def res(ref: str) -> str:
+            c = _unquote(ref.strip())
+            table.column(c)  # raises on unknown column
+            return c
+
         set_parts = _split_top_commas(m.group("sets"))
         for part in set_parts:
             if "=" not in part:
@@ -780,11 +861,22 @@ class Database:
             col = table.column(name)
             if col.primary_key:
                 raise SqlError("cannot UPDATE the primary key")
-            sets[name] = _parse_literal(raw, p)
+            try:
+                sets[name] = _parse_literal(raw, p)
+            except SqlError:
+                # UPDATE with an expression right side (SET x = x + 1,
+                # SET x = LENGTH(y) ...) — the reference gets this free
+                # from SQLite (sqlite.rs:121-139); evaluated against the
+                # PRE-update row, like SQL
+                exprs[name] = _ExprParser(raw, res, p, True).parse()
         pk = self._split_where_pk(table, m.group("where"), p)
         row = self.rows.get(table.name, pk)
         if row is None or not self._row_live(node, row, overlay):
             return 0, [], []
+        if exprs:
+            rec = self._row_record(node, table, pk, row, overlay)
+            for name, fn in exprs.items():
+                sets[name] = fn(rec)
         for name, value in sets.items():
             if value is None and table.column(name).not_null:
                 raise SqlError(f"NOT NULL violation: {table.name}.{name}")
@@ -1715,7 +1807,8 @@ class StagedTx:
         self._notes: List[tuple] = []
         self._results: List[ExecResult] = []
         self._done = False
-        db._open_txs.add(self)  # pin planned value ids vs compaction
+        with db._mu:  # pin planned value ids vs compaction
+            db._open_txs.add(self)
 
     def execute(self, sql: str, params: Any = None) -> ExecResult:
         if self._done:
@@ -1736,7 +1829,8 @@ class StagedTx:
         if self._done:
             raise SqlError("transaction already finished")
         self._done = True
-        self.db._open_txs.discard(self)
+        with self.db._mu:
+            self.db._open_txs.discard(self)
         cells = self.db._order_tx_cells(self._merged)
         if cells:
             self.db.agent.write_many(self.node, cells, wait=wait,
@@ -1748,6 +1842,7 @@ class StagedTx:
 
     def rollback(self) -> None:
         self._done = True
-        self.db._open_txs.discard(self)
+        with self.db._mu:
+            self.db._open_txs.discard(self)
         self._merged.clear()
         self._notes.clear()

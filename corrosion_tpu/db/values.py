@@ -108,6 +108,10 @@ class ValueHeap:
                 f"value id {vid} was compacted away (heap corruption or "
                 f"a reference the compaction scan missed)"
             )
+        # refresh the grace clock on READ too (GIL-atomic dict write): a
+        # query thread iterating an older store snapshot keeps the ids
+        # it is dereferencing alive against a concurrent compaction pass
+        self._touch[vid] = time.monotonic()
         return v
 
     def __len__(self) -> int:

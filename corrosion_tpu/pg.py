@@ -298,9 +298,18 @@ def _binary_value(v: Any, oid: int) -> Optional[bytes]:
     if v is None:
         return None
     if oid == OID_FLOAT8:
-        return struct.pack("!d", float(v))
+        try:
+            return struct.pack("!d", float(v))
+        except (TypeError, ValueError):
+            # flexible typing: a non-numeric value in a REAL column —
+            # fall back to its utf8 text (length-prefixed, so a strict
+            # client sees len != 8 rather than garbage)
+            return str(v).encode()
     if oid in OID_INTS:
-        return struct.pack("!q", int(v))
+        try:
+            return struct.pack("!q", int(v))
+        except (TypeError, ValueError):
+            return str(v).encode()
     if oid == OID_BYTEA:
         return v if isinstance(v, bytes) else str(v).encode()
     if isinstance(v, bool):  # bool as text-ish byte for OID_TEXT
@@ -640,6 +649,18 @@ def _make_handler(server: PgServer):
                     return
                 tx.commit()
                 self._command_complete("COMMIT")
+                return
+            if verb in ("SAVEPOINT", "RELEASE") or (
+                verb == "ROLLBACK"
+                and re.match(r"ROLLBACK\s+TO\b", upper)
+            ):
+                # savepoints are not supported; erroring (0A000) keeps
+                # the block's state honest — a silent full ROLLBACK for
+                # 'ROLLBACK TO SAVEPOINT' would drop buffered statements
+                # while the client believes the tx is still open
+                self._send_error("savepoints are not supported", "0A000")
+                if self.tx is not None:
+                    self.tx_failed = True
                 return
             if verb == "ROLLBACK":
                 if self.tx is not None:

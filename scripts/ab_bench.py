@@ -152,10 +152,19 @@ def main() -> None:
             ),
         })
 
-    # the control arm runs an IDENTICAL config to default: their spread
-    # is the measurement noise floor, and no other arm's delta counts
-    # unless it clears that floor
-    noise = abs(medians["control"] - medians["default"])
+    # the control arm runs an IDENTICAL config to default: their median
+    # gap is one noise estimate, but it can land near zero by chance —
+    # combine it with the within-arm rep spread (IQR) of both identical
+    # arms so the floor never collapses below the run's real jitter
+    def iqr(name):
+        rps = sorted(rounds / t for t in arms[name]["times"])
+        if len(rps) < 4:
+            return max(rps) - min(rps)
+        q = statistics.quantiles(rps, n=4)
+        return q[2] - q[0]
+
+    noise = max(abs(medians["control"] - medians["default"]),
+                iqr("default"), iqr("control"))
     summary = {
         "metric": f"ab_summary_n{n}_{platform}",
         "reps": reps,

@@ -1,0 +1,147 @@
+"""Quantify the origin-slot collision regime (VERDICT r4 next #9).
+
+With the unbounded writer set, per-actor bookkeeping rides a
+hash-slotted ``[N, n_origins]`` table. When ACTIVE writers outnumber
+slots, different nodes may track different actor subsets — head
+comparison is skipped on misaligned slots (``scale_crdt_metrics``), the
+full-store sweep (``sync_sweep_every``) still converges the data, and
+quiescence realigns the books. This probe measures, for writers ≫
+slots:
+
+- ``org_aligned_frac`` over time under sustained churn (how misaligned
+  the books run in steady state),
+- rounds until STORE convergence after the churn stops (the
+  user-visible guarantee), and
+- rounds until ``org_aligned_frac`` returns to 1.0 (bookkeeping
+  realignment), against the sweep cadence.
+
+Usage: python scripts/collision_probe.py [n] [writers] [churn_rounds]
+       (defaults 4096 64 64; slots = 16, i.e. writers = 4x slots)
+Writes one JSON line per phase + a summary to stdout and, with
+``--out=PATH``, the record list to PATH.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from corrosion_tpu.utils.compile_cache import enable_compile_cache
+
+enable_compile_cache()
+
+import jax.numpy as jnp  # noqa: E402
+import jax.random as jr  # noqa: E402
+
+from corrosion_tpu.sim.scale_step import (  # noqa: E402
+    ScaleRoundInput,
+    ScaleSimState,
+    make_write_inputs,
+    scale_crdt_metrics,
+    scale_run_rounds,
+    scale_sim_config,
+)
+from corrosion_tpu.sim.transport import NetModel  # noqa: E402
+
+CHUNK = 8
+MAX_QUIET = 512
+
+
+def main() -> None:
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    out_path = None
+    for a in sys.argv[1:]:
+        if a.startswith("--out="):
+            out_path = a.split("=", 1)[1]
+    n = int(args[0]) if len(args) > 0 else 4096
+    writers = int(args[1]) if len(args) > 1 else 64
+    churn_rounds = int(args[2]) if len(args) > 2 else 64
+    slots = int(os.environ.get("COLL_SLOTS", "16"))
+
+    cfg = scale_sim_config(n, n_origins=slots)
+    assert cfg.any_writer, "collision probe needs the unbounded writer set"
+    net = NetModel.create(n, drop_prob=0.01)
+    st = ScaleSimState.create(cfg)
+    key = jr.key(0)
+    records = []
+
+    def emit(rec):
+        records.append(rec)
+        print(json.dumps(rec), flush=True)
+
+    # writers spread across the WHOLE id space, 4x the slot table
+    k_w, k_m, k_in = jr.split(jr.key(1), 3)
+    writer_ids = jr.choice(k_w, n, (min(writers, n),), replace=False)
+    is_writer = jnp.zeros(n, bool).at[writer_ids].set(True)
+
+    # --- phase 1: sustained churn, writers >> slots ----------------------
+    aligned_trace = []
+    rounds = 0
+    t0 = time.perf_counter()
+    while rounds < churn_rounds:
+        w = (jr.uniform(jr.fold_in(k_m, rounds), (CHUNK, n)) < 0.25) \
+            & is_writer[None, :]
+        inputs = make_write_inputs(cfg, jr.fold_in(k_in, rounds), CHUNK, w)
+        st, _ = scale_run_rounds(cfg, st, net, jr.fold_in(key, rounds),
+                                 inputs)
+        jax.block_until_ready(st)
+        rounds += CHUNK
+        m = scale_crdt_metrics(cfg, st)
+        aligned_trace.append(round(float(m["org_aligned_frac"]), 4))
+    emit({
+        "phase": "churn",
+        "n": n, "slots": slots, "writers": writers,
+        "rounds": rounds,
+        "org_aligned_frac_trace": aligned_trace,
+        "steady_aligned_frac": aligned_trace[-1],
+        "ms_per_round": round(
+            (time.perf_counter() - t0) * 1000 / rounds, 3),
+        "platform": jax.devices()[0].platform,
+    })
+
+    # --- phase 2: quiescence — store convergence, then book realignment --
+    quiet = ScaleRoundInput.quiet(cfg)
+    quiet_chunk = jax.tree.map(
+        lambda a: jnp.broadcast_to(a, (CHUNK,) + a.shape), quiet
+    )
+    store_conv_at = None
+    realigned_at = None
+    q = 0
+    while q < MAX_QUIET:
+        st, _ = scale_run_rounds(cfg, st, net, jr.fold_in(key, 10_000 + q),
+                                 quiet_chunk)
+        jax.block_until_ready(st)
+        q += CHUNK
+        m = scale_crdt_metrics(cfg, st)
+        if store_conv_at is None and bool(m["converged"]):
+            store_conv_at = q
+        if realigned_at is None and float(m["org_aligned_frac"]) >= 1.0:
+            realigned_at = q
+        if store_conv_at is not None and realigned_at is not None:
+            break
+    sweep_period = max(1, cfg.sync_interval) * max(1, cfg.sync_sweep_every)
+    emit({
+        "phase": "quiescence",
+        "rounds_to_store_convergence": store_conv_at,
+        "rounds_to_book_realignment": realigned_at,
+        "sweep_period_rounds": sweep_period,
+        "realignment_in_sweep_periods": (
+            round(realigned_at / sweep_period, 2)
+            if realigned_at else None),
+        "converged": store_conv_at is not None,
+    })
+
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(records, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -581,6 +581,61 @@ def test_having_or(rich_db):
     assert list(rows) == [[2]]
 
 
+def test_update_with_expression(rich_db):
+    # round 5 dialect: SET col = <expr over the pre-update row>
+    # (the reference gets this free from embedded SQLite)
+    rich_db.execute(0, [("INSERT INTO players (pid, pname, team, score) "
+                         "VALUES (8, 'x', 1, 10)",)])
+    try:
+        rich_db.execute(0, [("UPDATE players SET score = score + 5 "
+                             "WHERE pid = 8",)])
+        _, rows = rich_db.query(0, "SELECT score FROM players WHERE pid = 8")
+        assert list(rows) == [[15]]
+        # expressions see the PRE-update row, and functions work
+        rich_db.execute(0, [("UPDATE players SET score = score * 2, "
+                             "pname = UPPER(pname) WHERE pid = 8",)])
+        _, rows = rich_db.query(
+            0, "SELECT pname, score FROM players WHERE pid = 8")
+        assert list(rows) == [["X", 30]]
+        # within one tx, a later statement reads the earlier write
+        rich_db.execute(0, [
+            ("UPDATE players SET score = 100 WHERE pid = 8",),
+            ("UPDATE players SET score = score + 1 WHERE pid = 8",),
+        ])
+        _, rows = rich_db.query(0, "SELECT score FROM players WHERE pid = 8")
+        assert list(rows) == [[101]]
+    finally:
+        rich_db.execute(0, [("DELETE FROM players WHERE pid = 8",)])
+
+
+def test_on_conflict_do_update(rich_db):
+    # round 5 dialect: ON CONFLICT DO UPDATE SET with excluded.* refs
+    rich_db.execute(0, [("INSERT INTO players (pid, pname, team, score) "
+                         "VALUES (7, 'up', 1, 10)",)])
+    try:
+        # conflicting insert: SET from excluded + expression over both
+        rich_db.execute(0, [(
+            "INSERT INTO players (pid, pname, team, score) "
+            "VALUES (7, 'new', 2, 5) "
+            "ON CONFLICT DO UPDATE SET score = score + excluded.score, "
+            "pname = excluded.pname",)])
+        _, rows = rich_db.query(
+            0, "SELECT pname, team, score FROM players WHERE pid = 7")
+        # team untouched (not in SET), score = 10 + 5, pname replaced
+        assert list(rows) == [["new", 1, 15]]
+        # non-conflicting insert with the clause inserts normally
+        rich_db.execute(0, [(
+            "INSERT INTO players (pid, pname, team, score) "
+            "VALUES (17, 'fresh', 3, 1) "
+            "ON CONFLICT DO UPDATE SET score = excluded.score",)])
+        _, rows = rich_db.query(
+            0, "SELECT pname FROM players WHERE pid = 17")
+        assert list(rows) == [["fresh"]]
+    finally:
+        rich_db.execute(0, [("DELETE FROM players WHERE pid = 7",),
+                            ("DELETE FROM players WHERE pid = 17",)])
+
+
 def test_quoted_identifier_with_keyword(rich_db):
     # ADVICE r4: a double-quoted identifier containing ' OR '/' AND '
     # must not mis-split the WHERE clause (sqlite3 resolves unknown

@@ -315,3 +315,23 @@ def test_join_subscription_tracks_both_tables(rig):
         assert got == "update"
     finally:
         mgr.close()
+
+
+def test_sync_trace_propagation_over_http(rig):
+    """Cross-node trace propagation over the sync surface (the
+    SyncTraceContextV1 analog, sync.rs:33-67: parallel_sync injects the
+    caller's traceparent, serve_sync extracts it and answers inside a
+    joined span)."""
+    from corrosion_tpu.utils.tracing import SpanContext, span
+
+    _, _, _, client = rig
+    with span("sync.client") as ctx:
+        state = client.sync_state(0)
+    server_tp = SpanContext.from_traceparent(state.get("traceparent"))
+    assert server_tp is not None
+    # the server span rides the CLIENT's trace id (joined, not a root)
+    assert server_tp.trace_id == ctx.trace_id
+    assert server_tp.span_id != ctx.span_id
+    # without an active client span the server still answers (own root)
+    state2 = client.sync_state(0)
+    assert SpanContext.from_traceparent(state2.get("traceparent"))

@@ -418,3 +418,19 @@ def test_or_not_through_pg_wire(pg):
         "SELECT name FROM users WHERE NOT (score < $1) AND id IN (7, 8)",
         [80])
     assert err is None and rows == [["svc-a"]]
+
+
+def test_savepoints_rejected_honestly(pg):
+    # code review r5: ROLLBACK TO SAVEPOINT must NOT silently discard
+    # the whole block while reporting success
+    _, db, _, c = pg
+    c.query("BEGIN")
+    c.query("INSERT INTO users (id, name, score) VALUES (30, 'sv', 1)")
+    _, _, _, err = c.query("SAVEPOINT s1")
+    assert err is not None and b"0A000" in err and c.last_status == "E"
+    _, _, _, err = c.query("ROLLBACK TO SAVEPOINT s1")
+    assert err is not None  # still aborted, not a silent full rollback
+    _, _, tag, _ = c.query("COMMIT")
+    assert tag == "ROLLBACK"  # aborted block applied nothing
+    _, rows = db.query(0, "SELECT id FROM users WHERE id = 30")
+    assert list(rows) == []
