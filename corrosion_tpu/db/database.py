@@ -22,6 +22,7 @@ and ``LIMIT n [OFFSET m]``.
 
 from __future__ import annotations
 
+import random as _random
 import re
 import threading
 import time
@@ -46,6 +47,11 @@ _INSERT_RE = re.compile(
     r"INSERT\s+(?:OR\s+(?P<or>IGNORE|REPLACE)\s+)?INTO\s+(?P<table>[\w\"]+)\s*"
     r"\((?P<cols>[^)]*)\)\s*VALUES\s*\((?P<vals>.*)\)\s*"
     r"(?P<conflict>ON\s+CONFLICT.*)?$",
+    re.IGNORECASE | re.DOTALL,
+)
+_INSERT_SELECT_RE = re.compile(
+    r"INSERT\s+(?:OR\s+(?P<or>IGNORE|REPLACE)\s+)?INTO\s+(?P<table>[\w\"]+)\s*"
+    r"\((?P<cols>[^)]*)\)\s*(?P<select>(?:WITH|SELECT)\s.*)$",
     re.IGNORECASE | re.DOTALL,
 )
 _UPDATE_RE = re.compile(
@@ -98,8 +104,11 @@ _ISNULL_RE = re.compile(
     r"^(?P<col>[\w\".]+)\s+IS\s+(?P<neg>NOT\s+)?NULL$",
     re.IGNORECASE | re.DOTALL,
 )
-_WITH_RE = re.compile(r"^\s*WITH\s+", re.IGNORECASE)
-_CTE_HEAD_RE = re.compile(r"^\s*([\w\"]+)\s+AS\s*\(", re.IGNORECASE)
+_WITH_RE = re.compile(r"^\s*WITH\s+(?:RECURSIVE\s+)?", re.IGNORECASE)
+_CTE_HEAD_RE = re.compile(
+    r"^\s*([\w\"]+)\s*(?:\(([^)]*)\))?\s+AS\s*\(", re.IGNORECASE
+)
+_UNION_ALL_RE = re.compile(r"\bUNION\s+ALL\b", re.IGNORECASE)
 
 
 class _CteColumn:
@@ -132,6 +141,43 @@ class _CteTable:
 
     def has_column(self, name: str) -> bool:
         return any(c.name == name for c in self.columns)
+
+
+class _DualTable(_CteTable):
+    """One-row, zero-column pseudo table backing FROM-less SELECTs
+    (``SELECT random()``, ``SELECT 1`` — the base select of the
+    reference's recursive bulk-insert generator)."""
+
+    def __init__(self):
+        super().__init__("__dual__", [], ast=None)
+
+
+class _RecursiveCte(_CteTable):
+    """``WITH RECURSIVE name(cols) AS (base UNION ALL step)`` — the
+    reference's stress drivers use exactly this as a bulk row generator
+    (``INSERT INTO testsbool (id) WITH RECURSIVE cte(id) AS (SELECT
+    random() UNION ALL SELECT random() FROM cte LIMIT n) ...``,
+    ``agent/tests.rs:622``, ``.antithesis/.../parallel_driver_large_tx_
+    sync.sh``). Evaluated iteratively: the step select sees the rows
+    produced by the PREVIOUS iteration; generation stops at the body's
+    LIMIT (total rows, like SQLite's compound limit) or a safety cap."""
+
+    MAX_ROWS = 1_000_000  # runaway-recursion backstop without a LIMIT
+
+    def __init__(self, name: str, col_names: List[str], base_ast,
+                 step_ast, limit: Optional[int], self_marker,
+                 self_referential: bool = True):
+        super().__init__(name, col_names, ast=None)
+        self.base_ast = base_ast
+        self.step_ast = step_ast
+        self.limit = limit
+        # the step's self-reference is a plain _CteTable whose ast IS
+        # this marker; execution pre-seeds the memo slot with the
+        # previous iteration's rows, so the self-ref never recurses
+        self.self_marker = self_marker
+        # a UNION ALL whose step never reads the CTE is a plain
+        # compound: base + one step pass, no iteration
+        self.self_referential = self_referential
 
 
 import functools
@@ -228,6 +274,9 @@ class _ExprParser:
                                else str(args[0]).lower()),
         "ABS": lambda args: (None if args[0] is None
                              else abs(_num(args[0]))),
+        # SQLite random(): a signed 64-bit integer (the reference's
+        # stress drivers generate pks with it, agent/tests.rs:622)
+        "RANDOM": lambda args: _random.randint(-(1 << 63), (1 << 63) - 1),
         "ROUND": lambda args: (
             None if args[0] is None
             else _sqlite_round(float(_num(args[0])),
@@ -676,7 +725,7 @@ class Database:
                 refs.update(v for v, _l in list(tx._merged.values()))
         return refs
 
-    def compact_heap(self, grace_seconds: float = 60.0) -> int:
+    def compact_heap(self, grace_seconds: float = 300.0) -> int:
         """One heap-compaction pass: free ids referenced nowhere in
         device state (ids are stable — unreferenced ones go to a free
         list for reuse, device planes are never rewritten). The grace
@@ -719,6 +768,9 @@ class Database:
         m = _INSERT_RE.match(sql)
         if m:
             return self._plan_insert(node, m, p, overlay)
+        m = _INSERT_SELECT_RE.match(sql)
+        if m:
+            return self._plan_insert_select(node, m, p, overlay)
         m = _UPDATE_RE.match(sql)
         if m:
             return self._plan_update(node, m, p, overlay)
@@ -730,11 +782,8 @@ class Database:
                            "statements go to /v1/queries)")
         raise SqlError(f"unsupported statement: {sql[:80]!r}")
 
-    def _plan_insert(self, node: int, m, p: _Params,
-                     overlay: Optional[Dict[int, int]] = None):
-        table = self.schema.table(_unquote(m.group("table")))
-        col_names = [_unquote(c) for c in m.group("cols").split(",")]
-        vals = [_parse_literal(v, p) for v in _split_top_commas(m.group("vals"))]
+    def _insert_by_col(self, table, col_names: List[str], vals: List[Any]):
+        """Shared INSERT row prep: (pk, by_col with defaults filled)."""
         if len(col_names) != len(vals):
             raise SqlError(f"{len(col_names)} columns but {len(vals)} values")
         by_col = dict(zip(col_names, vals))
@@ -751,12 +800,49 @@ class Database:
                 raise SqlError(f"NOT NULL violation: {table.name}.{c.name}")
         for name in by_col:
             table.column(name)  # raises on unknown column
+        return pk, by_col
 
+    def _plan_insert_select(self, node: int, m, p: _Params,
+                            overlay: Optional[Dict[int, int]] = None):
+        """``INSERT INTO t (cols) SELECT ...`` (incl. a WITH RECURSIVE
+        generator select — the reference's bulk-insert stress shape,
+        ``agent/tests.rs:622``). Each produced row plans like a VALUES
+        insert; later rows observe earlier ones through a local overlay
+        (duplicate pks upsert, like sequential inserts)."""
+        table = self.schema.table(_unquote(m.group("table")))
+        col_names = [_unquote(c) for c in m.group("cols").split(",")]
+        ast = self._parse_select(m.group("select"), p)
+        or_clause = (m.group("or") or "").upper()
+        ov = dict(overlay or {})
+        total, cells_acc, notes_acc = 0, [], []
+        for vals in list(self._run_select(node, ast, overlay=ov)):
+            pk, by_col = self._insert_by_col(table, col_names, list(vals))
+            n1, cells, notes = self._plan_insert_core(
+                node, table, pk, by_col, or_clause, "", p, ov)
+            ov.update({c: (v, l) for c, v, l in cells})
+            total += n1
+            cells_acc.extend(cells)
+            notes_acc.extend(notes)
+        return total, cells_acc, notes_acc
+
+    def _plan_insert(self, node: int, m, p: _Params,
+                     overlay: Optional[Dict[int, int]] = None):
+        table = self.schema.table(_unquote(m.group("table")))
+        col_names = [_unquote(c) for c in m.group("cols").split(",")]
+        vals = [_parse_literal(v, p) for v in _split_top_commas(m.group("vals"))]
+        pk, by_col = self._insert_by_col(table, col_names, vals)
+        return self._plan_insert_core(
+            node, table, pk, by_col, (m.group("or") or "").upper(),
+            (m.group("conflict") or "").strip(), p, overlay,
+        )
+
+    def _plan_insert_core(self, node: int, table, pk, by_col: Dict[str, Any],
+                          or_clause: str, conflict_raw: str, p: _Params,
+                          overlay: Optional[Dict[int, int]] = None):
+        pk_name = table.pk.name
         row = self.rows.get_or_alloc(table.name, pk)
         cl = self._read_plane(node, row, CL_COL, overlay)
         live = cl % 2 == 1
-        or_clause = (m.group("or") or "").upper()
-        conflict_raw = (m.group("conflict") or "").strip()
         conflict = conflict_raw.upper()
         if live and (or_clause == "IGNORE" or "DO NOTHING" in conflict):
             return 0, [], []
@@ -990,8 +1076,40 @@ class Database:
             if depth:
                 raise SqlError(f"unbalanced parens in WITH {name!r}")
             body = rest[hm.end():i - 1].strip()
-            sub = self._parse_select(body, p, check_params, ctes=out)
-            out[name] = _CteTable(name, [c[2] for c in sub["cols"]], sub)
+            head_cols = [
+                _unquote(c) for c in (hm.group(2) or "").split(",")
+                if c.strip()
+            ]
+            um = None
+            for m2 in _UNION_ALL_RE.finditer(body):
+                if self._top_level_mask(body)[m2.start()]:
+                    um = m2
+                    break
+            if um is not None:
+                # recursive CTE: base UNION ALL step [LIMIT total]
+                base_ast = self._parse_select(body[:um.start()], p,
+                                              check_params, ctes=out)
+                cols = head_cols or [c[2] for c in base_ast["cols"]]
+                marker = object()
+                placeholder = _CteTable(name, cols, marker)
+                step_ast = self._parse_select(
+                    body[um.end():], p, check_params,
+                    ctes={**out, name: placeholder},
+                )
+                # the compound's LIMIT (total generated rows) parses as
+                # the step select's limit — lift it off the step
+                limit = step_ast.get("limit")
+                step_ast = {**step_ast, "limit": None, "offset": None}
+                self_ref = any(
+                    isinstance(t, _CteTable) and t.ast is marker
+                    for t in step_ast["aliases"].values()
+                )
+                out[name] = _RecursiveCte(name, cols, base_ast, step_ast,
+                                          limit, marker, self_ref)
+            else:
+                sub = self._parse_select(body, p, check_params, ctes=out)
+                cols = head_cols or [c[2] for c in sub["cols"]]
+                out[name] = _CteTable(name, cols, sub)
             rest = rest[i:].lstrip()
             if rest.startswith(","):
                 rest = rest[1:].lstrip()
@@ -1014,7 +1132,15 @@ class Database:
         ]
         from_marks = [m for m in marks if m[2] == "FROM"]
         if not from_marks:
-            raise SqlError(f"SELECT without FROM: {sql[:80]!r}")
+            # FROM-less SELECT: evaluate the projection once against a
+            # one-row dual table (SQLite semantics); re-parse with the
+            # synthesized FROM inserted before any trailing clauses
+            insert_at = marks[0][0] if marks else len(sql)
+            sql2 = (sql[:insert_at] + " FROM __dual__ " + sql[insert_at:])
+            return self._parse_select(
+                sql2, p, check_params,
+                ctes={**(ctes or {}), "__dual__": _DualTable()},
+            )
         # clause segmentation: text between consecutive top-level keywords
         segs = []
         for i, (s, e, kw) in enumerate(marks):
@@ -1340,6 +1466,18 @@ class Database:
         A CTE materializes its sub-select against the same node ONCE
         per top-level execution (``cte_memo``): chained/self-joined CTE
         references reuse the rows, matching SQLite's materialization."""
+        if isinstance(table, _DualTable):
+            return [{}]  # one empty record: constant projections emit once
+        if isinstance(table, _RecursiveCte):
+            names = [c.name for c in table.columns]
+            memo = cte_memo if cte_memo is not None else {}
+            key = (node, id(table))
+            if key not in memo:
+                memo[key] = self._run_recursive_cte(node, table, memo)
+            return [
+                {f"{alias}.{k}": v for k, v in zip(names, row)}
+                for row in memo[key]
+            ]
         if isinstance(table, _CteTable):
             names = [c.name for c in table.columns]
             memo = cte_memo if cte_memo is not None else {}
@@ -1381,7 +1519,7 @@ class Database:
         return out
 
     def _run_select(self, node: int, ast,
-                    cte_memo=None) -> Iterable[List[Any]]:
+                    cte_memo=None, overlay=None) -> Iterable[List[Any]]:
         if cte_memo is None:
             cte_memo = {}
         ast = {
@@ -1392,6 +1530,18 @@ class Database:
         snap = self.agent.snapshot()
         vals = snap["store"][1][node]
         clps = snap["store"][4][node]
+        if overlay:
+            # transaction-local pending cells (INSERT ... SELECT inside
+            # a multi-statement tx must see earlier statements' writes,
+            # like every other write path); nested subqueries still read
+            # the committed store
+            import numpy as np
+
+            vals = np.array(vals)
+            clps = np.array(clps)
+            for cell, (v, lf) in overlay.items():
+                vals[cell] = v
+                clps[cell] = lf
         aliases = ast["aliases"]
         has_agg = any(k == "agg" for k, _, _ in ast["cols"])
         if (not ast["joins"] and not ast["group"] and not ast["order"]
@@ -1615,6 +1765,34 @@ class Database:
         if fn == "AVG":
             return sum(vals) / len(vals)
         raise SqlError(f"unknown aggregate {fn}")
+
+    def _run_recursive_cte(self, node: int, cte: _RecursiveCte,
+                           memo: dict) -> List[list]:
+        """Iterative evaluation: rows = base; repeat step (which sees
+        only the previous iteration's rows through the pre-seeded memo
+        slot) until no new rows, the total LIMIT, or the safety cap."""
+        cap = cte.limit if cte.limit is not None else cte.MAX_ROWS
+        rows = list(self._run_select(node, cte.base_ast, cte_memo=memo))
+        frontier = rows
+        self_key = (node, id(cte.self_marker))
+        if not cte.self_referential:
+            rows.extend(self._run_select(node, cte.step_ast,
+                                         cte_memo=memo))
+            return rows[:cap]
+        while frontier and len(rows) < cap:
+            # overwrite the self-ref slot: the step sees ONLY the
+            # previous iteration's rows (other CTEs stay memoized once)
+            memo[self_key] = frontier
+            frontier = list(
+                self._run_select(node, cte.step_ast, cte_memo=memo)
+            )
+            rows.extend(frontier)
+            if cte.limit is None and len(rows) > cte.MAX_ROWS:
+                raise SqlError(
+                    f"recursive CTE {cte.name!r} exceeded "
+                    f"{cte.MAX_ROWS} rows without a LIMIT"
+                )
+        return rows[:cap]
 
     def _materialize(self, table, pk, vals, clps, row) -> Dict[str, Any]:
         """A row's visible values: a cell counts only if it was written in

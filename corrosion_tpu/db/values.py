@@ -108,9 +108,13 @@ class ValueHeap:
                 f"value id {vid} was compacted away (heap corruption or "
                 f"a reference the compaction scan missed)"
             )
-        # refresh the grace clock on READ too (GIL-atomic dict write): a
-        # query thread iterating an older store snapshot keeps the ids
-        # it is dereferencing alive against a concurrent compaction pass
+        # refresh the grace clock on READ too (GIL-atomic dict write).
+        # Honest contract: this protects ids a reader RE-dereferences;
+        # an id a stale snapshot has not read yet is protected only by
+        # the grace window itself — a streaming consumer iterating a
+        # snapshot older than grace_seconds can hit a loud LookupError
+        # (never silent reuse inside the window). Size grace_seconds
+        # above the longest expected reader.
         self._touch[vid] = time.monotonic()
         return v
 
@@ -127,7 +131,7 @@ class ValueHeap:
     def freed_total(self) -> int:
         return self._freed_total
 
-    def compact(self, referenced, grace_seconds: float = 60.0) -> int:
+    def compact(self, referenced, grace_seconds: float = 300.0) -> int:
         """Free every id not in ``referenced`` and not interned within
         the last ``grace_seconds`` (a write planned on the host may not
         have reached device state yet — the grace window keeps its id

@@ -38,7 +38,10 @@ class MaintenanceLoop:
                  checkpoint_rounds: int = 512,
                  heap_soft_limit: int = 1_000_000,
                  heap_compact_rounds: int = 256,
-                 heap_grace_seconds: float = 60.0,
+                 # above the longest expected streaming reader: an id a
+                 # stale snapshot has not dereferenced yet is protected
+                 # only by this window (values.py lookup contract)
+                 heap_grace_seconds: float = 300.0,
                  interval_seconds: float = 2.0):
         self.agent = agent
         self.db = db

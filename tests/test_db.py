@@ -255,7 +255,7 @@ def test_json_contains():
 def rich_db():
     """Two tables + a deterministic dataset for the relational surface."""
     cfg = db_config()
-    cfg.sim.n_rows = 16  # 3 squads + up to 6 players share the row grid
+    cfg.sim.n_rows = 40  # squads + players + round-5 bulk-insert pks share the grid
     with Agent(cfg) as agent:
         agent.wait_rounds(5, timeout=120)
         d = Database(agent)
@@ -579,6 +579,72 @@ def test_having_or(rich_db):
         0, "SELECT team FROM players GROUP BY team "
            "HAVING NOT (COUNT(*) > 2) ORDER BY team")
     assert list(rows) == [[2]]
+
+
+def test_from_less_select_and_random(rich_db):
+    # round 5: FROM-less SELECTs evaluate once against a dual row
+    _, rows = rich_db.query(0, "SELECT 1 + 2")
+    assert list(rows) == [[3]]
+    _, rows = rich_db.query(0, "SELECT random()")
+    (v,), = list(rows)
+    assert isinstance(v, int) and -(1 << 63) <= v < (1 << 63)
+
+
+def test_recursive_cte_generator(rich_db):
+    # the reference's stress-driver shape (agent/tests.rs:622): a
+    # recursive CTE as a bounded row generator
+    _, rows = rich_db.query(
+        0, "WITH RECURSIVE cte(n) AS (SELECT 1 UNION ALL "
+           "SELECT n + 1 FROM cte LIMIT 5) SELECT n FROM cte")
+    assert list(rows) == [[1], [2], [3], [4], [5]]
+    # random() generator: LIMIT bounds the total row count
+    _, rows = rich_db.query(
+        0, "WITH RECURSIVE cte(id) AS (SELECT random() UNION ALL "
+           "SELECT random() FROM cte LIMIT 7) SELECT id FROM cte")
+    got = list(rows)
+    assert len(got) == 7 and all(isinstance(r[0], int) for r in got)
+
+
+def test_insert_select_bulk(rich_db):
+    # INSERT INTO t (cols) WITH RECURSIVE ... SELECT — the reference's
+    # bulk-insert driver (parallel_driver_large_tx_sync.sh)
+    res = rich_db.execute(0, [(
+        "INSERT INTO players (pid, pname, team, score) "
+        "WITH RECURSIVE g(n) AS (SELECT 100 UNION ALL "
+        "SELECT n + 1 FROM g LIMIT 4) "
+        "SELECT n, 'bulk', 1, n * 2 FROM g",)])
+    try:
+        assert res[0]["rows_affected"] == 4
+        _, rows = rich_db.query(
+            0, "SELECT pid, score FROM players WHERE pname = 'bulk' "
+               "ORDER BY pid")
+        assert list(rows) == [[100, 200], [101, 202], [102, 204],
+                              [103, 206]]
+    finally:
+        rich_db.execute(0, [
+            (f"DELETE FROM players WHERE pid = {i}",)
+            for i in range(100, 104)
+        ])
+
+
+def test_insert_select_sees_earlier_tx_statements(rich_db):
+    # code review r5: INSERT...SELECT must read the tx overlay — an
+    # earlier statement's row is selectable (SQLite sequential-tx
+    # semantics)
+    try:
+        res = rich_db.execute(0, [
+            ("INSERT INTO players (pid, pname, team, score) "
+             "VALUES (110, 'ov', 1, 7)",),
+            ("INSERT INTO squads (sid, title) "
+             "SELECT pid, pname FROM players WHERE pid = 110",),
+        ])
+        assert [r["rows_affected"] for r in res] == [1, 1]
+        _, rows = rich_db.query(0, "SELECT title FROM squads "
+                                   "WHERE sid = 110")
+        assert list(rows) == [["ov"]]
+    finally:
+        rich_db.execute(0, [("DELETE FROM players WHERE pid = 110",),
+                            ("DELETE FROM squads WHERE sid = 110",)])
 
 
 def test_update_with_expression(rich_db):

@@ -57,6 +57,9 @@ def test_single_writer_converges(cfg):
 
     m = scale_crdt_metrics(cfg, st)
     assert bool(m["converged"]), f"diverged: {int(m['n_diverged'])} nodes"
+    # full convergence implies the store-only milestone (round 5: the
+    # collision probe separates them — converged => store_converged)
+    assert bool(m["store_converged"])
     # writer's values actually landed everywhere
     assert int(st.crdt.store[1][-1, 0]) >= 100
 
