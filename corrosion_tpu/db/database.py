@@ -166,11 +166,13 @@ class _RecursiveCte(_CteTable):
 
     def __init__(self, name: str, col_names: List[str], base_ast,
                  step_ast, limit: Optional[int], self_marker,
-                 self_referential: bool = True):
+                 self_referential: bool = True,
+                 offset: Optional[int] = None):
         super().__init__(name, col_names, ast=None)
         self.base_ast = base_ast
         self.step_ast = step_ast
         self.limit = limit
+        self.offset = offset
         # the step's self-reference is a plain _CteTable whose ast IS
         # this marker; execution pre-seeds the memo slot with the
         # previous iteration's rows, so the self-ref never recurses
@@ -1096,16 +1098,19 @@ class Database:
                     body[um.end():], p, check_params,
                     ctes={**out, name: placeholder},
                 )
-                # the compound's LIMIT (total generated rows) parses as
-                # the step select's limit — lift it off the step
+                # the compound's LIMIT/OFFSET (total generated rows,
+                # SQLite semantics) parse as the step select's — lift
+                # them off the step
                 limit = step_ast.get("limit")
+                offset = step_ast.get("offset")
                 step_ast = {**step_ast, "limit": None, "offset": None}
                 self_ref = any(
                     isinstance(t, _CteTable) and t.ast is marker
                     for t in step_ast["aliases"].values()
                 )
                 out[name] = _RecursiveCte(name, cols, base_ast, step_ast,
-                                          limit, marker, self_ref)
+                                          limit, marker, self_ref,
+                                          offset=offset)
             else:
                 sub = self._parse_select(body, p, check_params, ctes=out)
                 cols = head_cols or [c[2] for c in sub["cols"]]
@@ -1461,11 +1466,14 @@ class Database:
 
     # --- SELECT execution -------------------------------------------------
     def _table_records(self, node: int, table, alias: str, vals, clps,
-                       cte_memo=None):
+                       cte_memo=None, overlay=None):
         """All live rows of one table as {'alias.col': value} dicts.
         A CTE materializes its sub-select against the same node ONCE
         per top-level execution (``cte_memo``): chained/self-joined CTE
-        references reuse the rows, matching SQLite's materialization."""
+        references reuse the rows, matching SQLite's materialization.
+        ``overlay`` (tx-pending cells) flows into CTE bodies so an
+        ``INSERT ... WITH ... SELECT`` inside a transaction sees earlier
+        statements, same as the plain-select form."""
         if isinstance(table, _DualTable):
             return [{}]  # one empty record: constant projections emit once
         if isinstance(table, _RecursiveCte):
@@ -1473,7 +1481,8 @@ class Database:
             memo = cte_memo if cte_memo is not None else {}
             key = (node, id(table))
             if key not in memo:
-                memo[key] = self._run_recursive_cte(node, table, memo)
+                memo[key] = self._run_recursive_cte(node, table, memo,
+                                                    overlay=overlay)
             return [
                 {f"{alias}.{k}": v for k, v in zip(names, row)}
                 for row in memo[key]
@@ -1483,8 +1492,17 @@ class Database:
             memo = cte_memo if cte_memo is not None else {}
             key = (node, id(table.ast))
             if key not in memo:
+                if not isinstance(table.ast, dict):
+                    # the bare self-reference marker outside a seeded
+                    # recursive evaluation (e.g. referenced from a
+                    # subquery, which runs with a fresh memo)
+                    raise SqlError(
+                        f"recursive reference to {table.name!r} is only "
+                        f"supported in the step's FROM/JOIN"
+                    )
                 memo[key] = list(
-                    self._run_select(node, table.ast, cte_memo=memo)
+                    self._run_select(node, table.ast, cte_memo=memo,
+                                     overlay=overlay)
                 )
             return [
                 {f"{alias}.{k}": v for k, v in zip(names, row)}
@@ -1554,14 +1572,14 @@ class Database:
             return
         records = self._table_records(
             node, aliases[ast["base"]], ast["base"], vals, clps,
-            cte_memo=cte_memo,
+            cte_memo=cte_memo, overlay=overlay,
         )
         # hash equi-joins, in declaration order
         for jtype, a, lref, rref in ast["joins"]:
             lkey, rkey = ast["resolve"](lref), ast["resolve"](rref)
             # probe side = the newly joined table's rows
             right = self._table_records(node, aliases[a], a, vals, clps,
-                                        cte_memo=cte_memo)
+                                        cte_memo=cte_memo, overlay=overlay)
             probe_key = rkey if rkey.startswith(f"{a}.") else lkey
             build_key = lkey if probe_key == rkey else rkey
             if not probe_key.startswith(f"{a}."):
@@ -1767,24 +1785,28 @@ class Database:
         raise SqlError(f"unknown aggregate {fn}")
 
     def _run_recursive_cte(self, node: int, cte: _RecursiveCte,
-                           memo: dict) -> List[list]:
+                           memo: dict, overlay=None) -> List[list]:
         """Iterative evaluation: rows = base; repeat step (which sees
         only the previous iteration's rows through the pre-seeded memo
-        slot) until no new rows, the total LIMIT, or the safety cap."""
-        cap = cte.limit if cte.limit is not None else cte.MAX_ROWS
-        rows = list(self._run_select(node, cte.base_ast, cte_memo=memo))
+        slot) until no new rows, the total LIMIT (+OFFSET skip, SQLite
+        compound semantics), or the safety cap."""
+        off = cte.offset or 0
+        cap = (cte.limit + off) if cte.limit is not None else cte.MAX_ROWS
+        rows = list(self._run_select(node, cte.base_ast, cte_memo=memo,
+                                     overlay=overlay))
         frontier = rows
         self_key = (node, id(cte.self_marker))
         if not cte.self_referential:
             rows.extend(self._run_select(node, cte.step_ast,
-                                         cte_memo=memo))
-            return rows[:cap]
+                                         cte_memo=memo, overlay=overlay))
+            return rows[off:cap]
         while frontier and len(rows) < cap:
             # overwrite the self-ref slot: the step sees ONLY the
             # previous iteration's rows (other CTEs stay memoized once)
             memo[self_key] = frontier
             frontier = list(
-                self._run_select(node, cte.step_ast, cte_memo=memo)
+                self._run_select(node, cte.step_ast, cte_memo=memo,
+                                 overlay=overlay)
             )
             rows.extend(frontier)
             if cte.limit is None and len(rows) > cte.MAX_ROWS:
@@ -1792,7 +1814,7 @@ class Database:
                     f"recursive CTE {cte.name!r} exceeded "
                     f"{cte.MAX_ROWS} rows without a LIMIT"
                 )
-        return rows[:cap]
+        return rows[off:cap]
 
     def _materialize(self, table, pk, vals, clps, row) -> Dict[str, Any]:
         """A row's visible values: a cell counts only if it was written in

@@ -588,6 +588,7 @@ def scale_crdt_metrics(cfg: ScaleSimConfig, st: ScaleSimState):
         # versions — while stores stay converged via the sweep; this
         # metric separates the two (scripts/collision_probe.py)
         "store_converged": jnp.all(store_ok),
+        "n_store_diverged": jnp.sum(~store_ok),
         "n_diverged": jnp.sum(~ok),
         "total_needs": jnp.sum(jnp.where(alive[:, None], jnp.maximum(needs, 0), 0)),
         "org_aligned_frac": org_aligned_frac,

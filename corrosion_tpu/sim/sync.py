@@ -182,8 +182,18 @@ def sync_step(
     claim_plain = (
         ok[:, 0, None]
         & evictable
-        & (org_p[:, 0, :] >= 0)
-        & (org_p[:, 0, :] != cst.book.org_id)
+        # monotone lattice rule, SAME as the sweep claim (round 5): the
+        # tracked actor id per slot is non-decreasing. Without the
+        # ordering, quiescence flip-flops forever — after
+        # org_keep_rounds idle rounds every slot is evictable, and two
+        # nodes tracking different slot-colliding actors keep swapping
+        # assignments (each claim resets head/known_max, re-opening
+        # needs that sync then re-drains: measured as total_needs
+        # oscillating at 200-380k through 512 quiet rounds at 4096
+        # nodes, scripts/collision_probe.py). Displaced smaller-id
+        # actors lose BOOKKEEPING only; their data still rides the
+        # sweep's full-store merge.
+        & (org_p[:, 0, :] > cst.book.org_id)
         # never trade real (idle) bookkeeping for a peer slot with
         # nothing to grant — an empty claim resets dedupe state for
         # zero data

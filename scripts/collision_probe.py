@@ -51,7 +51,9 @@ from corrosion_tpu.sim.scale_step import (  # noqa: E402
 from corrosion_tpu.sim.transport import NetModel  # noqa: E402
 
 CHUNK = 8
-MAX_QUIET = int(os.environ.get("COLL_MAX_QUIET", "512"))
+# long enough to capture the store-convergence epidemic tail (measured
+# at 1024/64w: divergence pinned until ~round 340, zero by ~472)
+MAX_QUIET = int(os.environ.get("COLL_MAX_QUIET", "1536"))
 
 
 def main() -> None:
@@ -65,7 +67,12 @@ def main() -> None:
     churn_rounds = int(args[2]) if len(args) > 2 else 64
     slots = int(os.environ.get("COLL_SLOTS", "16"))
 
-    cfg = scale_sim_config(n, n_origins=slots)
+    overrides = {}
+    if os.environ.get("COLL_SWEEP"):
+        # sweep-cadence arm: the full-store sweep is the store-epidemic
+        # engine; its cadence bounds store convergence latency
+        overrides["sync_sweep_every"] = int(os.environ["COLL_SWEEP"])
+    cfg = scale_sim_config(n, n_origins=slots, **overrides)
     assert cfg.any_writer, "collision probe needs the unbounded writer set"
     net = NetModel.create(n, drop_prob=0.01)
     st = ScaleSimState.create(cfg)
@@ -122,6 +129,7 @@ def main() -> None:
     store_conv_at = None
     full_conv_at = None
     needs_trace = []
+    store_div_trace = []
     q = 0
     while q < MAX_QUIET:
         st, _ = run(st, net, jr.fold_in(key, 10_000 + q), quiet_chunk)
@@ -129,6 +137,7 @@ def main() -> None:
         q += CHUNK
         m = scale_crdt_metrics(cfg, st)
         needs_trace.append(int(m["total_needs"]))
+        store_div_trace.append(int(m["n_store_diverged"]))
         if store_conv_at is None and bool(m["store_converged"]):
             store_conv_at = q
         if full_conv_at is None and bool(m["converged"]):
@@ -150,6 +159,8 @@ def main() -> None:
         "final_org_aligned_frac": round(float(m["org_aligned_frac"]), 4),
         "final_total_needs": int(m["total_needs"]),
         "needs_trace_per_chunk": needs_trace[::8],
+        # the store epidemic: diverged-replica count per 8th chunk
+        "store_div_trace_per_chunk": store_div_trace[::8],
         "sweep_period_rounds": sweep_period,
         "store_converged": store_conv_at is not None,
     })

@@ -647,6 +647,38 @@ def test_insert_select_sees_earlier_tx_statements(rich_db):
                             ("DELETE FROM squads WHERE sid = 110",)])
 
 
+def test_insert_with_cte_select_sees_tx_overlay(rich_db):
+    # code review r5: the overlay must flow into CTE bodies too
+    try:
+        res = rich_db.execute(0, [
+            ("INSERT INTO players (pid, pname, team, score) "
+             "VALUES (115, 'cte', 1, 3)",),
+            ("INSERT INTO squads (sid, title) "
+             "WITH c AS (SELECT pid, pname FROM players WHERE pid = 115) "
+             "SELECT pid, pname FROM c",),
+        ])
+        assert [r["rows_affected"] for r in res] == [1, 1]
+    finally:
+        rich_db.execute(0, [("DELETE FROM players WHERE pid = 115",),
+                            ("DELETE FROM squads WHERE sid = 115",)])
+
+
+def test_recursive_cte_offset_and_subquery_ref(rich_db):
+    # compound LIMIT n OFFSET m skips m rows (SQLite semantics)
+    _, rows = rich_db.query(
+        0, "WITH RECURSIVE c(n) AS (SELECT 1 UNION ALL "
+           "SELECT n + 1 FROM c LIMIT 3 OFFSET 2) SELECT n FROM c")
+    assert list(rows) == [[3], [4], [5]]
+    # a self-reference from a subquery fails loudly, not with TypeError
+    from corrosion_tpu.db.database import SqlError
+
+    with pytest.raises(SqlError):
+        _, rows = rich_db.query(
+            0, "WITH RECURSIVE c(n) AS (SELECT 1 UNION ALL SELECT 2 "
+               "WHERE 2 IN (SELECT n FROM c)) SELECT n FROM c")
+        list(rows)
+
+
 def test_update_with_expression(rich_db):
     # round 5 dialect: SET col = <expr over the pre-update row>
     # (the reference gets this free from embedded SQLite)
