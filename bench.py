@@ -472,11 +472,15 @@ def main() -> None:
             # bank a fresh small-N TPU number FIRST (fast compile),
             # then attempt 100k with whatever window remains.
             head = _git_head()
+            # fresh AND full-N: a banked fresh-25k record at this commit
+            # must not imply the 100k executable is cached
             cache_fresh = (
                 cached is not None
                 and cached.get("platform") not in (None, "cpu")
                 and head
                 and cached.get("measured_commit") == head
+                and f"_n{os.environ.get('BENCH_NODES', '100000')}_"
+                in str(cached.get("metric", ""))
             )
             if cache_fresh:
                 plan = [
@@ -521,7 +525,6 @@ def main() -> None:
             return rec
 
         probe_ok = True
-        banked = None  # a fresh small-N success held while 100k is tried
         for is_probe, label, env_extra, timeout_s, sleep_s in plan:
             if remaining() <= cpu_reserve + (120.0 if patient else 75.0):
                 errors.append(f"{label}: skipped, deadline budget exhausted")
@@ -544,10 +547,11 @@ def main() -> None:
                 if rec is not None:
                     _save_cache(rec)
                     if label == "fresh-25k":
-                        # bank it and still try 100k in the remaining
+                        # emit it and still try 100k in the remaining
                         # window (code review r5: returning here would
-                        # leave 100k forever unmeasured at new commits)
-                        banked = rec
+                        # leave 100k forever unmeasured at new commits);
+                        # if 100k fails, the tail re-emits emitted[-1]
+                        # — this record — as the final line
                         _emit(rec)
                         emitted.append(rec)
                         continue

@@ -1552,14 +1552,22 @@ class Database:
             # transaction-local pending cells (INSERT ... SELECT inside
             # a multi-statement tx must see earlier statements' writes,
             # like every other write path); nested subqueries still read
-            # the committed store
-            import numpy as np
+            # the committed store. Patched planes are memoized per
+            # (node, overlay) so a recursive CTE's per-iteration
+            # re-entry doesn't re-copy the full planes every time.
+            memo_key = ("__overlay__", node, id(overlay))
+            patched = cte_memo.get(memo_key)
+            if patched is None:
+                import numpy as np
 
-            vals = np.array(vals)
-            clps = np.array(clps)
-            for cell, (v, lf) in overlay.items():
-                vals[cell] = v
-                clps[cell] = lf
+                vals = np.array(vals)
+                clps = np.array(clps)
+                for cell, (v, lf) in overlay.items():
+                    vals[cell] = v
+                    clps[cell] = lf
+                cte_memo[memo_key] = (vals, clps)
+            else:
+                vals, clps = patched
         aliases = ast["aliases"]
         has_agg = any(k == "agg" for k, _, _ in ast["cols"])
         if (not ast["joins"] and not ast["group"] and not ast["order"]

@@ -140,14 +140,27 @@ def claim_slots_arrays(head, km, seen_flat, org_id, org_last, origin,
     and the pallas ingest kernel so the two cannot drift (the
     ``swim_tables_update`` convention).
 
-    Per slot column: if any fresh message's origin hashes there but the
-    slot tracks a different actor, the largest such origin takes the
-    slot — but only when the slot is free or its occupant has been idle
-    for ``keep_rounds`` (an active tracked actor is never evicted, so
-    the legacy fixed-pool regime — all writers < O, identity claims —
-    never churns). Eviction resets the slot's head/known_max/window;
-    sync rebuilds them (the bounded-table analog of the reference's
-    per-observed-actor map, ``agent.rs:1270-1604``).
+    Per slot column: if any fresh message's origin hashes there with a
+    LARGER id than the slot's occupant, the largest such origin takes
+    the slot — but only when the slot is free or its occupant has been
+    idle for ``keep_rounds`` (an active tracked actor is never evicted,
+    so the legacy fixed-pool regime — all writers < O, identity claims —
+    never churns). Claims are MONOTONE in the actor id (round 5, same
+    lattice rule as the sync-side claim): recency-ordered claims let a
+    quiescent cluster churn forever — circulating changesets for the
+    colliding smaller actor evict the idle occupant, the eviction wipes
+    the slot's seen window, the wiped window makes the occupant's old
+    versions look fresh again, and freshly-recorded versions re-enter
+    the broadcast queues with full budgets (measured: 50-140 org
+    flips + saw-tooth known_max per round through 512 quiet rounds,
+    PERF.md round 5). Under the monotone rule assignments converge and
+    the storm decays by budget exhaustion; a smaller-id actor colliding
+    with a larger one keeps apply-everywhere semantics but leans on the
+    writer's own fanout + the sync sweep for dissemination (the
+    documented collision trade; budget-following re-broadcast is the
+    round-6 fairness fix). Eviction resets the slot's
+    head/known_max/window; sync rebuilds them (the bounded-table analog
+    of the reference's per-observed-actor map, ``agent.rs:1270-1604``).
 
     Returns ``(head, km, seen_flat, org_id, org_last)``."""
     b, o = head.shape
@@ -156,7 +169,7 @@ def claim_slots_arrays(head, km, seen_flat, org_id, org_last, origin,
     for c in range(o):
         owner = org_id[:, c]
         cand = fresh & (slot == c) & (origin >= 0)
-        foreign = cand & (origin != owner[:, None])
+        foreign = cand & (origin > owner[:, None])
         any_f = jnp.any(foreign, axis=1)
         new_owner = jnp.max(jnp.where(foreign, origin, -1), axis=1)
         evictable = (owner < 0) | (org_last[:, c] + keep_rounds < now)
