@@ -55,11 +55,41 @@ OID_FLOAT8 = 701
 OID_TEXT = 25
 OID_BYTEA = 17
 
-# SQLSTATE codes (corro-pg ships a full table, sql_state.rs)
+# SQLSTATE codes (corro-pg ships a full table, sql_state.rs; this maps
+# the error classes this engine actually raises)
 SQLSTATE_SYNTAX = "42601"
 SQLSTATE_UNDEFINED_TABLE = "42P01"
+SQLSTATE_UNDEFINED_COLUMN = "42703"
+SQLSTATE_AMBIGUOUS_COLUMN = "42702"
+SQLSTATE_NOT_NULL = "23502"
+SQLSTATE_INVALID_TEXT = "22P02"
+SQLSTATE_FEATURE_UNSUPPORTED = "0A000"
+SQLSTATE_PROGRAM_LIMIT = "54000"
 SQLSTATE_INTERNAL = "XX000"
 SQLSTATE_IN_FAILED_TX = "25P02"
+
+
+def _sqlstate_for(exc: Exception) -> str:
+    """Map an engine error to the PG SQLSTATE a real server would send
+    (``corro-pg/src/sql_state.rs`` ships the full table; this covers
+    the classes this engine raises)."""
+    msg = str(exc).lower()
+    if "no such table" in msg:
+        return SQLSTATE_UNDEFINED_TABLE
+    if "no such column" in msg or "unknown column" in msg:
+        return SQLSTATE_UNDEFINED_COLUMN
+    if "ambiguous column" in msg:
+        return SQLSTATE_AMBIGUOUS_COLUMN
+    if "not null violation" in msg or "cannot be null" in msg:
+        return SQLSTATE_NOT_NULL
+    if "unsupported literal" in msg:
+        return SQLSTATE_INVALID_TEXT
+    if "not supported" in msg or "do not support" in msg:
+        return SQLSTATE_FEATURE_UNSUPPORTED
+    if ("capacity exhausted" in msg or "exceeded int32 id space" in msg
+            or ("recursive cte" in msg and "exceeded" in msg)):
+        return SQLSTATE_PROGRAM_LIMIT
+    return SQLSTATE_SYNTAX
 
 
 def _col_oid(sql_type: str) -> int:
@@ -809,9 +839,7 @@ def _make_handler(server: PgServer):
             except (SqlError, SchemaError) as e:
                 if self.tx is not None:
                     self.tx_failed = True  # abort the open BEGIN block
-                code = (SQLSTATE_UNDEFINED_TABLE if "no such table" in str(e)
-                        else SQLSTATE_SYNTAX)
-                self._send_error(str(e), code)
+                self._send_error(str(e), _sqlstate_for(e))
             except Exception as e:  # noqa: BLE001
                 if self.tx is not None:
                     self.tx_failed = True
@@ -947,9 +975,7 @@ def _make_handler(server: PgServer):
             except (SqlError, SchemaError) as e:
                 if self.tx is not None:
                     self.tx_failed = True  # abort the open BEGIN block
-                code = (SQLSTATE_UNDEFINED_TABLE if "no such table" in str(e)
-                        else SQLSTATE_SYNTAX)
-                self._send_error(str(e), code)
+                self._send_error(str(e), _sqlstate_for(e))
             except Exception as e:  # noqa: BLE001
                 if self.tx is not None:
                     self.tx_failed = True

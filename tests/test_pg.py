@@ -434,3 +434,16 @@ def test_savepoints_rejected_honestly(pg):
     assert tag == "ROLLBACK"  # aborted block applied nothing
     _, rows = db.query(0, "SELECT id FROM users WHERE id = 30")
     assert list(rows) == []
+
+
+def test_sqlstate_mapping(pg):
+    # round-5 SQLSTATE depth (sql_state.rs analog): error classes map
+    # to the codes a real PG server would send
+    _, _, _, c = pg
+    _, _, _, err = c.query("SELECT * FROM no_table_here")
+    assert b"42P01" in err
+    _, _, _, err = c.query("SELECT nope_col FROM users")
+    assert b"42703" in err
+    _, _, _, err = c.query(
+        "INSERT INTO users (id, name, score) VALUES (NULL, 'x', 1)")
+    assert b"23502" in err  # pk cannot be NULL
