@@ -688,7 +688,8 @@ def _make_handler(server: PgServer):
                 # the block's state honest — a silent full ROLLBACK for
                 # 'ROLLBACK TO SAVEPOINT' would drop buffered statements
                 # while the client believes the tx is still open
-                self._send_error("savepoints are not supported", "0A000")
+                self._send_error("savepoints are not supported",
+                                 SQLSTATE_FEATURE_UNSUPPORTED)
                 if self.tx is not None:
                     self.tx_failed = True
                 return

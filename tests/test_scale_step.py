@@ -334,6 +334,45 @@ def test_any_writer_beyond_origin_pool_converges():
     assert int(st.crdt.book.org_id[5, 30 % 8]) == 30
 
 
+def test_smaller_id_collider_still_converges_storewise():
+    """The monotone claim rule's documented trade (round 5): a writer
+    whose id is SMALLER than its slot's tracked actor never takes the
+    slot's bookkeeping — but its data must still reach every replica
+    (own fanout + the full-store sweep). Store convergence is the
+    user-visible guarantee; the slot stays with the larger actor."""
+    cfg = scale_sim_config(
+        48, m_slots=16, n_origins=8, n_rows=4, n_cols=2, sync_interval=4,
+        org_keep_rounds=8,
+    )
+    net = NetModel.create(cfg.n_nodes, drop_prob=0.0)
+    st = ScaleSimState.create(cfg)
+    st, _ = run(cfg, st, net, jr.key(0), quiet_inputs(cfg, 40))
+
+    n = cfg.n_nodes
+    # writer 10 (slot 10 % 8 = 2) writes FIRST and goes idle; writer 2
+    # (same slot, smaller id) writes later — under the monotone rule it
+    # must NOT take the slot, yet its cells must converge everywhere
+    rounds = 40
+    inp = quiet_inputs(cfg, rounds)
+    w = (jnp.zeros((rounds, n), bool)
+         .at[0:3, 10].set(True).at[25:28, 2].set(True))
+    val = (jnp.zeros((rounds, n), jnp.int32)
+           .at[0:3, 10].set(200).at[25:28, 2].set(100))
+    cell = (jnp.zeros((rounds, n), jnp.int32)
+            .at[0:3, 10].set(2).at[25:28, 2].set(1))
+    inp = inp._replace(write_mask=w, write_cell=cell, write_val=val)
+    st, _ = run(cfg, st, net, jr.key(1), inp)
+    st, _ = run(cfg, st, net, jr.key(2), quiet_inputs(cfg, 300))
+
+    m = scale_crdt_metrics(cfg, st)
+    assert bool(m["store_converged"]), int(m["n_store_diverged"])
+    # both writers' data landed everywhere
+    assert int(st.crdt.store[1][7, 2]) == 200
+    assert int(st.crdt.store[1][7, 1]) == 100
+    # the slot still tracks the LARGER actor (monotone: no downgrade)
+    assert int(st.crdt.book.org_id[7, 2]) == 10
+
+
 def test_slot_eviction_idle_owner_loses():
     """A colliding writer evicts an idle slot occupant after
     org_keep_rounds; the cluster still converges (sync rebuilds)."""
