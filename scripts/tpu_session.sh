@@ -48,4 +48,7 @@ TO=2400 step "convergence 100k tx4" 2400 \
 TO=1800 step "chunked-tx bench" 1800 \
   env BENCH_WORKER=1 BENCH_TX_CELLS=4 python bench.py
 
+TO=1800 step "many-writer bench (collision regime)" 1800 \
+  env BENCH_WORKER=1 BENCH_WRITERS=1024 python bench.py
+
 echo "=== session end $(date -u)"
