@@ -180,3 +180,46 @@ def test_subquery_table_change_falls_back_to_full(rig):
         assert m.n_queries > q0
     finally:
         mgr.close()
+
+
+def test_shared_tracker_serves_both_managers_once(rig):
+    """SubsManager and UpdatesManager share one DeltaTracker through
+    Database.delta_tracker(); the per-(node, round) cache means the
+    second consumer reuses the first's computation AND both still see
+    the same candidates (an earlier design advanced the baseline on
+    first read, handing the second manager an empty delta)."""
+    agent, db = rig
+    from corrosion_tpu.pubsub import SubsManager, UpdatesManager
+
+    mgr = SubsManager(db)
+    upd = UpdatesManager(db, node=0)
+    assert mgr._tracker is upd._tracker  # one tracker per Database
+    try:
+        m, _ = mgr.subscribe(0, "SELECT pk, v FROM items")
+        q_upd = upd.attach("items")
+        agent.wait_rounds(2, timeout=60)
+        calls = {"n": 0}
+        orig = type(mgr._tracker).changed
+
+        def spy(self, node):
+            calls["n"] += 1
+            return orig(self, node)
+
+        type(mgr._tracker).changed = spy
+        try:
+            db.execute(0, [("UPDATE items SET v = 777 WHERE pk = 3",)])
+            agent.wait_rounds(3, timeout=60)
+        finally:
+            type(mgr._tracker).changed = orig
+        # both consumers observed the change...
+        assert m._state[3] == (3, 777)
+        events = []
+        while not q_upd.empty():
+            events.append(q_upd.get_nowait())
+        assert any(ev[0] == "notify" and ev[1][1] == 3 for ev in events)
+        # ...and the tracker was consulted by both every round (cache
+        # hit for the second) — 2 calls per round, all served
+        assert calls["n"] >= 2
+    finally:
+        mgr.close()
+        db.agent.remove_round_listener(upd._on_round)
