@@ -590,6 +590,10 @@ class UpdatesManager:
         self._tracker = db.delta_tracker()  # shared, per-round cached
         self._feeds: Dict[str, List[queue.Queue]] = {}
         self._state: Dict[str, Dict[Any, Tuple]] = {}
+        # tables whose last incremental re-read failed: their deltas are
+        # consumed (the tracker baseline advanced), so the next round
+        # must run a full self-healing snapshot
+        self._force_full: set = set()
         self._mu = threading.Lock()
         db.agent.add_round_listener(self._on_round)
 
@@ -629,27 +633,63 @@ class UpdatesManager:
             logger.exception("delta tracking failed for node %s", self.node)
             cands = None
         for table in tables:
-            if cands is not None and table not in cands:
+            force = table in self._force_full
+            if cands is not None and table not in cands and not force:
                 continue  # no applied change touched this table
             try:
-                fresh = self._snapshot_table(table)
+                if cands is None or force:
+                    # unknown delta (or recovering from a failed
+                    # incremental read whose candidates are already
+                    # consumed): full table snapshot + full diff
+                    fresh = self._snapshot_table(table)
+                    partial = None
+                    self._force_full.discard(table)
+                else:
+                    # incremental: re-read only the candidate rows
+                    # (read_row returns None for dead/absent rows)
+                    t = self.db.schema.table(table)
+                    cols = [c.name for c in t.columns]
+                    partial = {}
+                    for pk in cands[table]:
+                        row = self.db.read_row(self.node, table, pk)
+                        partial[pk] = (
+                            tuple(row.get(c) for c in cols)
+                            if row is not None else None
+                        )
+                    fresh = None
             except Exception:  # noqa: BLE001
                 logger.exception("updates feed poll failed for %s", table)
+                # the round's candidates are consumed (tracker baseline
+                # advanced): self-heal with a full snapshot next round
+                self._force_full.add(table)
                 continue
             with self._mu:
                 old = self._state.get(table)
                 if old is None:
                     continue
                 events = []
-                for pk, row in fresh.items():
-                    if pk not in old:
-                        events.append((INSERT, pk))
-                    elif old[pk] != row:
-                        events.append((UPSERT, pk))
-                for pk in old:
-                    if pk not in fresh:
-                        events.append((DELETE, pk))
-                self._state[table] = fresh
+                if partial is not None:
+                    for pk, row in partial.items():
+                        if row is None:
+                            if pk in old:
+                                events.append((DELETE, pk))
+                                old.pop(pk, None)
+                        elif pk not in old:
+                            events.append((INSERT, pk))
+                            old[pk] = row
+                        elif old[pk] != row:
+                            events.append((UPSERT, pk))
+                            old[pk] = row
+                else:
+                    for pk, row in fresh.items():
+                        if pk not in old:
+                            events.append((INSERT, pk))
+                        elif old[pk] != row:
+                            events.append((UPSERT, pk))
+                    for pk in old:
+                        if pk not in fresh:
+                            events.append((DELETE, pk))
+                    self._state[table] = fresh
                 subs = list(self._feeds.get(table, ()))
             lagged = []
             for q in subs:

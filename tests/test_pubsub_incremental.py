@@ -223,3 +223,30 @@ def test_shared_tracker_serves_both_managers_once(rig):
     finally:
         mgr.close()
         db.agent.remove_round_listener(upd._on_round)
+
+
+def test_updates_feed_incremental_insert_update_delete(rig):
+    """The updates feed re-reads only candidate rows (round 5): INSERT,
+    UPSERT, and DELETE all surface through the partial path."""
+    agent, db = rig
+    from corrosion_tpu.pubsub import UpdatesManager
+
+    upd = UpdatesManager(db, node=0)
+    try:
+        q = upd.attach("items")
+        agent.wait_rounds(2, timeout=60)
+        db.execute(0, [("INSERT INTO items (pk, v, grp) "
+                        "VALUES (55, 1, 0)",)])
+        agent.wait_rounds(3, timeout=60)
+        db.execute(0, [("UPDATE items SET v = 2 WHERE pk = 55",)])
+        agent.wait_rounds(3, timeout=60)
+        db.execute(0, [("DELETE FROM items WHERE pk = 55",)])
+        agent.wait_rounds(3, timeout=60)
+        kinds = []
+        while not q.empty():
+            ev = q.get_nowait()
+            if ev[0] == "notify" and ev[1][1] == 55:
+                kinds.append(ev[1][0])
+        assert kinds == ["insert", "update", "delete"]
+    finally:
+        db.agent.remove_round_listener(upd._on_round)
