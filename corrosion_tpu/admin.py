@@ -186,6 +186,16 @@ class AdminServer:
                 db=self.db, repivot=bool(cmd.get("repivot", True)),
             )
             return {"ok": {"node": node}}
+        if name == "compact":
+            # operator-triggered heap compaction (the vacuum_db analog;
+            # the maintenance loop also runs it on cadence)
+            if self.db is None:
+                return {"error": "no database attached"}
+            freed = self.db.compact_heap(
+                grace_seconds=float(cmd.get("grace_seconds", 300.0)))
+            return {"ok": {"freed": freed,
+                           "live": self.db.heap.live_count,
+                           "len": len(self.db.heap)}}
         return {"error": f"unknown command {name!r}"}
 
 

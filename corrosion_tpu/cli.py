@@ -216,6 +216,13 @@ def cmd_locks(args) -> int:
     return 0
 
 
+def cmd_compact(args) -> int:
+    with _admin(args) as admin:
+        print(json.dumps(
+            admin.call("compact", grace_seconds=args.grace), indent=2))
+    return 0
+
+
 def cmd_backup(args) -> int:
     with _admin(args) as admin:
         path = admin.call("backup", path=args.path, node=args.node)
@@ -387,6 +394,12 @@ def build_parser() -> argparse.ArgumentParser:
     lk = sub.add_parser("locks", help="lock registry dump")
     lk.add_argument("--top", type=int, default=10)
     lk.set_defaults(fn=cmd_locks)
+
+    cp = sub.add_parser("compact",
+                        help="compact the value heap (vacuum analog)")
+    cp.add_argument("--grace", type=float, default=300.0,
+                    help="seconds of touch-recency that pin an id")
+    cp.set_defaults(fn=cmd_compact)
 
     b = sub.add_parser("backup", help="portable single-node backup")
     b.add_argument("path")

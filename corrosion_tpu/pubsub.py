@@ -613,6 +613,7 @@ class UpdatesManager:
                 if not self._feeds[table]:
                     del self._feeds[table]
                     del self._state[table]
+                    self._force_full.discard(table)
 
     def _snapshot_table(self, table: str) -> Dict[Any, Tuple]:
         t = self.db.schema.table(table)

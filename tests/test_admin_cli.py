@@ -135,3 +135,24 @@ def test_cli_backup_restore(tmp_path, rig, capsys):
     assert cli.main(base + ["checkpoint", str(tmp_path / "cli_ck")]) == 0
     ck = capsys.readouterr().out.strip()
     assert cli.main(base + ["restore", ck, "--full"]) == 0
+
+
+def test_admin_compact(rig):
+    """Operator-triggered heap compaction (round 5, vacuum_db analog):
+    an unreferenced value frees; the live value stays resolvable."""
+    agent, db, _, uds = rig
+    # a value UNIQUE to this test, then overwrite it everywhere
+    db.execute(0, [("UPDATE kv SET v = 987654 WHERE k = 'a'",)])
+    agent.wait_rounds(20, timeout=120)
+    vid_old = db.heap.intern(987654)
+    db.execute(0, [("UPDATE kv SET v = 987655 WHERE k = 'a'",)])
+    agent.wait_rounds(24, timeout=120)  # drain queues everywhere
+    import time
+    time.sleep(0.1)
+    with AdminClient(uds) as admin:
+        out = admin.call("compact", grace_seconds=0.0)
+    assert out["freed"] >= 1 and out["live"] <= out["len"]
+    with pytest.raises(LookupError):
+        db.heap.lookup(vid_old)
+    _, rows = db.query(0, "SELECT v FROM kv WHERE k = 'a'")
+    assert list(rows) == [[987655]]
