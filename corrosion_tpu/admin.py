@@ -191,8 +191,13 @@ class AdminServer:
             # the maintenance loop also runs it on cadence)
             if self.db is None:
                 return {"error": "no database attached"}
-            freed = self.db.compact_heap(
-                grace_seconds=float(cmd.get("grace_seconds", 300.0)))
+            # floor the grace on a LIVE agent: ids interned by writes
+            # not yet applied to device state are protected only by
+            # this window (values.py lookup contract); 0/negative would
+            # free them mid-flight. Tests hit Database.compact_heap
+            # directly when they need an immediate pass.
+            grace = max(5.0, float(cmd.get("grace_seconds", 300.0)))
+            freed = self.db.compact_heap(grace_seconds=grace)
             return {"ok": {"freed": freed,
                            "live": self.db.heap.live_count,
                            "len": len(self.db.heap)}}

@@ -80,10 +80,10 @@ def cmd_agent(args, cfg=None, regions=None) -> int:
     api = admin = pg = prom = None
     try:
         db = Database(agent)
+        from corrosion_tpu.maintenance import MaintenanceLoop
+
         maint = None
         if cfg.db.checkpoint_rounds > 0:
-            from corrosion_tpu.maintenance import MaintenanceLoop
-
             # boot-time resume from the newest restorable rotated side
             # (the reference replays buffered state at boot, run_root.rs);
             # runs BEFORE schema files so edited schemas apply on top of
@@ -95,13 +95,16 @@ def cmd_agent(args, cfg=None, regions=None) -> int:
         for path in cfg.db.schema_paths:
             with open(path) as f:
                 db.apply_schema_sql(f.read())
-        if cfg.db.checkpoint_rounds > 0:
-            from corrosion_tpu.maintenance import MaintenanceLoop
-
-            maint = MaintenanceLoop(
-                agent, db=db, checkpoint_path=cfg.db.path,
-                checkpoint_rounds=cfg.db.checkpoint_rounds,
-            ).start()
+        # the maintenance loop always runs (heap compaction, member
+        # persistence, gauges — handlers.rs:455-540's loop is
+        # unconditional too); checkpointing itself stays gated on the
+        # configured cadence
+        maint = MaintenanceLoop(
+            agent, db=db,
+            checkpoint_path=(cfg.db.path
+                             if cfg.db.checkpoint_rounds > 0 else None),
+            checkpoint_rounds=max(1, cfg.db.checkpoint_rounds),
+        ).start()
         api = ApiServer(db, addr=cfg.api.addr, port=cfg.api.port).start()
         admin = AdminServer(agent, cfg.admin.uds_path, db=db).start()
         if cfg.pg.enabled:
