@@ -447,3 +447,26 @@ def test_sqlstate_mapping(pg):
     _, _, _, err = c.query(
         "INSERT INTO users (id, name, score) VALUES (NULL, 'x', 1)")
     assert b"23502" in err  # pk cannot be NULL
+
+
+def test_sqlstate_mapper_units():
+    from corrosion_tpu.pg import _sqlstate_for
+
+    cases = [
+        ("no such table: users", "42P01"),
+        ("no such column: t.nope", "42703"),
+        ("unknown column 'x'", "42703"),
+        ("ambiguous column 'id' (qualify it)", "42702"),
+        ("NOT NULL violation: users.name", "23502"),
+        ("pk users.id cannot be NULL", "23502"),
+        ("unsupported literal: 'x", "22P02"),
+        ("savepoints are not supported", "0A000"),
+        ("subscriptions do not support WITH (CTEs)", "0A000"),
+        ("grid row capacity exhausted (8); raise [sim].n_rows", "54000"),
+        ("value heap exceeded int32 id space", "54000"),
+        ("recursive CTE 'c' exceeded 1000000 rows without a LIMIT",
+         "54000"),
+        ("unsupported WHERE/HAVING clause: '???'", "42601"),
+    ]
+    for msg, want in cases:
+        assert _sqlstate_for(Exception(msg)) == want, msg
