@@ -327,6 +327,10 @@ def use_fused() -> bool:
 
 def use_fused_ingest(cfg, msgs: int = 16, emit: bool = False) -> bool:
     """Shape-aware answer for the ingest kernel at ``cfg``'s widths."""
+    if getattr(cfg, "bcast_wire_budget", False):
+        # the wire-budget payload lane predates the kernel's ref layout
+        # — flagged configs take the XLA path (round-6 kernel work)
+        return False
     if FORCE_FUSED is not None:
         return FORCE_FUSED
     return use_fused() and _width_ok_ingest(cfg, msgs, emit)

@@ -314,7 +314,8 @@ def local_write_tx(cfg: SimConfig, cst: CrdtState, tx_mask, tx_cell, tx_val,
 
 
 def ingest_changes(cfg, cst: CrdtState, live, m_origin, m_dbv, m_cell, m_ver,
-                   m_val, m_site, m_clp, m_seq=None, m_nseq=None, m_ts=None):
+                   m_val, m_site, m_clp, m_seq=None, m_nseq=None, m_ts=None,
+                   m_tx=None):
     """Receiver ingest shared by every dissemination carrier: dedupe via
     the Book, apply fresh cells to the LWW store, re-enqueue fresh changes
     for re-broadcast with a decremented budget (``handlers.rs:548-786``,
@@ -354,6 +355,20 @@ def ingest_changes(cfg, cst: CrdtState, live, m_origin, m_dbv, m_cell, m_ver,
     rebudget = jnp.full(
         m_origin.shape, max(1, cfg.bcast_max_transmissions - 1), jnp.int32
     )
+    wire_budget = (
+        m_tx is not None and getattr(cfg, "bcast_wire_budget", False)
+    )
+    if wire_budget:
+        # budget-following re-broadcast (round 5): an unowned fresh
+        # message re-enqueues at the INCOMING budget minus one —
+        # circulation terminates by budget depth, not seen-dedupe, so
+        # actors displaced from their hash slot by the monotone claim
+        # rule still spread epidemically. Owned/recorded messages keep
+        # the classic fresh budget (dedupe bounds them).
+        wire_next = jnp.clip(
+            m_tx.astype(jnp.int32) - 1, 0,
+            max(1, cfg.bcast_max_transmissions - 1),
+        )
 
     # fold received HLC stamps into each node's clock; stamps too far
     # ahead of local time get their changes dropped (handlers.rs:689-701)
@@ -375,6 +390,17 @@ def ingest_changes(cfg, cst: CrdtState, live, m_origin, m_dbv, m_cell, m_ver,
 
     fresh = fresh1
     enq = rec1
+    wire_extra = None
+    if wire_budget:
+        from corrosion_tpu.ops.versions import org_slot
+
+        _, owned1 = org_slot(book, m_origin)
+        wire_extra = fresh1 & ~owned1 & (wire_next > 0)
+        enq = rec1 | wire_extra
+        # ONLY the unowned-fresh messages ride the wire budget; owned/
+        # recorded ones (incl. chunked fragments below) keep the classic
+        # fresh budget — seen-dedupe bounds those
+        rebudget = jnp.where(wire_extra, wire_next, rebudget)
     completed = jnp.int32(0)
     if cfg.tx_max_cells > 1:
         # --- chunked versions: buffer, complete, then apply atomically --
@@ -416,6 +442,10 @@ def ingest_changes(cfg, cst: CrdtState, live, m_origin, m_dbv, m_cell, m_ver,
 
         _, owned_m = org_slot(book, m_origin)
         enq = rec1 | (fresh_m & owned_m)
+        if wire_extra is not None:
+            # keep the wire-budget re-broadcast for displaced actors'
+            # single-cell messages in chunked configs too
+            enq = enq | wire_extra
         completed = jnp.sum(full)
 
     # re-broadcast only RECORDED changes (+ buffered fresh chunks):

@@ -97,6 +97,15 @@ class ScaleSimConfig:
     # --- dissemination ---------------------------------------------------
     bcast_queue: int = 32
     bcast_max_transmissions: int = 4
+    # budget-following re-broadcast (round 5, default OFF): the wire
+    # payload carries each changeset's REMAINING transmission budget,
+    # and receivers re-enqueue even bookkeeping-less (unowned) fresh
+    # messages at ``incoming - 1`` — circulation terminates by budget
+    # depth instead of relying on seen-dedupe, which restores epidemic
+    # spread for actors displaced from their hash slot by the monotone
+    # claim rule (collision fairness). Forces the XLA ingest path (the
+    # fused kernel predates the wire lane).
+    bcast_wire_budget: bool = False
     pig_changes: int = 4  # changesets per SWIM packet
     # per-node per-round send budget in wire bytes (10 MiB/s analog);
     # bounds how many queued changesets may ride this round's packets
@@ -326,27 +335,43 @@ def piggyback_bcast_step(cfg: ScaleSimConfig, cst: CrdtState, channels, key,
         # [N, 11*R] plane; each channel is ONE fast row gather of that
         # small plane (barriered — a fused row gather scalarizes on this
         # backend, see PERF.md)
-        fields = (
+        fields = [
             cst.q_origin, cst.q_dbv, cst.q_cell, cst.q_ver, cst.q_val,
             cst.q_site, cst.q_clp, cst.q_seq, cst.q_nseq, cst.q_ts,
-        )
+        ]
+        if cfg.bcast_wire_budget:
+            # wire-budget lane: the changeset's REMAINING transmission
+            # budget rides the packet so receivers can re-enqueue at
+            # incoming-1 (budget-following re-broadcast)
+            fields.append(cst.q_tx.astype(jnp.int32))
         payload = jnp.concatenate(
             [select_cols(f, sel_slots) for f in fields]
             + [sel_ok.astype(jnp.int32)],
             axis=1,
-        )  # [N, 11*R]
+        )  # [N, (n_fields+1)*R]
 
     # --- gather each channel's payload; [N, n_channels*R] messages ------
+    # an emitted (kernel-packed) payload is always 10 lanes + ok; the
+    # use_fused_ingest gate forces the XLA path under the flag — keep
+    # that invariant local
+    assert emitted is None or not cfg.bcast_wire_budget
+    n_fields = 11 if cfg.bcast_wire_budget else 10
     parts, valids = [], []
     for src, valid in channels:
         src = jnp.clip(src, 0)
-        got = jax.lax.optimization_barrier(payload[src])  # [N, 11*R]
-        parts.append([got[:, i * r:(i + 1) * r] for i in range(10)])
-        valids.append(valid[:, None] & (got[:, 10 * r:11 * r] != 0))
+        got = jax.lax.optimization_barrier(payload[src])
+        parts.append([got[:, i * r:(i + 1) * r] for i in range(n_fields)])
+        valids.append(
+            valid[:, None]
+            & (got[:, n_fields * r:(n_fields + 1) * r] != 0)
+        )
+    lanes = [
+        jnp.concatenate([p[i] for p in parts], axis=1)
+        for i in range(n_fields)
+    ]
     (m_origin, m_dbv, m_cell, m_ver, m_val, m_site, m_clp, m_seq, m_nseq,
-     m_ts) = (
-        jnp.concatenate([p[i] for p in parts], axis=1) for i in range(10)
-    )
+     m_ts) = lanes[:10]
+    m_tx = lanes[10] if cfg.bcast_wire_budget else None
     live = jnp.concatenate(valids, axis=1)
 
     # --- sender budget decrement: one per delivered packet ---------------
@@ -366,7 +391,7 @@ def piggyback_bcast_step(cfg: ScaleSimConfig, cst: CrdtState, channels, key,
     # --- receiver ingest: dedupe, apply, re-broadcast --------------------
     return ingest_changes(
         cfg, cst, live, m_origin, m_dbv, m_cell, m_ver, m_val, m_site, m_clp,
-        m_seq, m_nseq, m_ts,
+        m_seq, m_nseq, m_ts, m_tx=m_tx,
     )
 
 

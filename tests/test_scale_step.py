@@ -7,6 +7,7 @@ same LWW store, equal heads, and no outstanding needs.
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import jax.random as jr
 import pytest
 
@@ -371,6 +372,63 @@ def test_smaller_id_collider_still_converges_storewise():
     assert int(st.crdt.store[1][7, 1]) == 100
     # the slot still tracks the LARGER actor (monotone: no downgrade)
     assert int(st.crdt.book.org_id[7, 2]) == 10
+
+
+def test_wire_budget_restores_displaced_actor_epidemic():
+    """Budget-following re-broadcast (round 5, bcast_wire_budget): with
+    sync effectively disabled, a displaced smaller-id actor's write
+    reaches every node ONLY when receivers re-forward it at the wire
+    budget minus one — without the flag, circulation stops at the
+    writer's own fanout (receivers hold no bookkeeping for the actor,
+    so the classic rec-gate never re-enqueues). Circulation then
+    terminates by budget depth: queues drain to empty."""
+    import dataclasses
+
+    n = 48
+    base = scale_sim_config(
+        n, m_slots=16, n_origins=8, n_rows=4, n_cols=2,
+        sync_interval=10_000, org_keep_rounds=10_000,
+        bcast_max_transmissions=8,
+    )
+    rounds = 48
+
+    def coverage(cfg):
+        net = NetModel.create(n, drop_prob=0.0)
+        st = ScaleSimState.create(cfg)
+        st, _ = run(cfg, st, net, jr.key(0), quiet_inputs(cfg, 30))
+        # org slots initialize to IDENTITY (slot c tracks actor c) and
+        # the huge keep_rounds means nothing ever evicts: actor 10
+        # (slot 10 % 8 = 2, owned by actor 2 everywhere) is permanently
+        # bookkeeping-less — the displaced regime, with no setup phase
+        assert int((np.asarray(st.crdt.book.org_id)[:, 2] == 2).sum()) == n
+        inp = quiet_inputs(cfg, rounds)
+        w = jnp.zeros((rounds, n), bool).at[0:2, 10].set(True)
+        inp = inp._replace(
+            write_mask=w,
+            write_cell=jnp.ones((rounds, n), jnp.int32),
+            write_val=jnp.zeros((rounds, n), jnp.int32)
+            .at[0:2, 10].set(900),
+        )
+        st, infos = run(cfg, st, net, jr.key(2), inp)
+        got = np.asarray(st.crdt.store[1])[:, 1] == 900
+        return int(got.sum()), int(np.asarray(infos["queued"])[-1]), st
+
+    cov_off, _, _ = coverage(base)
+    cov_on, _, st_on = coverage(
+        dataclasses.replace(base, bcast_wire_budget=True))
+    # near-total epidemic coverage (budget depth 4 over random fanout
+    # can stochastically miss a node or two with sync disabled — the
+    # sweep backstop is what guarantees the tail in real configs)
+    assert cov_on >= n - 2, f"epidemic incomplete: {cov_on}/{n}"
+    assert cov_off < n // 2 and cov_on > 3 * cov_off, (
+        f"arms no longer discriminate: on={cov_on} off={cov_off}"
+    )
+    # bounded circulation: the budget depth exhausts and queues drain
+    cfg_on = dataclasses.replace(base, bcast_wire_budget=True)
+    net = NetModel.create(n, drop_prob=0.0)
+    st_on, infos = run(cfg_on, st_on, net, jr.key(3),
+                       quiet_inputs(cfg_on, 40))
+    assert int(np.asarray(infos["queued"])[-1]) == 0
 
 
 def test_slot_eviction_idle_owner_loses():
