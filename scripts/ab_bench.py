@@ -76,6 +76,12 @@ def main() -> None:
         arm_cfgs["wide"] = dataclasses.replace(base, narrow_dtypes=False)
     arm_cfgs["tx4"] = scale_sim_config(n, n_origins=min(16, n),
                                        tx_max_cells=4)
+    if any(f.name == "bcast_wire_budget"
+           for f in dataclasses.fields(type(base))):
+        # the round-5 fairness flag: measures the wire lane's cost for
+        # the round-6 default-on decision (forces the XLA ingest path)
+        arm_cfgs["wirebudget"] = dataclasses.replace(
+            base, bcast_wire_budget=True)
 
     out_path = os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
