@@ -102,7 +102,13 @@ class Agent:
         # + chunked-changeset commit (``public/mod.rs:177-256``).
         n = self.n_nodes
         self._tx_k = max(1, getattr(self.cfg, "tx_max_cells", 1))
-        self._write_queues: dict = {}  # node -> list of ([(cell, val, clp)...], event|None)
+        # node -> list of ([(cell, val, clp)...], event|None, final).
+        # Chunks of one write_many transaction SHARE the event (the
+        # waiter handle) but only the final chunk wakes it on commit;
+        # the shared handle lets a failed round drop the WHOLE
+        # transaction — flagging the waiter and purging queued
+        # trailing chunks — instead of committing it partially.
+        self._write_queues: dict = {}
         # API-boundary hybrid logical clocks, one per writer node: every
         # transaction is stamped on entry (crsql_set_ts analog,
         # public/mod.rs:88-100); the in-round clock lives device-side as
@@ -118,6 +124,22 @@ class Agent:
         self._snapshot_host = None  # (round_no, store planes, heads, alive)
         self._thread = None
         self._listeners = []  # subscription manager hooks
+
+        # --- recovery / supervision (resilience subsystem) --------------
+        # generation fences stale state: every applied restore bumps it,
+        # and a round result computed against an older generation is
+        # discarded at commit instead of clobbering the restored state
+        self.generation = 0
+        self._supervisor = None  # optional watchdog around dispatch
+        # the attached Database registers itself here so checkpoint
+        # recovery restores the HOST state (schema, heap, rows) together
+        # with the device state — a rewound cluster must not keep
+        # serving rows it no longer holds
+        self.recovery_db = None
+        self._auto_recover = False
+        self._recovering = False  # True while a checkpoint restore runs
+        self._consec_failures = 0
+        self._max_recoveries = 3  # consecutive failed rounds before giving up
 
     def _bootstrap_from_members_file(self) -> None:
         """Replay a persisted member list into the fresh SWIM state — the
@@ -178,12 +200,94 @@ class Agent:
         os.replace(tmp, path)
 
     # --- lifecycle ------------------------------------------------------
-    def start(self, pace_seconds: float = 0.0):
+    def start(self, pace_seconds: float = 0.0, auto_recover: bool = False,
+              supervisor=None):
+        """Boot the round loop.
+
+        ``auto_recover`` restores the newest valid checkpoint under
+        ``config.db.path`` before the first round (missing/corrupt
+        checkpoints are skipped — a fresh cluster boots clean), and
+        re-arms after a mid-run round failure: the loop rolls back to
+        the last good checkpoint instead of dying, up to
+        ``_max_recoveries`` consecutive failures.
+
+        ``supervisor`` (a ``resilience.Supervisor``) wraps every device
+        dispatch with its deadline + jittered-retry policy."""
         assert self._thread is None, "already started"
+        if supervisor is not None:
+            self._supervisor = supervisor.bind_abort(
+                lambda: self.tripwire.tripped, sleep=self.tripwire.wait
+            )
+        self._auto_recover = auto_recover
+        if auto_recover:
+            self.recover_latest()
         self._thread = spawn_counted(
             self._run_loop, pace_seconds, name="agent-round-loop"
         )
         return self
+
+    def recover_latest(self, root: Optional[str] = None,
+                       db=None) -> Optional[dict]:
+        """Restore from the newest checkpoint under ``root`` (default
+        ``config.db.path``) that passes integrity verification AND is
+        config-compatible AND actually restores — candidates failing any
+        of those gates are logged and skipped for the next-newest, so a
+        bad newest side never masks an older good recovery point. Stale
+        in-flight state is fenced by the generation bump the restore
+        applies. Returns the restored manifest, or None when nothing
+        restorable exists. This is the ONE recovery path: boot-time
+        resume (``MaintenanceLoop.resume_latest``) and mid-run crash
+        rollback both land here."""
+        import dataclasses
+        import json
+        import os
+
+        from corrosion_tpu.checkpoint import restore_checkpoint
+        from corrosion_tpu.resilience.retention import (
+            iter_valid_checkpoints,
+        )
+
+        root = root or self.config.db.path
+        db = db if db is not None else self.recovery_db
+        self._recovering = True
+        try:
+            for path in iter_valid_checkpoints(root):
+                # manifest-only read for the config gate: verification
+                # already deserialized the full state once and the
+                # restore will again — don't pay a third decode here
+                with open(os.path.join(path, "manifest.json")) as f:
+                    manifest = json.load(f)
+                if manifest["sim_config"] != dataclasses.asdict(self.cfg):
+                    logger.error(
+                        "checkpoint %s has a different sim config than "
+                        "this agent; trying the next-newest", path,
+                    )
+                    continue
+                try:
+                    # the iterator already ran the full hash pass on this
+                    # path — don't hash/decompress the state a second time
+                    man = restore_checkpoint(self, path, db=db,
+                                             verify=False)
+                except Exception:  # noqa: BLE001 — try the next-newest
+                    logger.exception(
+                        "checkpoint %s is unrestorable; trying the "
+                        "next-newest", path,
+                    )
+                    continue
+                man["path"] = path
+                if self._thread is None:
+                    # boot-time recover: resume the round counter at the
+                    # saved round (a live loop keeps its own monotonic
+                    # counter for waiters)
+                    self.round_no = int(man.get("round", self.round_no))
+                logger.info(
+                    "recovered from %s (round %d, generation %d)",
+                    path, man["round"], self.generation,
+                )
+                return man
+            return None
+        finally:
+            self._recovering = False
 
     def shutdown(self):
         self.tripwire.trip()
@@ -202,7 +306,31 @@ class Agent:
         try:
             while not self.tripwire.tripped:
                 t0 = time.perf_counter()
-                self._one_round()
+                try:
+                    self._one_round()
+                    self._consec_failures = 0
+                except Exception:  # noqa: BLE001 — recovery decides below
+                    if not self._auto_recover:
+                        raise
+                    self._consec_failures += 1
+                    if self._consec_failures > self._max_recoveries:
+                        logger.error(
+                            "round failed %d times in a row; giving up",
+                            self._consec_failures,
+                        )
+                        raise
+                    logger.exception(
+                        "round failed; rolling back to the last good "
+                        "checkpoint (recovery %d/%d)",
+                        self._consec_failures, self._max_recoveries,
+                    )
+                    if self.recover_latest() is None:
+                        logger.error(
+                            "no restorable checkpoint under %r; shutting "
+                            "down", self.config.db.path,
+                        )
+                        raise
+                    continue
                 if pace_seconds > 0:
                     left = pace_seconds - (time.perf_counter() - t0)
                     if left > 0 and self.tripwire.wait(left):
@@ -218,6 +346,9 @@ class Agent:
                 for q in self._write_queues.values():
                     for _cells, ev in q:
                         if ev is not None:
+                            # never entered a round — the wake must read
+                            # as a drop, not a commit
+                            ev.dropped = True
                             ev.set()
                 self._write_queues.clear()
             with self._round_cv:
@@ -232,12 +363,30 @@ class Agent:
         state, ev, box = self._pend_restore
         self._pend_restore = None
         self._state = jax.tree.map(jnp.asarray, state)
+        # fence: any round result computed against the pre-restore state
+        # is now stale and must not commit over this one
+        self.generation += 1
         box["applied"] = True
         ev.set()
+
+    def _run_step(self, st, net, sub, inp):
+        new_state, info = self._step(st, net, sub, inp)
+        # completion inside the (possibly supervised) call: a wedged
+        # device surfaces as a deadline miss, not a hang at next use
+        jax.block_until_ready(new_state)
+        return new_state, info
+
+    def _dispatch(self, st, net, sub, inp):
+        if self._supervisor is not None:
+            return self._supervisor.call(
+                self._run_step, st, net, sub, inp, label="round-dispatch"
+            )
+        return self._run_step(st, net, sub, inp)
 
     def _one_round(self):
         with self._input_lock:
             self._apply_pend_restore()
+            gen = self.generation
             n, k = self.n_nodes, self._tx_k
             write_mask = np.zeros(n, bool)
             write_cell = np.zeros(n, np.int32)
@@ -300,8 +449,40 @@ class Agent:
         with RoundTimer("round", warn_seconds=1.0, registry=self.metrics,
                         logger=logger):
             self._key, sub = jr.split(self._key)
-            self._state, info = self._step(self._state, net, sub, inp)
-            jax.block_until_ready(self._state)
+            try:
+                new_state, info = self._dispatch(self._state, net, sub, inp)
+            except BaseException:
+                # the drained writes die with the failed round (recovery
+                # rolls back past them like any post-checkpoint write) —
+                # wake their waiters now; they were popped off
+                # _write_queues, so the shutdown sweep can't reach them
+                # and they'd otherwise block out their full timeout. The
+                # flag turns the wake into a clear error at the caller
+                # instead of a false success.
+                for ev in waiters:
+                    ev.dropped = True
+                    ev.set()
+                raise
+
+        with self._input_lock:
+            if self.generation != gen:
+                # a restore applied while this round was in flight (e.g.
+                # crash recovery rolling back): its result was computed
+                # against pre-restore state — fence it out. Writes that
+                # entered this round roll back with it, exactly like any
+                # write committed after the checkpoint being restored;
+                # their waiters are woken (flagged, so the caller gets a
+                # clear error rather than a false success) instead of
+                # hanging
+                logger.warning(
+                    "round result fenced: generation %d -> %d",
+                    gen, self.generation,
+                )
+                for ev in waiters:
+                    ev.dropped = True
+                    ev.set()
+                return
+            self._state = new_state
 
         vals = {k: float(v) for k, v in info.items()}
         record_round_info(vals, registry=self.metrics)
@@ -405,8 +586,17 @@ class Agent:
             for chunk in chunks[:-1]:
                 q.append((chunk, None))
             q.append((chunks[-1], ev))
-        if wait and not ev.wait(timeout):
-            raise TimeoutError("write did not enter a round in time")
+        if wait:
+            if not ev.wait(timeout):
+                raise TimeoutError("write did not enter a round in time")
+            if getattr(ev, "dropped", False):
+                # the round that drained this write failed, was fenced
+                # out by a recovery rollback, or the agent shut down —
+                # the write did NOT commit; the caller must retry
+                raise RuntimeError(
+                    "write was dropped before it committed (round "
+                    "failure, recovery rollback, or shutdown) — retry"
+                )
         return {"rows_affected": len(cells), "round": self.round_no,
                 "ts": str(ts)}
 
@@ -481,7 +671,10 @@ class Agent:
                 old_ev.set()
             self._pend_restore = (state, ev, box)
             loop_running = self._thread is not None and self._thread.is_alive()
-            if not loop_running:
+            if not loop_running or threading.current_thread() is self._thread:
+                # no round thread — or we ARE it (crash recovery between
+                # rounds): apply inline; waiting on the next round
+                # boundary would deadlock
                 self._apply_pend_restore()
         ok = ev.wait(timeout) and box["applied"]
         if ok:
@@ -493,6 +686,47 @@ class Agent:
                         and self._pend_restore[1] is ev):
                     self._pend_restore = None
         return ok
+
+    # --- health / readiness (feeds /v1/health + /v1/ready) ---------------
+    def health(self) -> dict:
+        """Liveness + readiness summary.
+
+        ``status``: ``ok`` (serving), ``restoring`` (a checkpoint
+        restore is staged or being applied), ``backoff`` (the watchdog
+        supervisor is between dispatch retries), ``down`` (tripped).
+        ``retry_after`` (seconds, present when not ok) feeds the HTTP
+        ``Retry-After`` header."""
+        with self._input_lock:
+            restoring = self._pend_restore is not None or self._recovering
+        sup = self._supervisor
+        sup_state = sup.state if sup is not None else "idle"
+        if self.tripwire.tripped:
+            status = "down"
+        elif restoring:
+            status = "restoring"
+        elif sup_state == "backoff":
+            status = "backoff"
+        else:
+            status = "ok"
+        out = {
+            "status": status,
+            "ready": status == "ok",
+            "round": self.round_no,
+            "generation": self.generation,
+            "mode": self.mode,
+            "n_nodes": self.n_nodes,
+        }
+        if sup is not None:
+            out["supervisor"] = {
+                "state": sup_state,
+                "retries": sup.retries,
+                "aborts": sup.aborts,
+            }
+        if status == "backoff":
+            out["retry_after"] = max(1, int(round(sup.retry_after_seconds())))
+        elif status != "ok":
+            out["retry_after"] = 1
+        return out
 
     # --- read path ------------------------------------------------------
     def snapshot(self) -> dict:

@@ -200,7 +200,9 @@ def _make_handler(server: ApiServer):
         def do_GET(self):
             path, q = self._route()
             try:
-                if path == "/v1/table_stats":
+                if path in ("/v1/health", "/v1/ready"):
+                    self._health()
+                elif path == "/v1/table_stats":
                     self._reply_json(
                         200, server.db.table_stats(self._node(q)))
                 elif path == "/v1/members":
@@ -247,6 +249,26 @@ def _make_handler(server: ApiServer):
                     pass
 
         # --- route bodies ------------------------------------------------
+        def _health(self) -> None:
+            """``/v1/health`` and ``/v1/ready`` (both route here — the
+            two names exist for orchestrator convention; this agent has
+            no alive-but-not-ready phase they could distinguish).
+
+            Degrades gracefully instead of lying: while the agent is
+            restoring a checkpoint or the watchdog supervisor is backing
+            off between dispatch retries, the reply is 503 with a
+            ``Retry-After`` hint so load balancers drain politely and
+            clients (whose retries ride the shared ``retry_call``
+            policy) know when to come back. Once the agent is shut down
+            for good the 503 carries no ``Retry-After``: nothing will
+            recover — restart instead of waiting."""
+            h = server.agent.health()
+            ok = h["ready"]
+            headers = {}
+            if not ok and h["status"] != "down":
+                headers["Retry-After"] = str(h.get("retry_after", 1))
+            self._reply_json(200 if ok else 503, h, headers=headers)
+
         def _transactions(self, q: dict) -> None:
             stmts = parse_statements(self._json_body() or [])
             results = server.db.execute(self._node(q), stmts)

@@ -23,6 +23,7 @@ Here the durable artifacts are:
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
 import os
 from typing import Optional, Tuple
@@ -30,11 +31,50 @@ from typing import Optional, Tuple
 import jax
 import numpy as np
 
-FORMAT_VERSION = 1
+# format 2 adds per-file SHA-256 content hashes to the manifest
+# (``files``) and an optional ``extra`` payload (the segmented soak
+# runner records its PRNG key + completed-round counter there). Format-1
+# checkpoints (no hashes) still PARSE — integrity checking is simply
+# unavailable for them — but any checkpoint predating a state-schema
+# change (new pytree leaves, e.g. ``CrdtState.sync_defer``) is rejected
+# loudly at the leaf-count gate below; recovery then falls back to the
+# next-newest candidate or boots fresh with the rejection logged.
+FORMAT_VERSION = 2
+_SUPPORTED_FORMATS = (1, 2)
+
+
+class CheckpointIntegrityError(ValueError):
+    """A checkpoint directory is incomplete, tampered with, or corrupt."""
 
 
 def _leaves(state) -> list:
     return jax.tree.leaves(state)
+
+
+def _file_sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _verify_files(path: str, manifest: dict) -> None:
+    """Recompute every recorded leaf-file hash; mismatch = corruption."""
+    for name, want in (manifest.get("files") or {}).items():
+        fp = os.path.join(path, name)
+        if not os.path.exists(fp):
+            raise CheckpointIntegrityError(
+                f"checkpoint {path}: leaf file {name} is missing"
+            )
+        got = _file_sha256(fp)
+        if got != want:
+            raise CheckpointIntegrityError(
+                f"checkpoint {path}: leaf file {name} content hash mismatch "
+                f"(manifest {want[:12]}…, on disk {got[:12]}…) — the file "
+                f"was truncated or tampered with after the checkpoint "
+                f"was committed"
+            )
 
 
 def _state_template(mode: str, cfg):
@@ -47,21 +87,30 @@ def _state_template(mode: str, cfg):
     return SimState.create(cfg)
 
 
-def save_checkpoint(agent, db=None, path: str = "./checkpoint") -> str:
+def save_checkpoint(agent, db=None, path: str = "./checkpoint",
+                    extra: Optional[dict] = None) -> str:
     """Write the full cluster state to ``path`` (a directory).
 
     Crash-safe ordering: the manifest is removed first and (re)written
     LAST via an atomic rename — a directory without a valid manifest is
     incomplete by definition, so a crash mid-write can never leave a
-    side that looks restorable but is not."""
+    side that looks restorable but is not. Every leaf file's SHA-256 is
+    recorded in the manifest, so post-commit corruption (bit rot, a
+    truncating copy) is detected on load instead of silently restoring
+    garbage.
+
+    ``extra`` is an arbitrary JSON-able payload stored in the manifest —
+    the segmented soak runner records its scan carry (PRNG key data +
+    completed rounds) there."""
     os.makedirs(path, exist_ok=True)
     manifest_path = os.path.join(path, "manifest.json")
     if os.path.exists(manifest_path):
         os.unlink(manifest_path)
     state = agent.device_state()
     leaves = [np.asarray(x) for x in _leaves(state)]
+    state_path = os.path.join(path, "state.npz")
     np.savez_compressed(
-        os.path.join(path, "state.npz"),
+        state_path,
         **{f"leaf_{i}": a for i, a in enumerate(leaves)},
     )
     manifest = {
@@ -70,8 +119,11 @@ def save_checkpoint(agent, db=None, path: str = "./checkpoint") -> str:
         "round": agent.round_no,
         "sim_config": dataclasses.asdict(agent.cfg),
         "n_leaves": len(leaves),
+        "files": {"state.npz": _file_sha256(state_path)},
         "db": db.state_dict() if db is not None else None,
     }
+    if extra is not None:
+        manifest["extra"] = extra
     tmp = manifest_path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(manifest, f)
@@ -79,14 +131,23 @@ def save_checkpoint(agent, db=None, path: str = "./checkpoint") -> str:
     return path
 
 
-def load_checkpoint(path: str) -> Tuple[dict, object]:
+def load_checkpoint(path: str, verify: bool = True) -> Tuple[dict, object]:
     """-> (manifest, device-state pytree). The pytree is rebuilt against
     a template constructed from the saved config, so leaf order/shape
-    mismatches fail loudly."""
-    with open(os.path.join(path, "manifest.json")) as f:
+    mismatches fail loudly; leaf-file content hashes are verified against
+    the manifest before anything is deserialized."""
+    manifest_path = os.path.join(path, "manifest.json")
+    if not os.path.exists(manifest_path):
+        raise CheckpointIntegrityError(
+            f"checkpoint {path}: no manifest — directory is incomplete "
+            f"(a crash mid-save, or not a checkpoint)"
+        )
+    with open(manifest_path) as f:
         manifest = json.load(f)
-    if manifest["format"] != FORMAT_VERSION:
+    if manifest["format"] not in _SUPPORTED_FORMATS:
         raise ValueError(f"unsupported checkpoint format {manifest['format']}")
+    if verify:
+        _verify_files(path, manifest)
     if manifest["mode"] == "scale":
         from corrosion_tpu.sim.scale_step import ScaleSimConfig as CfgCls
     else:
@@ -106,21 +167,64 @@ def load_checkpoint(path: str) -> Tuple[dict, object]:
             raise ValueError(
                 f"leaf shape mismatch: checkpoint {l.shape} vs config {t.shape}"
             )
+        if t.dtype != l.dtype:
+            raise ValueError(
+                f"leaf dtype mismatch: checkpoint {l.dtype} vs config "
+                f"{t.dtype}"
+            )
     state = jax.tree.unflatten(treedef, loaded)
     return manifest, state
 
 
-def restore_checkpoint(agent, path: str, db=None) -> dict:
-    """Swap a checkpoint into a live agent (+ its Database host state)."""
-    manifest, state = load_checkpoint(path)
+def verify_checkpoint(path: str) -> dict:
+    """Full integrity check of a checkpoint directory without touching
+    any live agent: manifest present + parseable, format supported, leaf
+    files hash-clean, and the state pytree deserializes against the saved
+    config. Returns a summary dict; raises (``CheckpointIntegrityError``
+    / ``ValueError``) on any defect — the CLI's ``verify-checkpoint``
+    maps that to a non-zero exit."""
+    manifest, state = load_checkpoint(path, verify=True)
+    return {
+        "path": path,
+        "format": manifest["format"],
+        "mode": manifest["mode"],
+        "round": manifest["round"],
+        "n_leaves": manifest["n_leaves"],
+        "hashed_files": sorted((manifest.get("files") or {})),
+        "extra": manifest.get("extra"),
+    }
+
+
+def restore_checkpoint(agent, path: str, db=None, verify: bool = True) -> dict:
+    """Swap a checkpoint into a live agent (+ its Database host state).
+
+    ``verify=False`` skips the hash pass — for callers that just ran
+    ``verify_checkpoint``/``latest_valid_checkpoint`` on the same path
+    and would otherwise hash and decompress the state twice per
+    recovery."""
+    manifest, state = load_checkpoint(path, verify=verify)
     if manifest["mode"] != agent.mode:
         raise ValueError(
             f"checkpoint mode {manifest['mode']!r} != agent mode {agent.mode!r}"
         )
     if not agent.restore_state(state):
         raise TimeoutError("restore did not apply in time")
-    if db is not None and manifest.get("db") is not None:
-        db.load_state_dict(manifest["db"])
+    if db is not None:
+        if manifest.get("db") is not None:
+            db.load_state_dict(manifest["db"])
+        else:
+            # the device state rewinds but the host DB cannot: this
+            # checkpoint was written without db= (a soak segment, an
+            # external save). Rows committed after the checkpoint stay
+            # visible host-side even though the cluster no longer holds
+            # them — surface the divergence instead of hiding it.
+            from corrosion_tpu.utils.tracing import logger
+
+            logger.warning(
+                "checkpoint %s carries no host-DB state; the attached "
+                "Database was NOT rewound and may serve rows the "
+                "restored cluster no longer holds", path,
+            )
     return manifest
 
 

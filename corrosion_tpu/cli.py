@@ -252,6 +252,84 @@ def cmd_checkpoint(args) -> int:
     return 0
 
 
+def cmd_verify_checkpoint(args) -> int:
+    """Offline integrity check of a checkpoint directory: manifest,
+    format, SHA-256 leaf-file hashes, and state deserialization against
+    the saved config. Exits non-zero on any defect."""
+    from corrosion_tpu.checkpoint import verify_checkpoint
+
+    try:
+        out = verify_checkpoint(args.path)
+    except Exception as e:  # noqa: BLE001 — any defect is a failed verify
+        print(json.dumps({"ok": False, "path": args.path,
+                          "error": f"{type(e).__name__}: {e}"}))
+        return 1
+    print(json.dumps({"ok": True, **out}, indent=2))
+    return 0
+
+
+def cmd_soak(args) -> int:
+    """Preemption-safe soak run: R rounds in K-round segments with a
+    crash-consistent checkpoint after each. ``--resume`` continues from
+    the newest valid checkpoint under ``--checkpoint-dir`` (losing at
+    most one segment); the segmented run is bitwise identical to a
+    straight ``lax.scan`` of the same seed.
+
+    ``--resume`` must be given the same config / ``--rounds`` /
+    ``--write-frac`` as the original run — the input stack is rebuilt
+    from the seed, and a different workload would not continue the same
+    scan (sim-config drift is detected and refused; workload flags are
+    the caller's contract)."""
+    import jax.random as jr
+    import numpy as np
+
+    from corrosion_tpu.resilience import (
+        Supervisor,
+        resume_segmented,
+        run_segmented,
+    )
+    from corrosion_tpu.resilience.segments import make_soak_inputs
+    from corrosion_tpu.sim.transport import NetModel
+
+    cfg_file = load_config(args.config) if args.config else Config()
+    cfg = cfg_file.sim_config()
+    net = NetModel.create(
+        cfg.n_nodes,
+        drop_prob=cfg_file.gossip.drop_prob,
+        n_regions=cfg_file.gossip.n_regions,
+    )
+    inputs = make_soak_inputs(
+        cfg, jr.key(cfg_file.sim.seed + 1), args.rounds,
+        write_frac=args.write_frac,
+    )
+    supervisor = Supervisor(deadline_seconds=args.deadline or None)
+    common = dict(
+        checkpoint_root=args.checkpoint_dir, keep_last=args.keep_last,
+        supervisor=supervisor,
+    )
+    if args.resume:
+        result = resume_segmented(cfg, net, inputs, args.segment, **common)
+    else:
+        if cfg_file.sim.mode == "scale":
+            from corrosion_tpu.sim.scale_step import ScaleSimState as StCls
+        else:
+            from corrosion_tpu.sim.step import SimState as StCls
+        result = run_segmented(
+            cfg, StCls.create(cfg), net, jr.key(cfg_file.sim.seed), inputs,
+            args.segment, **common,
+        )
+    summary = {
+        "completed_rounds": result.completed_rounds,
+        "aborted": result.aborted,
+        "checkpoint": result.checkpoint,
+        "metrics": {
+            k: float(np.asarray(v).sum()) for k, v in result.infos.items()
+        },
+    }
+    print(json.dumps(summary, indent=2))
+    return 1 if result.aborted else 0
+
+
 def cmd_template(args) -> int:
     from corrosion_tpu.tpl import render_template_cli
 
@@ -419,6 +497,29 @@ def build_parser() -> argparse.ArgumentParser:
     ck = sub.add_parser("checkpoint", help="write a full cluster checkpoint")
     ck.add_argument("path")
     ck.set_defaults(fn=cmd_checkpoint)
+
+    vc = sub.add_parser("verify-checkpoint",
+                        help="verify a checkpoint directory's integrity")
+    vc.add_argument("path")
+    vc.set_defaults(fn=cmd_verify_checkpoint)
+
+    sk = sub.add_parser("soak",
+                        help="segmented soak run with per-segment "
+                             "checkpoints (preemption-safe)")
+    sk.add_argument("-c", "--config", default=None)
+    sk.add_argument("--rounds", type=int, default=1024)
+    sk.add_argument("--segment", type=int, default=128,
+                    help="rounds per segment (checkpoint cadence)")
+    sk.add_argument("--checkpoint-dir", default="./soak_checkpoints")
+    sk.add_argument("--keep-last", type=int, default=3)
+    sk.add_argument("--write-frac", type=float, default=0.25,
+                    help="fraction of nodes writing per round")
+    sk.add_argument("--deadline", type=float, default=0.0,
+                    help="per-segment dispatch deadline in seconds "
+                         "(0 = none)")
+    sk.add_argument("--resume", action="store_true",
+                    help="continue from the newest valid checkpoint")
+    sk.set_defaults(fn=cmd_soak)
 
     t = sub.add_parser("template", help="render templates (re-render on change)")
     t.add_argument("spec", nargs="+", help="template.py:output pairs")

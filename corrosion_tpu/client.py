@@ -13,6 +13,8 @@ import json
 import urllib.parse
 from typing import Any, Iterator, List, Optional, Sequence, Tuple
 
+from corrosion_tpu.utils.backoff import Backoff, retry_call
+
 
 class ApiError(RuntimeError):
     def __init__(self, status: int, message: str):
@@ -86,10 +88,24 @@ class CorrosionApiClient:
     """Client for one agent's HTTP API."""
 
     def __init__(self, addr: str = "127.0.0.1", port: int = 8787,
-                 timeout: float = 30.0):
+                 timeout: float = 30.0, connect_retries: int = 2):
         self.addr = addr
         self.port = port
         self.timeout = timeout
+        # connection-refused retries ride the shared retry_call policy:
+        # a CLI racing agent boot (or an agent restarting under its
+        # supervisor) answers after a brief jittered wait instead of
+        # failing the one-shot command. Refused means nothing was sent,
+        # so retrying is safe for writes too.
+        self.connect_retries = connect_retries
+
+    def _retry_connect(self, attempt):
+        return retry_call(
+            attempt,
+            backoff=Backoff(min_wait=0.05, max_wait=0.5,
+                            max_retries=self.connect_retries),
+            retry_on=(ConnectionRefusedError,),
+        )
 
     # --- plumbing --------------------------------------------------------
     _UNSET = object()  # sentinel: None must mean "no timeout" (endless streams)
@@ -101,47 +117,59 @@ class CorrosionApiClient:
         )
 
     def _request_json(self, method: str, path: str, body: Any = None) -> Any:
-        conn = self._connect()
-        try:
-            payload = None if body is None else json.dumps(body)
-            headers = {"Content-Type": "application/json"}
-            # cross-process trace propagation (the reference injects
-            # SyncTraceContextV1 into sync handshakes, sync.rs:33-67 +
-            # peer/mod.rs:1017-1020); any active client span rides the
-            # standard W3C header
-            from corrosion_tpu.utils.tracing import inject_traceparent
+        payload = None if body is None else json.dumps(body)
+        headers = {"Content-Type": "application/json"}
+        # cross-process trace propagation (the reference injects
+        # SyncTraceContextV1 into sync handshakes, sync.rs:33-67 +
+        # peer/mod.rs:1017-1020); any active client span rides the
+        # standard W3C header
+        from corrosion_tpu.utils.tracing import inject_traceparent
 
-            tp = inject_traceparent()
-            if tp:
-                headers["traceparent"] = tp
-            conn.request(method, path, body=payload, headers=headers)
-            resp = conn.getresponse()
-            data = resp.read()
-            obj = json.loads(data) if data else None
-            if resp.status >= 400:
-                msg = obj.get("error", data.decode()) if isinstance(
-                    obj, dict) else data.decode()
-                raise ApiError(resp.status, msg)
-            return obj
-        finally:
-            conn.close()
+        tp = inject_traceparent()
+        if tp:
+            headers["traceparent"] = tp
+
+        def attempt():
+            conn = self._connect()
+            try:
+                conn.request(method, path, body=payload, headers=headers)
+                resp = conn.getresponse()
+                data = resp.read()
+                obj = json.loads(data) if data else None
+                if resp.status >= 400:
+                    msg = obj.get("error", data.decode()) if isinstance(
+                        obj, dict) else data.decode()
+                    raise ApiError(resp.status, msg)
+                return obj
+            finally:
+                conn.close()
+
+        return self._retry_connect(attempt)
 
     def _request_stream(self, method: str, path: str, body: Any = None,
                         stream_timeout=_UNSET):
-        conn = self._connect(timeout=stream_timeout)
         payload = None if body is None else json.dumps(body)
-        conn.request(method, path, body=payload,
-                     headers={"Content-Type": "application/json"})
-        resp = conn.getresponse()
-        if resp.status >= 400:
-            data = resp.read()
-            conn.close()
+
+        def attempt():
+            conn = self._connect(timeout=stream_timeout)
             try:
-                msg = json.loads(data).get("error", data.decode())
-            except Exception:  # noqa: BLE001
-                msg = data.decode()
-            raise ApiError(resp.status, msg)
-        return conn, resp
+                conn.request(method, path, body=payload,
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+            except BaseException:
+                conn.close()
+                raise
+            if resp.status >= 400:
+                data = resp.read()
+                conn.close()
+                try:
+                    msg = json.loads(data).get("error", data.decode())
+                except Exception:  # noqa: BLE001
+                    msg = data.decode()
+                raise ApiError(resp.status, msg)
+            return conn, resp
+
+        return self._retry_connect(attempt)
 
     @staticmethod
     def _stmts(statements: Sequence) -> list:

@@ -18,7 +18,11 @@ from __future__ import annotations
 
 import dataclasses
 import os
-import tomllib
+
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python < 3.11: stdlib tomllib is absent
+    import tomli as tomllib
 from typing import Any, Optional
 
 ENV_PREFIX = "CORRO_TPU"

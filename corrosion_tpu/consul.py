@@ -23,7 +23,7 @@ import urllib.error
 import urllib.request
 from typing import Dict, Optional, Tuple
 
-from corrosion_tpu.utils.backoff import Backoff
+from corrosion_tpu.utils.backoff import Backoff, retry_call
 from corrosion_tpu.utils.tracing import logger
 
 CONSUL_SCHEMA = """
@@ -114,22 +114,30 @@ class ConsulSync:
         return updates
 
     def run(self, poll_seconds: float = 1.0) -> None:
-        """Poll forever with backoff on consul errors (the reference
-        polls every 1 s, ``command/consul/sync.rs``)."""
-        errors = iter(Backoff(min_wait=1.0, max_wait=30.0))
+        """Poll forever (the reference polls every 1 s,
+        ``command/consul/sync.rs``); consul errors retry through the
+        shared :func:`retry_call` policy (1 s -> 30 s jittered, no retry
+        cap — the bridge outlives any consul outage), with waits
+        interruptible by :meth:`stop`."""
         while not self._stop.is_set():
             try:
-                n_svc, n_chk = self.sync_once()
-                if n_svc or n_chk:
-                    logger.info("consul sync: %d services, %d checks changed",
-                                n_svc, n_chk)
-                errors = iter(Backoff(min_wait=1.0, max_wait=30.0))
-                self._stop.wait(poll_seconds)
-            except (urllib.error.URLError, ConnectionError, OSError) as e:
-                delay = next(errors)
-                logger.warning("consul poll failed (%s); retry in %.1fs",
-                               e, delay)
-                self._stop.wait(delay)
+                n_svc, n_chk = retry_call(
+                    self.sync_once,
+                    backoff=Backoff(min_wait=1.0, max_wait=30.0),
+                    retry_on=(urllib.error.URLError, ConnectionError,
+                              OSError),
+                    sleep=self._stop.wait,
+                    abort=self._stop.is_set,
+                    on_retry=lambda e, delay, n: logger.warning(
+                        "consul poll failed (%s); retry in %.1fs", e, delay
+                    ),
+                )
+            except (urllib.error.URLError, ConnectionError, OSError):
+                break  # stop() tripped mid-backoff
+            if n_svc or n_chk:
+                logger.info("consul sync: %d services, %d checks changed",
+                            n_svc, n_chk)
+            self._stop.wait(poll_seconds)
 
     def stop(self) -> None:
         self._stop.set()

@@ -565,6 +565,9 @@ class Database:
 
     def __init__(self, agent):
         self.agent = agent
+        # register for checkpoint recovery: a rollback must rewind the
+        # host state (schema, heap, rows) together with the device state
+        agent.recovery_db = self
         self.schema = Schema()
         self.heap = ValueHeap()
         self.rows = RowMap(agent.cfg.n_rows)

@@ -166,18 +166,11 @@ class MaintenanceLoop:
 
     @staticmethod
     def resume_latest(agent, checkpoint_path: str, db=None) -> Optional[dict]:
-        """Boot-time resume: try rotated sides newest-first, falling back
-        to the older side if the newest fails to load (a half-written or
-        corrupted side must never brick startup). Returns the restored
-        manifest, or None when nothing restorable exists."""
-        from corrosion_tpu.checkpoint import restore_checkpoint
-
-        for p in MaintenanceLoop._sides_newest_first(checkpoint_path):
-            try:
-                man = restore_checkpoint(agent, p, db=db)
-                man["path"] = p
-                return man
-            except Exception:  # noqa: BLE001 — fall back to the other side
-                logger.exception("checkpoint %s is unrestorable; trying the "
-                                 "other side", p)
-        return None
+        """Boot-time resume — a thin alias for ``Agent.recover_latest``,
+        the ONE recovery path (integrity scan, sim-config gate,
+        restore-failure fallback to the next-newest candidate): rotated
+        auto-a/auto-b sides and soak segments alike, and a half-written,
+        tampered, or config-drifted side can never brick startup or mask
+        an older good one. Returns the restored manifest, or None when
+        nothing restorable exists."""
+        return agent.recover_latest(root=checkpoint_path, db=db)

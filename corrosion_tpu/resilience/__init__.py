@@ -1,0 +1,35 @@
+"""Preemption-safe recovery: segmented soak runs, checkpoint retention,
+and the watchdog supervisor.
+
+The reference survives agent restarts by construction — the SQLite file
+is the durable replica, sync backfills the gap (PAPER.md: backup/restore
+via ``VACUUM INTO``, the 1 s -> 15 s sync backoff). The simulator's
+long-``lax.scan`` runs had the inverse shape: one host crash or TPU
+preemption lost the whole run. This package closes that gap:
+
+- :mod:`segments` — split an R-round scan into K-round segments,
+  threading the full scan carry (state + PRNG key) so the segmented run
+  is bitwise identical to the straight-through one, with a
+  crash-consistent checkpoint after every segment;
+- :mod:`retention` — keep-last-K pruning plus an atomic ``LATEST``
+  pointer naming the newest committed checkpoint;
+- :mod:`supervisor` — deadline-and-retry watchdog around device
+  dispatch, built on :class:`corrosion_tpu.utils.backoff.Backoff`.
+"""
+
+from corrosion_tpu.resilience.retention import (  # noqa: F401
+    latest_valid_checkpoint,
+    prune_checkpoints,
+    read_latest,
+    update_latest,
+)
+from corrosion_tpu.resilience.segments import (  # noqa: F401
+    SoakResult,
+    resume_segmented,
+    run_segmented,
+)
+from corrosion_tpu.resilience.supervisor import (  # noqa: F401
+    DispatchTimeout,
+    Supervisor,
+    SupervisorAborted,
+)

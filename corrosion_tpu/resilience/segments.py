@@ -1,0 +1,326 @@
+"""Segmented soak runner: preemption-safe long simulations.
+
+``run_rounds`` / ``scale_run_rounds`` compile an R-round run into one
+``lax.scan`` — fast, but a host crash or TPU preemption at round R-1
+loses everything. The segmented runner splits the scan into K-round
+segments and threads the FULL scan carry (state pytree + PRNG key)
+across them, so the segmented run is **bitwise identical** to the
+straight-through one (the per-round key is split off the carried key
+inside the scan body; chaining carries reproduces the same key
+sequence). After every segment it writes a crash-consistent checkpoint
+(manifest-last + SHA-256 leaf hashes, ``checkpoint.py``), updates the
+atomic ``LATEST`` pointer, and prunes to the retention budget — a
+preempted run resumes from the newest committed segment, losing at most
+K rounds of work. The same shape transfers directly to a training
+stack: segment = accumulation window, checkpoint = optimizer state.
+
+Segments dispatch through an optional :class:`~corrosion_tpu.resilience
+.supervisor.Supervisor`; on retry exhaustion the run aborts gracefully
+with the last committed checkpoint as the recovery point.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+import numpy as np
+
+from corrosion_tpu.checkpoint import load_checkpoint, save_checkpoint
+from corrosion_tpu.resilience.retention import (
+    latest_valid_checkpoint,
+    prune_checkpoints,
+    update_latest,
+)
+from corrosion_tpu.resilience.supervisor import SupervisorAborted
+from corrosion_tpu.utils.tracing import logger
+
+
+class SoakResult(NamedTuple):
+    state: object  # final device-state pytree
+    key: object  # final carried PRNG key (feed back in to continue)
+    infos: dict  # per-round metrics, concatenated over the rounds RUN
+    completed_rounds: int  # absolute index into the run's input stack
+    aborted: bool  # True when the supervisor exhausted its retries
+    checkpoint: Optional[str]  # newest committed checkpoint path
+
+
+class _SegmentView:
+    """The minimal agent-shaped surface ``save_checkpoint`` needs — the
+    soak runner has no live Agent, just the scan carry."""
+
+    def __init__(self, mode: str, cfg, state, round_no: int):
+        self.mode = mode
+        self.cfg = cfg
+        self.round_no = round_no
+        self._state = state
+
+    def device_state(self):
+        return self._state
+
+
+def _infer_mode(cfg) -> str:
+    from corrosion_tpu.sim.scale_step import ScaleSimConfig
+
+    return "scale" if isinstance(cfg, ScaleSimConfig) else "full"
+
+
+def _run_carry_fn(cfg, mode: str):
+    if mode == "scale":
+        from corrosion_tpu.sim.scale_step import scale_run_rounds_carry
+
+        return scale_run_rounds_carry
+    from corrosion_tpu.sim.step import run_rounds_carry
+
+    return run_rounds_carry
+
+
+def _key_to_json(key) -> dict:
+    """Serialize a PRNG key (typed or raw uint32) into the manifest."""
+    if jnp.issubdtype(jnp.asarray(key).dtype, jax.dtypes.prng_key):
+        return {
+            "typed": True,
+            "impl": str(jr.key_impl(key)),
+            "data": np.asarray(jr.key_data(key)).tolist(),
+        }
+    return {"typed": False, "data": np.asarray(key).tolist()}
+
+
+def _key_from_json(d: dict):
+    data = jnp.asarray(np.asarray(d["data"], np.uint32))
+    # impl must round-trip too: rewrapping rbg key words as the default
+    # threefry impl would resume a DIFFERENT key sequence and silently
+    # break the bitwise-identity guarantee
+    return jr.wrap_key_data(data, impl=d["impl"]) if d["typed"] else data
+
+
+def _n_rounds(inputs) -> int:
+    return int(jax.tree.leaves(inputs)[0].shape[0])
+
+
+def _slice_inputs(inputs, lo: int, hi: int):
+    return jax.tree.map(lambda a: a[lo:hi], inputs)
+
+
+def _concat_infos(parts: list) -> dict:
+    if not parts:
+        return {}
+    return {
+        k: np.concatenate([np.asarray(p[k]) for p in parts])
+        for k in parts[0]
+    }
+
+
+def run_segmented(
+    cfg,
+    st,
+    net,
+    key,
+    inputs,
+    segment_rounds: int,
+    *,
+    mode: Optional[str] = None,
+    checkpoint_root: Optional[str] = None,
+    keep_last: int = 3,
+    db=None,
+    supervisor=None,
+    start_round: int = 0,
+) -> SoakResult:
+    """Run ``inputs`` (stacked per-round, leading axis = rounds) in
+    K-round segments, checkpointing after each.
+
+    Bitwise identical to ``run_rounds(cfg, st, net, key, inputs)`` on
+    the same carry-in: final state leaves AND per-round infos match a
+    straight-through scan exactly. ``start_round`` offsets checkpoint
+    round numbers when resuming a longer run (``resume_segmented``).
+
+    With a ``supervisor``, each segment's dispatch rides its deadline +
+    retry policy; on exhaustion the run stops gracefully
+    (``aborted=True``) with the last committed checkpoint intact."""
+    assert segment_rounds > 0, "segment_rounds must be positive"
+    mode = mode or _infer_mode(cfg)
+    run_carry = _run_carry_fn(cfg, mode)
+    rounds = _n_rounds(inputs)
+    # one jitted program per distinct segment length (at most two: K and
+    # the final partial segment)
+    jitted: dict = {}
+
+    def dispatch(st, key, seg_inputs):
+        n = _n_rounds(seg_inputs)
+        if n not in jitted:
+            jitted[n] = jax.jit(
+                lambda s, k, i: run_carry(cfg, s, net, k, i)
+            )
+        (st2, key2), infos = jitted[n](st, key, seg_inputs)
+        # completion inside the supervised call: a wedged device shows
+        # up as a deadline miss here, not as a hang at the next use
+        jax.block_until_ready(st2)
+        return (st2, key2), infos
+
+    info_parts: list = []
+    completed = 0
+    aborted = False
+    last_ckpt = None
+    while completed < rounds:
+        hi = min(completed + segment_rounds, rounds)
+        seg = _slice_inputs(inputs, completed, hi)
+        try:
+            if supervisor is not None:
+                (st, key), infos = supervisor.call(
+                    dispatch, st, key, seg,
+                    label=f"segment[{start_round + completed}:"
+                          f"{start_round + hi}]",
+                )
+            else:
+                (st, key), infos = dispatch(st, key, seg)
+        except SupervisorAborted:
+            logger.exception(
+                "soak aborted at round %d; last good checkpoint: %s",
+                start_round + completed, last_ckpt,
+            )
+            aborted = True
+            break
+        completed = hi
+        info_parts.append(infos)
+        if checkpoint_root:
+            last_ckpt = _checkpoint_segment(
+                cfg, mode, st, key, start_round + completed,
+                checkpoint_root, keep_last, db,
+            )
+    return SoakResult(
+        state=st,
+        key=key,
+        infos=_concat_infos(info_parts),
+        completed_rounds=start_round + completed,
+        aborted=aborted,
+        checkpoint=(last_ckpt if last_ckpt
+                    else (latest_valid_checkpoint(checkpoint_root)
+                          if checkpoint_root else None)),
+    )
+
+
+def _checkpoint_segment(cfg, mode, st, key, completed: int, root: str,
+                        keep_last: int, db) -> str:
+    name = f"seg-{completed:08d}"
+    view = _SegmentView(mode, cfg, st, completed)
+    path = save_checkpoint(
+        view, db=db, path=os.path.join(root, name),
+        extra={"soak": {
+            "completed_rounds": completed,
+            "key": _key_to_json(key),
+        }},
+    )
+    # pointer moves only AFTER the directory is fully committed; pruning
+    # runs last so the recovery point is never the one being deleted
+    update_latest(root, name)
+    prune_checkpoints(root, keep_last)
+    logger.info("soak checkpoint at round %d -> %s", completed, path)
+    return path
+
+
+def resume_segmented(
+    cfg,
+    net,
+    inputs,
+    segment_rounds: int,
+    *,
+    checkpoint_root: str,
+    keep_last: int = 3,
+    db=None,
+    supervisor=None,
+    mode: Optional[str] = None,
+) -> SoakResult:
+    """Resume a segmented run from the newest valid checkpoint under
+    ``checkpoint_root``.
+
+    ``inputs`` is the FULL run's input stack (same one the interrupted
+    run was started with); the restored ``completed_rounds`` selects the
+    remaining slice. The restored carry (state + PRNG key) continues the
+    original scan bit for bit, so straight / interrupted-and-resumed
+    runs converge to identical final state. Returned ``infos`` cover
+    only the rounds run by THIS call.
+
+    Raises ``FileNotFoundError`` when no restorable checkpoint exists
+    and ``ValueError`` on config drift (the checkpoint was written by a
+    run with a different sim config)."""
+    mode = mode or _infer_mode(cfg)
+    path = latest_valid_checkpoint(checkpoint_root)
+    if path is None:
+        raise FileNotFoundError(
+            f"no restorable checkpoint under {checkpoint_root!r}"
+        )
+    # latest_valid_checkpoint just ran the full hash pass on this path —
+    # skip re-hashing the state it already proved clean
+    manifest, state = load_checkpoint(path, verify=False)
+    if manifest["mode"] != mode:
+        raise ValueError(
+            f"checkpoint mode {manifest['mode']!r} != run mode {mode!r}"
+        )
+    if manifest["sim_config"] != dataclasses.asdict(cfg):
+        raise ValueError(
+            "checkpoint sim config differs from the resuming run's — "
+            "resuming would not reproduce the original scan"
+        )
+    soak = (manifest.get("extra") or {}).get("soak")
+    if not soak:
+        raise ValueError(
+            f"checkpoint {path} was not written by the segmented runner "
+            f"(no soak carry in its manifest)"
+        )
+    completed = int(soak["completed_rounds"])
+    key = _key_from_json(soak["key"])
+    rounds = _n_rounds(inputs)
+    logger.info("resuming soak from %s at round %d/%d", path, completed,
+                rounds)
+    if completed >= rounds:
+        return SoakResult(state, key, {}, completed, False, path)
+    return run_segmented(
+        cfg, state, net, key, _slice_inputs(inputs, completed, rounds),
+        segment_rounds, mode=mode, checkpoint_root=checkpoint_root,
+        keep_last=keep_last, db=db, supervisor=supervisor,
+        start_round=completed,
+    )
+
+
+def make_soak_inputs(cfg, key, rounds: int, write_frac: float = 0.0,
+                     mode: Optional[str] = None):
+    """Stacked per-round inputs for a soak run: quiet rounds with an
+    optional ``write_frac`` of nodes issuing random single-cell writes
+    each round (conflict-heavy, the convergence-bench workload shape)."""
+    mode = mode or _infer_mode(cfg)
+    if mode == "scale":
+        from corrosion_tpu.sim.scale_step import ScaleRoundInput as RI
+    else:
+        from corrosion_tpu.sim.step import RoundInput as RI
+    quiet = RI.quiet(cfg)
+    inputs = jax.tree.map(
+        lambda a: jnp.broadcast_to(a, (rounds,) + a.shape), quiet
+    )
+    if write_frac <= 0.0:
+        return inputs
+    k_mask, k_w = jr.split(key)
+    n = cfg.n_nodes
+    mask = jr.uniform(k_mask, (rounds, n)) < write_frac
+    if not getattr(cfg, "any_writer", False):
+        # only the origin pool may write on the legacy fixed-pool path
+        mask = mask & (jnp.arange(n) < cfg.n_origins)[None, :]
+    if mode == "scale":
+        # the ONE shared write construction (bench.py / ab_bench /
+        # convergence_bench ride it too) — soak workloads follow the
+        # chunked-tx path when cfg.tx_max_cells asks, instead of
+        # drifting on a private copy
+        from corrosion_tpu.sim.scale_step import make_write_inputs
+
+        return make_write_inputs(cfg, k_w, rounds, mask)
+    k_cell, k_val = jr.split(k_w)
+    return inputs._replace(
+        write_mask=mask,
+        write_cell=jr.randint(k_cell, (rounds, n), 0, cfg.n_cells,
+                              dtype=jnp.int32),
+        write_val=jr.randint(k_val, (rounds, n), 0, 1 << 20,
+                             dtype=jnp.int32),
+    )

@@ -124,6 +124,10 @@ class CrdtState(NamedTuple):
     last_sync: jax.Array  # int32 [N, S] — rounds since last sync per track
     # (S = peer node id for the full-view sim, member-table slot at scale;
     #  drives the "then by last-sync time" ordering of handlers.rs:808-863)
+    sync_defer: jax.Array  # int32 [N] — consecutive rounds this node's
+    # sync requests were ALL shed by overloaded servers; at
+    # cfg.sync_defer_cap the next request is force-admitted (the shed's
+    # anti-starvation bound)
 
     @staticmethod
     def create(cfg: SimConfig) -> "CrdtState":
@@ -156,6 +160,7 @@ class CrdtState(NamedTuple):
                 max(1, cfg.tx_max_cells),
             ),
             last_sync=jnp.full((n, cfg.sync_tracks), LAST_SYNC_CAP, ndt),
+            sync_defer=z(n),
         )
 
 

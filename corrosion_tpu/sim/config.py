@@ -70,6 +70,10 @@ class SimConfig:
     # count and the survivors' grants shrink toward sync_min_chunk
     serve_cap: int = 3
     sync_min_chunk: int = 4
+    # anti-starvation bound on the shed: after this many consecutive
+    # shed rounds a requesting client is admitted unconditionally, so
+    # degradation stays budget-shaped without ever starving a client
+    sync_defer_cap: int = 8
     # every k-th cohort/sync period, lane 0 merges its peer's FULL
     # store (ignores grants/ownership; LWW join is idempotent) — the
     # convergence backstop when bookkeeping slots are contended, which

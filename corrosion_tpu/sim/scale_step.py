@@ -123,6 +123,8 @@ class ScaleSimConfig:
     # server-side load adaptation (see SimConfig.serve_cap)
     serve_cap: int = 3
     sync_min_chunk: int = 4
+    # anti-starvation bound on the shed (see SimConfig.sync_defer_cap)
+    sync_defer_cap: int = 8
     # every k-th cohort/sync period, lane 0 merges its peer's FULL
     # store (ignores grants/ownership; LWW join is idempotent) — the
     # convergence backstop when bookkeeping slots are contended
@@ -560,8 +562,11 @@ def _narrow_carry(cfg: ScaleSimConfig, st: ScaleSimState) -> ScaleSimState:
     return ScaleSimState(swim, crdt)
 
 
-def scale_run_rounds(cfg: ScaleSimConfig, st, net: NetModel, key, inputs):
-    """``lax.scan`` over stacked per-round inputs — one XLA program."""
+def scale_run_rounds_carry(cfg: ScaleSimConfig, st, net: NetModel, key,
+                           inputs):
+    """Scan returning the FULL carry ``((state, key), infos)`` — the
+    segment entry point (see ``sim/step.run_rounds_carry``): chaining
+    segment carries reproduces the straight-through scan bit for bit."""
 
     def body(carry, inp):
         st, key = carry
@@ -569,7 +574,12 @@ def scale_run_rounds(cfg: ScaleSimConfig, st, net: NetModel, key, inputs):
         st, info = scale_sim_step(cfg, st, net, sub, inp)
         return (st, key), info
 
-    (st, key), infos = jax.lax.scan(body, (st, key), inputs)
+    return jax.lax.scan(body, (st, key), inputs)
+
+
+def scale_run_rounds(cfg: ScaleSimConfig, st, net: NetModel, key, inputs):
+    """``lax.scan`` over stacked per-round inputs — one XLA program."""
+    (st, _key), infos = scale_run_rounds_carry(cfg, st, net, key, inputs)
     return st, infos
 
 

@@ -142,11 +142,16 @@ def sim_step(cfg: SimConfig, st: SimState, net: NetModel, key, inp: RoundInput):
     return SimState(swim, cst), info
 
 
-def run_rounds(cfg: SimConfig, st: SimState, net: NetModel, key, inputs: RoundInput):
-    """``lax.scan`` over stacked per-round inputs (leading axis = rounds).
+def run_rounds_carry(cfg: SimConfig, st: SimState, net: NetModel, key,
+                     inputs: RoundInput):
+    """``lax.scan`` over stacked per-round inputs, returning the FULL
+    scan carry ``((state, key), infos)``.
 
-    The whole simulation compiles to one XLA program — the form the
-    benchmark runs and the mesh shards.
+    This is the segment entry point: because the per-round key is split
+    off the carried key inside the scan body, feeding one segment's
+    carry-out into the next segment's carry-in reproduces the
+    straight-through scan bit for bit — the segmented soak runner
+    (``resilience/segments.py``) rides on exactly this property.
     """
 
     def body(carry, inp):
@@ -155,7 +160,16 @@ def run_rounds(cfg: SimConfig, st: SimState, net: NetModel, key, inputs: RoundIn
         st, info = sim_step(cfg, st, net, sub, inp)
         return (st, key), info
 
-    (st, key), infos = jax.lax.scan(body, (st, key), inputs)
+    return jax.lax.scan(body, (st, key), inputs)
+
+
+def run_rounds(cfg: SimConfig, st: SimState, net: NetModel, key, inputs: RoundInput):
+    """``lax.scan`` over stacked per-round inputs (leading axis = rounds).
+
+    The whole simulation compiles to one XLA program — the form the
+    benchmark runs and the mesh shards.
+    """
+    (st, _key), infos = run_rounds_carry(cfg, st, net, key, inputs)
     return st, infos
 
 

@@ -153,8 +153,26 @@ def sync_step(
         (4.0 * serve_cap) / jnp.maximum(loadp, 1).astype(jnp.float32),
         1.0,
     )
-    admitted = ok & (jr.uniform(k_adm, ok.shape) < admit_p)
+    # anti-starvation force-admit: the shed coin flips are independent
+    # per round, so an unlucky client could lose every one of them for
+    # arbitrarily long. cst.sync_defer counts consecutive fully-shed
+    # rounds per client; at cfg.sync_defer_cap the next request is
+    # admitted unconditionally — a requesting client is served at least
+    # once every cap+1 rounds, deterministically, while the expected
+    # granted work stays budget-shaped.
+    defer_cap = max(1, getattr(cfg, "sync_defer_cap", 8))
+    force = (cst.sync_defer >= defer_cap)[:, None]
+    admitted = ok & ((jr.uniform(k_adm, ok.shape) < admit_p) | force)
     rejects = jnp.sum(ok & ~admitted)
+    admitted_any = jnp.any(admitted, axis=1)
+    shed_all = jnp.any(ok, axis=1) & ~admitted_any
+    cst = cst._replace(sync_defer=jnp.where(
+        admitted_any,
+        0,
+        jnp.where(shed_all,
+                  jnp.minimum(cst.sync_defer + 1, defer_cap),
+                  cst.sync_defer),
+    ))
     ok = admitted
     chunk_eff = jnp.clip(
         (cfg.sync_chunk * serve_cap)

@@ -11,7 +11,8 @@ fraction so a fleet of nodes does not thunder-herd. Used by the sync loop
 from __future__ import annotations
 
 import random
-from typing import Iterator, Optional
+import time
+from typing import Callable, Iterator, Optional, Tuple, Type
 
 
 class Backoff:
@@ -61,3 +62,58 @@ class Backoff:
             yield base
             base = min(self.max_wait, base * self.factor)
             n += 1
+
+
+def retry_call(
+    fn: Callable,
+    *args,
+    backoff: Optional[Backoff] = None,
+    retry_on: Tuple[Type[BaseException], ...] = (
+        ConnectionError, TimeoutError, OSError,
+    ),
+    sleep: Callable[[float], object] = time.sleep,
+    abort: Optional[Callable[[], bool]] = None,
+    on_retry: Optional[Callable[[BaseException, float, int], None]] = None,
+    **kwargs,
+):
+    """Call ``fn(*args, **kwargs)`` until it succeeds, sleeping through
+    one shared jittered policy between attempts — the ONE retry engine
+    every poll/reconnect loop in the codebase rides (the reference's
+    ``backoff`` crate is likewise the single policy behind sync retries
+    and bootstrap announcements).
+
+    - ``backoff``: delay source; default ``Backoff(max_retries=5)``. A
+      ``Backoff`` without ``max_retries`` retries forever (pair it with
+      ``abort``).
+    - ``retry_on``: exception types that trigger a retry; anything else
+      propagates immediately.
+    - ``sleep``: delay function — pass an ``Event.wait`` to make waits
+      interruptible by shutdown.
+    - ``abort``: checked after each failure; when it returns True the
+      last exception propagates instead of sleeping (shutdown must not
+      sit out a 30 s delay).
+    - ``on_retry(exc, delay, attempt)``: observation hook (logging,
+      supervisor state).
+
+    When the delay iterator is exhausted the last exception propagates —
+    callers keep their natural ``except`` types."""
+    delays = iter(backoff if backoff is not None else Backoff(max_retries=5))
+    attempt = 0
+    while True:
+        try:
+            return fn(*args, **kwargs)
+        except retry_on as e:
+            if abort is not None and abort():
+                raise
+            delay = next(delays, None)
+            if delay is None:
+                raise
+            attempt += 1
+            if on_retry is not None:
+                on_retry(e, delay, attempt)
+            sleep(delay)
+            if abort is not None and abort():
+                # an interruptible sleep (Event.wait) returns early on
+                # shutdown — don't launch one more full attempt after
+                # the caller already tripped
+                raise

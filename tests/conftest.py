@@ -40,6 +40,15 @@ enable_compile_cache()
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # tier-1 filters on `-m 'not slow'`; register the marker so the
+    # filter is meaningful instead of a warning on an unknown marker
+    config.addinivalue_line(
+        "markers",
+        "slow: long soak/stress tests excluded from the tier-1 run",
+    )
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_between_modules():
     yield

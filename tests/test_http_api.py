@@ -170,6 +170,63 @@ def test_updates_unknown_table(rig):
         next(iter(client.updates("nope")))
 
 
+def _raw_get(server, path):
+    import http.client
+    import json as _json
+
+    conn = http.client.HTTPConnection(server.addr, server.port, timeout=10)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        body = _json.loads(resp.read())
+        return resp.status, dict(resp.headers), body
+    finally:
+        conn.close()
+
+
+def test_health_and_ready_green(rig):
+    _, _, server, _ = rig
+    status, _headers, body = _raw_get(server, "/v1/health")
+    assert status == 200 and body["status"] == "ok"
+    assert body["generation"] == 0 and body["round"] >= 1
+    status, _headers, body = _raw_get(server, "/v1/ready")
+    assert status == 200 and body["ready"] is True
+
+
+def test_ready_degrades_during_supervisor_backoff(rig):
+    """While the watchdog is between dispatch retries both probe routes
+    answer 503 + Retry-After instead of serving from a stalled
+    cluster."""
+    agent, _, server, _ = rig
+
+    class BackingOff:
+        state = "backoff"
+        retries = 3
+        aborts = 0
+
+        @staticmethod
+        def retry_after_seconds():
+            return 2.4
+
+        @staticmethod
+        def call(fn, *args, label=None, **kwargs):
+            # the rig agent's round loop dispatches through the
+            # installed supervisor — keep it running
+            return fn(*args, **kwargs)
+
+    old = agent._supervisor
+    agent._supervisor = BackingOff()
+    try:
+        status, headers, body = _raw_get(server, "/v1/ready")
+        assert status == 503 and body["status"] == "backoff"
+        assert int(headers["Retry-After"]) >= 1
+        status, headers, body = _raw_get(server, "/v1/health")
+        assert status == 503 and body["status"] == "backoff"
+        assert int(headers["Retry-After"]) >= 1
+    finally:
+        agent._supervisor = old
+
+
 def test_introspection_endpoints(rig):
     _, _, _, client = rig
     stats = client.table_stats()

@@ -1,0 +1,636 @@
+"""Preemption-safe recovery: segmented runs, crash-consistent
+checkpoints, the watchdog supervisor, and agent auto-recovery.
+
+The contract under test mirrors the reference's whole value proposition
+(survive failure, converge anyway): a segmented soak run is bitwise
+identical to a straight ``lax.scan``; a crash mid-save never leaves a
+directory that both loads and differs from a committed state; tampered
+leaf files are refused on load; and a failing round loop rolls back to
+the last good checkpoint instead of dying."""
+
+import json
+import os
+import threading
+import time
+
+import jax
+import jax.random as jr
+import numpy as np
+import pytest
+
+from corrosion_tpu.checkpoint import (
+    CheckpointIntegrityError,
+    load_checkpoint,
+    save_checkpoint,
+    verify_checkpoint,
+)
+from corrosion_tpu.resilience import (
+    DispatchTimeout,
+    Supervisor,
+    SupervisorAborted,
+    latest_valid_checkpoint,
+    prune_checkpoints,
+    read_latest,
+    resume_segmented,
+    run_segmented,
+    update_latest,
+)
+from corrosion_tpu.resilience.segments import make_soak_inputs
+from corrosion_tpu.sim.transport import NetModel
+from corrosion_tpu.utils.backoff import Backoff, retry_call
+
+# --- shared rigs ---------------------------------------------------------
+
+
+def scale_cfg():
+    from corrosion_tpu.sim.scale_step import scale_sim_config
+
+    return scale_sim_config(
+        24, m_slots=8, n_origins=4, n_rows=4, n_cols=2, sync_interval=4
+    )
+
+
+def full_cfg():
+    from corrosion_tpu.sim.config import SimConfig
+
+    return SimConfig(n_nodes=12, n_origins=4, n_rows=4, n_cols=2,
+                     tx_max_cells=2)
+
+
+def straight_run(cfg, st, net, key, inputs, mode):
+    if mode == "scale":
+        from corrosion_tpu.sim.scale_step import scale_run_rounds as rr
+    else:
+        from corrosion_tpu.sim.step import run_rounds as rr
+    return jax.jit(lambda s, k, i: rr(cfg, s, net, k, i))(st, key, inputs)
+
+
+def fresh_state(cfg, mode):
+    if mode == "scale":
+        from corrosion_tpu.sim.scale_step import ScaleSimState as St
+    else:
+        from corrosion_tpu.sim.step import SimState as St
+    return St.create(cfg)
+
+
+def assert_trees_equal(a, b, what=""):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for i, (x, y) in enumerate(zip(la, lb)):
+        assert np.array_equal(np.asarray(x), np.asarray(y)), (
+            f"{what} leaf {i} differs"
+        )
+
+
+# --- resume parity (satellite): straight vs segmented+save/load ----------
+
+
+@pytest.mark.parametrize("mode", ["full", "scale"])
+def test_resume_parity_bitwise(tmp_path, mode):
+    """N rounds straight vs 2 segments with a REAL save/load round-trip
+    between them: final state leaves and per-round metrics must be
+    bitwise identical (the segmented runner's core guarantee)."""
+    cfg = scale_cfg() if mode == "scale" else full_cfg()
+    net = NetModel.create(cfg.n_nodes, drop_prob=0.02)
+    st0 = fresh_state(cfg, mode)
+    key0 = jr.key(3)
+    rounds = 16
+    inputs = make_soak_inputs(cfg, jr.key(5), rounds, write_frac=0.25,
+                              mode=mode)
+    st_ref, infos_ref = straight_run(cfg, st0, net, key0, inputs, mode)
+
+    root = str(tmp_path / "soak")
+    # segment 1 only: runs rounds [0, 8) and commits seg-00000008
+    r1 = run_segmented(cfg, st0, net, key0,
+                       jax.tree.map(lambda a: a[:8], inputs),
+                       segment_rounds=8, mode=mode, checkpoint_root=root)
+    assert r1.completed_rounds == 8 and not r1.aborted
+    # a different process resumes purely from disk
+    r2 = resume_segmented(cfg, net, inputs, segment_rounds=8,
+                          checkpoint_root=root, mode=mode)
+    assert r2.completed_rounds == rounds and not r2.aborted
+    assert_trees_equal(st_ref, r2.state, f"{mode} resumed state")
+    for k in infos_ref:
+        got = np.concatenate([np.asarray(r1.infos[k]), r2.infos[k]])
+        assert np.array_equal(np.asarray(infos_ref[k]), got), (
+            f"{mode} metric {k} differs after resume"
+        )
+
+
+def test_soak_smoke_two_segments():
+    """Tier-1 smoke: a 2-segment in-memory run (no checkpoint dir)
+    matches the straight scan bitwise."""
+    cfg = scale_cfg()
+    net = NetModel.create(cfg.n_nodes, drop_prob=0.0)
+    st0 = fresh_state(cfg, "scale")
+    key0 = jr.key(11)
+    inputs = make_soak_inputs(cfg, jr.key(13), 12, write_frac=0.2)
+    st_ref, infos_ref = straight_run(cfg, st0, net, key0, inputs, "scale")
+    res = run_segmented(cfg, st0, net, key0, inputs, segment_rounds=6)
+    assert res.completed_rounds == 12
+    assert_trees_equal(st_ref, res.state, "smoke state")
+    for k in infos_ref:
+        assert np.array_equal(np.asarray(infos_ref[k]), res.infos[k])
+
+
+@pytest.mark.slow
+def test_long_soak_many_segments_with_retention(tmp_path):
+    """Soak-length: many segments with checkpoint/restore between EVERY
+    segment pair, retention at keep_last=2, resumed twice mid-run."""
+    cfg = scale_cfg()
+    net = NetModel.create(cfg.n_nodes, drop_prob=0.05)
+    st0 = fresh_state(cfg, "scale")
+    key0 = jr.key(17)
+    rounds = 96
+    inputs = make_soak_inputs(cfg, jr.key(19), rounds, write_frac=0.3)
+    st_ref, _ = straight_run(cfg, st0, net, key0, inputs, "scale")
+    root = str(tmp_path / "soak")
+    # run the first third, then resume from disk twice (simulated
+    # preemptions at arbitrary segment boundaries)
+    run_segmented(cfg, st0, net, key0,
+                  jax.tree.map(lambda a: a[:32], inputs),
+                  segment_rounds=8, checkpoint_root=root, keep_last=2)
+    resume_segmented(cfg, net, jax.tree.map(lambda a: a[:64], inputs),
+                     segment_rounds=8, checkpoint_root=root, keep_last=2)
+    res = resume_segmented(cfg, net, inputs, segment_rounds=8,
+                           checkpoint_root=root, keep_last=2)
+    assert res.completed_rounds == rounds
+    assert_trees_equal(st_ref, res.state, "long soak state")
+    dirs = [d for d in os.listdir(root) if d.startswith("seg-")]
+    assert len(dirs) <= 2  # retention held across resumes
+
+
+# --- crash injection (satellite): manifest-last ordering -----------------
+
+
+class _AgentView:
+    """Minimal agent shape for save_checkpoint in crash tests."""
+
+    def __init__(self, cfg, state, mode="scale", round_no=7):
+        self.cfg, self._state = cfg, state
+        self.mode, self.round_no = mode, round_no
+
+    def device_state(self):
+        return self._state
+
+
+def test_crash_mid_save_rejected_and_previous_survives(tmp_path,
+                                                       monkeypatch):
+    """Kill the process mid-save: the half-written directory must be
+    rejected by load_checkpoint, and the PREVIOUS checkpoint must remain
+    the recovery point."""
+    cfg = scale_cfg()
+    view = _AgentView(cfg, fresh_state(cfg, "scale"))
+    root = str(tmp_path)
+    good = save_checkpoint(view, path=os.path.join(root, "seg-00000007"))
+    update_latest(root, "seg-00000007")
+    verify_checkpoint(good)
+
+    import corrosion_tpu.checkpoint as ckpt_mod
+
+    def exploding_savez(path, **arrays):
+        with open(path, "wb") as f:
+            f.write(b"PK\x03\x04 partial npz garbage")
+        raise OSError("simulated crash mid-write")
+
+    monkeypatch.setattr(ckpt_mod.np, "savez_compressed", exploding_savez)
+    half = os.path.join(root, "seg-00000014")
+    with pytest.raises(OSError):
+        save_checkpoint(view, path=half)
+    monkeypatch.undo()
+
+    # the half-written side has no manifest -> rejected outright
+    with pytest.raises(CheckpointIntegrityError):
+        load_checkpoint(half)
+    with pytest.raises(CheckpointIntegrityError):
+        verify_checkpoint(half)
+    # recovery scanning still lands on the previous good side
+    assert latest_valid_checkpoint(root) == good
+    manifest, _state = load_checkpoint(good)
+    assert manifest["round"] == 7
+
+
+def test_crash_mid_overwrite_rejects_the_side(tmp_path, monkeypatch):
+    """Overwriting an EXISTING side removes its manifest first, so a
+    crash mid-overwrite leaves the side invalid rather than a stale
+    manifest describing fresh half-written leaves."""
+    cfg = scale_cfg()
+    view = _AgentView(cfg, fresh_state(cfg, "scale"))
+    side = save_checkpoint(view, path=str(tmp_path / "auto-a"))
+    verify_checkpoint(side)
+
+    import corrosion_tpu.checkpoint as ckpt_mod
+
+    def exploding_savez(path, **arrays):
+        raise OSError("simulated crash before leaves hit disk")
+
+    monkeypatch.setattr(ckpt_mod.np, "savez_compressed", exploding_savez)
+    with pytest.raises(OSError):
+        save_checkpoint(view, path=side)
+    monkeypatch.undo()
+    with pytest.raises(CheckpointIntegrityError):
+        load_checkpoint(side)
+
+
+# --- corruption detection (satellite) ------------------------------------
+
+
+def test_tampered_leaf_file_refused(tmp_path):
+    """Flip one byte in the committed ``state.npz``: load_checkpoint
+    must refuse with a clear integrity error and verify-checkpoint must
+    exit non-zero."""
+    cfg = scale_cfg()
+    view = _AgentView(cfg, fresh_state(cfg, "scale"))
+    path = save_checkpoint(view, path=str(tmp_path / "ckpt"))
+    npz = os.path.join(path, "state.npz")
+    blob = bytearray(open(npz, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    with open(npz, "wb") as f:
+        f.write(blob)
+
+    with pytest.raises(CheckpointIntegrityError) as e:
+        load_checkpoint(path)
+    assert "hash mismatch" in str(e.value)
+
+    from corrosion_tpu.cli import main
+
+    assert main(["verify-checkpoint", path]) != 0
+    # untampered directory verifies clean through the same CLI
+    good = save_checkpoint(view, path=str(tmp_path / "ckpt2"))
+    assert main(["verify-checkpoint", good]) == 0
+
+
+# --- retention + LATEST pointer ------------------------------------------
+
+
+def test_retention_and_latest_pointer(tmp_path):
+    cfg = scale_cfg()
+    root = str(tmp_path)
+    for r in (8, 16, 24, 32):
+        view = _AgentView(cfg, fresh_state(cfg, "scale"), round_no=r)
+        save_checkpoint(view, path=os.path.join(root, f"seg-{r:08d}"))
+        update_latest(root, f"seg-{r:08d}")
+    assert read_latest(root) == "seg-00000032"
+    pruned = prune_checkpoints(root, keep_last=2)
+    left = sorted(d for d in os.listdir(root) if d.startswith("seg-"))
+    assert left == ["seg-00000024", "seg-00000032"]
+    assert sorted(pruned) == ["seg-00000008", "seg-00000016"]
+    # LATEST's target is pinned even under keep_last=1 with a stale set
+    update_latest(root, "seg-00000024")
+    prune_checkpoints(root, keep_last=1)
+    assert os.path.isdir(os.path.join(root, "seg-00000024"))
+
+
+# --- retry_call + supervisor ---------------------------------------------
+
+
+def test_retry_call_retries_then_succeeds():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("transient")
+        return "ok"
+
+    slept = []
+    out = retry_call(flaky, backoff=Backoff(0.01, 0.02, max_retries=5),
+                     sleep=slept.append)
+    assert out == "ok" and len(calls) == 3 and len(slept) == 2
+
+
+def test_retry_call_exhaustion_raises_last_error():
+    def always():
+        raise TimeoutError("still down")
+
+    with pytest.raises(TimeoutError):
+        retry_call(always, backoff=Backoff(0.01, 0.02, max_retries=2),
+                   sleep=lambda _d: None)
+
+
+def test_retry_call_non_retryable_propagates_immediately():
+    calls = []
+
+    def boom():
+        calls.append(1)
+        raise ValueError("logic bug")
+
+    with pytest.raises(ValueError):
+        retry_call(boom, backoff=Backoff(0.01, max_retries=5),
+                   sleep=lambda _d: None)
+    assert len(calls) == 1
+
+
+def test_retry_call_abort_short_circuits():
+    def always():
+        raise ConnectionError("down")
+
+    with pytest.raises(ConnectionError):
+        retry_call(always, backoff=Backoff(0.01),  # infinite policy
+                   sleep=lambda _d: None, abort=lambda: True)
+
+
+def test_retry_call_abort_during_sleep_skips_next_attempt():
+    """Shutdown mid-backoff (an interruptible Event.wait returning
+    early) must NOT launch one more full attempt."""
+    tripped = []
+    calls = []
+
+    def always():
+        calls.append(1)
+        raise ConnectionError("down")
+
+    with pytest.raises(ConnectionError):
+        retry_call(always, backoff=Backoff(0.01),  # infinite policy
+                   sleep=lambda _d: tripped.append(1),
+                   abort=lambda: bool(tripped))
+    assert len(calls) == 1
+
+
+def test_supervisor_retries_transient_then_recovers():
+    sup = Supervisor(backoff=Backoff(0.01, 0.02, max_retries=3),
+                     sleep=lambda _d: None)
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise RuntimeError("device hiccup")
+        return 42
+
+    assert sup.call(flaky) == 42
+    assert sup.retries == 2 and sup.state == "idle" and sup.aborts == 0
+
+
+def test_supervisor_exhaustion_aborts_gracefully():
+    sup = Supervisor(backoff=Backoff(0.01, 0.02, max_retries=1),
+                     sleep=lambda _d: None)
+
+    def always():
+        raise RuntimeError("device gone")
+
+    with pytest.raises(SupervisorAborted):
+        sup.call(always)
+    assert sup.state == "aborted" and sup.aborts == 1
+
+
+def test_supervisor_deadline_times_out_wedged_dispatch():
+    import threading
+
+    release = threading.Event()
+    sup = Supervisor(deadline_seconds=0.05,
+                     backoff=Backoff(0.01, max_retries=1),
+                     sleep=lambda _d: None)
+    with pytest.raises(SupervisorAborted) as e:
+        sup.call(lambda: release.wait(30))
+    assert isinstance(e.value.__cause__, DispatchTimeout)
+    release.set()  # unwedge the orphaned worker
+
+
+def test_supervisor_resets_state_on_non_retryable_error():
+    """An exception outside retry_on propagates immediately AND returns
+    the observable state to idle — /v1/health must not report a dead
+    dispatcher as running forever."""
+    sup = Supervisor(backoff=Backoff(0.01, max_retries=3),
+                     sleep=lambda _d: None)
+
+    def bad_input():
+        raise ValueError("not a pytree")
+
+    with pytest.raises(ValueError, match="not a pytree"):
+        sup.call(bad_input)
+    assert sup.state == "idle" and sup.aborts == 0
+
+
+def test_supervisor_never_retries_an_inner_abort():
+    """A SupervisorAborted raised INSIDE a supervised call (nested
+    supervisor / segmented run) must pass through un-retried even though
+    it subclasses RuntimeError, which IS in the default retry set."""
+    sup = Supervisor(backoff=Backoff(0.01, max_retries=3),
+                     sleep=lambda _d: None)
+    calls = []
+
+    def inner_already_aborted():
+        calls.append(1)
+        raise SupervisorAborted("inner gave up")
+
+    with pytest.raises(SupervisorAborted, match="inner gave up"):
+        sup.call(inner_already_aborted)
+    assert len(calls) == 1 and sup.state == "aborted"
+
+
+def test_segmented_run_aborts_at_last_checkpoint(tmp_path):
+    """Supervisor exhaustion mid-soak: the run stops gracefully and the
+    last committed segment remains the recovery point."""
+    cfg = scale_cfg()
+    net = NetModel.create(cfg.n_nodes, drop_prob=0.0)
+    st0 = fresh_state(cfg, "scale")
+    inputs = make_soak_inputs(cfg, jr.key(23), 12, write_frac=0.0)
+    root = str(tmp_path / "soak")
+
+    class FailAfterOne(Supervisor):
+        def __init__(self):
+            super().__init__(backoff=Backoff(0.01, max_retries=1),
+                             sleep=lambda _d: None)
+            self.seen = 0
+
+        def call(self, fn, *args, **kwargs):
+            self.seen += 1
+            if self.seen > 1:
+                raise SupervisorAborted("injected exhaustion")
+            return super().call(fn, *args, **kwargs)
+
+    res = run_segmented(cfg, st0, net, jr.key(29), inputs,
+                        segment_rounds=4, checkpoint_root=root,
+                        supervisor=FailAfterOne())
+    assert res.aborted
+    assert res.completed_rounds == 4
+    assert res.checkpoint and res.checkpoint.endswith("seg-00000004")
+    # and the checkpoint is a genuine recovery point
+    res2 = resume_segmented(cfg, net, inputs, segment_rounds=4,
+                            checkpoint_root=root)
+    assert res2.completed_rounds == 12 and not res2.aborted
+
+
+# --- agent auto-recovery + generation fencing ----------------------------
+
+
+def agent_config(tmp_path):
+    from corrosion_tpu.config import Config
+
+    cfg = Config()
+    cfg.sim.mode = "scale"
+    cfg.sim.n_nodes = 16
+    cfg.sim.m_slots = 8
+    cfg.sim.n_origins = 4
+    cfg.sim.n_rows = 4
+    cfg.sim.n_cols = 2
+    cfg.gossip.drop_prob = 0.0
+    cfg.db.path = str(tmp_path / "state")
+    return cfg
+
+
+def test_agent_boot_time_auto_recover(tmp_path):
+    from corrosion_tpu.agent import Agent
+
+    cfg = agent_config(tmp_path)
+    root = cfg.db.path
+    agent = Agent(cfg)
+    with agent:
+        assert agent.wait_rounds(6, timeout=120)
+    # shut down first: the state is frozen, so the saved checkpoint and
+    # the comparison copy are deterministically the same round
+    save_checkpoint(agent, path=os.path.join(root, "seg-00000006"))
+    update_latest(root, "seg-00000006")
+    saved_round = agent.round_no
+    snap_store = np.asarray(agent.device_state().crdt.store[1]).copy()
+
+    fresh = Agent(cfg)
+    man = fresh.recover_latest()
+    assert man is not None and man["path"].endswith("seg-00000006")
+    assert fresh.generation == 1  # the restore fenced generation 0
+    assert fresh.round_no == man["round"] == saved_round
+    got = np.asarray(fresh.device_state().crdt.store[1])
+    assert np.array_equal(got, snap_store)
+    # health is green on a recovered-but-unstarted agent
+    h = fresh.health()
+    assert h["status"] == "ok" and h["generation"] == 1
+
+    # auto_recover=True wires the same path through start()
+    live = Agent(cfg).start(auto_recover=True)
+    try:
+        assert live.generation == 1
+        assert live.wait_rounds(2, timeout=60)
+    finally:
+        live.shutdown()
+
+
+def test_agent_mid_run_crash_rolls_back_to_checkpoint(tmp_path):
+    """Watchdogged loop: rounds that raise roll the cluster back to the
+    newest checkpoint (generation bumps) and the loop keeps running."""
+    from corrosion_tpu.agent import Agent
+
+    cfg = agent_config(tmp_path)
+    root = cfg.db.path
+    agent = Agent(cfg)
+    try:
+        agent.start(auto_recover=True)
+        assert agent.wait_rounds(4, timeout=120)
+        save_checkpoint(agent, path=os.path.join(root, "seg-00000004"))
+        update_latest(root, "seg-00000004")
+
+        real_step = agent._step
+        fails = {"left": 2}
+
+        def flaky_step(st, net, key, inp):
+            if fails["left"] > 0:
+                fails["left"] -= 1
+                raise RuntimeError("injected device failure")
+            return real_step(st, net, key, inp)
+
+        agent._step = flaky_step
+        gen_before = agent.generation
+        assert agent.wait_rounds(4, timeout=120)
+        assert agent.generation > gen_before  # rollback(s) applied
+        assert not agent.tripwire.tripped
+        assert agent.health()["status"] == "ok"
+    finally:
+        agent.shutdown()
+
+
+def test_dropped_write_raises_instead_of_false_success(tmp_path):
+    """A write drained into a round that fails (and rolls back) must
+    surface as a clear error at the writer — not hang out its timeout,
+    and not return a success dict for a write that never committed."""
+    from corrosion_tpu.agent import Agent
+
+    cfg = agent_config(tmp_path)
+    root = cfg.db.path
+    agent = Agent(cfg)
+    try:
+        agent.start(auto_recover=True)
+        assert agent.wait_rounds(2, timeout=120)
+        save_checkpoint(agent, path=os.path.join(root, "seg-00000002"))
+        update_latest(root, "seg-00000002")
+
+        real_step = agent._step
+        entered = threading.Event()
+
+        def failing_step(st, net, key, inp):
+            if bool(np.asarray(inp.write_mask).any()):
+                entered.set()
+                raise RuntimeError("injected device failure")
+            return real_step(st, net, key, inp)
+
+        agent._step = failing_step
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError, match="dropped"):
+            agent.write(0, 0, 123, wait=True, timeout=60)
+        assert entered.is_set()
+        assert time.monotonic() - t0 < 30  # woken, not timed out
+        agent._step = real_step
+        assert agent.wait_rounds(2, timeout=120)  # loop recovered
+    finally:
+        agent.shutdown()
+
+
+def test_agent_recovery_restores_host_db_state(tmp_path):
+    """A rollback must rewind the HOST state (schema/heap/rows) together
+    with the device state — the recovered cluster must not keep serving
+    rows it no longer holds (the attached Database registers itself as
+    the agent's recovery_db)."""
+    from corrosion_tpu.agent import Agent
+    from corrosion_tpu.db import Database
+
+    cfg = agent_config(tmp_path)
+    root = cfg.db.path
+    with Agent(cfg) as agent:
+        db = Database(agent)
+        assert agent.recovery_db is db
+        db.apply_schema_sql(
+            "CREATE TABLE kv (k TEXT PRIMARY KEY, v INTEGER);"
+        )
+        db.execute(0, [("INSERT INTO kv (k, v) VALUES ('a', 1)",)])
+        agent.wait_rounds(2, timeout=60)
+        save_checkpoint(agent, db=db,
+                        path=os.path.join(root, "seg-00000002"))
+        update_latest(root, "seg-00000002")
+        # host state advances past the checkpoint...
+        db.execute(0, [("INSERT INTO kv (k, v) VALUES ('b', 2)",)])
+        agent.wait_rounds(2, timeout=60)
+        assert db.read_row(0, "kv", "b") is not None
+        # ...and the rollback rewinds BOTH sides
+        man = agent.recover_latest()
+        assert man is not None
+        assert db.read_row(0, "kv", "b") is None
+        row = db.read_row(0, "kv", "a")
+        assert row is not None and row["v"] == 1
+
+
+def test_agent_without_recovery_point_trips_on_crash(tmp_path):
+    from corrosion_tpu.agent import Agent
+
+    cfg = agent_config(tmp_path)  # db.path exists but holds no checkpoint
+    agent = Agent(cfg)
+    try:
+        agent.start(auto_recover=True)
+        assert agent.wait_rounds(2, timeout=120)
+        agent._step = lambda *a: (_ for _ in ()).throw(
+            RuntimeError("injected")
+        )
+        assert agent.tripwire.wait(60), "loop should trip without a " \
+                                        "recovery point"
+    finally:
+        agent.shutdown()
+
+
+def test_checkpoint_extra_payload_roundtrip(tmp_path):
+    cfg = scale_cfg()
+    view = _AgentView(cfg, fresh_state(cfg, "scale"))
+    path = save_checkpoint(view, path=str(tmp_path / "ck"),
+                           extra={"soak": {"completed_rounds": 7}})
+    manifest, _ = load_checkpoint(path)
+    assert manifest["extra"]["soak"]["completed_rounds"] == 7
+    assert manifest["files"]["state.npz"]
+    # manifest survives a json round-trip (the CLI prints it)
+    json.dumps(verify_checkpoint(path))

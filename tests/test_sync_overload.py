@@ -98,3 +98,24 @@ def test_overload_is_repaired_by_later_rounds(rig):
     # every client must have been admitted at least a few times
     assert min_head > 0
     assert min_head >= cfg.sync_min_chunk
+
+
+def test_defer_cap_force_admits_starved_clients(rig):
+    """A client at the defer cap is admitted unconditionally whatever
+    the shed coin flips say (the deterministic anti-starvation bound),
+    and its counter resets on the served round."""
+    cfg, cst, net = rig
+    peers, p_ok = overload_peers(cfg)
+    alive = jnp.ones(N, bool)
+    cst = cst._replace(
+        sync_defer=jnp.full(N, cfg.sync_defer_cap, jnp.int32)
+    )
+    cst2, ok, info = sync_step(
+        cfg, cst, peers, p_ok, alive, net, jr.key(3), go_all=True
+    )
+    assert int(info["serve_rejects"]) == 0
+    assert bool(jnp.all(ok[1:, 0]))
+    # every served CLIENT resets; node 0 never requests, so its counter
+    # is (correctly) untouched
+    assert int(jnp.max(cst2.sync_defer[1:])) == 0
+    assert int(jnp.min(cst2.book.head[1:, 0])) > 0
