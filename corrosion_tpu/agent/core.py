@@ -687,6 +687,76 @@ class Agent:
                     self._pend_restore = None
         return ok
 
+    def soak(self, rounds: int, segment_rounds: int = 128,
+             checkpoint_root: Optional[str] = None, keep_last: int = 3,
+             write_frac: float = 0.0, resume: bool = False,
+             donate: bool = True, async_checkpoint: bool = True,
+             supervisor=None, inputs=None):
+        """Throughput soak dispatch: run ``rounds`` rounds from the
+        agent's current state through the segmented runner
+        (:func:`corrosion_tpu.resilience.segments.run_segmented`) — the
+        scan carry is buffer-donated across segment boundaries and
+        checkpoints drain on the overlapped background writer — then
+        adopt the final carry as the agent's state (round counter
+        advances by the completed rounds; the generation fence bumps so
+        any stale in-flight result cannot commit over it).
+
+        The round loop must be stopped: a live round's in-flight carry
+        would race the donated buffers. The agent's own state buffers
+        are never donated (the runner's first segment runs un-donated),
+        so an aborted soak leaves the agent usable at the runner's last
+        good carry. ``resume=True`` continues from the newest valid
+        checkpoint under ``checkpoint_root`` instead of the live state.
+        """
+        # real errors, not asserts (python -O strips asserts, and a live
+        # round's in-flight carry racing the donated segment buffers
+        # corrupts state instead of failing loudly)
+        if self._thread is not None and self._thread.is_alive():
+            raise RuntimeError("stop the round loop before a soak dispatch")
+        if resume and not checkpoint_root:
+            raise ValueError("resume needs a checkpoint root")
+        from corrosion_tpu.resilience.segments import (
+            make_soak_inputs,
+            resume_segmented,
+            run_segmented,
+        )
+
+        if inputs is None:
+            inputs = make_soak_inputs(
+                self.cfg, jr.key(self.config.sim.seed + 1), rounds,
+                write_frac=write_frac, mode=self.mode,
+            )
+        common = dict(
+            mode=self.mode, checkpoint_root=checkpoint_root,
+            keep_last=keep_last, db=self.recovery_db,
+            supervisor=supervisor or self._supervisor,
+            donate=donate, async_checkpoint=async_checkpoint,
+        )
+        if resume:
+            result = resume_segmented(
+                self.cfg, self._net, inputs, segment_rounds, **common
+            )
+        else:
+            result = run_segmented(
+                self.cfg, self._state, self._net, self._key, inputs,
+                segment_rounds, **common,
+            )
+        with self._input_lock:
+            self._state = result.state
+            self._key = result.key
+            if resume:
+                # completed_rounds is ABSOLUTE within the input stack
+                # (start_round included) and the adopted state replaces
+                # this agent's, it doesn't extend it — adding would
+                # double-count the pre-crash rounds
+                self.round_no = result.completed_rounds
+            else:
+                self.round_no += result.completed_rounds
+            self.generation += 1
+        with self._snap_lock:
+            self._snapshot_host = None
+        return result
+
     # --- health / readiness (feeds /v1/health + /v1/ready) ---------------
     def health(self) -> dict:
         """Liveness + readiness summary.

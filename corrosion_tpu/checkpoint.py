@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
+import io
 import json
 import os
 from typing import Optional, Tuple
@@ -77,6 +78,24 @@ def _verify_files(path: str, manifest: dict) -> None:
             )
 
 
+def _serialize_state(leaves: list) -> bytes:
+    """Compress the state leaves into npz bytes in memory, so the
+    content hash is computed over the bytes once instead of re-reading
+    the file from disk after the write (the old shape paid a full file
+    re-read per checkpoint — a hidden extra IO pass in the soak hot
+    loop)."""
+    buf = io.BytesIO()
+    np.savez_compressed(
+        buf, **{f"leaf_{i}": a for i, a in enumerate(leaves)}
+    )
+    return buf.getvalue()
+
+
+def _write_bytes(path: str, data: bytes) -> None:
+    with open(path, "wb") as f:
+        f.write(data)
+
+
 def _state_template(mode: str, cfg):
     if mode == "scale":
         from corrosion_tpu.sim.scale_step import ScaleSimState
@@ -109,17 +128,16 @@ def save_checkpoint(agent, db=None, path: str = "./checkpoint",
     state = agent.device_state()
     leaves = [np.asarray(x) for x in _leaves(state)]
     state_path = os.path.join(path, "state.npz")
-    np.savez_compressed(
-        state_path,
-        **{f"leaf_{i}": a for i, a in enumerate(leaves)},
-    )
+    blob = _serialize_state(leaves)
+    sha = hashlib.sha256(blob).hexdigest()
+    _write_bytes(state_path, blob)
     manifest = {
         "format": FORMAT_VERSION,
         "mode": agent.mode,
         "round": agent.round_no,
         "sim_config": dataclasses.asdict(agent.cfg),
         "n_leaves": len(leaves),
-        "files": {"state.npz": _file_sha256(state_path)},
+        "files": {"state.npz": sha},
         "db": db.state_dict() if db is not None else None,
     }
     if extra is not None:

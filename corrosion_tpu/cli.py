@@ -305,7 +305,8 @@ def cmd_soak(args) -> int:
     supervisor = Supervisor(deadline_seconds=args.deadline or None)
     common = dict(
         checkpoint_root=args.checkpoint_dir, keep_last=args.keep_last,
-        supervisor=supervisor,
+        supervisor=supervisor, donate=not args.no_donate,
+        async_checkpoint=not args.sync_checkpoint,
     )
     if args.resume:
         result = resume_segmented(cfg, net, inputs, args.segment, **common)
@@ -322,6 +323,9 @@ def cmd_soak(args) -> int:
         "completed_rounds": result.completed_rounds,
         "aborted": result.aborted,
         "checkpoint": result.checkpoint,
+        # which pipeline ran: donation/async-checkpoint engagement plus
+        # the stall-vs-overlapped-IO split (segments.run_segmented docs)
+        "stats": result.stats,
         "metrics": {
             k: float(np.asarray(v).sum()) for k, v in result.infos.items()
         },
@@ -519,6 +523,13 @@ def build_parser() -> argparse.ArgumentParser:
                          "(0 = none)")
     sk.add_argument("--resume", action="store_true",
                     help="continue from the newest valid checkpoint")
+    sk.add_argument("--no-donate", action="store_true",
+                    help="disable carry buffer donation across segment "
+                         "boundaries (debug: doubles state HBM)")
+    sk.add_argument("--sync-checkpoint", action="store_true",
+                    help="write checkpoints synchronously on the hot "
+                         "loop instead of the overlapped background "
+                         "writer")
     sk.set_defaults(fn=cmd_soak)
 
     t = sub.add_parser("template", help="render templates (re-render on change)")

@@ -9,9 +9,13 @@ traffic. See ``mesh.py``.
 """
 
 from corrosion_tpu.parallel.mesh import (  # noqa: F401
+    buffers_donated,
     make_mesh,
+    make_multihost_mesh,
     node_sharding,
     shard_state,
     sharded_step,
     sharded_run,
+    sharded_scale_run,
+    sharded_scale_run_carry,
 )

@@ -63,9 +63,12 @@ def make_multihost_mesh(n_hosts: int, devices=None) -> Mesh:
     import numpy as np
 
     devices = np.asarray(devices)
-    assert len(devices) % n_hosts == 0, (
-        f"{len(devices)} devices do not split over {n_hosts} hosts"
-    )
+    # a real error, not a bare assert: ``python -O`` strips asserts and a
+    # silently mis-shaped mesh would crash far away in device_put
+    if n_hosts <= 0 or len(devices) % n_hosts != 0:
+        raise ValueError(
+            f"{len(devices)} devices do not split over {n_hosts} hosts"
+        )
     return Mesh(
         devices.reshape(n_hosts, -1), (DCN_AXIS, NODE_AXIS)
     )
@@ -103,6 +106,18 @@ def shard_state(mesh: Mesh, n_nodes: int, tree: Any) -> Any:
     return jax.tree.map(lambda x: jax.device_put(x, spec(x)), tree)
 
 
+def buffers_donated(tree: Any) -> bool:
+    """True when any leaf buffer of ``tree`` was consumed by a donated
+    dispatch (jit reused it for an output). The one shared probe for
+    "did donation actually engage": the bench records it per
+    measurement, the soak runner uses it to detect a consumed carry
+    before a retry, and the multichip dryrun asserts it."""
+    return any(
+        getattr(leaf, "is_deleted", lambda: False)()
+        for leaf in jax.tree.leaves(tree)
+    )
+
+
 @functools.partial(jax.jit, static_argnums=(0,))
 def _step(cfg: SimConfig, st: SimState, net: NetModel, key, inp: RoundInput):
     return sim_step(cfg, st, net, key, inp)
@@ -137,3 +152,45 @@ def sharded_run(cfg: SimConfig, mesh: Mesh, st, net, key, inputs):
     whole simulation compiles to one XLA program spanning the mesh."""
     del mesh  # sharding travels on the arguments
     return _run(cfg, st, net, key, inputs)
+
+
+# --- flagship (scale) path -------------------------------------------------
+#
+# ``ScaleSimState`` / ``ScaleRoundInput`` / ``NetModel`` are all
+# struct-of-arrays with a leading node axis, so the same ``shard_state``
+# placement covers them; these are the scan entry points for the
+# 100k-capable simulator with the carry DONATED — at 100k nodes the scan
+# carry is the HBM working set, and an un-donated dispatch would hold
+# two copies of it across every call boundary (bench rep, soak segment).
+
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))
+def _scale_run(cfg, st, net, key, inputs):
+    from corrosion_tpu.sim.scale_step import scale_run_rounds
+
+    return scale_run_rounds(cfg, st, net, key, inputs)
+
+
+def sharded_scale_run(cfg, mesh, st, net, key, inputs):
+    """Flagship scan (``scale_run_rounds``) with node-sharded, DONATED
+    state: the carry-out reuses the carry-in's buffers, so stepping the
+    returned state in a loop never holds two device copies. The caller's
+    ``st`` is consumed — keep a host copy if it must survive."""
+    del mesh  # sharding travels on the arguments
+    return _scale_run(cfg, st, net, key, inputs)
+
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(1, 2))
+def _scale_run_carry(cfg, st, key, net, inputs):
+    from corrosion_tpu.sim.scale_step import scale_run_rounds_carry
+
+    return scale_run_rounds_carry(cfg, st, net, key, inputs)
+
+
+def sharded_scale_run_carry(cfg, mesh, st, net, key, inputs):
+    """Segment entry point (``scale_run_rounds_carry``) with the FULL
+    scan carry (state + PRNG key) donated — chaining the returned
+    ``(state, key)`` back in reproduces the straight scan bit for bit
+    with zero duplicate carry allocations at segment boundaries."""
+    del mesh  # sharding travels on the arguments
+    return _scale_run_carry(cfg, st, key, net, inputs)

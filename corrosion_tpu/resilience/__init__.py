@@ -10,13 +10,22 @@ preemption lost the whole run. This package closes that gap:
 - :mod:`segments` — split an R-round scan into K-round segments,
   threading the full scan carry (state + PRNG key) so the segmented run
   is bitwise identical to the straight-through one, with a
-  crash-consistent checkpoint after every segment;
+  crash-consistent checkpoint after every segment; internal segment
+  carries are buffer-donated so boundaries never hold two device copies
+  of the state;
+- :mod:`async_ckpt` — the double-buffered background checkpoint writer:
+  the hot loop pays only the device→host drain, hashing/serialization/
+  IO overlap the next segment's scan;
 - :mod:`retention` — keep-last-K pruning plus an atomic ``LATEST``
   pointer naming the newest committed checkpoint;
 - :mod:`supervisor` — deadline-and-retry watchdog around device
   dispatch, built on :class:`corrosion_tpu.utils.backoff.Backoff`.
 """
 
+from corrosion_tpu.resilience.async_ckpt import (  # noqa: F401
+    AsyncCheckpointWriter,
+    write_segment_checkpoint,
+)
 from corrosion_tpu.resilience.retention import (  # noqa: F401
     latest_valid_checkpoint,
     prune_checkpoints,

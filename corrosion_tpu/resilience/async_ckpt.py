@@ -1,0 +1,168 @@
+"""Asynchronous, double-buffered segment checkpointing.
+
+PR-3's segmented soak runner stalled the device at every segment
+boundary: a synchronous ``device_get`` of the whole state, then SHA-256
+hashing and a compressed ``.npz`` write — all on the hot loop, all
+scaling with state size. Training stacks solve this with an async
+checkpointer (snapshot to host, hand off to a background writer, keep
+stepping); this module is that shape for the soak runner.
+
+Split of work per segment boundary:
+
+- **hot loop (synchronous)** — enqueue ``copy_to_host_async`` on every
+  leaf, then materialize owned numpy copies. This is the only stall and
+  it is bounded by the D2H transfer, NOT by hashing/compression/IO. The
+  copies must be owned (``np.array``, not ``np.asarray`` views): the
+  next segment's dispatch donates the device buffers, and a numpy view
+  of a donated buffer would both block the donation and read freed
+  memory.
+- **worker thread (overlapped)** — serialize + SHA-256 + manifest write
+  + ``LATEST`` pointer + retention pruning, via the exact same
+  crash-consistent path as the synchronous writer
+  (:func:`write_segment_checkpoint`), while the next segment's
+  ``lax.scan`` runs.
+
+Invariants preserved bit for bit from PR-1/PR-3: manifest-last commit
+ordering, SHA-256 leaf hashes, ``LATEST`` moves only after the directory
+is committed, pruning never deletes the pointer target. The only
+semantic change is the loss window: a crash can now also lose the ONE
+checkpoint still in flight on the worker (the queue is depth-1), i.e. at
+most one extra segment of work.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from typing import Callable, NamedTuple, Optional
+
+from corrosion_tpu.checkpoint import save_checkpoint
+from corrosion_tpu.resilience.retention import (
+    prune_checkpoints,
+    update_latest,
+)
+from corrosion_tpu.utils.tracing import logger
+
+
+class _SegmentView:
+    """The minimal agent-shaped surface ``save_checkpoint`` needs — the
+    soak runner has no live Agent, just the scan carry."""
+
+    def __init__(self, mode: str, cfg, state, round_no: int):
+        self.mode = mode
+        self.cfg = cfg
+        self.round_no = round_no
+        self._state = state
+
+    def device_state(self):
+        return self._state
+
+
+def write_segment_checkpoint(cfg, mode: str, state, key_json: dict,
+                             completed: int, root: str, keep_last: int,
+                             db=None) -> str:
+    """Commit one segment checkpoint (crash-consistent ordering).
+
+    ``state`` may be a device pytree or host numpy copies — the save
+    path ``np.asarray``'s either. ``key_json`` is the serialized carried
+    PRNG key (``segments._key_to_json``)."""
+    name = f"seg-{completed:08d}"
+    view = _SegmentView(mode, cfg, state, completed)
+    path = save_checkpoint(
+        view, db=db, path=os.path.join(root, name),
+        extra={"soak": {
+            "completed_rounds": completed,
+            "key": key_json,
+        }},
+    )
+    # pointer moves only AFTER the directory is fully committed; pruning
+    # runs last so the recovery point is never the one being deleted
+    update_latest(root, name)
+    prune_checkpoints(root, keep_last)
+    logger.info("soak checkpoint at round %d -> %s", completed, path)
+    return path
+
+
+class _Job(NamedTuple):
+    state: object  # host numpy pytree (owned copies)
+    key_json: dict
+    completed: int
+    seg_index: int  # the submitting segment's ordinal in this run
+
+
+class AsyncCheckpointWriter:
+    """Single background writer with a depth-1 queue (double buffering).
+
+    At most one snapshot is in flight: submitting while the previous
+    write is still running blocks until it commits, bounding host memory
+    at two snapshots (the one being written + the one being staged) and
+    keeping ``LATEST`` updates ordered. A write failure is re-raised on
+    the next :meth:`submit` or on :meth:`close` — the soak must not keep
+    running believing checkpoints are landing."""
+
+    def __init__(self, cfg, mode: str, root: str, keep_last: int = 3,
+                 db=None, progress: Optional[Callable[[], int]] = None):
+        self._cfg, self._mode = cfg, mode
+        self._root, self._keep_last, self._db = root, keep_last, db
+        # reports the runner's current segment ordinal; a write that
+        # finishes after the runner moved past its segment genuinely
+        # overlapped compute
+        self._progress = progress or (lambda: 0)
+        self._q: "queue.Queue[Optional[_Job]]" = queue.Queue(maxsize=1)
+        self._error: Optional[BaseException] = None
+        self.last_path: Optional[str] = None
+        self.io_seconds = 0.0
+        self.written = 0
+        self.overlapped = 0
+        self._thread = threading.Thread(
+            target=self._run, name="async-ckpt-writer", daemon=True
+        )
+        self._thread.start()
+
+    def _raise_pending(self) -> None:
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise RuntimeError(
+                "async checkpoint write failed; the previous segment has "
+                "no committed recovery point"
+            ) from err
+
+    def submit(self, state, key_json: dict, completed: int,
+               seg_index: int) -> None:
+        """Queue one snapshot for writing. Blocks while the previous
+        write is still in flight (double-buffer backpressure)."""
+        self._raise_pending()
+        self._q.put(_Job(state, key_json, completed, seg_index))
+
+    def close(self) -> Optional[str]:
+        """Drain outstanding writes, stop the worker, and return the
+        newest committed checkpoint path. Re-raises a pending write
+        failure."""
+        self._q.put(None)
+        self._thread.join()
+        self._raise_pending()
+        return self.last_path
+
+    def _run(self) -> None:
+        while True:
+            job = self._q.get()
+            if job is None:
+                return
+            try:
+                t0 = time.perf_counter()
+                self.last_path = write_segment_checkpoint(
+                    self._cfg, self._mode, job.state, job.key_json,
+                    job.completed, self._root, self._keep_last, self._db,
+                )
+                self.io_seconds += time.perf_counter() - t0
+                self.written += 1
+                if self._progress() > job.seg_index:
+                    self.overlapped += 1
+            except BaseException as exc:  # noqa: BLE001 — surfaced on submit/close
+                logger.exception(
+                    "async checkpoint write for round %d failed",
+                    job.completed,
+                )
+                self._error = exc

@@ -22,7 +22,7 @@ with the last committed checkpoint as the recovery point.
 from __future__ import annotations
 
 import dataclasses
-import os
+import time
 from typing import NamedTuple, Optional
 
 import jax
@@ -30,12 +30,12 @@ import jax.numpy as jnp
 import jax.random as jr
 import numpy as np
 
-from corrosion_tpu.checkpoint import load_checkpoint, save_checkpoint
-from corrosion_tpu.resilience.retention import (
-    latest_valid_checkpoint,
-    prune_checkpoints,
-    update_latest,
+from corrosion_tpu.checkpoint import load_checkpoint
+from corrosion_tpu.resilience.async_ckpt import (
+    AsyncCheckpointWriter,
+    write_segment_checkpoint,
 )
+from corrosion_tpu.resilience.retention import latest_valid_checkpoint
 from corrosion_tpu.resilience.supervisor import SupervisorAborted
 from corrosion_tpu.utils.tracing import logger
 
@@ -47,20 +47,7 @@ class SoakResult(NamedTuple):
     completed_rounds: int  # absolute index into the run's input stack
     aborted: bool  # True when the supervisor exhausted its retries
     checkpoint: Optional[str]  # newest committed checkpoint path
-
-
-class _SegmentView:
-    """The minimal agent-shaped surface ``save_checkpoint`` needs — the
-    soak runner has no live Agent, just the scan carry."""
-
-    def __init__(self, mode: str, cfg, state, round_no: int):
-        self.mode = mode
-        self.cfg = cfg
-        self.round_no = round_no
-        self._state = state
-
-    def device_state(self):
-        return self._state
+    stats: dict = {}  # pipeline facts: donation, checkpoint stall/IO/overlap
 
 
 def _infer_mode(cfg) -> str:
@@ -102,6 +89,44 @@ def _n_rounds(inputs) -> int:
     return int(jax.tree.leaves(inputs)[0].shape[0])
 
 
+def _pipeline_stats(donate: bool, async_checkpoint: bool) -> dict:
+    """A zeroed stats record (the keys every SoakResult.stats carries)."""
+    return {
+        "donate": donate,
+        "async_checkpoint": async_checkpoint,
+        "segments": 0,
+        "donated_segments": 0,
+        "carry_reuploads": 0,
+        "ckpt_stall_s": 0.0,
+        "ckpt_io_s": 0.0,
+        "ckpt_written": 0,
+        "ckpt_overlapped_segments": 0,
+    }
+
+
+def _host_copy(tree):
+    """Owned host copies of a pytree's leaves.
+
+    The D2H transfers are enqueued asynchronously for every leaf first
+    (on TPU they DMA in parallel while the host walks the tree), then
+    materialized as OWNED numpy arrays — ``np.array``, never
+    ``np.asarray``: on the CPU backend ``asarray`` returns a view of the
+    device buffer, which would silently block the next segment's buffer
+    donation AND read freed memory once the donated buffer is reused."""
+    for leaf in jax.tree.leaves(tree):
+        copy_async = getattr(leaf, "copy_to_host_async", None)
+        if copy_async is not None:
+            copy_async()
+    return jax.tree.map(lambda a: np.array(a), tree)
+
+
+def _carry_deleted(st) -> bool:
+    """True when any leaf buffer was consumed by a donated dispatch."""
+    from corrosion_tpu.parallel.mesh import buffers_donated
+
+    return buffers_donated(st)
+
+
 def _slice_inputs(inputs, lo: int, hi: int):
     return jax.tree.map(lambda a: a[lo:hi], inputs)
 
@@ -129,6 +154,8 @@ def run_segmented(
     db=None,
     supervisor=None,
     start_round: int = 0,
+    donate: bool = True,
+    async_checkpoint: bool = True,
 ) -> SoakResult:
     """Run ``inputs`` (stacked per-round, leading axis = rounds) in
     K-round segments, checkpointing after each.
@@ -140,20 +167,42 @@ def run_segmented(
 
     With a ``supervisor``, each segment's dispatch rides its deadline +
     retry policy; on exhaustion the run stops gracefully
-    (``aborted=True``) with the last committed checkpoint intact."""
+    (``aborted=True``) with the last committed checkpoint intact.
+
+    **Donation** (``donate=True``): segments after the first dispatch
+    through a carry-donating jit, so a segment boundary never holds two
+    device copies of the (possibly HBM-filling) state — the scan reuses
+    the carry-in buffers for the carry-out. The CALLER's ``st``/``key``
+    are never donated (the first segment runs un-donated), so they stay
+    valid after the call. Supervised retries of a donated dispatch
+    re-upload the carry from the host snapshot the checkpointer keeps;
+    with no ``checkpoint_root`` there is no snapshot to retry from, so a
+    supervised run without checkpoints keeps donation off.
+
+    **Async checkpointing** (``async_checkpoint=True``, needs
+    ``checkpoint_root``): the hot loop only materializes host copies of
+    the carry (bounded by the D2H transfer); serialization, SHA-256
+    hashing, manifest commit, ``LATEST`` and pruning all run on a
+    background writer overlapped with the next segment's scan. Commit
+    ordering and integrity invariants are unchanged; the crash-loss
+    window grows by at most the one in-flight checkpoint. ``stats`` on
+    the result records what the pipeline actually did (donated segment
+    count, checkpoint stall vs overlapped IO seconds, retry re-uploads).
+    """
     assert segment_rounds > 0, "segment_rounds must be positive"
     mode = mode or _infer_mode(cfg)
     run_carry = _run_carry_fn(cfg, mode)
     rounds = _n_rounds(inputs)
-    # one jitted program per distinct segment length (at most two: K and
-    # the final partial segment)
+    # one jitted program per distinct (segment length, donation) pair —
+    # at most K and the final partial segment, donated and not
     jitted: dict = {}
 
-    def dispatch(st, key, seg_inputs):
-        n = _n_rounds(seg_inputs)
+    def dispatch(st, key, seg_inputs, donate_now: bool):
+        n = (_n_rounds(seg_inputs), donate_now)
         if n not in jitted:
             jitted[n] = jax.jit(
-                lambda s, k, i: run_carry(cfg, s, net, k, i)
+                lambda s, k, i: run_carry(cfg, s, net, k, i),
+                donate_argnums=((0, 1) if donate_now else ()),
             )
         (st2, key2), infos = jitted[n](st, key, seg_inputs)
         # completion inside the supervised call: a wedged device shows
@@ -161,36 +210,112 @@ def run_segmented(
         jax.block_until_ready(st2)
         return (st2, key2), infos
 
+    seg_box = {"index": 0}  # read by the async writer's overlap probe
+    writer = None
+    if checkpoint_root and async_checkpoint:
+        writer = AsyncCheckpointWriter(
+            cfg, mode, checkpoint_root, keep_last, db,
+            progress=lambda: seg_box["index"],
+        )
+    stats = _pipeline_stats(donate, writer is not None)
+    host_carry = None  # (numpy state pytree, key json) at the last boundary
     info_parts: list = []
     completed = 0
     aborted = False
     last_ckpt = None
-    while completed < rounds:
-        hi = min(completed + segment_rounds, rounds)
-        seg = _slice_inputs(inputs, completed, hi)
-        try:
-            if supervisor is not None:
-                (st, key), infos = supervisor.call(
-                    dispatch, st, key, seg,
-                    label=f"segment[{start_round + completed}:"
-                          f"{start_round + hi}]",
+    try:
+        while completed < rounds:
+            hi = min(completed + segment_rounds, rounds)
+            seg = _slice_inputs(inputs, completed, hi)
+            # never donate the caller's carry; supervised donated
+            # dispatches additionally need a host snapshot to retry from
+            donate_now = (
+                donate
+                and seg_box["index"] > 0
+                and (supervisor is None or host_carry is not None)
+            )
+
+            def seg_dispatch():
+                nonlocal st, key
+                if donate_now and _carry_deleted(st):
+                    # a failed donated attempt consumed the carry — the
+                    # retry re-uploads the host snapshot of the same
+                    # boundary (bitwise-identical values; re-sharding is
+                    # the driver's concern on a genuine device loss)
+                    st = jax.tree.map(jnp.asarray, host_carry[0])
+                    key = _key_from_json(host_carry[1])
+                    stats["carry_reuploads"] += 1
+                    logger.warning(
+                        "re-uploaded donated soak carry from the host "
+                        "snapshot for retry at round %d",
+                        start_round + completed,
+                    )
+                return dispatch(st, key, seg, donate_now)
+
+            try:
+                if supervisor is not None:
+                    (st, key), infos = supervisor.call(
+                        seg_dispatch,
+                        label=f"segment[{start_round + completed}:"
+                              f"{start_round + hi}]",
+                    )
+                else:
+                    (st, key), infos = seg_dispatch()
+            except SupervisorAborted:
+                if host_carry is not None and _carry_deleted(st):
+                    # the exhausted donated attempts consumed the carry —
+                    # hand back the last boundary's values so the caller
+                    # (e.g. Agent.soak) adopts a USABLE state, not
+                    # deleted buffers
+                    st = jax.tree.map(jnp.asarray, host_carry[0])
+                    key = _key_from_json(host_carry[1])
+                logger.exception(
+                    "soak aborted at round %d; last good checkpoint: %s",
+                    start_round + completed, last_ckpt,
                 )
-            else:
-                (st, key), infos = dispatch(st, key, seg)
-        except SupervisorAborted:
-            logger.exception(
-                "soak aborted at round %d; last good checkpoint: %s",
-                start_round + completed, last_ckpt,
-            )
-            aborted = True
-            break
-        completed = hi
-        info_parts.append(infos)
-        if checkpoint_root:
-            last_ckpt = _checkpoint_segment(
-                cfg, mode, st, key, start_round + completed,
-                checkpoint_root, keep_last, db,
-            )
+                aborted = True
+                break
+            completed = hi
+            seg_box["index"] += 1
+            stats["segments"] += 1
+            if donate_now:
+                stats["donated_segments"] += 1
+            info_parts.append(infos)
+            if checkpoint_root:
+                # the only synchronous cost on the hot loop: owned host
+                # copies of the carry (plus writer backpressure when the
+                # PREVIOUS segment's checkpoint is still being written)
+                t0 = time.perf_counter()
+                host_carry = (_host_copy(st), _key_to_json(key))
+                if writer is not None:
+                    writer.submit(host_carry[0], host_carry[1],
+                                  start_round + completed,
+                                  seg_box["index"])
+                stats["ckpt_stall_s"] += time.perf_counter() - t0
+                if writer is None:
+                    t0 = time.perf_counter()
+                    last_ckpt = write_segment_checkpoint(
+                        cfg, mode, host_carry[0], host_carry[1],
+                        start_round + completed, checkpoint_root,
+                        keep_last, db,
+                    )
+                    stats["ckpt_stall_s"] += time.perf_counter() - t0
+    finally:
+        if writer is not None:
+            # drain overlapped writes; a write failure surfaces here
+            # (or earlier, on submit) rather than being silently lost
+            try:
+                last_ckpt = writer.close() or last_ckpt
+            except BaseException:
+                if aborted:  # don't mask the abort path's result
+                    logger.exception("async checkpoint drain failed")
+                else:
+                    raise
+            stats["ckpt_io_s"] = writer.io_seconds
+            stats["ckpt_written"] = writer.written
+            stats["ckpt_overlapped_segments"] = writer.overlapped
+        elif checkpoint_root:
+            stats["ckpt_written"] = stats["segments"]
     return SoakResult(
         state=st,
         key=key,
@@ -200,26 +325,8 @@ def run_segmented(
         checkpoint=(last_ckpt if last_ckpt
                     else (latest_valid_checkpoint(checkpoint_root)
                           if checkpoint_root else None)),
+        stats=stats,
     )
-
-
-def _checkpoint_segment(cfg, mode, st, key, completed: int, root: str,
-                        keep_last: int, db) -> str:
-    name = f"seg-{completed:08d}"
-    view = _SegmentView(mode, cfg, st, completed)
-    path = save_checkpoint(
-        view, db=db, path=os.path.join(root, name),
-        extra={"soak": {
-            "completed_rounds": completed,
-            "key": _key_to_json(key),
-        }},
-    )
-    # pointer moves only AFTER the directory is fully committed; pruning
-    # runs last so the recovery point is never the one being deleted
-    update_latest(root, name)
-    prune_checkpoints(root, keep_last)
-    logger.info("soak checkpoint at round %d -> %s", completed, path)
-    return path
 
 
 def resume_segmented(
@@ -233,6 +340,8 @@ def resume_segmented(
     db=None,
     supervisor=None,
     mode: Optional[str] = None,
+    donate: bool = True,
+    async_checkpoint: bool = True,
 ) -> SoakResult:
     """Resume a segmented run from the newest valid checkpoint under
     ``checkpoint_root``.
@@ -277,12 +386,16 @@ def resume_segmented(
     logger.info("resuming soak from %s at round %d/%d", path, completed,
                 rounds)
     if completed >= rounds:
-        return SoakResult(state, key, {}, completed, False, path)
+        # explicit zeroed stats: the shared class default must never be
+        # handed out (mutable) and consumers index the documented keys
+        return SoakResult(state, key, {}, completed, False, path,
+                          stats=_pipeline_stats(donate, async_checkpoint))
     return run_segmented(
         cfg, state, net, key, _slice_inputs(inputs, completed, rounds),
         segment_rounds, mode=mode, checkpoint_root=checkpoint_root,
         keep_last=keep_last, db=db, supervisor=supervisor,
-        start_round=completed,
+        start_round=completed, donate=donate,
+        async_checkpoint=async_checkpoint,
     )
 
 

@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Pre-warm the persistent XLA compile cache (.jax_cache) before a timed
+# tier-1 run or bench capture (ISSUE 4 CI/tooling satellite).
+#
+# The smoke bench compiles the exact flagship shapes the throughput
+# pipeline dispatches — the donated scale scan and the segmented soak's
+# (segment length, donation) program pair — so one run here makes every
+# subsequent timed run dispatch-only. tests/conftest.py exports the same
+# JAX_COMPILATION_CACHE_DIR to its subprocesses, so the suite and this
+# script share one cache.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-$PWD/.jax_cache}"
+
+BENCH_SMOKE=1 python bench.py > /dev/null
+# WARM_FLAGSHIP=1 additionally makes the pytest session pre-compile the
+# flagship round at the shared test shape (tests/conftest.py fixture)
+echo "warm: $JAX_COMPILATION_CACHE_DIR"

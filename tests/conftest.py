@@ -26,9 +26,16 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 # persistent compilation cache: the suite is dominated by jit compiles
-# of small-N programs that rarely change between runs
-from corrosion_tpu.utils.compile_cache import enable_compile_cache  # noqa: E402
+# of small-N programs that rarely change between runs. Exported through
+# the ENV too (not just jax.config) so subprocess tests — the smoke
+# bench, CLI invocations — land in the same .jax_cache instead of
+# recompiling cold every run.
+from corrosion_tpu.utils.compile_cache import (  # noqa: E402
+    default_cache_dir,
+    enable_compile_cache,
+)
 
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", default_cache_dir())
 enable_compile_cache()
 
 
@@ -53,3 +60,37 @@ def pytest_configure(config):
 def _clear_jax_caches_between_modules():
     yield
     jax.clear_caches()
+
+
+@pytest.fixture(autouse=True, scope="session")
+def _warm_flagship_compile():
+    """Opt-in (``WARM_FLAGSHIP=1``) pre-warm of the flagship (scale)
+    round compile before timed runs (ISSUE 4): throughput-sensitive
+    tests — the smoke bench, the async-checkpoint stall comparison —
+    should measure steady-state dispatch, not first-compile latency.
+    The compiled program lands in the persistent ``.jax_cache``; the
+    default tier-1 run skips the warm pass and relies on that cache
+    (``scripts/warm_cache.sh`` populates it ahead of timed captures)."""
+    if not os.environ.get("WARM_FLAGSHIP"):
+        yield
+        return
+    import jax.random as jr
+
+    from corrosion_tpu.sim.scale_step import (
+        ScaleRoundInput,
+        ScaleSimState,
+        scale_sim_config,
+        scale_sim_step,
+    )
+    from corrosion_tpu.sim.transport import NetModel
+
+    cfg = scale_sim_config(
+        24, m_slots=8, n_origins=4, n_rows=4, n_cols=2, sync_interval=4
+    )
+    st = ScaleSimState.create(cfg)
+    net = NetModel.create(cfg.n_nodes, drop_prob=0.02)
+    step = jax.jit(lambda s, k, i: scale_sim_step(cfg, s, net, k, i))
+    jax.block_until_ready(
+        step(st, jr.key(0), ScaleRoundInput.quiet(cfg))[0]
+    )
+    yield

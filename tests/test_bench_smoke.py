@@ -1,0 +1,57 @@
+"""The CPU-budget smoke bench (``BENCH_SMOKE=1``) as a tier test.
+
+One subprocess run of the pipeline regression check: it must exit 0
+inside its hard deadline and report the two facts the throughput
+trajectory depends on — the scale bench path dispatches with buffer
+donation active (no duplicate carry allocation), and the segmented
+soak's per-segment checkpoint stall is the host drain only, with
+serialization/hash/IO overlapped onto the background writer. A lost
+``donate_argnums`` or an accidental synchronous host transfer in the hot
+loop fails here without needing a TPU.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+BENCH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "bench.py")
+
+
+@pytest.mark.slow
+def test_bench_smoke_pipeline_facts():
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        BENCH_SMOKE="1",
+        BENCH_NODES="512",
+        BENCH_ROUNDS="3",
+        BENCH_SMOKE_SOAK_ROUNDS="8",
+        BENCH_SMOKE_DEADLINE_S="200",
+    )
+    # the smoke subprocess shares the suite's persistent compile cache
+    # (conftest exports JAX_COMPILATION_CACHE_DIR), so repeat runs are
+    # dispatch-only
+    proc = subprocess.run(
+        [sys.executable, BENCH], capture_output=True, text=True,
+        timeout=220, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    assert lines, f"no JSON line on stdout: {proc.stdout!r}"
+    rec = json.loads(lines[-1])
+
+    assert rec["ok"], rec.get("problems")
+    assert rec["donated"] is True
+    assert rec["value"] > 0
+    soak = rec["soak"]
+    assert soak["async_checkpoint"] is True
+    assert soak["donated_segments"] >= 1
+    assert soak["ckpt_written"] == soak["segments"]
+    # the overlapped drain: hot-loop stall well under the writer's IO
+    assert soak["ckpt_stall_s"] < soak["ckpt_io_s"]
+    assert rec["elapsed_s"] <= rec["deadline_s"]

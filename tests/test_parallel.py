@@ -65,3 +65,109 @@ def test_state_is_actually_sharded():
     assert st.swim.view.sharding.spec[0] == "node"
 
 
+def test_multihost_mesh_rejects_bad_host_split():
+    """A device count that does not split over the host count must raise
+    a real ValueError — a bare assert is stripped under ``python -O``
+    and the mis-shaped mesh would crash far away in device_put."""
+    from corrosion_tpu.parallel.mesh import make_multihost_mesh
+
+    devs = jax.devices()[:8]
+    with pytest.raises(ValueError, match="do not split"):
+        make_multihost_mesh(3, devs)
+    with pytest.raises(ValueError, match="do not split"):
+        make_multihost_mesh(0, devs)
+    with pytest.raises(ValueError, match="do not split"):
+        make_multihost_mesh(-2, devs)
+
+
+# --- flagship (scale) path -------------------------------------------------
+
+
+def scale_rig(rounds=6):
+    from corrosion_tpu.sim.scale_step import (
+        ScaleSimState,
+        make_write_inputs,
+        scale_sim_config,
+    )
+
+    cfg = scale_sim_config(
+        32, m_slots=8, n_origins=4, n_rows=4, n_cols=2, sync_interval=4
+    )
+    st = ScaleSimState.create(cfg)
+    net = NetModel.create(cfg.n_nodes, drop_prob=0.05)
+    mask = jr.uniform(jr.key(9), (rounds, cfg.n_nodes)) < 0.4
+    inputs = make_write_inputs(cfg, jr.key(8), rounds, mask)
+    return cfg, st, net, inputs
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+@pytest.mark.parametrize("mesh_factory", ["flat", "multihost"])
+def test_sharded_scale_flagship_matches_single_device(mesh_factory):
+    """The 100k-capable flagship scan (``scale_run_rounds``) under the
+    mesh with DONATED carry must stay a pure placement change: bitwise
+    identical state and per-round metrics vs the single-device scan, on
+    both the flat 1-D mesh and the 2-D (dcn, node) multi-host mesh."""
+    from corrosion_tpu.parallel.mesh import (
+        make_multihost_mesh,
+        sharded_scale_run,
+    )
+    from corrosion_tpu.sim.scale_step import scale_run_rounds
+
+    cfg, st, net, inputs = scale_rig()
+    key = jr.key(7)
+    ref, ref_infos = jax.jit(
+        lambda s, k, i: scale_run_rounds(cfg, s, net, k, i)
+    )(st, key, inputs)
+    jax.block_until_ready(ref)
+
+    mesh = (make_mesh(jax.devices()[:8]) if mesh_factory == "flat"
+            else make_multihost_mesh(2, jax.devices()[:8]))
+    st_s = shard_state(mesh, cfg.n_nodes, st)
+    net_s = shard_state(mesh, cfg.n_nodes, net)
+    in_s = shard_state(mesh, cfg.n_nodes, inputs)
+    probe = st_s
+    out, infos = sharded_scale_run(cfg, mesh, st_s, net_s, key, in_s)
+    jax.block_until_ready(out)
+
+    # donation: the sharded carry-in was consumed, not copied
+    assert any(leaf.is_deleted() for leaf in jax.tree.leaves(probe))
+    for a, b in zip(jax.tree.leaves(ref), jax.tree.leaves(out)):
+        assert jnp.array_equal(a, b)
+    for k in ref_infos:
+        assert jnp.array_equal(ref_infos[k], infos[k]), k
+    # carry-out keeps the node-axis placement for the next dispatch
+    assert len(out.crdt.store[0].sharding.device_set) == 8
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+def test_sharded_scale_carry_chain_matches_straight():
+    """Two donated sharded segments chained through the FULL scan carry
+    (state + PRNG key) == one straight scan — the soak runner's
+    multi-chip contract (``sharded_scale_run_carry``)."""
+    from corrosion_tpu.parallel.mesh import sharded_scale_run_carry
+    from corrosion_tpu.sim.scale_step import scale_run_rounds_carry
+
+    cfg, st, net, inputs = scale_rig(rounds=8)
+    key = jr.key(21)
+    (ref_st, ref_key), _ = jax.jit(
+        lambda s, k, i: scale_run_rounds_carry(cfg, s, net, k, i)
+    )(st, key, inputs)
+    jax.block_until_ready(ref_st)
+
+    mesh = make_mesh(jax.devices()[:8])
+    net_s = shard_state(mesh, cfg.n_nodes, net)
+    st_s = shard_state(mesh, cfg.n_nodes, st)
+    k_s = key
+    for lo, hi in ((0, 4), (4, 8)):
+        seg = shard_state(
+            mesh, cfg.n_nodes, jax.tree.map(lambda a: a[lo:hi], inputs)
+        )
+        (st_s, k_s), _ = sharded_scale_run_carry(
+            cfg, mesh, st_s, net_s, k_s, seg
+        )
+    jax.block_until_ready(st_s)
+    for a, b in zip(jax.tree.leaves(ref_st), jax.tree.leaves(st_s)):
+        assert jnp.array_equal(a, b)
+    assert jnp.array_equal(jr.key_data(ref_key), jr.key_data(k_s))
+
+
