@@ -213,7 +213,8 @@ class Agent:
 
         ``supervisor`` (a ``resilience.Supervisor``) wraps every device
         dispatch with its deadline + jittered-retry policy."""
-        assert self._thread is None, "already started"
+        if self._thread is not None:
+            raise RuntimeError("agent already started")
         if supervisor is not None:
             self._supervisor = supervisor.bind_abort(
                 lambda: self.tripwire.tripped, sleep=self.tripwire.wait
@@ -612,7 +613,11 @@ class Agent:
     def set_partition(self, groups: np.ndarray):
         """Assign partition group per node (same group = connected)."""
         groups = np.asarray(groups, np.int32)
-        assert groups.shape == (self.n_nodes,)
+        if groups.shape != (self.n_nodes,):
+            raise ValueError(
+                f"partition groups shape {groups.shape} != "
+                f"({self.n_nodes},)"
+            )
         with self._input_lock:
             self._pend_partition = groups
 
@@ -643,7 +648,10 @@ class Agent:
         """Assign geographic region per node (drives the RTT rings).
         Applied between rounds, like partitions."""
         regions = np.asarray(regions, np.int32)
-        assert regions.shape == (self.n_nodes,)
+        if regions.shape != (self.n_nodes,):
+            raise ValueError(
+                f"regions shape {regions.shape} != ({self.n_nodes},)"
+            )
         with self._input_lock:
             self._net = self._net._replace(region=jnp.asarray(regions))
 

@@ -1,0 +1,51 @@
+"""corrolint: repo-specific static analysis.
+
+The runtime layers lean on Antithesis-style always/sometimes
+instrumentation (``utils/assertions.py``) — check the invariant
+everywhere, mechanically. This package applies the same philosophy
+*before* runtime: four AST checkers over the codebase catch the bug
+classes the last PRs introduced machinery for, where a runtime test only
+catches them on the path it happens to take:
+
+- **donation-safety** (``donation.py``) — a variable read after being
+  passed in donated position to a jit is a ``DeletedBuffer`` landmine
+  (the hazard ``resilience/segments.py`` handles by re-uploading host
+  snapshots).
+- **lock-discipline** (``locks.py``) — threaded writers/supervisors
+  guarding shared state with one ``threading.Lock``: mutations outside
+  the lock, blocking IO under it.
+- **strippable-assert** (``asserts.py``) — bare ``assert`` in library
+  code vanishes under ``python -O`` (the bug class PR 4 fixed one
+  instance of in ``make_multihost_mesh``).
+- **trace-hygiene** (``trace.py``) — Python control flow on traced
+  values, ``jnp`` work at import time, unhashable static-arg defaults:
+  each one is a retrace (or a crash) per call, collapsing the PERF.md
+  story.
+
+``python -m corrosion_tpu.analysis [--format text|json] [paths]`` runs
+them all and exits nonzero on findings. Inline suppressions:
+``# corrolint: disable=<rule> -- <reason>`` (the reason is required).
+
+What AST analysis cannot see — "this refactor made the hot path retrace
+per call" — is covered by the trace-stability harness
+(``tracecount.py``): it jit-wraps the registered hot entry points with a
+compile counter and asserts exactly one compilation across
+representative re-invocations.
+"""
+
+from corrosion_tpu.analysis.base import Finding, RULES
+from corrosion_tpu.analysis.runner import (
+    ALL_CHECKERS,
+    check_source,
+    iter_python_files,
+    run_paths,
+)
+
+__all__ = [
+    "ALL_CHECKERS",
+    "Finding",
+    "RULES",
+    "check_source",
+    "iter_python_files",
+    "run_paths",
+]

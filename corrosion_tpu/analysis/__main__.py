@@ -1,0 +1,86 @@
+"""``python -m corrosion_tpu.analysis`` — run corrolint.
+
+Exit status: 0 clean, 1 findings, 2 usage error. ``--format json``
+emits a machine-readable findings array (one object per finding, the
+``Finding`` fields verbatim) for editor/CI integration.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional, Sequence
+
+from corrosion_tpu.analysis.base import RULES
+from corrosion_tpu.analysis.runner import ALL_CHECKERS, run_paths
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m corrosion_tpu.analysis",
+        description="corrolint: donation-safety, lock-discipline, "
+                    "strippable-assert, and trace-hygiene checks",
+    )
+    parser.add_argument(
+        "paths", nargs="*", default=None,
+        help="files/directories to check (default: the installed "
+             "corrosion_tpu package, wherever the CLI runs from)",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="findings output format",
+    )
+    parser.add_argument(
+        "--checkers", default=None,
+        help=f"comma-separated subset of {sorted(ALL_CHECKERS)}",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalog and exit",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule, desc in sorted(RULES.items()):
+            print(f"{rule}: {desc}")
+        return 0
+
+    checkers = (
+        [c.strip() for c in args.checkers.split(",") if c.strip()]
+        if args.checkers else None
+    )
+    paths = args.paths
+    if not paths:
+        # default to the package the CLI shipped in — a cwd-relative
+        # default would exit 2 anywhere but the checkout root
+        import corrosion_tpu
+
+        paths = [os.path.dirname(os.path.abspath(corrosion_tpu.__file__))]
+    try:
+        findings = run_paths(paths, checkers)
+    except (ValueError, OSError) as e:
+        print(str(e), file=sys.stderr)
+        return 2
+
+    if args.format == "json":
+        print(json.dumps([f.to_json() for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        if findings:
+            print(f"\n{len(findings)} finding(s). Suppress deliberate "
+                  "ones with `# corrolint: disable=<rule> -- <reason>`.")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        # downstream pager/head closed the pipe — normal unix behavior
+        try:
+            sys.stdout.close()
+        finally:
+            sys.exit(0)

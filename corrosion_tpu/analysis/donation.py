@@ -1,0 +1,214 @@
+"""donation-safety: no reads after a buffer was donated away.
+
+End-to-end carry donation (PR 4) made the flagship pipeline hold ONE
+device copy of the state — and created the repo's sharpest silent bug
+class: pass an array to a ``donate_argnums`` jit, then read the same
+variable again, and you get a ``DeletedBuffer`` error **only on the
+code path that actually reuses it** (``resilience/segments.py`` handles
+the one legitimate case by re-uploading host snapshots). This checker
+flags the lexical shape of the hazard:
+
+1. collect **donating callables** visible in the file — ``x =
+   jax.jit(f, donate_argnums=...)`` assignments, ``@partial(jax.jit,
+   donate_argnums=...)`` decorated defs — plus the repo's registered
+   cross-module donating entry points (:data:`KNOWN_DONATING`);
+2. inside each function, after a call that passes a plain variable in a
+   donated position, flag any later read of that variable **before it
+   is re-bound**.
+
+Known limits (precision over recall): tracking is lexical within one
+function body — a donating call under a loop whose next iteration
+re-reads the carry, or donation through a dict of jits
+(``segments.py``'s ``jitted[n]``), is invisible; any re-binding (even
+on one branch of an ``if``) ends tracking. The trace-stability harness
+and the donation probes in the runtime tests cover what this pass
+cannot see.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from corrosion_tpu.analysis.base import (
+    Finding,
+    dotted_name,
+    jit_call,
+    walk_shallow,
+)
+
+RULE = "donation-reuse"
+
+#: cross-module donating entry points: terminal call name -> donated
+#: positional-arg indices. These are the repo's public donating
+#: surfaces (``parallel/mesh.py``); keep in sync when adding one.
+KNOWN_DONATING: Dict[str, Tuple[int, ...]] = {
+    # sharded_scale_run(cfg, mesh, st, net, key, inputs) — st donated
+    "sharded_scale_run": (2,),
+    # sharded_scale_run_carry(cfg, mesh, st, net, key, inputs) — st+key
+    "sharded_scale_run_carry": (2, 4),
+}
+
+def _donated_indices(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """Donated positions from a ``jax.jit(...)`` call, None if it does
+    not donate (or the spec is not a literal we can read)."""
+    for kw in call.keywords:
+        if kw.arg not in ("donate_argnums", "donate_argnames"):
+            continue
+        try:
+            spec = ast.literal_eval(kw.value)
+        except (ValueError, SyntaxError):
+            return ()  # donates, but positions unknown: track nothing
+        if isinstance(spec, int):
+            return (spec,)
+        if isinstance(spec, (tuple, list)) and all(
+                isinstance(i, int) for i in spec):
+            return tuple(spec)
+        return ()
+    return None
+
+
+def _collect_donating(tree: ast.AST) -> Dict[str, Tuple[int, ...]]:
+    """File-local donating callables by name."""
+    table = dict(KNOWN_DONATING)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            call = jit_call(node.value)
+            if call is None:
+                continue
+            idx = _donated_indices(call)
+            if not idx:
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    table[tgt.id] = idx
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                call = jit_call(dec)
+                if call is None:
+                    continue
+                idx = _donated_indices(call)
+                if idx:
+                    table[node.name] = idx
+    return table
+
+
+def _stores_in(node) -> set:
+    return {
+        sub.id for sub in walk_shallow(node)
+        if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Store)
+    }
+
+
+class _FunctionScan:
+    def __init__(self, donating: Dict[str, Tuple[int, ...]], path: str,
+                 findings: List[Finding]):
+        self.donating = donating
+        self.path = path
+        self.findings = findings
+        # var -> (donating call name, call line); tracked until re-bound
+        self.tracked: Dict[str, Tuple[str, int]] = {}
+
+    def _note_call(self, call: ast.Call) -> None:
+        name = dotted_name(call.func).rsplit(".", 1)[-1]
+        idx = self.donating.get(name)
+        if not idx:
+            return
+        for i in idx:
+            if i < len(call.args) and isinstance(call.args[i], ast.Name):
+                self.tracked[call.args[i].id] = (name, call.lineno)
+
+    def scan_body(self, body: List[ast.stmt]) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # nested defs get their own scan via check()
+            if isinstance(stmt, (ast.If, ast.While, ast.For)):
+                # header expressions first; then each branch starts
+                # from the PRE-branch state — `if fast: out = step(st)
+                # else: out = other(st)` must not leak the if-branch's
+                # donation into the mutually exclusive else. After the
+                # statement the branch states merge by union: a var
+                # donated on EITHER path may be dead, so later reads
+                # still flag.
+                for header in self._headers(stmt):
+                    self._process(header)
+                pre = dict(self.tracked)
+                merged: Dict[str, Tuple[str, int]] = {}
+                for field in ("body", "orelse"):
+                    self.tracked = dict(pre)
+                    self.scan_body(getattr(stmt, field, []))
+                    merged.update(self.tracked)
+                self.tracked = merged
+                continue
+            if isinstance(stmt, (ast.Try, ast.With)):
+                # these bodies DO run in sequence (with-body after the
+                # items; handlers/finalbody after a partial try-body)
+                for header in self._headers(stmt):
+                    self._process(header)
+                for field in ("body", "orelse", "finalbody"):
+                    self.scan_body(getattr(stmt, field, []))
+                for handler in getattr(stmt, "handlers", []):
+                    self.scan_body(handler.body)
+                continue
+            self._process(stmt)
+
+    @staticmethod
+    def _headers(stmt) -> List[ast.AST]:
+        if isinstance(stmt, (ast.If, ast.While)):
+            return [stmt.test]
+        if isinstance(stmt, ast.For):
+            return [stmt.iter, stmt.target]
+        if isinstance(stmt, ast.With):
+            return [it.context_expr for it in stmt.items] + [
+                it.optional_vars for it in stmt.items
+                if it.optional_vars is not None
+            ]
+        return []
+
+    def _process(self, stmt: ast.AST) -> None:
+        """One simple statement (or header expr), in lexical order."""
+        if self.tracked:
+            for var, node in self._loads_before_store(stmt).items():
+                fn, line = self.tracked.pop(var)
+                self.findings.append(Finding(
+                    path=self.path, line=node.lineno, rule=RULE,
+                    message=f"`{var}` read after being donated to "
+                            f"{fn}() on line {line}",
+                    hint="re-bind the variable from the call's result, "
+                         "or keep a host copy (np.array) before "
+                         "donating",
+                ))
+        # record donations in this statement LAST: a var donated and
+        # re-bound in the same statement (st, _ = f(st, ...)) is the
+        # correct donation idiom
+        for sub in walk_shallow(stmt):
+            if isinstance(sub, ast.Call):
+                self._note_call(sub)
+        stores = _stores_in(stmt)
+        for var in list(self.tracked):
+            if var in stores:
+                self.tracked.pop(var)
+
+    def _loads_before_store(self, stmt) -> Dict[str, ast.Name]:
+        """Tracked vars loaded by this statement (first Name node each).
+
+        A load in the same statement that also re-binds the var (``v =
+        g(v)``) still reads the donated buffer — flagged."""
+        out: Dict[str, ast.Name] = {}
+        for sub in walk_shallow(stmt):
+            if (isinstance(sub, ast.Name)
+                    and isinstance(sub.ctx, ast.Load)
+                    and sub.id in self.tracked
+                    and sub.id not in out):
+                out[sub.id] = sub
+        return out
+
+
+def check(tree: ast.AST, source: str, path: str) -> List[Finding]:
+    donating = _collect_donating(tree)
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _FunctionScan(donating, path, findings).scan_body(node.body)
+    return findings

@@ -1,0 +1,217 @@
+"""trace-hygiene: keep jitted code jittable and retrace-free.
+
+The PERF.md numbers assume every hot entry point compiles once and
+replays; three lexically-detectable mistakes break that silently:
+
+- **tracer-branch** — Python ``if``/``while`` on a traced argument
+  inside a ``@jax.jit`` function. Best case it raises
+  ``TracerBoolConversionError`` on the first call; worst case (when the
+  value is concrete on some calls) it works in tests and retraces per
+  value in production. Shape/dtype/None checks are static and stay
+  allowed (``x.shape``, ``x.ndim``, ``x.dtype``, ``len(x)``,
+  ``x is None``, ``isinstance(x, ...)``).
+- **import-time-jnp** — ``jnp.*`` / ``jax.random.*`` calls in module
+  scope (including argument defaults) run device work at import, before
+  backends/meshes are configured — and a module first imported inside a
+  trace bakes a leaked tracer into a global.
+- **unhashable-static-default** — a ``static_argnums`` parameter whose
+  default is a list/dict/set literal: the first defaulted call dies in
+  jit's hashability check, far from the definition.
+
+Only decorator-visible jits are analyzed (``@jax.jit``,
+``@partial(jax.jit, ...)``); dynamically constructed jits are covered
+by the trace-stability harness (``tracecount.py``), which counts actual
+compilations of the registered hot entry points.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Sequence, Set, Tuple
+
+from corrosion_tpu.analysis.base import (
+    Finding,
+    dotted_name,
+    jit_call,
+    walk_shallow,
+)
+
+RULE_BRANCH = "tracer-branch"
+RULE_IMPORT = "import-time-jnp"
+RULE_STATIC_DEFAULT = "unhashable-static-default"
+
+#: attribute reads on a tracer that are static facts, safe to branch on
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "aval"}
+#: calls whose result is static even on tracer arguments
+_STATIC_CALLS = {"len", "isinstance", "getattr", "hasattr", "type",
+                 "jnp.shape", "jnp.ndim", "jnp.result_type"}
+#: module prefixes whose calls do device work at import time
+_DEVICE_PREFIXES = ("jnp.", "jax.numpy.", "jr.", "jax.random.")
+
+
+def _static_spec(call: ast.Call) -> Tuple[Set[int], Set[str]]:
+    nums: Set[int] = set()
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg not in ("static_argnums", "static_argnames"):
+            continue
+        try:
+            spec = ast.literal_eval(kw.value)
+        except (ValueError, SyntaxError):
+            continue
+        if isinstance(spec, int):
+            nums.add(spec)
+        elif isinstance(spec, str):
+            names.add(spec)
+        elif isinstance(spec, (tuple, list)):
+            for item in spec:
+                (nums if isinstance(item, int) else names).add(
+                    item if isinstance(item, int) else str(item))
+    return nums, names
+
+
+def _traced_params(fn, jit_call: ast.Call) -> Set[str]:
+    nums, names = _static_spec(jit_call)
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    traced = {
+        p for i, p in enumerate(params)
+        if i not in nums and p not in names and p != "self"
+    }
+    # keyword-only args are traced too (static_argnums cannot reach
+    # them — only static_argnames can)
+    traced.update(
+        a.arg for a in fn.args.kwonlyargs if a.arg not in names
+    )
+    return traced
+
+
+class _TestScan(ast.NodeVisitor):
+    """Find hazardous loads of traced params in a test expression: a
+    bare Name that is not consumed by a static attribute/call."""
+
+    def __init__(self, traced: Set[str]):
+        self.traced = traced
+        self.hits: List[ast.Name] = []
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if node.attr in _STATIC_ATTRS:
+            return  # x.shape and friends are static facts
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if dotted_name(node.func) in _STATIC_CALLS:
+            return
+        self.generic_visit(node)
+
+    def visit_Compare(self, node: ast.Compare) -> None:
+        # `x is None` / `x is not None` — identity on a tracer is a
+        # static fact (the optional-argument idiom)
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            consts = [node.left] + list(node.comparators)
+            if any(isinstance(c, ast.Constant) and c.value is None
+                   for c in consts):
+                return
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if isinstance(node.ctx, ast.Load) and node.id in self.traced:
+            self.hits.append(node)
+
+
+def _check_jitted_fn(fn, jit_call: ast.Call, path: str,
+                     findings: List[Finding]) -> None:
+    traced = _traced_params(fn, jit_call)
+    nums, names = _static_spec(jit_call)
+    # unhashable defaults on static params
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    defaults = fn.args.defaults
+    offset = len(params) - len(defaults)
+    for i, default in enumerate(defaults):
+        pos = offset + i
+        if pos >= len(params):
+            continue
+        is_static = pos in nums or params[pos] in names
+        if is_static and isinstance(default, (ast.List, ast.Dict, ast.Set)):
+            findings.append(Finding(
+                path=path, line=default.lineno, rule=RULE_STATIC_DEFAULT,
+                message=f"static arg `{params[pos]}` of jitted "
+                        f"`{fn.name}` defaults to an unhashable "
+                        f"{type(default).__name__.lower()} literal",
+                hint="use a tuple/frozenset or None-and-normalize",
+            ))
+    # keyword-only statics (reachable via static_argnames only)
+    for arg, default in zip(fn.args.kwonlyargs, fn.args.kw_defaults):
+        if default is None or arg.arg not in names:
+            continue
+        if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+            findings.append(Finding(
+                path=path, line=default.lineno, rule=RULE_STATIC_DEFAULT,
+                message=f"static arg `{arg.arg}` of jitted `{fn.name}` "
+                        f"defaults to an unhashable "
+                        f"{type(default).__name__.lower()} literal",
+                hint="use a tuple/frozenset or None-and-normalize",
+            ))
+    # Python control flow on traced values (nested defs — scan bodies —
+    # are traced too, so the walk descends into them)
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.If, ast.While)):
+            scan = _TestScan(traced)
+            scan.visit(node.test)
+            for hit in scan.hits:
+                findings.append(Finding(
+                    path=path, line=node.lineno, rule=RULE_BRANCH,
+                    message=f"Python {type(node).__name__.lower()} on "
+                            f"traced arg `{hit.id}` inside jitted "
+                            f"`{fn.name}`",
+                    hint="use jnp.where / lax.cond / lax.while_loop, or "
+                         "mark the arg static",
+                ))
+
+
+def _module_level_device_calls(tree: ast.Module, path: str,
+                               findings: List[Finding]) -> None:
+    def flag_calls(node: ast.AST) -> None:
+        for sub in walk_shallow(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            name = dotted_name(sub.func)
+            if name.startswith(_DEVICE_PREFIXES):
+                findings.append(Finding(
+                    path=path, line=sub.lineno, rule=RULE_IMPORT,
+                    message=f"`{name}(...)` runs at module import time",
+                    hint="build inside a function (or use a numpy "
+                         "constant; np scalars don't touch the device)",
+                ))
+
+    def scan_scope(body) -> None:
+        # statements that RUN at import: module body and class bodies,
+        # but never function bodies
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(stmt, ast.ClassDef):
+                scan_scope(stmt.body)
+                continue
+            flag_calls(stmt)
+
+    scan_scope(tree.body)
+    # argument defaults evaluate at import time too, wherever the def is
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for default in list(node.args.defaults) + [
+                    d for d in node.args.kw_defaults if d is not None]:
+                flag_calls(default)
+
+
+def check(tree: ast.AST, source: str, path: str) -> List[Finding]:
+    findings: List[Finding] = []
+    _module_level_device_calls(tree, path, findings)
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for dec in node.decorator_list:
+            call = jit_call(dec)
+            if call is not None:
+                _check_jitted_fn(node, call, path, findings)
+                break
+    return findings

@@ -1,0 +1,210 @@
+"""Trace-stability harness: "we never retrace" as an enforced contract.
+
+AST analysis (``trace.py``) catches the lexical retrace hazards; this
+harness catches the semantic ones — weak-type drift, a config object
+that stopped being hashable, an input builder that changed a dtype, a
+refactor that threads a Python scalar where an array used to flow.
+Each registered hot entry point is jit-wrapped with a **compile
+counter** (the wrapped Python body runs once per trace, so the counter
+IS the trace count) and invoked several representative ways:
+
+- fresh PRNG keys (same aval, different value);
+- inputs rebuilt from scratch (same shapes/dtypes);
+- the carry round-tripped through host numpy and re-uploaded — the
+  exact shape of a checkpoint resume, where weak-type or dtype drift
+  would silently retrace;
+- for the donated probes, the returned carry chained back in (the soak
+  segment pattern).
+
+``assert_trace_stable`` raises if any entry point compiled more than
+once — turning the PERF.md claim into a tier-1 test
+(``tests/test_analysis.py``). Entry points registered here are the ones
+whose throughput the bench records: the full-sim round step, the scale
+round step, the segment dispatch (``scale_run_rounds_carry``), and the
+node-sharded flagship run.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+import numpy as np
+
+
+def counting_jit(fn: Callable, **jit_kwargs):
+    """``jax.jit(fn)`` plus a trace counter: the wrapped Python body
+    executes exactly once per trace, never on cache hits."""
+    counter = {"traces": 0}
+
+    def traced(*args, **kwargs):
+        counter["traces"] += 1
+        return fn(*args, **kwargs)
+
+    return jax.jit(traced, **jit_kwargs), (lambda: counter["traces"])
+
+
+def _host_roundtrip(tree):
+    """Checkpoint-resume shape: drain to owned numpy, re-upload."""
+    host = jax.tree.map(lambda a: np.array(a), tree)
+    return jax.tree.map(jnp.asarray, host)
+
+
+# tiny CPU-sized configs, matching shapes tier-1 already compiles
+# (tests/test_resilience.py) so the persistent cache is shared
+def _full_cfg():
+    from corrosion_tpu.sim.config import SimConfig
+
+    return SimConfig(n_nodes=12, n_origins=4, n_rows=4, n_cols=2,
+                     tx_max_cells=2)
+
+
+def _scale_cfg():
+    from corrosion_tpu.sim.scale_step import scale_sim_config
+
+    return scale_sim_config(
+        24, m_slots=8, n_origins=4, n_rows=4, n_cols=2, sync_interval=4
+    )
+
+
+def _probe_full_step(repeats: int) -> int:
+    from corrosion_tpu.sim.step import RoundInput, SimState, sim_step
+    from corrosion_tpu.sim.transport import NetModel
+
+    cfg = _full_cfg()
+    net = NetModel.create(cfg.n_nodes)
+    fn, traces = counting_jit(sim_step, static_argnums=(0,))
+    st = SimState.create(cfg)
+    for i in range(repeats):
+        inp = RoundInput.quiet(cfg)  # rebuilt fresh: same avals
+        st, _info = fn(cfg, st, net, jr.key(i), inp)
+        if i == 0:
+            st = _host_roundtrip(st)  # the resume path must not retrace
+    jax.block_until_ready(st)
+    return traces()
+
+
+def _probe_scale_step(repeats: int) -> int:
+    from corrosion_tpu.sim.scale_step import (
+        ScaleRoundInput,
+        ScaleSimState,
+        scale_sim_step,
+    )
+    from corrosion_tpu.sim.transport import NetModel
+
+    cfg = _scale_cfg()
+    net = NetModel.create(cfg.n_nodes)
+    fn, traces = counting_jit(scale_sim_step, static_argnums=(0,))
+    st = ScaleSimState.create(cfg)
+    for i in range(repeats):
+        inp = ScaleRoundInput.quiet(cfg)
+        st, _info = fn(cfg, st, net, jr.key(i), inp)
+        if i == 0:
+            st = _host_roundtrip(st)
+    jax.block_until_ready(st)
+    return traces()
+
+
+def _probe_segment_dispatch(repeats: int, rounds_per_segment: int = 2) -> int:
+    """The soak runner's dispatch: ``scale_run_rounds_carry`` with the
+    FULL carry chained across segments (one jitted program per segment
+    length — re-dispatching the same length must not recompile)."""
+    from corrosion_tpu.resilience.segments import make_soak_inputs
+    from corrosion_tpu.sim.scale_step import (
+        ScaleSimState,
+        scale_run_rounds_carry,
+    )
+    from corrosion_tpu.sim.transport import NetModel
+
+    cfg = _scale_cfg()
+    net = NetModel.create(cfg.n_nodes)
+    fn, traces = counting_jit(
+        lambda s, k, i: scale_run_rounds_carry(cfg, s, net, k, i)
+    )
+    st, key = ScaleSimState.create(cfg), jr.key(0)
+    for i in range(repeats):
+        seg = make_soak_inputs(cfg, jr.key(i), rounds_per_segment,
+                               write_frac=0.25)
+        (st, key), _infos = fn(st, key, seg)
+        if i == 0:
+            st = _host_roundtrip(st)  # supervised-retry re-upload shape
+    jax.block_until_ready(st)
+    return traces()
+
+
+def _probe_sharded_scale_run(repeats: int, rounds: int = 2) -> int:
+    """The flagship path: the REAL ``parallel/mesh.sharded_scale_run``
+    (module-level donated jit) with node-sharded state and the carry
+    chained back in — exactly how ``bench.py`` steps it.
+
+    The entry point's jit already exists, so a fresh compile counter
+    cannot wrap it; instead the probe reads the jit's own cache size.
+    Warmup is TWO calls — the first on freshly-placed state, the second
+    chaining the jit's own output (on current jax the output arrays key
+    one extra cache entry the first time they re-enter, with identical
+    avals/shardings/weak types; bench.py's warmup absorbs the same
+    entry). The enforced contract is the steady state the bench's timed
+    loop runs in: every chained re-invocation after that adds ZERO
+    compilations. Reported as ``1 + extra`` so stable == 1."""
+    from corrosion_tpu.parallel import mesh as pmesh
+    from corrosion_tpu.resilience.segments import make_soak_inputs
+    from corrosion_tpu.sim.scale_step import ScaleSimState
+    from corrosion_tpu.sim.transport import NetModel
+
+    cfg = _scale_cfg()
+    mesh = pmesh.make_mesh()
+    net = pmesh.shard_state(mesh, cfg.n_nodes, NetModel.create(cfg.n_nodes))
+    st = pmesh.shard_state(mesh, cfg.n_nodes, ScaleSimState.create(cfg))
+    for i in range(2):  # fresh-placed, then first output-chained call
+        inputs = pmesh.shard_state(mesh, cfg.n_nodes, make_soak_inputs(
+            cfg, jr.key(i), rounds, write_frac=0.25))
+        st, _ = pmesh.sharded_scale_run(cfg, mesh, st, net,
+                                        jr.key(i), inputs)
+    jax.block_until_ready(st)
+    base = pmesh._scale_run._cache_size()
+    for i in range(2, 2 + repeats):
+        inputs = pmesh.shard_state(mesh, cfg.n_nodes, make_soak_inputs(
+            cfg, jr.key(i), rounds, write_frac=0.25))
+        st, _infos = pmesh.sharded_scale_run(cfg, mesh, st, net,
+                                             jr.key(i), inputs)
+    jax.block_until_ready(st)
+    return 1 + (pmesh._scale_run._cache_size() - base)
+
+
+#: name -> probe(repeats) -> observed trace count
+HOT_ENTRY_POINTS: Dict[str, Callable[[int], int]] = {
+    "full_sim_step": _probe_full_step,
+    "scale_sim_step": _probe_scale_step,
+    "segment_dispatch": _probe_segment_dispatch,
+    "sharded_scale_run": _probe_sharded_scale_run,
+}
+
+
+def trace_counts(names: Optional[Iterable[str]] = None,
+                 repeats: int = 3) -> Dict[str, int]:
+    """Observed compile counts per entry point over ``repeats``
+    representative invocations each."""
+    selected = list(names) if names is not None else list(HOT_ENTRY_POINTS)
+    unknown = [n for n in selected if n not in HOT_ENTRY_POINTS]
+    if unknown:
+        raise ValueError(
+            f"unknown entry points {unknown} "
+            f"(registered: {sorted(HOT_ENTRY_POINTS)})"
+        )
+    return {name: HOT_ENTRY_POINTS[name](repeats) for name in selected}
+
+
+def assert_trace_stable(names: Optional[Iterable[str]] = None,
+                        repeats: int = 3) -> Dict[str, int]:
+    """Raise unless every entry point compiled exactly once."""
+    counts = trace_counts(names, repeats)
+    unstable = {n: c for n, c in counts.items() if c != 1}
+    if unstable:
+        raise RuntimeError(
+            f"hot entry points retraced: {unstable} (expected exactly "
+            f"one compilation over {repeats} representative invocations "
+            f"each — a refactor introduced a per-call retrace)"
+        )
+    return counts

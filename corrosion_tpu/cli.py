@@ -429,6 +429,17 @@ def cmd_assertions(args) -> int:
     return 0
 
 
+def cmd_lint(args) -> int:
+    """corrolint over the given paths (same engine as
+    ``python -m corrosion_tpu.analysis`` and the tier-1 gate)."""
+    from corrosion_tpu.analysis.__main__ import main as lint_main
+
+    argv = list(args.paths or [])
+    if args.format != "text":
+        argv = ["--format", args.format] + argv
+    return lint_main(argv)
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="corrosion-tpu",
@@ -560,6 +571,14 @@ def build_parser() -> argparse.ArgumentParser:
     asr = sub.add_parser("assertions",
                          help="always/sometimes assertion report")
     asr.set_defaults(fn=cmd_assertions)
+
+    lint = sub.add_parser(
+        "lint", help="corrolint static analysis (donation-safety, "
+                     "lock-discipline, strippable-assert, trace-hygiene)")
+    lint.add_argument("paths", nargs="*", default=None,
+                      help="files/dirs (default: corrosion_tpu)")
+    lint.add_argument("--format", choices=("text", "json"), default="text")
+    lint.set_defaults(fn=cmd_lint)
 
     d = sub.add_parser("default-config", help="print an example config file")
     d.set_defaults(fn=cmd_default_config)

@@ -616,7 +616,8 @@ class Database:
         """hook(node, table, pk, {col: value}, deleted: bool) after a
         local write enters the round loop — the ``match_changes`` seam
         (``util.rs:1034-1037``)."""
-        self._write_hooks.append(hook)
+        with self._mu:
+            self._write_hooks.append(hook)
 
     # --- cell helpers ----------------------------------------------------
     def _cell(self, row: int, col: int) -> int:
@@ -689,8 +690,10 @@ class Database:
         cells = self._order_tx_cells(merged)
         if cells:
             self.agent.write_many(node, cells, wait=wait, timeout=timeout)
+        with self._mu:
+            hooks = list(self._write_hooks)
         for note in notifications:
-            for hook in self._write_hooks:
+            for hook in hooks:
                 hook(node, *note)
         return results
 
@@ -2046,8 +2049,10 @@ class StagedTx:
         if cells:
             self.db.agent.write_many(self.node, cells, wait=wait,
                                      timeout=timeout)
+        with self.db._mu:
+            hooks = list(self.db._write_hooks)
         for note in self._notes:
-            for hook in self.db._write_hooks:
+            for hook in hooks:
                 hook(self.node, *note)
         return self._results
 

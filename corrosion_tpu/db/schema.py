@@ -334,5 +334,9 @@ class RowMap:
 
         for t, pk_enc, row in sorted(state["rows"], key=lambda x: x[2]):
             got = rm.get_or_alloc(t, dec(pk_enc))
-            assert got == row, "row map restore out of order"
+            if got != row:
+                raise SchemaError(
+                    f"row map restore out of order: expected row {row}, "
+                    f"allocated {got}"
+                )
         return rm

@@ -115,7 +115,7 @@ class ValueHeap:
         # snapshot older than grace_seconds can hit a loud LookupError
         # (never silent reuse inside the window). Size grace_seconds
         # above the longest expected reader.
-        self._touch[vid] = time.monotonic()
+        self._touch[vid] = time.monotonic()  # corrolint: disable=unlocked-mutation -- deliberate GIL-atomic dict write; taking _mu here would serialize every read (see contract above)
         return v
 
     def __len__(self) -> int:

@@ -204,7 +204,10 @@ class NativeCluster:
 
     def set_partition(self, groups) -> None:
         g = np.ascontiguousarray(groups, dtype=np.int32)
-        assert g.shape == (self.n_nodes,)
+        if g.shape != (self.n_nodes,):
+            raise ValueError(
+                f"partition groups shape {g.shape} != ({self.n_nodes},)"
+            )
         self._lib.corro_cluster_set_partition(
             self._h, g.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
         )

@@ -53,7 +53,11 @@ def lex_wins(a: Sequence[jax.Array], b: Sequence[jax.Array]) -> jax.Array:
     of ``doc/crdts.md:237``. Full ties keep ``a`` (the incumbent) — a full
     tie means an identical change, so it is immaterial.
     """
-    assert len(a) == len(b) and len(a) >= 1
+    if len(a) != len(b) or len(a) < 1:
+        raise ValueError(
+            f"key tuples must have equal nonzero length, got "
+            f"{len(a)}/{len(b)}"
+        )
     # Build from the last key up: wins_k = a_k > b_k | (a_k == b_k & wins_{k+1})
     wins = a[-1] >= b[-1]
     for ak, bk in zip(reversed(a[:-1]), reversed(b[:-1])):

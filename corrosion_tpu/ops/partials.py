@@ -50,7 +50,11 @@ class Partials(NamedTuple):
 
     @staticmethod
     def create(n_nodes: int, p_slots: int, k_seqs: int) -> "Partials":
-        assert 1 <= k_seqs <= 30, "seq bitmask lives in an int32"
+        if not 1 <= k_seqs <= 30:
+            raise ValueError(
+                f"k_seqs {k_seqs} not in 1..30 (seq bitmask lives in "
+                f"an int32)"
+            )
         z2 = lambda: jnp.zeros((n_nodes, p_slots), jnp.int32)  # noqa: E731
         z3 = lambda: jnp.zeros((n_nodes, p_slots, k_seqs), jnp.int32)  # noqa: E731
         return Partials(

@@ -22,6 +22,7 @@ reference calls ``match_changes`` on every applied changeset
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import queue
@@ -270,7 +271,9 @@ class Matcher:
         return {tuple(row[:k]): tuple(row[k:]) for row in rows}
 
     def _prime(self) -> None:
-        self._state = self._current()
+        fresh = self._current()
+        with self._mu:
+            self._state = fresh
 
     # --- diffing ---------------------------------------------------------
     def poll(self, candidates: Optional[Dict[str, set]] = None) -> int:
@@ -291,15 +294,15 @@ class Matcher:
         ):
             candidates = None
         if candidates is None:
-            self._force_full = False
             fresh = self._current()
             with self._mu:
+                self._force_full = False
                 events = self._diff_upserts(fresh)
                 for key in self._state:
                     if key not in fresh:
                         events.append((DELETE, key, None))
                 self._state = fresh
-                out, subs = self._log_events(events)
+                out, subs = self._log_events_locked(events)
             return self._fanout(out, subs)
         # incremental: ONE re-query restricted to the candidate pks — a
         # disjunction of per-alias IN conds, so a delta touching both
@@ -342,7 +345,7 @@ class Matcher:
                     self._state.pop(key, None)
                 else:
                     self._state[key] = tuple(row)
-            out, subs = self._log_events(events)
+            out, subs = self._log_events_locked(events)
         return self._fanout(out, subs)
 
     def _diff_upserts(self, fresh: Dict[Any, Tuple]) -> list:
@@ -357,9 +360,10 @@ class Matcher:
                 events.append((UPSERT, key, list(row)))
         return events
 
-    def _log_events(self, events):
-        """Assign change ids + append to the log; ``self._mu`` must be
-        held (state already updated). Returns (records, subscribers)."""
+    def _log_events_locked(self, events):
+        """Assign change ids + append to the log. Named per the
+        ``*_locked`` convention: ``self._mu`` must be held (state
+        already updated). Returns (records, subscribers)."""
         out = []
         for kind, key, row in events:
             self.last_change_id += 1
@@ -474,7 +478,8 @@ class SubsManager:
         for m in matchers:
             try:
                 if m.poll(cands.get(m.node)):
-                    self._dirty.add(m.id)
+                    with self._mu:
+                        self._dirty.add(m.id)
             except Exception:  # noqa: BLE001 — a bad matcher must not stall rounds
                 logger.exception("matcher %s poll failed", m.id)
         # re-persist dirty matchers periodically (not every round — the
@@ -484,10 +489,11 @@ class SubsManager:
         # state, skips a max_log id alias gap, and attach() treats
         # from>last_change_id as backlog-lost
         if self._dirty and round_no % self.PERSIST_EVERY == 0:
-            for mid in list(self._dirty):
+            with self._mu:
+                dirty, self._dirty = self._dirty, set()
+            for mid in dirty:
                 if mid in self._matchers:
                     self._persist_q.put(mid)
-            self._dirty.clear()
 
     def _persist_worker(self) -> None:
         while True:
@@ -500,6 +506,16 @@ class SubsManager:
                     self._persist(m)
                 except Exception:  # noqa: BLE001
                     logger.exception("failed to persist subscription %s", mid)
+                # an unsubscribe() racing the write above has already
+                # unlinked the manifest — a write that lands after it
+                # would resurrect the dead subscription on restart.
+                # Re-check liveness and remove the file we just wrote.
+                with self._mu:
+                    alive = mid in self._matchers
+                if not alive and self.persist_dir:
+                    path = os.path.join(self.persist_dir, f"{mid}.json")
+                    with contextlib.suppress(FileNotFoundError):
+                        os.unlink(path)
 
     def subscribe(self, node: int, sql: str, params: Any = None
                   ) -> Tuple[Matcher, bool]:
@@ -526,11 +542,12 @@ class SubsManager:
                 return False
             self._by_query = {k: v for k, v in self._by_query.items()
                               if v != sub_id}
-            if self.persist_dir:
-                path = os.path.join(self.persist_dir, f"{sub_id}.json")
-                if os.path.exists(path):
-                    os.unlink(path)
-            return True
+        # filesystem work OUTSIDE the lock (corrolint blocking-under-lock)
+        if self.persist_dir:
+            path = os.path.join(self.persist_dir, f"{sub_id}.json")
+            if os.path.exists(path):
+                os.unlink(path)
+        return True
 
     def ids(self) -> List[str]:
         return list(self._matchers)
@@ -539,15 +556,18 @@ class SubsManager:
         """Detach from the agent's round loop and flush pending manifests
         (matchers stop polling; their state stays restorable)."""
         self.db.agent.remove_round_listener(self._on_round)
-        if self._persist_thread is not None:
+        thread = self._persist_thread
+        if thread is not None:
             self._persist_q.put(None)
-            self._persist_thread.join(timeout=30.0)
-            self._persist_thread = None
-        for mid in list(self._dirty):
+            thread.join(timeout=30.0)
+            with self._mu:
+                self._persist_thread = None
+        with self._mu:
+            dirty, self._dirty = self._dirty, set()
+        for mid in dirty:
             m = self._matchers.get(mid)
             if m is not None:
                 self._persist(m)
-        self._dirty.clear()
 
     def _persist(self, m: Matcher) -> None:
         if not self.persist_dir:
@@ -644,7 +664,10 @@ class UpdatesManager:
                     # consumed): full table snapshot + full diff
                     fresh = self._snapshot_table(table)
                     partial = None
-                    self._force_full.discard(table)
+                    # detach() also mutates _force_full from API
+                    # threads — not single-writer, so take the lock
+                    with self._mu:
+                        self._force_full.discard(table)
                 else:
                     # incremental: re-read only the candidate rows
                     # (read_row returns None for dead/absent rows)
@@ -662,7 +685,8 @@ class UpdatesManager:
                 logger.exception("updates feed poll failed for %s", table)
                 # the round's candidates are consumed (tracker baseline
                 # advanced): self-heal with a full snapshot next round
-                self._force_full.add(table)
+                with self._mu:
+                    self._force_full.add(table)
                 continue
             with self._mu:
                 old = self._state.get(table)

@@ -111,6 +111,10 @@ class AsyncCheckpointWriter:
         # overlapped compute
         self._progress = progress or (lambda: 0)
         self._q: "queue.Queue[Optional[_Job]]" = queue.Queue(maxsize=1)
+        # the error handoff crosses threads (worker sets, submitter
+        # clears): guard it — an unsynchronized check-then-clear could
+        # drop a failure between the read and the reset
+        self._mu = threading.Lock()
         self._error: Optional[BaseException] = None
         self.last_path: Optional[str] = None
         self.io_seconds = 0.0
@@ -122,8 +126,9 @@ class AsyncCheckpointWriter:
         self._thread.start()
 
     def _raise_pending(self) -> None:
-        if self._error is not None:
+        with self._mu:
             err, self._error = self._error, None
+        if err is not None:
             raise RuntimeError(
                 "async checkpoint write failed; the previous segment has "
                 "no committed recovery point"
@@ -165,4 +170,5 @@ class AsyncCheckpointWriter:
                     "async checkpoint write for round %d failed",
                     job.completed,
                 )
-                self._error = exc
+                with self._mu:
+                    self._error = exc

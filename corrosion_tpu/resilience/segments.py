@@ -189,7 +189,8 @@ def run_segmented(
     the result records what the pipeline actually did (donated segment
     count, checkpoint stall vs overlapped IO seconds, retry re-uploads).
     """
-    assert segment_rounds > 0, "segment_rounds must be positive"
+    if segment_rounds <= 0:
+        raise ValueError("segment_rounds must be positive")
     mode = mode or _infer_mode(cfg)
     run_carry = _run_carry_fn(cfg, mode)
     rounds = _n_rounds(inputs)

@@ -109,11 +109,13 @@ class Supervisor:
         """Late-bind the abort predicate and (optionally) an
         interruptible sleep — the Agent ties both to its tripwire so
         shutdown never sits out a backoff delay. Explicitly-constructed
-        hooks are kept."""
-        if self._abort is None:
-            self._abort = fn
-        if sleep is not None and self._sleep is time.sleep:
-            self._sleep = sleep
+        hooks are kept. Mutates under ``_mu``: binding can race an API
+        thread reading supervisor state (corrolint unlocked-mutation)."""
+        with self._mu:
+            if self._abort is None:
+                self._abort = fn
+            if sleep is not None and self._sleep is time.sleep:
+                self._sleep = sleep
         return self
 
     # --- the wrapper -----------------------------------------------------

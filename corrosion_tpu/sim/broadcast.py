@@ -272,7 +272,11 @@ def local_write_tx(cfg: SimConfig, cst: CrdtState, tx_mask, tx_cell, tx_val,
     /v1/transactions`` atomicity, ``public/mod.rs:177-256``).
     """
     n, k = cfg.n_nodes, tx_cell.shape[1]
-    assert k <= max(1, cfg.tx_max_cells)
+    if k > max(1, cfg.tx_max_cells):
+        raise ValueError(
+            f"tx_cell has {k} lanes > tx_max_cells "
+            f"{max(1, cfg.tx_max_cells)}"
+        )
     iarr = jnp.arange(n, dtype=jnp.int32)
     if getattr(cfg, "any_writer", False):
         w = tx_mask
@@ -502,7 +506,11 @@ def bcast_step(
     n, q, f = cfg.n_nodes, cfg.bcast_queue, cfg.bcast_fanout
     iarr = jnp.arange(n, dtype=jnp.int32)
     k_drop = key
-    assert targets.shape == (n, f)
+    if targets.shape != (n, f):
+        raise ValueError(
+            f"targets shape {targets.shape} != ({n}, {f}) "
+            f"(n_nodes, bcast_fanout)"
+        )
 
     # --- sendable slots: anything queued with budget left ---------------
     live_slot = (cst.q_origin != NO_Q) & (cst.q_tx > 0)  # [N, Q]

@@ -93,9 +93,22 @@ class SimConfig:
         return self.n_nodes
 
     def validate(self) -> "SimConfig":
-        assert self.n_origins <= self.n_nodes
-        assert self.piggyback >= 1 and self.n_indirect >= 0
-        assert 1 <= self.tx_max_cells <= 30, "seq bitmask lives in an int32"
+        # real errors, not bare asserts: ``python -O`` strips asserts
+        # and a silently-invalid config would crash far from here
+        if self.n_origins > self.n_nodes:
+            raise ValueError(
+                f"n_origins {self.n_origins} > n_nodes {self.n_nodes}"
+            )
+        if self.piggyback < 1 or self.n_indirect < 0:
+            raise ValueError(
+                f"need piggyback >= 1 and n_indirect >= 0, got "
+                f"{self.piggyback}/{self.n_indirect}"
+            )
+        if not 1 <= self.tx_max_cells <= 30:
+            raise ValueError(
+                f"tx_max_cells {self.tx_max_cells} not in 1..30 "
+                f"(seq bitmask lives in an int32)"
+            )
         return self
 
 

@@ -271,7 +271,11 @@ class OracleCluster:
         db_version, stamped seq 0..n-1 (``ChunkedChanges``,
         ``change.rs:66-178``); applied atomically to the writer's own
         store. ``cells`` = [(cell, value, clp), ...], distinct cells."""
-        assert node < self.n_origins
+        if node >= self.n_origins:
+            raise ValueError(
+                f"node {node} is not a writer (n_origins="
+                f"{self.n_origins})"
+            )
         me = self.nodes[node]
         dbv = self.next_dbv[node]
         self.next_dbv[node] += 1
@@ -400,7 +404,11 @@ def run_sim_script(script: WorkloadScript, seed: int = 0,
         sync_interval=sync_interval, tx_max_cells=tx_k,
     )
     # the configured grid must cover the script's cell space
-    assert cfg.n_cells >= script.n_cells
+    if cfg.n_cells < script.n_cells:
+        raise ValueError(
+            f"config grid has {cfg.n_cells} cells < script's "
+            f"{script.n_cells}"
+        )
     st = ScaleSimState.create(cfg)
     net = NetModel.create(script.n_nodes, drop_prob=drop_prob)
     step = jax.jit(lambda s, nt, k, i: scale_sim_step(cfg, s, nt, k, i))
@@ -423,10 +431,11 @@ def run_sim_script(script: WorkloadScript, seed: int = 0,
             # the sim's RoundInput holds ONE write per node per round; a
             # second same-node write would silently overwrite the lanes
             # and diverge from the oracle's apply-all-in-order semantics
-            assert node not in seen_nodes, (
-                f"script batch has two writes for node {node}; the sim "
-                "round carries one write per node per round"
-            )
+            if node in seen_nodes:
+                raise ValueError(
+                    f"script batch has two writes for node {node}; the "
+                    f"sim round carries one write per node per round"
+                )
             seen_nodes.add(node)
             if len(cells) == 1:
                 cell, val, clp = cells[0]

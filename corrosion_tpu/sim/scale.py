@@ -104,17 +104,30 @@ class ScaleConfig:
     narrow_dtypes: bool = False
 
     def validate(self) -> "ScaleConfig":
-        assert self.m_slots > 0 and self.n_seeds >= 1
+        # real errors, not bare asserts (stripped under ``python -O``)
+        if self.m_slots <= 0 or self.n_seeds < 1:
+            raise ValueError(
+                f"need m_slots > 0 and n_seeds >= 1, got "
+                f"{self.m_slots}/{self.n_seeds}"
+            )
         # sender-election packs a 12-bit priority above the node id in one
         # int32 (_one_sender_per_receiver); larger clusters would overflow
-        assert self.n_nodes <= 1 << 19, "max 2^19 nodes per sender-election word"
-        assert 0 <= self.pig_members <= self.m_slots, (
-            "pig_members must be 0..m_slots (top_k over the slot axis)"
-        )
-        if self.narrow_dtypes:
-            assert max(self.max_transmissions, self.suspicion_rounds,
-                       self.down_purge_rounds) < (1 << 15), (
-                "narrow_dtypes stores timers/budgets as int16"
+        if self.n_nodes > 1 << 19:
+            raise ValueError(
+                f"n_nodes {self.n_nodes} > 2^19: sender-election packs "
+                f"the node id in one int32 word"
+            )
+        if not 0 <= self.pig_members <= self.m_slots:
+            raise ValueError(
+                f"pig_members {self.pig_members} must be 0..m_slots "
+                f"({self.m_slots}) (top_k over the slot axis)"
+            )
+        if self.narrow_dtypes and max(
+                self.max_transmissions, self.suspicion_rounds,
+                self.down_purge_rounds) >= (1 << 15):
+            raise ValueError(
+                "narrow_dtypes stores timers/budgets as int16; a "
+                "timer/budget bound exceeds int16 range"
             )
         return self
 

@@ -158,22 +158,39 @@ class ScaleSimConfig:
         return self.m_slots
 
     def validate(self) -> "ScaleSimConfig":
-        assert self.n_origins <= self.n_nodes and self.m_slots > 0
-        assert 1 <= self.tx_max_cells <= 30, "seq bitmask lives in an int32"
+        # real errors, not bare asserts (stripped under ``python -O``)
+        if self.n_origins > self.n_nodes or self.m_slots <= 0:
+            raise ValueError(
+                f"need n_origins <= n_nodes and m_slots > 0, got "
+                f"{self.n_origins}/{self.n_nodes}/{self.m_slots}"
+            )
+        if not 1 <= self.tx_max_cells <= 30:
+            raise ValueError(
+                f"tx_max_cells {self.tx_max_cells} not in 1..30 "
+                f"(seq bitmask lives in an int32)"
+            )
         # shares the sender-election int32 packing (see ScaleConfig.validate)
-        assert self.n_nodes <= 1 << 19, "max 2^19 nodes per sender-election word"
-        assert 0 <= self.pig_members <= self.m_slots, (
-            "pig_members must be 0..m_slots (top_k over the slot axis)"
-        )
+        if self.n_nodes > 1 << 19:
+            raise ValueError(
+                f"n_nodes {self.n_nodes} > 2^19: sender-election packs "
+                f"the node id in one int32 word"
+            )
+        if not 0 <= self.pig_members <= self.m_slots:
+            raise ValueError(
+                f"pig_members {self.pig_members} must be 0..m_slots "
+                f"({self.m_slots}) (top_k over the slot axis)"
+            )
         if self.narrow_dtypes:
             from corrosion_tpu.sim.broadcast import LAST_SYNC_CAP
 
-            assert max(self.n_cells, self.tx_max_cells + 1,
-                       self.bcast_max_transmissions + 1,
-                       self.max_transmissions, self.suspicion_rounds,
-                       self.down_purge_rounds, LAST_SYNC_CAP) < (1 << 15), (
-                "narrow_dtypes stores these planes as int16"
-            )
+            if max(self.n_cells, self.tx_max_cells + 1,
+                   self.bcast_max_transmissions + 1,
+                   self.max_transmissions, self.suspicion_rounds,
+                   self.down_purge_rounds, LAST_SYNC_CAP) >= (1 << 15):
+                raise ValueError(
+                    "narrow_dtypes stores these planes as int16; a "
+                    "plane bound exceeds int16 range"
+                )
         return self
 
     @property
@@ -356,7 +373,11 @@ def piggyback_bcast_step(cfg: ScaleSimConfig, cst: CrdtState, channels, key,
     # an emitted (kernel-packed) payload is always 10 lanes + ok; the
     # use_fused_ingest gate forces the XLA path under the flag — keep
     # that invariant local
-    assert emitted is None or not cfg.bcast_wire_budget
+    if emitted is not None and cfg.bcast_wire_budget:
+        raise ValueError(
+            "fused-ingest (emitted) payloads carry no wire-budget lane; "
+            "bcast_wire_budget requires the XLA path"
+        )
     n_fields = 11 if cfg.bcast_wire_budget else 10
     parts, valids = [], []
     for src, valid in channels:

@@ -114,7 +114,11 @@ def sync_step(
     p_cnt = peers.shape[1]
     iarr = jnp.arange(n, dtype=jnp.int32)
     k_go, k_bi = jr.split(key)
-    assert peers.shape[0] == n and p_ok.shape == peers.shape
+    if peers.shape[0] != n or p_ok.shape != peers.shape:
+        raise ValueError(
+            f"peers {peers.shape} / p_ok {p_ok.shape} must both be "
+            f"({n}, P)"
+        )
 
     if go_all:
         syncing = alive

@@ -63,8 +63,8 @@ def launch_test_agent(schema: Optional[str] = TEST_SCHEMA,
 
     rig = Rig()
     with Agent(cluster_config(**overrides)) as agent:
-        assert agent.wait_rounds(warm_rounds, timeout=180), \
-            "test agent failed to warm up"
+        if not agent.wait_rounds(warm_rounds, timeout=180):
+            raise RuntimeError("test agent failed to warm up")
         rig.agent = agent
         rig.db = Database(agent)
         if schema:

@@ -32,8 +32,13 @@ class Backoff:
         max_retries: Optional[int] = None,
         rng: Optional[random.Random] = None,
     ):
-        assert min_wait > 0 and max_wait >= min_wait and factor >= 1.0
-        assert 0.0 <= jitter <= 1.0
+        if not (min_wait > 0 and max_wait >= min_wait and factor >= 1.0):
+            raise ValueError(
+                f"need 0 < min_wait <= max_wait and factor >= 1.0, got "
+                f"min={min_wait} max={max_wait} factor={factor}"
+            )
+        if not 0.0 <= jitter <= 1.0:
+            raise ValueError(f"jitter must be in [0, 1], got {jitter}")
         self.min_wait = min_wait
         self.max_wait = max_wait
         self.factor = factor

@@ -81,17 +81,21 @@ class OtlpFileExporter:
     def export(self, span_record: dict) -> None:
         with self._mu:
             self._buf.append(span_record)
-            if len(self._buf) >= self.flush_every:
-                self._flush_locked()
-
-    def flush(self) -> None:
-        with self._mu:
-            self._flush_locked()
+            ready = len(self._buf) >= self.flush_every
+        if ready:
+            self.flush()
 
     MAX_BUFFERED = 4096  # retained spans across failed flushes
 
-    def _flush_locked(self) -> None:
-        if not self._buf:
+    def flush(self) -> None:
+        # detach the pending batch under the lock, write it OUTSIDE —
+        # exporting threads must never stall behind a slow disk
+        # (lock-discipline: no IO under self._mu). Concurrent flushes
+        # may interleave batches in the file; span order within a batch
+        # is preserved, which is all OTLP consumers assume.
+        with self._mu:
+            pending, self._buf = self._buf, []
+        if not pending:
             return
         batch = {
             "resourceSpans": [{
@@ -101,12 +105,10 @@ class OtlpFileExporter:
                 }]},
                 "scopeSpans": [{
                     "scope": {"name": "corrosion_tpu"},
-                    "spans": self._buf,
+                    "spans": pending,
                 }],
             }]
         }
-        pending = self._buf
-        self._buf = []
         try:
             with open(self.path, "a") as f:
                 f.write(json.dumps(batch) + "\n")
@@ -114,7 +116,8 @@ class OtlpFileExporter:
             # keep the batch for the next flush attempt (bounded so a
             # permanently broken path cannot grow without limit)
             logger.exception("OTLP file export failed; retaining batch")
-            self._buf = (pending + self._buf)[-self.MAX_BUFFERED:]
+            with self._mu:
+                self._buf = (pending + self._buf)[-self.MAX_BUFFERED:]
 
 
 _exporter: Optional[OtlpFileExporter] = None

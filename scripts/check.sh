@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# The local CI gate: corrolint static analysis + tier-1 tests.
+#
+#   scripts/check.sh            # lint + tier-1
+#   scripts/check.sh --lint     # lint only (fast, no jax compile)
+#
+# The same analyzer also rides tier-1 itself
+# (tests/test_analysis.py::test_repo_is_clean), so running the pytest
+# command alone still enforces the lint gate; this script just fails
+# faster and prints findings directly.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== corrolint =="
+python -m corrosion_tpu.analysis corrosion_tpu
+echo "corrolint: clean"
+
+if [[ "${1:-}" == "--lint" ]]; then
+    exit 0
+fi
+
+echo "== tier-1 tests =="
+exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
+    -p no:randomly

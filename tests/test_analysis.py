@@ -1,0 +1,527 @@
+"""corrolint: each checker fires on seeded bad code, honors
+suppressions, and the shipped tree is clean; the trace-stability
+harness holds the one-compile-per-entry-point contract."""
+
+import textwrap
+
+import pytest
+
+from corrosion_tpu.analysis import check_source, run_paths
+from corrosion_tpu.analysis.__main__ import main as lint_main
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+def lint(src, checkers=None):
+    from corrosion_tpu.analysis import ALL_CHECKERS
+
+    selected = ({k: ALL_CHECKERS[k] for k in checkers}
+                if checkers else None)
+    return check_source(textwrap.dedent(src), "fixture.py", selected)
+
+
+# --- donation-safety ------------------------------------------------------
+
+BAD_DONATION_LOCAL = """
+    import jax
+
+    step = jax.jit(lambda s: s + 1, donate_argnums=(0,))
+
+    def run(st):
+        out = step(st)
+        total = st.sum()  # use-after-donate
+        return out, total
+"""
+
+
+def test_donation_reuse_fires_on_local_jit():
+    findings = lint(BAD_DONATION_LOCAL, ["donation-safety"])
+    assert rules_of(findings) == ["donation-reuse"]
+    assert findings[0].line == 8
+    assert "`st` read after being donated" in findings[0].message
+
+
+def test_donation_reuse_fires_on_registered_entry_point():
+    src = """
+        def drive(cfg, mesh, st, net, key, inputs):
+            out, infos = sharded_scale_run(cfg, mesh, st, net, key, inputs)
+            return st.swim, infos  # st was donated away
+    """
+    findings = lint(src, ["donation-safety"])
+    assert rules_of(findings) == ["donation-reuse"]
+    assert "sharded_scale_run" in findings[0].message
+
+
+def test_donation_rebind_is_clean():
+    src = """
+        import jax
+
+        step = jax.jit(lambda s: s + 1, donate_argnums=(0,))
+
+        def run(st):
+            st = step(st)  # canonical donation idiom: re-bind
+            return st.sum()
+    """
+    assert lint(src, ["donation-safety"]) == []
+
+
+def test_donation_decorated_def_and_carry_chain():
+    src = """
+        import functools, jax
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def seg(st, key, inputs):
+            return (st, key), 0
+
+        def soak(st, key, inputs):
+            (st, key), infos = seg(st, key, inputs)  # chained: clean
+            bad = seg(st, key, inputs)
+            return key.sum(), bad  # key donated by the second call
+    """
+    findings = lint(src, ["donation-safety"])
+    assert rules_of(findings) == ["donation-reuse"]
+    assert "`key` read after being donated to seg()" in findings[0].message
+
+
+def test_donation_exclusive_branches_do_not_leak():
+    """A donation on one if-branch must not flag a read on the
+    mutually exclusive else-branch — but a read AFTER the if/else
+    still flags (either path may have consumed the buffer)."""
+    src = """
+        import jax
+
+        step = jax.jit(lambda s: s + 1, donate_argnums=(0,))
+
+        def run(st, fast):
+            if fast:
+                out = step(st)
+            else:
+                out = st * 2  # st alive on this path: clean
+            return out
+
+        def run_then_read(st, fast):
+            if fast:
+                out = step(st)
+            else:
+                out = st * 2
+            return out, st.sum()  # st MAY be donated here: flag
+    """
+    findings = lint(src, ["donation-safety"])
+    assert rules_of(findings) == ["donation-reuse"]
+    assert "`st` read after being donated" in findings[0].message
+    assert findings[0].line == 18  # the read AFTER the merged branches
+
+
+# --- lock-discipline ------------------------------------------------------
+
+BAD_LOCK_MUTATION = """
+    import threading
+
+    class Writer:
+        def __init__(self):
+            self._mu = threading.Lock()
+            self._state = []
+
+        def push(self, item):
+            self._state.append(item)  # unlocked mutation
+
+        def set(self, item):
+            self._error = item  # unlocked mutation
+
+        def ok(self, item):
+            with self._mu:
+                self._state.append(item)
+"""
+
+
+def test_unlocked_mutation_fires():
+    findings = lint(BAD_LOCK_MUTATION, ["lock-discipline"])
+    assert rules_of(findings) == ["unlocked-mutation"] * 2
+    assert "Writer.push" in findings[0].message
+    assert "Writer.set" in findings[1].message
+
+
+def test_blocking_under_lock_fires():
+    src = """
+        import threading
+
+        class Writer:
+            def __init__(self):
+                self._mu = threading.Lock()
+
+            def flush(self, batch):
+                with self._mu:
+                    with open("/tmp/x", "w") as f:
+                        f.write(batch)
+
+            def wait(self, fut):
+                with self._mu:
+                    return fut.result()
+    """
+    findings = lint(src, ["lock-discipline"])
+    assert rules_of(findings) == ["blocking-under-lock"] * 2
+
+
+def test_locked_suffix_convention():
+    src = """
+        import threading
+
+        class Writer:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self._buf = []
+
+            def _push_locked(self, item):
+                self._buf.append(item)  # caller holds the lock: clean
+
+            def _flush_locked(self):
+                import json
+                with open("/tmp/x", "w") as f:  # IO with lock held
+                    f.write(json.dumps(self._buf))
+    """
+    findings = lint(src, ["lock-discipline"])
+    assert rules_of(findings) == ["blocking-under-lock"]
+    assert "_flush_locked" in findings[0].message
+
+
+def test_multi_lock_class_is_skipped():
+    src = """
+        import threading
+
+        class TwoLocks:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+                self._x = 0
+
+            def bump(self):
+                self._x += 1  # ownership not inferable: out of scope
+    """
+    assert lint(src, ["lock-discipline"]) == []
+
+
+def test_closure_under_with_is_not_held():
+    src = """
+        import threading
+
+        class Spawner:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self._n = 0
+
+            def start(self):
+                with self._mu:
+                    def worker():
+                        self._n += 1  # runs later, lock released
+                    return worker
+    """
+    findings = lint(src, ["lock-discipline"])
+    assert rules_of(findings) == ["unlocked-mutation"]
+
+
+def test_nested_class_lock_does_not_shield_outer():
+    """A nested class owning its own lock must not flip the outer
+    class into the multi-lock skip."""
+    src = """
+        import threading
+
+        class Outer:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self._buf = []
+
+            class Inner:
+                def __init__(self):
+                    self._lk = threading.Lock()
+
+            def push(self, v):
+                self._buf.append(v)  # unlocked: must still flag
+    """
+    findings = lint(src, ["lock-discipline"])
+    assert rules_of(findings) == ["unlocked-mutation"]
+    assert "Outer.push" in findings[0].message
+
+
+# --- strippable-assert ----------------------------------------------------
+
+
+def test_bare_assert_fires():
+    findings = lint("""
+        def f(x):
+            assert x > 0, "must be positive"
+            return x
+    """, ["strippable-assert"])
+    assert rules_of(findings) == ["bare-assert"]
+    assert "python -O" in findings[0].message
+
+
+# --- trace-hygiene --------------------------------------------------------
+
+
+def test_tracer_branch_fires():
+    src = """
+        import functools, jax
+
+        @functools.partial(jax.jit, static_argnums=(0,))
+        def step(cfg, x):
+            if x > cfg.limit:  # tracer bool conversion
+                return x
+            while x.sum() > 0:  # tracer loop
+                x = x - 1
+            return x
+    """
+    findings = lint(src, ["trace-hygiene"])
+    assert rules_of(findings) == ["tracer-branch"] * 2
+    assert all("`x`" in f.message for f in findings)
+
+
+def test_static_facts_are_allowed():
+    src = """
+        import jax
+
+        @jax.jit
+        def step(x, y=None):
+            if y is None:  # identity on None: static
+                y = x
+            if x.shape[0] > 4:  # shapes are static
+                return x + y
+            if len(x) == 2 or isinstance(x, tuple):
+                return x
+            return y
+    """
+    assert lint(src, ["trace-hygiene"]) == []
+
+
+def test_static_arg_branch_is_allowed():
+    src = """
+        import functools, jax
+
+        @functools.partial(jax.jit, static_argnames=("mode",))
+        def step(x, mode):
+            if mode == "fast":  # static arg: concrete at trace time
+                return x * 2
+            return x
+    """
+    assert lint(src, ["trace-hygiene"]) == []
+
+
+def test_import_time_jnp_fires():
+    src = """
+        import jax.numpy as jnp
+
+        LIMIT = jnp.array(3)  # device work at import
+
+        def f(x, table=jnp.zeros(4)):  # defaults evaluate at import
+            return x + table + LIMIT
+    """
+    findings = lint(src, ["trace-hygiene"])
+    assert rules_of(findings) == ["import-time-jnp"] * 2
+
+
+def test_unhashable_static_default_fires():
+    src = """
+        import functools, jax
+
+        @functools.partial(jax.jit, static_argnums=(1,))
+        def f(x, axes=[0, 1]):
+            return x.sum(axes[0])
+    """
+    findings = lint(src, ["trace-hygiene"])
+    assert rules_of(findings) == ["unhashable-static-default"]
+
+
+def test_tracer_branch_covers_keyword_only_args():
+    src = """
+        import jax
+
+        @jax.jit
+        def step(x, *, y):
+            if y > 0:  # kw-only args are traced too
+                return x
+            return -x
+    """
+    findings = lint(src, ["trace-hygiene"])
+    assert rules_of(findings) == ["tracer-branch"]
+    assert "`y`" in findings[0].message
+
+
+def test_static_argnames_covers_keyword_only():
+    src = """
+        import functools, jax
+
+        @functools.partial(jax.jit, static_argnames=("mode",))
+        def step(x, *, mode, table={}):
+            if mode:  # static kw-only: clean
+                return x
+            return -x
+    """
+    # `mode` is static (clean branch); `table` is a traced kw-only arg
+    # whose dict default is NOT a static-default finding (it is not
+    # static), but branching is not done on it either
+    assert lint(src, ["trace-hygiene"]) == []
+
+
+def test_unhashable_static_default_keyword_only():
+    src = """
+        import functools, jax
+
+        @functools.partial(jax.jit, static_argnames=("axes",))
+        def f(x, *, axes=[0, 1]):
+            return x.sum()
+    """
+    findings = lint(src, ["trace-hygiene"])
+    assert rules_of(findings) == ["unhashable-static-default"]
+
+
+# --- suppressions ---------------------------------------------------------
+
+
+def test_suppression_with_reason_is_honored():
+    findings = lint("""
+        def f(x):
+            assert x > 0  # corrolint: disable=bare-assert -- perf-critical inner loop, validated at boot
+            return x
+    """, ["strippable-assert"])
+    assert findings == []
+
+
+def test_suppression_without_reason_is_a_finding():
+    findings = lint("""
+        def f(x):
+            assert x > 0  # corrolint: disable=bare-assert
+            return x
+    """, ["strippable-assert"])
+    assert sorted(rules_of(findings)) == [
+        "bare-assert", "suppression-missing-reason",
+    ]
+
+
+def test_suppression_on_own_line_guards_next_line():
+    findings = lint("""
+        def f(x):
+            # corrolint: disable=bare-assert -- documented invariant
+            assert x > 0
+            return x
+    """, ["strippable-assert"])
+    assert findings == []
+
+
+def test_suppression_for_other_rule_does_not_mask():
+    findings = lint("""
+        def f(x):
+            assert x > 0  # corrolint: disable=tracer-branch -- wrong rule
+            return x
+    """, ["strippable-assert"])
+    assert rules_of(findings) == ["bare-assert"]
+
+
+def test_suppression_inside_string_literal_is_inert():
+    """The directive only counts in REAL comments — inside a string it
+    neither suppresses nor misfires as a reasonless suppression."""
+    findings = lint('''
+        def f(x):
+            msg = "use # corrolint: disable=bare-assert to waive"
+            assert x > 0  # the string above must not mask this
+            return msg
+    ''', ["strippable-assert"])
+    assert rules_of(findings) == ["bare-assert"]
+
+
+def test_missing_path_is_an_error_not_clean(tmp_path):
+    """A lint gate must never read 'walked nothing' as 'clean'."""
+    with pytest.raises(FileNotFoundError):
+        run_paths([str(tmp_path / "nope")])
+    (tmp_path / "empty").mkdir()
+    with pytest.raises(FileNotFoundError):
+        run_paths([str(tmp_path / "empty")])
+    assert lint_main([str(tmp_path / "nope")]) == 2
+
+
+# --- the repo gate --------------------------------------------------------
+
+
+def _package_dir():
+    import os
+
+    import corrosion_tpu
+
+    return os.path.dirname(corrosion_tpu.__file__)
+
+
+def test_repo_is_clean():
+    """The shipped tree passes its own analyzer — the tier-1 lint gate.
+
+    Every finding must be fixed or suppressed-with-reason; this is the
+    same engine the CLI runs, so CI and `python -m
+    corrosion_tpu.analysis` can never disagree."""
+    findings = run_paths([_package_dir()])
+    assert findings == [], "\n" + "\n".join(f.render() for f in findings)
+
+
+def test_cli_clean_file_exits_zero(capsys):
+    # one clean file, not the whole package — test_repo_is_clean
+    # already walks the tree; this only covers the CLI's exit-0 path
+    import os
+
+    assert lint_main([os.path.join(_package_dir(), "analysis", "base.py")]) == 0
+
+
+def test_cli_reports_findings(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(x):\n    assert x\n    return x\n")
+    assert lint_main([str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert "bare-assert" in out and "bad.py:2" in out
+
+    assert lint_main(["--format", "json", str(bad)]) == 1
+    out = capsys.readouterr().out
+    import json
+
+    payload = json.loads(out)
+    assert payload[0]["rule"] == "bare-assert"
+    assert payload[0]["line"] == 2
+
+
+def test_cli_default_works_from_any_cwd(tmp_path, monkeypatch, capsys):
+    """With no paths the CLI lints the installed package, not a
+    cwd-relative directory name."""
+    monkeypatch.chdir(tmp_path)
+    assert lint_main([]) == 0
+
+
+def test_cli_rejects_unknown_checker(capsys):
+    assert lint_main(["--checkers", "nope", "corrosion_tpu"]) == 2
+
+
+# --- trace stability ------------------------------------------------------
+
+
+def test_hot_entry_points_compile_once():
+    """One compilation per registered hot entry point across
+    representative re-invocations (fresh keys, rebuilt inputs, host
+    round-trips, donated-carry chaining) — the PERF.md no-retrace story
+    as an enforced contract."""
+    from corrosion_tpu.analysis.tracecount import assert_trace_stable
+
+    counts = assert_trace_stable(repeats=3)
+    assert set(counts) == {
+        "full_sim_step", "scale_sim_step", "segment_dispatch",
+        "sharded_scale_run",
+    }
+
+
+def test_counting_jit_counts_retraces():
+    """The counter itself must detect instability (meta-test: a probe
+    that DOES retrace reports > 1)."""
+    import jax.numpy as jnp
+
+    from corrosion_tpu.analysis.tracecount import counting_jit
+
+    fn, traces = counting_jit(lambda x: x * 2)
+    fn(jnp.zeros(3))
+    fn(jnp.zeros(3))  # cache hit
+    assert traces() == 1
+    fn(jnp.zeros(4))  # new shape: retrace
+    assert traces() == 2

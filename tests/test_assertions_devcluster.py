@@ -35,6 +35,67 @@ def test_unreachable():
     assert reg.violations() == {"unreachable: impossible state": 1}
 
 
+def test_strict_mode_tracks_env_live(monkeypatch):
+    """``strict`` reads the env per call: flipping
+    CORRO_TPU_STRICT_ASSERTS mid-run arms/disarms raising without
+    rebuilding the registry (the admin-reload story)."""
+    reg = AssertionRegistry()
+    monkeypatch.delenv("CORRO_TPU_STRICT_ASSERTS", raising=False)
+    assert not reg.strict
+    assert reg.always(False, "soft") is False  # logs + counts, no raise
+    monkeypatch.setenv("CORRO_TPU_STRICT_ASSERTS", "1")
+    assert reg.strict
+    with pytest.raises(AssertionError, match="soft"):
+        reg.always(False, "soft", "ctx")
+    with pytest.raises(AssertionError, match="unreachable: dead"):
+        reg.unreachable("dead")
+    # failures kept counting through both modes
+    assert reg.violations()["soft"] == 2
+
+
+def test_strict_mode_never_raises_on_sometimes(monkeypatch):
+    """Liveness probes are observations, not invariants: a probe that
+    has not fired YET must not kill a strict run."""
+    monkeypatch.setenv("CORRO_TPU_STRICT_ASSERTS", "1")
+    reg = AssertionRegistry()
+    assert reg.sometimes(False, "syncs") is False
+    assert reg.liveness_report()["syncs"]["never_hit"]
+
+
+def test_liveness_report_transitions_and_counts():
+    """A probe leaves ``never_hit`` the first time it observes True and
+    stays hit; checks/hits count every evaluation."""
+    reg = AssertionRegistry()
+    reg.sometimes(False, "delivers")
+    assert reg.liveness_report()["delivers"] == {
+        "checks": 1, "hits": 0, "never_hit": True,
+    }
+    reg.sometimes(True, "delivers")
+    reg.sometimes(False, "delivers")
+    rep = reg.liveness_report()["delivers"]
+    assert rep == {"checks": 3, "hits": 1, "never_hit": False}
+    # independent probes do not share counters
+    reg.sometimes(True, "other")
+    assert reg.liveness_report()["delivers"]["checks"] == 3
+
+
+def test_module_helpers_hit_global_registry():
+    from corrosion_tpu.utils.assertions import (
+        REGISTRY,
+        assert_always,
+        assert_sometimes,
+        assert_unreachable,
+    )
+
+    assert_sometimes(True, "test-probe-global")
+    rep = REGISTRY.liveness_report()["test-probe-global"]
+    assert rep["hits"] >= 1 and not rep["never_hit"]
+    assert_always(True, "test-inv-global")
+    assert "test-inv-global" not in REGISTRY.violations()
+    assert_unreachable("test-unreachable-global")
+    assert REGISTRY.violations()["unreachable: test-unreachable-global"] >= 1
+
+
 def test_parse_topology():
     names, edges, groups = parse_topology("""
         # two components
