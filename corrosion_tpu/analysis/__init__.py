@@ -22,12 +22,34 @@ catches them on the path it happens to take:
   each one is a retrace (or a crash) per call, collapsing the PERF.md
   story.
 
-``python -m corrosion_tpu.analysis [--format text|json] [paths]`` runs
-them all and exits nonzero on findings. Inline suppressions:
-``# corrolint: disable=<rule> -- <reason>`` (the reason is required).
+Since v2 a second tier of **interprocedural** checkers runs over a
+module-level call graph (``callgraph.py``) and a forward dataflow
+engine (``dataflow.py``) — cross-function properties a lexical pass
+provably cannot see:
 
-What AST analysis cannot see — "this refactor made the hot path retrace
-per call" — is covered by the trace-stability harness
+- **donation-flow** (``donation.check_project``) — transitive
+  donation: a helper that passes its parameter into a donated slot is
+  donating too, so its callers' reuse flags at the call site; plus the
+  closure blind spot (a nested def reading a donated variable).
+- **sharding-contract** (``sharding.py``) — ``shard-gather`` /
+  ``shard-spec-drift``: sharded mesh state host-materialized outside
+  the drain registry, or fresh state entering a sharded entry point
+  unplaced.
+- **dtype-flow** (``dtypes.py``) — ``dtype-widen``: jnp promotion
+  simulated through the hot sim/ops modules; silent widening of a
+  declared-narrow (int16) leaf at a carry/kernel boundary.
+- **lock-order** (``lockorder.py``) — ``lock-cycle`` /
+  ``lock-inversion``: the cross-class lock-acquisition-order graph
+  must stay acyclic.
+
+``python -m corrosion_tpu.analysis [--format text|json] [paths]`` runs
+them all and exits nonzero on findings (``--changed <git-ref>`` lints
+only touched files; ``--output-json`` writes the CI artifact). Inline
+suppressions: ``# corrolint: disable=<rule> -- <reason>`` (the reason
+is required).
+
+What static analysis cannot see — "this refactor made the hot path
+retrace per call" — is covered by the trace-stability harness
 (``tracecount.py``): it jit-wraps the registered hot entry points with a
 compile counter and asserts exactly one compilation across
 representative re-invocations.
@@ -36,16 +58,20 @@ representative re-invocations.
 from corrosion_tpu.analysis.base import Finding, RULES
 from corrosion_tpu.analysis.runner import (
     ALL_CHECKERS,
+    PROJECT_CHECKERS,
     check_source,
     iter_python_files,
+    lint_report,
     run_paths,
 )
 
 __all__ = [
     "ALL_CHECKERS",
+    "PROJECT_CHECKERS",
     "Finding",
     "RULES",
     "check_source",
     "iter_python_files",
+    "lint_report",
     "run_paths",
 ]

@@ -57,6 +57,29 @@ RULES: Dict[str, str] = {
     "suppression-missing-reason": (
         "`# corrolint: disable=...` without a `-- reason` justification"
     ),
+    # --- v2 interprocedural rules (call-graph + dataflow engine) ---
+    "shard-gather": (
+        "node-sharded state host-materialized (device_get/np.asarray/"
+        "whole-pytree drain) outside the sharding drain registry — "
+        "funnels the HBM working set through one host"
+    ),
+    "shard-spec-drift": (
+        "freshly-built state passed into a sharded entry point without "
+        "`shard_state` placement — silently drops the P(\"node\") layout"
+    ),
+    "dtype-widen": (
+        "declared-narrow (int16) state leaf receives a silently "
+        "promotion-widened value at a carry/kernel boundary — doubles "
+        "HBM traffic and retraces every consumer"
+    ),
+    "lock-cycle": (
+        "non-reentrant lock re-acquired while held, or a >2-lock "
+        "acquisition cycle across the call graph (deadlock)"
+    ),
+    "lock-inversion": (
+        "two locks acquired in opposite orders on two code paths "
+        "(ABBA deadlock across threads)"
+    ),
 }
 
 
@@ -120,15 +143,6 @@ def parse_suppressions(
             target = lineno + 1  # standalone comment guards the next line
         by_line.setdefault(target, set()).update(rules)
     return by_line, bad
-
-
-def apply_suppressions(
-    findings: List[Finding], by_line: Dict[int, set]
-) -> List[Finding]:
-    return [
-        f for f in findings
-        if f.rule not in by_line.get(f.line, ())
-    ]
 
 
 #: names that resolve to ``jax.jit`` / ``functools.partial`` in this

@@ -23,6 +23,21 @@ re-reads the carry, or donation through a dict of jits
 on one branch of an ``if``) ends tracking. The trace-stability harness
 and the donation probes in the runtime tests cover what this pass
 cannot see.
+
+The v2 **interprocedural** pass (:func:`check_project`, registered as
+``donation-flow``) removes the two blind spots the lexical pass
+documents:
+
+- **transitive donation** — a helper that passes its own parameter
+  into a donated position is itself donating at that position; the
+  summary propagates over the call graph to a fixed point, so ``out =
+  helper(st); st.sum()`` is caught at the call site even though the
+  jit lives two frames down. Only project-unique bare names carry the
+  summary (an ambiguous name grows no fact, never a wrong one).
+- **closures** — a nested ``def`` that reads an outer variable via
+  closure is invisible to the statement scan; the project pass treats
+  a call to a local closure as a read of its free variables, so
+  ``def report(): return st.sum()`` called after ``step(st)`` flags.
 """
 
 from __future__ import annotations
@@ -102,10 +117,14 @@ def _stores_in(node) -> set:
 
 class _FunctionScan:
     def __init__(self, donating: Dict[str, Tuple[int, ...]], path: str,
-                 findings: List[Finding]):
+                 findings: List[Finding],
+                 closures: Optional[Dict[str, frozenset]] = None):
         self.donating = donating
         self.path = path
         self.findings = findings
+        # nested-def name -> outer variables it reads via closure
+        # (project pass only; the lexical pass passes None)
+        self.closures = closures or {}
         # var -> (donating call name, call line); tracked until re-bound
         self.tracked: Dict[str, Tuple[str, int]] = {}
 
@@ -179,6 +198,27 @@ class _FunctionScan:
                          "or keep a host copy (np.array) before "
                          "donating",
                 ))
+        if self.tracked and self.closures:
+            # the closure blind spot: calling a local def whose body
+            # reads a donated variable IS a read of that variable
+            for sub in walk_shallow(stmt):
+                if not (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Name)
+                        and sub.func.id in self.closures):
+                    continue
+                for var in sorted(self.closures[sub.func.id]):
+                    if var not in self.tracked:
+                        continue
+                    fn, line = self.tracked.pop(var)
+                    self.findings.append(Finding(
+                        path=self.path, line=sub.lineno, rule=RULE,
+                        message=f"closure `{sub.func.id}` reads `{var}`"
+                                f" which was donated to {fn}() on "
+                                f"line {line}",
+                        hint="re-bind the variable from the donating "
+                             "call's result before invoking the "
+                             "closure",
+                    ))
         # record donations in this statement LAST: a var donated and
         # re-bound in the same statement (st, _ = f(st, ...)) is the
         # correct donation idiom
@@ -211,4 +251,156 @@ def check(tree: ast.AST, source: str, path: str) -> List[Finding]:
     for node in ast.walk(tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             _FunctionScan(donating, path, findings).scan_body(node.body)
+    return findings
+
+
+# --- interprocedural pass (donation-flow) ---------------------------------
+
+
+def _def_params(node) -> set:
+    """Every parameter name a def/lambda binds, incl. *args/**kwargs."""
+    a = node.args
+    names = {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
+    for extra in (a.vararg, a.kwarg):
+        if extra is not None:
+            names.add(extra.arg)
+    return names
+
+
+def _closure_free_reads(fn_node: ast.AST) -> Dict[str, frozenset]:
+    """name -> outer variables each nested def reads via closure.
+
+    Conservative scoping: only defs bound in THIS function's own scope
+    are mapped (a deeper def is not callable from the outer body by
+    its bare name, and keying it here could overwrite the one that
+    is); two same-scope defs sharing a name carry no facts at all.
+    Inside a mapped def, any bound name — its own params, stores, and
+    the params of defs/lambdas nested deeper (which shadow in their
+    own scopes) — is treated as bound throughout, so a deeper def's
+    parameter never reads as a free read of the outer variable. Trades
+    rare true positives for never flagging correct code."""
+    def own_scope_defs(root):
+        """Defs bound in root's own scope: reachable without crossing
+        another def/lambda boundary (yielded, not descended into)."""
+        stack = list(ast.iter_child_nodes(root))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield node
+                continue
+            if isinstance(node, ast.Lambda):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+
+    out: Dict[str, frozenset] = {}
+    collided: set = set()
+    for node in own_scope_defs(fn_node):
+        if node.name in out:
+            collided.add(node.name)  # redefinition: facts ambiguous
+        bound = _def_params(node)
+        loads = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name):
+                if isinstance(sub.ctx, ast.Store):
+                    bound.add(sub.id)
+                elif isinstance(sub.ctx, ast.Load):
+                    loads.add(sub.id)
+            elif isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)) and sub is not node:
+                bound |= _def_params(sub)
+                if not isinstance(sub, ast.Lambda):
+                    bound.add(sub.name)
+        # `nonlocal` names are writes-through, still reads of the outer
+        # binding for donation purposes — keep them in `loads`
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Nonlocal):
+                loads.update(sub.names)
+                bound -= set(sub.names)
+        out[node.name] = frozenset(loads - bound)
+    for name in collided:
+        out.pop(name, None)
+    return out
+
+
+def _fn_param_donations(fn, donating: Dict[str, Tuple[int, ...]]
+                        ) -> Tuple[int, ...]:
+    """Param positions ``fn`` passes straight into a donated slot of a
+    known donating callee — i.e. positions ``fn`` itself donates.
+
+    A param that is EVER re-bound in the body is excluded: after ``st =
+    st + 1`` the name no longer aliases the caller's buffer, so a later
+    donation of it must not mark the caller's arg dead (this trades the
+    rare rebind-after-donate true positive for never flagging correct
+    code — the same never-a-wrong-fact contract as call resolution)."""
+    params = fn.param_names()
+    rebound = _stores_in(fn.node)
+    positions: set = set()
+    # walk_shallow: a nested def/lambda runs later with its OWN scope —
+    # a shadowed param name there must not mark the outer fn donating
+    for node in walk_shallow(fn.node):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func).rsplit(".", 1)[-1]
+        idx = donating.get(name)
+        if not idx:
+            continue
+        for i in idx:
+            if i < len(node.args) and isinstance(node.args[i], ast.Name):
+                arg = node.args[i].id
+                if arg in params and arg not in rebound:
+                    positions.add(params.index(arg))
+    return tuple(sorted(positions))
+
+
+def compute_project_donating(project) -> Dict[str, Tuple[int, ...]]:
+    """Project-wide donating table: ``KNOWN_DONATING`` + every
+    file-local donating jit + module-level functions that transitively
+    pass a parameter into a donated position (to a fixed point).
+
+    Only bare names unique across the project carry a transitive fact;
+    methods are excluded (their call-site arg numbering shifts by the
+    receiver and a wrong offset would flag the wrong variable)."""
+    from corrosion_tpu.analysis.callgraph import fixpoint
+
+    local_tables = {
+        mod.name: _collect_donating(mod.tree) for mod in project.modules
+    }
+
+    def summarize(fn, summaries):
+        if fn.cls is not None:
+            return ()
+        table = dict(local_tables[fn.module.name])
+        for qual, positions in summaries.items():
+            if not positions:
+                continue
+            other = project.functions[qual]
+            if len(project.by_name.get(other.name, ())) == 1:
+                table.setdefault(other.name, tuple(positions))
+        return _fn_param_donations(fn, table)
+
+    summaries = fixpoint(project, summarize)
+    out: Dict[str, Tuple[int, ...]] = {}
+    for qual, positions in summaries.items():
+        if not positions:
+            continue
+        fn = project.functions[qual]
+        if len(project.by_name.get(fn.name, ())) == 1:
+            out[fn.name] = tuple(positions)
+    return out
+
+
+def check_project(project) -> List[Finding]:
+    """The interprocedural donation pass: the lexical scan, run with
+    the project-wide donating table and closure free-variable maps."""
+    transitive = compute_project_donating(project)
+    findings: List[Finding] = []
+    for mod in project.modules:
+        donating = dict(transitive)
+        donating.update(_collect_donating(mod.tree))  # local names win
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _FunctionScan(
+                    donating, mod.path, findings,
+                    closures=_closure_free_reads(node),
+                ).scan_body(node.body)
     return findings

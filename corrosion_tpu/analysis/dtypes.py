@@ -1,0 +1,268 @@
+"""dtype-flow: the narrow-dtype byte budget, enforced at the boundary.
+
+PERF.md's fused-path budget (~10k rounds/s) is a BYTE budget: the
+small-range HBM planes (``mem_timer``, ``mem_tx``, ``q_cell``,
+``q_seq``, ``q_nseq``, ``q_tx``, ``last_sync``) live as int16
+(``ScaleSimConfig.narrow_dtypes``) and one silent int16->int32 upcast
+on a carry leaf doubles that plane's traffic — AND changes the carry
+aval, so every downstream jit retraces. jnp makes the upcast easy to
+write: mix a narrow plane with any concrete int32 operand and the
+promotion rules widen silently.
+
+**dtype-widen** simulates those promotion rules through the hot
+``sim``/``ops`` modules (on the :mod:`dataflow` engine): narrow-leaf
+reads seed int16 abstract dtypes, Python scalars stay weak (they do
+NOT widen — jax's weak-type rule), concrete wider operands promote,
+and an explicit ``.astype(...)`` resets to whatever it names. The rule
+fires only at the declared-narrow BOUNDARIES — a narrow keyword
+(``_replace(mem_timer=...)``, constructor kwargs) or a narrow kernel
+out-ref store (``o_timer[:] = ...``) receiving a provably-wider
+concrete integer. Mid-kernel promotion stays free (megakernel
+deliberately computes wide and casts back at the store); a dynamic
+``.astype(ref.dtype)`` evaluates to unknown and never flags.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Any, Dict, List, NamedTuple, Optional
+
+from corrosion_tpu.analysis.base import Finding, dotted_name
+from corrosion_tpu.analysis.callgraph import FunctionInfo, Project
+from corrosion_tpu.analysis.dataflow import Env, ForwardAnalysis
+
+RULE = "dtype-widen"
+
+#: declared-narrow state leaves -> bit width (the ``narrow_dtypes``
+#: registry, seeded from ``sim/scale_step.py`` + ``ops/megakernel.py``
+#: boundaries; keep in sync with ``ScaleSimConfig.timer_dtype``)
+NARROW_LEAVES: Dict[str, int] = {
+    "mem_timer": 16,
+    "mem_tx": 16,
+    "q_cell": 16,
+    "q_seq": 16,
+    "q_nseq": 16,
+    "q_tx": 16,
+    "last_sync": 16,
+}
+
+#: kernel out-ref spellings of the same planes (``ops/megakernel.py``)
+NARROW_REFS: Dict[str, int] = {
+    "o_timer": 16, "o_tx": 16, "m_timer": 16, "m_tx": 16,
+}
+NARROW_REFS.update(NARROW_LEAVES)
+
+_DTYPE_NAMES = {
+    "int8": ("int", 8), "int16": ("int", 16), "int32": ("int", 32),
+    "int64": ("int", 64), "uint8": ("uint", 8), "uint16": ("uint", 16),
+    "uint32": ("uint", 32), "uint64": ("uint", 64),
+    "bool_": ("bool", 1), "float16": ("float", 16),
+    "bfloat16": ("float", 16), "float32": ("float", 32),
+    "float64": ("float", 64),
+}
+
+
+class Dtype(NamedTuple):
+    kind: str  # "int" | "uint" | "float" | "bool" | "weak"
+    bits: int
+    origin: Optional[str] = None  # narrow leaf this value derives from
+
+
+def _literal_dtype(node: Optional[ast.AST]) -> Optional[Dtype]:
+    """``jnp.int16`` / ``np.int32`` / ``"int16"`` -> Dtype; dynamic
+    expressions (``ref.dtype``) -> None (unknown, never flags)."""
+    if node is None:
+        return None
+    name = ""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        name = node.value
+    else:
+        name = dotted_name(node).rsplit(".", 1)[-1]
+    if name == "bool":
+        name = "bool_"
+    if name in _DTYPE_NAMES:
+        kind, bits = _DTYPE_NAMES[name]
+        return Dtype(kind, bits)
+    return None
+
+
+def promote(a: Optional[Dtype], b: Optional[Dtype]) -> Optional[Dtype]:
+    """jnp-style promotion, narrowed to what the rule needs: weak
+    scalars adopt the other side; mixed concrete ints widen to the max
+    width; anything involving unknown is unknown."""
+    if a is None or b is None:
+        return None
+    if a.kind == "weak":
+        return b
+    if b.kind == "weak":
+        return a
+    origin = a.origin or b.origin
+    if a.kind in ("int", "uint") and b.kind in ("int", "uint"):
+        bits = max(a.bits, b.bits)
+        if a.kind != b.kind and a.bits == b.bits:
+            bits = min(64, bits * 2)  # int16 x uint16 -> int32, etc.
+        kind = "int" if "int" in (a.kind, b.kind) else "uint"
+        return Dtype(kind, bits, origin)
+    if "float" in (a.kind, b.kind):
+        bits = max(x.bits for x in (a, b) if x.kind == "float")
+        return Dtype("float", bits, origin)
+    return Dtype(a.kind, max(a.bits, b.bits), origin)
+
+
+#: jnp calls whose result keeps the first array argument's dtype
+#: (verified against real jnp: cumsum/max/min reductions keep int16;
+#: sum does NOT — it accumulates at int32 and lives below)
+_PASS_FIRST = {
+    "abs", "negative", "cumsum", "max", "min", "roll",
+    "reshape", "broadcast_to", "squeeze", "transpose", "sort", "flip",
+}
+#: jnp calls that promote across their array arguments (clip/mod/
+#: bitwise widen when any operand is wider — same rules as binops)
+_PROMOTING = {"minimum", "maximum", "add", "multiply", "remainder",
+              "power", "clip", "mod", "floor_divide", "bitwise_and",
+              "bitwise_or", "bitwise_xor"}
+#: reductions that accumulate at (at least) 32 bits regardless of the
+#: input width — jnp.sum(int16) is int32
+_WIDENING_REDUCTIONS = {"sum", "prod", "dot", "matmul", "tensordot"}
+
+
+class _Analysis(ForwardAnalysis):
+    def __init__(self, fn: FunctionInfo, findings: List[Finding]):
+        super().__init__(fn, fn.path, findings)
+
+    def initial_env(self) -> Env:
+        # kernel refs arrive as parameters named after their plane
+        return {
+            name: Dtype("int", NARROW_REFS[name], origin=name)
+            for name in self.fn.param_names() if name in NARROW_REFS
+        }
+
+    def join(self, a, b):
+        if isinstance(a, Dtype) and isinstance(b, Dtype):
+            return a if a == b else None
+        return super().join(a, b)
+
+    def eval_constant(self, node, env):
+        if isinstance(node.value, bool):
+            return Dtype("bool", 1)
+        if isinstance(node.value, int):
+            return Dtype("weak", 0)
+        if isinstance(node.value, float):
+            return Dtype("weak", 0)
+        return None
+
+    def eval_attr(self, node, base, env):
+        if node.attr in NARROW_LEAVES:
+            return Dtype("int", NARROW_LEAVES[node.attr],
+                         origin=node.attr)
+        if isinstance(base, Dtype) and node.attr in ("T", "real"):
+            return base
+        return None
+
+    def eval_subscript(self, node, base, env):
+        # indexing/slicing an array keeps its dtype
+        if isinstance(base, Dtype):
+            return base
+        return super().eval_subscript(node, base, env)
+
+    def eval_binop(self, node, left, right, env):
+        # arithmetic and bit ops follow the same promotion rules
+        return promote(self._as_dtype(left), self._as_dtype(right))
+
+    @staticmethod
+    def _as_dtype(v) -> Optional[Dtype]:
+        return v if isinstance(v, Dtype) else None
+
+    def _check_boundary(self, node: ast.AST, target: str,
+                        value: Any) -> None:
+        narrow_bits = NARROW_REFS.get(target)
+        if narrow_bits is None or not isinstance(value, Dtype):
+            return
+        if value.kind in ("int", "uint") and value.bits > narrow_bits:
+            came_from = (f" (derives from narrow `{value.origin}`)"
+                         if value.origin else "")
+            self.findings.append(Finding(
+                path=self.path, line=node.lineno, rule=RULE,
+                message=f"declared-narrow `{target}` (int{narrow_bits}) "
+                        f"receives a silently widened int{value.bits} "
+                        f"value{came_from} — doubles the plane's HBM "
+                        "traffic and retraces every consumer",
+                hint=f"cast back at the boundary: "
+                     f".astype(jnp.int{narrow_bits}) or "
+                     ".astype(<ref>.dtype)",
+            ))
+
+    def eval_call(self, node, env, args, keywords):
+        name = dotted_name(node.func)
+        last = name.rsplit(".", 1)[-1]
+        # narrow keyword boundary: _replace(mem_timer=...), ctor kwargs
+        for kw in node.keywords:
+            if kw.arg in NARROW_LEAVES:
+                self._check_boundary(kw.value, kw.arg,
+                                     keywords.get(kw.arg))
+        if isinstance(node.func, ast.Attribute) and (
+                node.func.attr == "astype"):
+            if node.args:
+                target = _literal_dtype(node.args[0])
+            else:
+                target = _literal_dtype(
+                    node.keywords[0].value if node.keywords else None)
+            base = self.eval_expr(node.func.value, env)
+            if target is not None:
+                origin = base.origin if isinstance(base, Dtype) else None
+                return Dtype(target.kind, target.bits, origin)
+            return None
+        if "dtype" in keywords or (last in ("zeros", "ones", "full",
+                                            "arange", "empty", "randint",
+                                            "asarray")):
+            for kw in node.keywords:
+                if kw.arg == "dtype":
+                    return _literal_dtype(kw.value)
+            # positional dtype in arange/zeros is rare here; unknown
+            return None
+        if last in _PASS_FIRST and args:
+            return self._as_dtype(args[0])
+        if last in _WIDENING_REDUCTIONS and args:
+            first = self._as_dtype(args[0])
+            if first is not None and first.kind in ("int", "uint"):
+                return Dtype(first.kind, max(first.bits, 32),
+                             first.origin)
+            return first
+        if last == "where" and len(args) == 3:
+            return promote(self._as_dtype(args[1]),
+                           self._as_dtype(args[2]))
+        if last in _PROMOTING and args:
+            out = self._as_dtype(args[0])
+            for v in args[1:]:
+                out = promote(out, self._as_dtype(v))
+            return out
+        return None
+
+    def on_store_into(self, target, value, node, env):
+        # kernel out-ref boundary: o_timer[:] = <wider int>
+        if isinstance(target, ast.Subscript) and isinstance(
+                target.value, ast.Name):
+            self._check_boundary(node, target.value.id, value)
+
+
+def in_scope(path: str) -> bool:
+    """Scope on the ABSOLUTE path, so the CLI (relative paths) and the
+    tier-1 gate (absolute paths) can never disagree about which files
+    the rule covers. Paths that don't exist on disk are synthetic
+    fixture sources — always in scope."""
+    import os
+
+    p = os.path.abspath(path)
+    if not os.path.exists(p):
+        return True  # fixture / bare source blob
+    norm = p.replace("\\", "/")
+    return "/sim/" in norm or "/ops/" in norm
+
+
+def check_project(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for fn in project.iter_functions():
+        if not in_scope(fn.path):
+            continue
+        _Analysis(fn, findings).analyze()
+    return findings

@@ -1,32 +1,69 @@
 """File walking + checker orchestration for ``corrolint``.
 
 ``run_paths`` is the whole engine: walk the given files/directories,
-parse each Python file once, run every (selected) checker over the
-tree, apply inline suppressions, and return sorted findings. The CLI
-(``__main__``) and the tier-1 gate
-(``tests/test_analysis.py::test_repo_is_clean``) both call it, so the
-lint that blocks CI is byte-identical to the one run by hand.
+parse each Python file once, run every (selected) per-file checker
+over its tree, build the project call graph, run the (selected)
+interprocedural project checkers over it, apply inline suppressions,
+de-duplicate, and return sorted findings. The CLI (``__main__``) and
+the tier-1 gate (``tests/test_analysis.py::test_repo_is_clean``) both
+call it, so the lint that blocks CI is byte-identical to the one run
+by hand.
+
+Two checker shapes since v2:
+
+- **per-file** (:data:`ALL_CHECKERS`) — ``(tree, source, path) ->
+  [Finding]``, pure AST passes over one file;
+- **project** (:data:`PROJECT_CHECKERS`) — ``(Project) -> [Finding]``,
+  interprocedural passes over the whole walked set (call graph +
+  dataflow). On a partial walk (``--changed``) they still run, over
+  just the walked files — facts are derived from the SUBSET's view, so
+  cross-file facts whose other half was not walked go missing, and a
+  bare name that is only unique within the subset can resolve where
+  the full walk would abstain. The full walk is the gate of record;
+  ``--changed`` is the fast pre-commit approximation.
+
+The lexical donation pass and the interprocedural ``donation-flow``
+pass overlap by construction (the project table is a superset); both
+emit identical Finding records for the shared cases and the global
+de-dup collapses them.
 """
 
 from __future__ import annotations
 
 import ast
 import os
-from typing import Callable, Dict, Iterable, Iterator, List, Optional
+from typing import Callable, Dict, Iterable, Iterator, List, Optional, Tuple
 
-from corrosion_tpu.analysis import asserts, donation, locks, trace
-from corrosion_tpu.analysis.base import (
-    Finding,
-    apply_suppressions,
-    parse_suppressions,
+from corrosion_tpu.analysis import (
+    asserts,
+    donation,
+    dtypes,
+    locks,
+    lockorder,
+    sharding,
+    trace,
+)
+from corrosion_tpu.analysis.base import Finding, parse_suppressions
+from corrosion_tpu.analysis.callgraph import (
+    ModuleInfo,
+    Project,
+    module_name_for,
 )
 
-#: checker name -> callable(tree, source, path) -> [Finding]
+#: per-file checker name -> callable(tree, source, path) -> [Finding]
 ALL_CHECKERS: Dict[str, Callable] = {
     "donation-safety": donation.check,
     "lock-discipline": locks.check,
     "strippable-assert": asserts.check,
     "trace-hygiene": trace.check,
+}
+
+#: project checker name -> callable(Project) -> [Finding]
+PROJECT_CHECKERS: Dict[str, Callable] = {
+    "donation-flow": donation.check_project,
+    "sharding-contract": sharding.check_project,
+    "dtype-flow": dtypes.check_project,
+    "lock-order": lockorder.check_project,
 }
 
 _SKIP_DIRS = {"__pycache__", ".git", ".jax_cache", "node_modules"}
@@ -52,6 +89,61 @@ def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
                     yield os.path.join(root, name)
 
 
+def _select(checkers: Optional[Iterable[str]]) -> Tuple[Dict, Dict]:
+    """(per-file, project) checker subsets for a ``--checkers`` spec."""
+    if checkers is None:
+        return ALL_CHECKERS, PROJECT_CHECKERS
+    names = list(checkers)
+    unknown = set(names) - set(ALL_CHECKERS) - set(PROJECT_CHECKERS)
+    if unknown:
+        raise ValueError(
+            f"unknown checkers: {sorted(unknown)} (available: "
+            f"{sorted(ALL_CHECKERS) + sorted(PROJECT_CHECKERS)})"
+        )
+    return (
+        {k: ALL_CHECKERS[k] for k in names if k in ALL_CHECKERS},
+        {k: PROJECT_CHECKERS[k] for k in names if k in PROJECT_CHECKERS},
+    )
+
+
+def _lint_sources(
+    sources: List[Tuple[str, str]],
+    per_file: Dict[str, Callable],
+    project_checkers: Dict[str, Callable],
+) -> List[Finding]:
+    """The shared engine body over parsed (path, source) pairs."""
+    findings: List[Finding] = []
+    suppressions: Dict[str, Dict[int, set]] = {}
+    modules = []
+    for path, source in sources:
+        by_line, bad = parse_suppressions(source, path)
+        suppressions[path] = by_line
+        findings.extend(bad)
+        try:
+            tree = ast.parse(source)
+        except SyntaxError as e:
+            findings.append(Finding(
+                path=path, line=e.lineno or 0, rule="syntax-error",
+                message=f"not parseable: {e.msg}",
+            ))
+            continue
+        for _, checker in sorted(per_file.items()):
+            findings.extend(checker(tree, source, path))
+        modules.append(ModuleInfo(
+            path=path, name=module_name_for(path), tree=tree,
+            source=source, suppressions=by_line, bad_suppressions=bad,
+        ))
+    if project_checkers and modules:
+        project = Project(modules)
+        for _, checker in sorted(project_checkers.items()):
+            findings.extend(checker(project))
+    kept = [
+        f for f in findings
+        if f.rule not in suppressions.get(f.path, {}).get(f.line, ())
+    ]
+    return sorted(set(kept))
+
+
 def check_source(
     source: str,
     path: str = "<string>",
@@ -59,19 +151,36 @@ def check_source(
 ) -> List[Finding]:
     """Run checkers over one source blob (the test-fixture entry
     point). Suppressions are honored; a suppression with no reason is
-    itself a finding."""
-    try:
-        tree = ast.parse(source)
-    except SyntaxError as e:
-        return [Finding(
-            path=path, line=e.lineno or 0, rule="syntax-error",
-            message=f"not parseable: {e.msg}",
-        )]
-    by_line, bad_suppressions = parse_suppressions(source, path)
-    findings: List[Finding] = list(bad_suppressions)
-    for _, checker in sorted((checkers or ALL_CHECKERS).items()):
-        findings.extend(checker(tree, source, path))
-    return sorted(apply_suppressions(findings, by_line))
+    itself a finding. ``checkers`` maps names to callables — names in
+    :data:`PROJECT_CHECKERS` run as project passes over the one-file
+    project."""
+    if checkers is None:
+        per_file, project_checkers = ALL_CHECKERS, PROJECT_CHECKERS
+    else:
+        per_file = {k: v for k, v in checkers.items()
+                    if k not in PROJECT_CHECKERS}
+        project_checkers = {k: v for k, v in checkers.items()
+                            if k in PROJECT_CHECKERS}
+    return _lint_sources([(path, source)], per_file, project_checkers)
+
+
+def lint_report(
+    paths: Iterable[str],
+    checkers: Optional[Iterable[str]] = None,
+) -> Tuple[List[Finding], int]:
+    """(findings, files walked) over ``paths`` — the machine-readable
+    artifact's data source."""
+    per_file, project_checkers = _select(checkers)
+    sources: List[Tuple[str, str]] = []
+    for file_path in iter_python_files(paths):
+        with open(file_path, "r", encoding="utf-8") as f:
+            sources.append((file_path, f.read()))
+    if not sources:
+        raise FileNotFoundError(
+            f"no Python files under {list(paths)!r} — refusing to "
+            f"report a clean result for an empty walk"
+        )
+    return _lint_sources(sources, per_file, project_checkers), len(sources)
 
 
 def run_paths(
@@ -80,25 +189,4 @@ def run_paths(
 ) -> List[Finding]:
     """All findings over ``paths``, suppressions applied, sorted by
     (path, line)."""
-    selected = ALL_CHECKERS
-    if checkers is not None:
-        unknown = set(checkers) - set(ALL_CHECKERS)
-        if unknown:
-            raise ValueError(
-                f"unknown checkers: {sorted(unknown)} "
-                f"(available: {sorted(ALL_CHECKERS)})"
-            )
-        selected = {k: ALL_CHECKERS[k] for k in checkers}
-    findings: List[Finding] = []
-    n_files = 0
-    for file_path in iter_python_files(paths):
-        n_files += 1
-        with open(file_path, "r", encoding="utf-8") as f:
-            source = f.read()
-        findings.extend(check_source(source, file_path, selected))
-    if n_files == 0:
-        raise FileNotFoundError(
-            f"no Python files under {list(paths)!r} — refusing to "
-            f"report a clean result for an empty walk"
-        )
-    return sorted(findings)
+    return lint_report(paths, checkers)[0]

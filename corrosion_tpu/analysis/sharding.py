@@ -1,0 +1,361 @@
+"""sharding-contract: sharded state stays on the mesh.
+
+At flagship scale the carry IS the HBM working set sharded over the
+node axis; two cross-function mistakes silently collapse that story:
+
+- **shard-gather** — host-materializing sharded state. A
+  ``jax.device_get``/``np.asarray`` on a value that derives from the
+  sharded mesh entry points funnels the whole working set through one
+  host (doubling host memory and serializing the drain — the exact
+  debt the per-shard-checkpoint ROADMAP item exists to pay). Flagged
+  both **at the call site** when tainted state flows into a
+  materializer — including a helper that materializes its argument
+  somewhere down the call graph (the interprocedural part) — and **at
+  the definition** of any ``_host_copy``-style whole-pytree drain
+  (``jax.tree.map(np.array, tree)``, ``[np.asarray(x) for x in
+  leaves]``) outside the :data:`DRAIN_REGISTRY`.
+- **shard-spec-drift** — passing freshly-built (never placed) state
+  into a sharded entry point's state slot. The run still works — XLA
+  re-lays the arrays out — but the inputs silently arrive replicated /
+  default-placed instead of riding the ``P("node")`` specs
+  ``shard_state`` stamps, so the "sharded" bench record measures a
+  single-device layout. Values of unknown origin (parameters, loads)
+  never flag; only a provably-fresh build (``*.create(...)``,
+  ``make_soak_inputs``) flowing in unplaced does.
+
+Taint sources are the registries below (the ``parallel/mesh.py``
+surfaces); propagation runs on :mod:`~corrosion_tpu.analysis.dataflow`
+with union-join, so a value that MAY be sharded on one branch keeps the
+taint, while a maybe-placed value never raises spec-drift.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Any, Dict, FrozenSet, List, Optional, Tuple
+
+from corrosion_tpu.analysis.base import Finding, dotted_name
+from corrosion_tpu.analysis.callgraph import (
+    FunctionInfo,
+    Project,
+    fixpoint,
+)
+from corrosion_tpu.analysis.dataflow import Env, ForwardAnalysis, TupleVal
+
+RULE_GATHER = "shard-gather"
+RULE_DRIFT = "shard-spec-drift"
+
+#: sharded mesh entry points: positions that must receive PLACED state
+#: (st, net, inputs — the key replicates and may come from anywhere)
+SHARDED_STATE_PARAMS: Dict[str, Tuple[int, ...]] = {
+    "sharded_step": (2, 3, 5),
+    "sharded_run": (2, 3, 5),
+    "sharded_scale_run": (2, 3, 5),
+    "sharded_scale_run_carry": (2, 3, 5),
+}
+
+#: entry point -> abstract return shape with the sharded paths marked
+#: (built lazily; P("node") rides exactly these outputs)
+def _sharded_returns() -> Dict[str, Any]:
+    S = frozenset({"sharded"})
+    return {
+        "shard_state": S,  # whole result is placed
+        "sharded_step": TupleVal((S, None)),
+        "sharded_run": TupleVal((S, None)),
+        "sharded_scale_run": TupleVal((S, None)),
+        # ((st, key), infos): st is the sharded carry; the key is tiny
+        # and replicated — reading it back is not a gather
+        "sharded_scale_run_carry": TupleVal((TupleVal((S, None)), None)),
+    }
+
+
+#: call names whose RESULT is freshly-built, never-placed device state
+FRESH_BUILDERS = {"create", "make_soak_inputs", "make_write_inputs",
+                  "quiet"}
+
+#: direct host materializers (dotted and bare forms)
+MATERIALIZERS = {
+    "np.array", "np.asarray", "numpy.array", "numpy.asarray",
+    "onp.array", "onp.asarray", "jax.device_get", "device_get",
+    "float", "int",
+}
+MATERIALIZER_METHODS = {"item", "tolist"}
+
+#: functions whose whole-pytree host drain is sanctioned — the drain
+#: registry the issue's checkpoint/restore machinery rides. Every entry
+#: carries its reason; anything else doing a tree-wide materialization
+#: is a finding.
+DRAIN_REGISTRY: Dict[str, str] = {
+    # checkpoint serialization: operates on carry copies its callers
+    # already staged host-side (segments._host_copy is the one device
+    # drain, tracked separately as suppressed debt)
+    "save_checkpoint": "serializes host-staged copies for the "
+                       "crash-consistent commit path",
+    # trace-stability probe: deliberately exercises the checkpoint
+    # resume drain on tiny probe state
+    "_host_roundtrip": "tracecount probe of the resume path on "
+                       "probe-sized state",
+}
+
+
+def _tags(value: Any) -> FrozenSet:
+    """Every tag reachable in a (possibly tuple-nested) value."""
+    if isinstance(value, frozenset):
+        return value
+    if isinstance(value, TupleVal):
+        out: FrozenSet = frozenset()
+        for el in value.elements:
+            out = out | _tags(el)
+        return out
+    return frozenset()
+
+
+def _strip_params(value: Any) -> Any:
+    """Return-summary hygiene: a callee's param tags must not leak
+    into its caller's environment — but "sharded"/"fresh" are global
+    facts that DO travel (a factory helper wrapping ``create()``
+    still returns never-placed state)."""
+    if isinstance(value, frozenset):
+        kept = frozenset(t for t in value if t in ("sharded", "fresh"))
+        return kept or None
+    if isinstance(value, TupleVal):
+        return TupleVal(_strip_params(el) for el in value.elements)
+    return None
+
+
+def _lambda_materializes(node: ast.AST) -> bool:
+    """``lambda a: np.array(a)``-shaped materializer?"""
+    if not isinstance(node, ast.Lambda):
+        return dotted_name(node) in MATERIALIZERS
+    for sub in ast.walk(node.body):
+        if isinstance(sub, ast.Call) and dotted_name(
+                sub.func) in MATERIALIZERS:
+            return True
+    return False
+
+
+def _is_tree_map(name: str) -> bool:
+    return name.endswith("tree_map") or name.endswith("tree.map")
+
+
+def _is_leaves(name: str) -> bool:
+    return name.endswith("tree_leaves") or name.endswith(
+        "tree.leaves") or name.endswith("_leaves")
+
+
+class _Analysis(ForwardAnalysis):
+    """One function: taint propagation + gather/drift sinks.
+
+    ``summaries`` maps qualname -> (gathered param indices, return
+    value); during the summary fixpoint ``collect`` is False and no
+    findings are emitted."""
+
+    def __init__(self, fn: FunctionInfo, project: Project,
+                 summaries: Dict[str, tuple], collect: bool,
+                 findings: List[Finding]):
+        super().__init__(fn, fn.path, findings)
+        self.project = project
+        self.summaries = summaries
+        self.collect = collect
+        self.gathered_params: set = set()
+        self.returns_table = _sharded_returns()
+
+    # -- environment -------------------------------------------------------
+
+    def initial_env(self) -> Env:
+        return {
+            name: frozenset({("param", i)})
+            for i, name in enumerate(self.fn.param_names())
+        }
+
+    def join(self, a, b):
+        if a is None:
+            return b
+        if b is None:
+            return a
+        if isinstance(a, TupleVal) or isinstance(b, TupleVal):
+            if (isinstance(a, TupleVal) and isinstance(b, TupleVal)
+                    and len(a.elements) == len(b.elements)):
+                return TupleVal(self.join(x, y)
+                                for x, y in zip(a.elements, b.elements))
+            return _tags(a) | _tags(b) or None
+        return a | b
+
+    #: static metadata reads — host facts, not device data; taint ends
+    _META_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "aval",
+                   "nbytes", "itemsize"}
+
+    def eval_attr(self, node, base, env):
+        # attribute reads keep taint: st.crdt of sharded st is sharded
+        # — but metadata like .shape/.dtype never moves device bytes,
+        # so `int(st.crdt.shape[0])` must not read as a gather
+        if node.attr in self._META_ATTRS:
+            return None
+        return _tags(base) or None
+
+    def eval_subscript(self, node, base, env):
+        picked = super().eval_subscript(node, base, env)
+        if picked is not None:
+            return picked
+        return _tags(base) or None
+
+    def eval_binop(self, node, left, right, env):
+        return (_tags(left) | _tags(right)) or None
+
+    # -- calls -------------------------------------------------------------
+
+    def _flag(self, node: ast.AST, rule: str, message: str,
+              hint: str) -> None:
+        if self.collect:
+            self.findings.append(Finding(
+                path=self.path, line=node.lineno, rule=rule,
+                message=message, hint=hint))
+
+    def _note_gather(self, node: ast.AST, value: Any, what: str) -> None:
+        tags = _tags(value)
+        for tag in tags:
+            if isinstance(tag, tuple) and tag[0] == "param":
+                self.gathered_params.add(tag[1])
+        if "sharded" in tags:
+            self._flag(
+                node, RULE_GATHER,
+                f"node-sharded state is host-materialized by {what}",
+                hint="keep the drain per-shard (or route through the "
+                     "sharding drain registry with a reason)",
+            )
+
+    def eval_call(self, node, env, args, keywords):
+        name = dotted_name(node.func)
+        last = name.rsplit(".", 1)[-1]
+
+        # whole-pytree drain shape: jax.tree.map(materializer, X)
+        if _is_tree_map(name) and node.args and _lambda_materializes(
+                node.args[0]):
+            if self.fn.name not in DRAIN_REGISTRY:
+                self._flag(
+                    node, RULE_GATHER,
+                    f"`{self.fn.name}` funnels a whole pytree through "
+                    "the host (tree-wide materialization)",
+                    hint="drain per shard, or register the function in "
+                         "sharding.DRAIN_REGISTRY with a reason",
+                )
+            for value in args[1:]:
+                self._note_gather(node, value, f"{name}(...)")
+
+        # direct materializer
+        if name in MATERIALIZERS:
+            for value in args:
+                self._note_gather(node, value, f"{name}()")
+            return None
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in MATERIALIZER_METHODS):
+            self._note_gather(node, self.eval_expr(node.func.value, env),
+                              f".{node.func.attr}()")
+            return None
+
+        # sharded entry points: spec-drift sink + tainted returns
+        if last in SHARDED_STATE_PARAMS:
+            for pos in SHARDED_STATE_PARAMS[last]:
+                if pos < len(args) and "fresh" in _tags(args[pos]) and (
+                        "sharded" not in _tags(args[pos])):
+                    self._flag(
+                        node, RULE_DRIFT,
+                        f"freshly-built state reaches `{last}` arg "
+                        f"{pos} without `shard_state` placement — the "
+                        "run silently drops the P(\"node\") layout",
+                        hint="place it with parallel.mesh.shard_state("
+                             "mesh, n_nodes, ...) first",
+                    )
+            return self.returns_table.get(last)
+        if last in self.returns_table:
+            return self.returns_table[last]
+
+        if last in FRESH_BUILDERS:
+            return frozenset({"fresh"})
+
+        # interprocedural: a callee that gathers one of its params
+        resolved = self.project.resolve_call(node, self.fn)
+        if resolved is not None:
+            summary = self.summaries.get(resolved.qualname)
+            if summary:
+                gathers, returns = summary
+                # a method's param 0 is its receiver; call-site args
+                # start at param 1
+                params = resolved.param_names()
+                offset = 1 if (resolved.cls is not None and params
+                               and params[0] == "self") else 0
+                if resolved.name not in DRAIN_REGISTRY:
+                    for raw in gathers:
+                        i = raw - offset
+                        if not 0 <= i < len(args):
+                            continue
+                        tags = _tags(args[i])
+                        # transitive summary: OUR param flowing into a
+                        # gathering callee makes US a gatherer too, so
+                        # two-hop drains flag at the outermost call
+                        for tag in tags:
+                            if isinstance(tag, tuple) and (
+                                    tag[0] == "param"):
+                                self.gathered_params.add(tag[1])
+                        if "sharded" in tags:
+                            self._flag(
+                                node, RULE_GATHER,
+                                f"node-sharded state is passed to "
+                                f"`{resolved.name}()` which "
+                                "host-materializes it "
+                                f"({resolved.path.rsplit('/', 1)[-1]})",
+                                hint="drain per shard, or register the "
+                                     "callee in sharding.DRAIN_REGISTRY "
+                                     "with a reason",
+                            )
+                return returns
+        return None
+
+
+def _comprehension_drains(fn: FunctionInfo) -> List[ast.AST]:
+    """``[np.asarray(x) for x in tree.leaves(state)]``-shaped whole-tree
+    drains (the other spelling of ``_host_copy``)."""
+    out: List[ast.AST] = []
+    for sub in ast.walk(fn.node):
+        if not isinstance(sub, (ast.ListComp, ast.GeneratorExp)):
+            continue
+        if not (sub.generators and isinstance(
+                sub.generators[0].iter, ast.Call) and _is_leaves(
+                dotted_name(sub.generators[0].iter.func))):
+            continue
+        for part in ast.walk(sub.elt):
+            if isinstance(part, ast.Call) and dotted_name(
+                    part.func) in MATERIALIZERS:
+                out.append(sub)
+                break
+    return out
+
+
+def _summarize(fn: FunctionInfo, project: Project,
+               summaries: Dict[str, tuple]) -> tuple:
+    run = _Analysis(fn, project, summaries, collect=False, findings=[])
+    try:
+        ret = run.analyze()
+    except RecursionError:  # pragma: no cover - pathological nesting
+        return (frozenset(), None)
+    return (frozenset(run.gathered_params), _strip_params(ret))
+
+
+def check_project(project: Project) -> List[Finding]:
+    summaries = fixpoint(
+        project, lambda fn, s: _summarize(fn, project, s))
+    findings: List[Finding] = []
+    for fn in project.iter_functions():
+        _Analysis(fn, project, summaries, collect=True,
+                  findings=findings).analyze()
+        if fn.name in DRAIN_REGISTRY:
+            continue
+        for site in _comprehension_drains(fn):
+            findings.append(Finding(
+                path=fn.path, line=site.lineno, rule=RULE_GATHER,
+                message=f"`{fn.name}` materializes every pytree leaf "
+                        "on the host (leaves-comprehension drain)",
+                hint="drain per shard, or register the function in "
+                     "sharding.DRAIN_REGISTRY with a reason",
+            ))
+    return findings

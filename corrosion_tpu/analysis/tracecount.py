@@ -173,12 +173,80 @@ def _probe_sharded_scale_run(repeats: int, rounds: int = 2) -> int:
     return 1 + (pmesh._scale_run._cache_size() - base)
 
 
+def _probe_segmented_soak(repeats: int, rounds_per_segment: int = 8) -> int:
+    """The REAL segmented soak (``run_segmented``) with the async
+    checkpoint writer active: dispatches must compile exactly TWO
+    programs — the un-donated first segment and the donated steady
+    state — and every later segment boundary (carry chained through a
+    donated dispatch while the writer drains host copies in the
+    background) must add ZERO compilations.
+
+    Shapes match ``tests/test_resilience.py``'s ``scale16`` fixture
+    (``_scale_cfg`` config, 8-round segments, ``write_frac=0.25``) so
+    the persistent compile cache is shared — keep them in sync.
+    Reported as ``observed - 1`` so the expected two programs read as
+    the stable ``1``: a per-segment retrace (or donation silently
+    disabled, which would collapse the two programs into one) fails
+    the gate either way."""
+    import tempfile
+
+    from corrosion_tpu.resilience import segments
+    from corrosion_tpu.sim.scale_step import ScaleSimState
+    from corrosion_tpu.sim.transport import NetModel
+
+    cfg = _scale_cfg()
+    net = NetModel.create(cfg.n_nodes)
+    st = ScaleSimState.create(cfg)
+    # un-donated, donated, then steady state — capped at ONE steady
+    # segment: it re-runs the donated program with the chained carry
+    # while the writer drains, which is the whole claim; more segments
+    # only re-prove it at ~3 s of tier-1 budget each
+    n_segments = 2 + min(repeats, 1)
+    inputs = segments.make_soak_inputs(
+        cfg, jr.key(5), rounds_per_segment * n_segments, write_frac=0.25
+    )
+    counter = {"traces": 0}
+    real_jit = segments._jit
+
+    def counting(fn, **kwargs):
+        def wrapped(*a, **k):
+            counter["traces"] += 1
+            return fn(*a, **k)
+
+        return real_jit(wrapped, **kwargs)
+
+    segments._jit = counting
+    try:
+        with tempfile.TemporaryDirectory() as root:
+            res = segments.run_segmented(
+                cfg, st, net, jr.key(0), inputs, rounds_per_segment,
+                checkpoint_root=root, donate=True, async_checkpoint=True,
+            )
+    finally:
+        segments._jit = real_jit
+    if res.aborted or res.stats["ckpt_written"] != n_segments:
+        raise RuntimeError(
+            f"segmented-soak probe did not run as configured: "
+            f"aborted={res.aborted} "
+            f"ckpt_written={res.stats['ckpt_written']}/{n_segments} "
+            "(the writer must be active for the probed steady state)"
+        )
+    if res.stats["donated_segments"] != n_segments - 1:
+        raise RuntimeError(
+            f"segmented-soak probe expected {n_segments - 1} donated "
+            f"segments, got {res.stats['donated_segments']} — the "
+            "steady state being enforced is the donated one"
+        )
+    return counter["traces"] - 1
+
+
 #: name -> probe(repeats) -> observed trace count
 HOT_ENTRY_POINTS: Dict[str, Callable[[int], int]] = {
     "full_sim_step": _probe_full_step,
     "scale_sim_step": _probe_scale_step,
     "segment_dispatch": _probe_segment_dispatch,
     "sharded_scale_run": _probe_sharded_scale_run,
+    "segmented_soak": _probe_segmented_soak,
 }
 
 

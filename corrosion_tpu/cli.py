@@ -437,6 +437,10 @@ def cmd_lint(args) -> int:
     argv = list(args.paths or [])
     if args.format != "text":
         argv = ["--format", args.format] + argv
+    if args.changed is not None:
+        argv = ["--changed", args.changed] + argv
+    if args.output_json is not None:
+        argv = ["--output-json", args.output_json] + argv
     return lint_main(argv)
 
 
@@ -573,11 +577,17 @@ def build_parser() -> argparse.ArgumentParser:
     asr.set_defaults(fn=cmd_assertions)
 
     lint = sub.add_parser(
-        "lint", help="corrolint static analysis (donation-safety, "
-                     "lock-discipline, strippable-assert, trace-hygiene)")
+        "lint", help="corrolint static analysis (v1 lexical checkers "
+                     "plus the v2 interprocedural sharding-contract, "
+                     "dtype-flow, lock-order, donation-flow passes)")
     lint.add_argument("paths", nargs="*", default=None,
                       help="files/dirs (default: corrosion_tpu)")
     lint.add_argument("--format", choices=("text", "json"), default="text")
+    lint.add_argument("--changed", metavar="GIT_REF", default=None,
+                      help="lint only .py files changed vs the git ref "
+                           "(fast pre-commit mode)")
+    lint.add_argument("--output-json", metavar="PATH", default=None,
+                      help="write a machine-readable findings report")
     lint.set_defaults(fn=cmd_lint)
 
     d = sub.add_parser("default-config", help="print an example config file")

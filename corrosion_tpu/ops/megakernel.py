@@ -910,7 +910,9 @@ def _swim_kernel(consts, *refs):
     o_id[:] = mem_id
     o_view[:] = mem_view
     # narrowed configs store timer/budget planes int16: mid-kernel
-    # promotion is free, the store casts back to the plane dtype
+    # promotion is free, the store casts back to the plane dtype —
+    # corrolint's dtype-widen rule (analysis/dtypes.py NARROW_REFS)
+    # enforces exactly this cast-at-the-store shape
     o_timer[:] = timer.astype(o_timer.dtype)
     o_tx[:] = tx.astype(o_tx.dtype)
     o_inc[:] = inc[:, None]

@@ -162,6 +162,15 @@ def sharded_run(cfg: SimConfig, mesh: Mesh, st, net, key, inputs):
 # 100k-capable simulator with the carry DONATED — at 100k nodes the scan
 # carry is the HBM working set, and an un-donated dispatch would hold
 # two copies of it across every call boundary (bench rep, soak segment).
+#
+# Changing donate_argnums here REQUIRES updating
+# ``analysis/donation.py::KNOWN_DONATING`` — enforced by
+# ``tests/test_analysis_v2.py::test_known_donating_matches_runtime``,
+# which traces these jits and compares the donated leaf set against the
+# registry. These wrappers are also the sharding-contract checker's
+# taint sources (``analysis/sharding.py``): their state args must come
+# placed through ``shard_state`` and their outputs must never be
+# host-materialized outside the drain registry.
 
 
 @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))

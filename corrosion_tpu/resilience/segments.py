@@ -89,6 +89,12 @@ def _n_rounds(inputs) -> int:
     return int(jax.tree.leaves(inputs)[0].shape[0])
 
 
+#: the jit used for segment dispatch — a module attribute so the
+#: trace-stability harness (``analysis/tracecount.py``) can wrap it
+#: with a compile counter without patching ``jax.jit`` globally
+_jit = jax.jit
+
+
 def _pipeline_stats(donate: bool, async_checkpoint: bool) -> dict:
     """A zeroed stats record (the keys every SoakResult.stats carries)."""
     return {
@@ -117,6 +123,7 @@ def _host_copy(tree):
         copy_async = getattr(leaf, "copy_to_host_async", None)
         if copy_async is not None:
             copy_async()
+    # corrolint: disable=shard-gather -- tracked debt: drains a replicated view of the whole carry through one host; the per-shard-checkpoint ROADMAP item replaces this with per-shard slice writes
     return jax.tree.map(lambda a: np.array(a), tree)
 
 
@@ -201,7 +208,7 @@ def run_segmented(
     def dispatch(st, key, seg_inputs, donate_now: bool):
         n = (_n_rounds(seg_inputs), donate_now)
         if n not in jitted:
-            jitted[n] = jax.jit(
+            jitted[n] = _jit(
                 lambda s, k, i: run_carry(cfg, s, net, k, i),
                 donate_argnums=((0, 1) if donate_now else ()),
             )

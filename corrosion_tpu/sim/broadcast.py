@@ -554,7 +554,12 @@ def bcast_step(
     # --- sender-side budget decrement, free exhausted slots -------------
     # one "transmission" = one flush to the fanout set; decrement on the
     # attempt (the sender cannot observe datagram loss)
-    attempted = (live_slot & jnp.any(t_ok, axis=1)[:, None]).astype(jnp.int32)
+    # plane-dtype arithmetic (same idiom as piggyback_bcast_step): the
+    # decrement must not widen q_tx — under narrow_dtypes the plane is
+    # int16 and an int32 result would double its HBM traffic and change
+    # the carry aval
+    attempted = (live_slot & jnp.any(t_ok, axis=1)[:, None]).astype(
+        cst.q_tx.dtype)
     q_tx = jnp.where(live_slot, cst.q_tx - attempted, cst.q_tx)
     exhausted = (cst.q_origin != NO_Q) & (q_tx <= 0)
     cst = cst._replace(

@@ -138,8 +138,11 @@ class ScaleSimConfig:
     # wall-clock spread the round model abstracts anyway)
     sync_cohort: bool = True
     # dtype narrowing (PERF.md cut #4): small-range planes (mem_timer,
-    # mem_tx, q_cell, q_seq, q_nseq, q_tx, last_sync) live as int16 in
-    # HBM; compute widens freely (XLA fuses the converts) and the round
+    # mem_tx, q_cell, q_seq, q_nseq, q_tx, last_sync — mirrored in
+    # corrolint's analysis/dtypes.py::NARROW_LEAVES registry, whose
+    # dtype-widen rule flags any silent widening at these boundaries)
+    # live as int16 in HBM; compute widens freely (XLA fuses the
+    # converts) and the round
     # step re-narrows once on carry-out — the scan carry (the HBM
     # working set between rounds) halves for those planes. Default ON
     # (round 4): narrow == wide is pinned bit-for-bit, the CPU A/B

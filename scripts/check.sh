@@ -4,6 +4,11 @@
 #   scripts/check.sh            # lint + tier-1
 #   scripts/check.sh --lint     # lint only (fast, no jax compile)
 #
+# Lint scope since corrolint v2: the package PLUS bench.py and
+# scripts/ — everything that drives the hot entry points. Findings are
+# also published machine-readably (rule counts + per-finding records)
+# to artifacts/lint_r06.json for trend tracking across PRs.
+#
 # The same analyzer also rides tier-1 itself
 # (tests/test_analysis.py::test_repo_is_clean), so running the pytest
 # command alone still enforces the lint gate; this script just fails
@@ -12,8 +17,9 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 echo "== corrolint =="
-python -m corrosion_tpu.analysis corrosion_tpu
-echo "corrolint: clean"
+python -m corrosion_tpu.analysis corrosion_tpu bench.py scripts \
+    --output-json artifacts/lint_r06.json
+echo "corrolint: clean (report: artifacts/lint_r06.json)"
 
 if [[ "${1:-}" == "--lint" ]]; then
     exit 0

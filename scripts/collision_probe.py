@@ -73,7 +73,11 @@ def main() -> None:
         # engine; its cadence bounds store convergence latency
         overrides["sync_sweep_every"] = int(os.environ["COLL_SWEEP"])
     cfg = scale_sim_config(n, n_origins=slots, **overrides)
-    assert cfg.any_writer, "collision probe needs the unbounded writer set"
+    if not cfg.any_writer:
+        raise ValueError(
+            "collision probe needs the unbounded writer set "
+            "(cfg.any_writer)"
+        )
     net = NetModel.create(n, drop_prob=0.01)
     st = ScaleSimState.create(cfg)
     key = jr.key(0)

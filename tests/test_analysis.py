@@ -453,10 +453,20 @@ def _package_dir():
 def test_repo_is_clean():
     """The shipped tree passes its own analyzer — the tier-1 lint gate.
 
-    Every finding must be fixed or suppressed-with-reason; this is the
-    same engine the CLI runs, so CI and `python -m
-    corrosion_tpu.analysis` can never disagree."""
-    findings = run_paths([_package_dir()])
+    Scope since v2: the package plus ``bench.py`` and ``scripts/``
+    (everything driving the hot entry points). Every finding must be
+    fixed or suppressed-with-reason; this is the same engine the CLI
+    runs, so CI and `python -m corrosion_tpu.analysis` can never
+    disagree."""
+    import os
+
+    repo = os.path.dirname(_package_dir())
+    paths = [_package_dir()]
+    for extra in ("bench.py", "scripts"):
+        candidate = os.path.join(repo, extra)
+        if os.path.exists(candidate):  # absent in installed-package runs
+            paths.append(candidate)
+    findings = run_paths(paths)
     assert findings == [], "\n" + "\n".join(f.render() for f in findings)
 
 
@@ -508,7 +518,7 @@ def test_hot_entry_points_compile_once():
     counts = assert_trace_stable(repeats=3)
     assert set(counts) == {
         "full_sim_step", "scale_sim_step", "segment_dispatch",
-        "sharded_scale_run",
+        "sharded_scale_run", "segmented_soak",
     }
 
 
