@@ -46,10 +46,11 @@ class AdminServer:
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> "AdminServer":
-        self._thread = threading.Thread(
-            target=self.server.serve_forever, name="admin-uds", daemon=True
+        from corrosion_tpu.utils.lifecycle import spawn_counted
+
+        self._thread = spawn_counted(
+            self.server.serve_forever, name="corro-admin-uds"
         )
-        self._thread.start()
         return self
 
     def stop(self) -> None:

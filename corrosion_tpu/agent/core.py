@@ -223,7 +223,7 @@ class Agent:
         if auto_recover:
             self.recover_latest()
         self._thread = spawn_counted(
-            self._run_loop, pace_seconds, name="agent-round-loop"
+            self._run_loop, pace_seconds, name="corro-agent-round-loop"
         )
         return self
 
@@ -466,6 +466,7 @@ class Agent:
                 raise
 
         with self._input_lock:
+            listeners = list(self._listeners)
             if self.generation != gen:
                 # a restore applied while this round was in flight (e.g.
                 # crash recovery rolling back): its result was computed
@@ -509,7 +510,7 @@ class Agent:
             self._round_cv.notify_all()
         for ev in waiters:
             ev.set()
-        for hook in list(self._listeners):
+        for hook in listeners:
             try:
                 hook(self.round_no)
             except Exception:  # noqa: BLE001 — a bad subscriber must not kill the loop
@@ -526,11 +527,17 @@ class Agent:
             ) and self.round_no >= target
 
     def add_round_listener(self, hook):
-        self._listeners.append(hook)
+        # under _input_lock: registration is how the pubsub managers
+        # PUBLISH themselves (and everything they built) to the round
+        # thread — an unlocked append would hand the hook over with no
+        # happens-before edge to its owner's construction (corrosan)
+        with self._input_lock:
+            self._listeners.append(hook)
 
     def remove_round_listener(self, hook) -> None:
-        if hook in self._listeners:
-            self._listeners.remove(hook)
+        with self._input_lock:
+            if hook in self._listeners:
+                self._listeners.remove(hook)
 
     # --- write path (transactions) --------------------------------------
     def write(self, node: int, cell: int, value: int, wait: bool = True,

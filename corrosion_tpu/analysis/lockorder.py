@@ -79,12 +79,18 @@ def _self_attr(node) -> Optional[str]:
 
 def _collect_locks(project: Project) -> Tuple[
         Dict[Tuple[str, str, str], LockNode],
-        Dict[Tuple[str, str], LockNode]]:
+        Dict[Tuple[str, str], LockNode],
+        Dict[LockNode, Tuple[str, int]]]:
     """(class locks keyed by (module, class name, attr) — two
     same-named classes in different modules own DIFFERENT locks —
-    module locks keyed by (module name, var))."""
+    module locks keyed by (module name, var), creation sites keyed by
+    node). The creation site is the line of the ``threading.Lock()``
+    call itself — the runtime sanitizer names live lock objects by
+    matching the frame that executes that line, so the dynamic witness
+    and this static graph share one node namespace."""
     class_locks: Dict[Tuple[str, str, str], LockNode] = {}
     module_locks: Dict[Tuple[str, str], LockNode] = {}
+    sites: Dict[LockNode, Tuple[str, int]] = {}
     for mod in project.modules:
         for top in mod.tree.body:
             if isinstance(top, ast.Assign) and isinstance(
@@ -93,8 +99,10 @@ def _collect_locks(project: Project) -> Tuple[
                 if kind:
                     for tgt in top.targets:
                         if isinstance(tgt, ast.Name):
-                            module_locks[(mod.name, tgt.id)] = LockNode(
+                            lock = LockNode(
                                 f"{mod.name}.{tgt.id}", kind)
+                            module_locks[(mod.name, tgt.id)] = lock
+                            sites[lock] = (mod.path, top.value.lineno)
             if not isinstance(top, ast.ClassDef):
                 continue
             # walk the class's own body without descending into nested
@@ -114,9 +122,11 @@ def _collect_locks(project: Project) -> Tuple[
                 for tgt in node.targets:
                     attr = _self_attr(tgt)
                     if attr:
-                        class_locks[(mod.name, top.name, attr)] = LockNode(
+                        lock = LockNode(
                             f"{mod.name}.{top.name}.{attr}", kind)
-    return class_locks, module_locks
+                        class_locks[(mod.name, top.name, attr)] = lock
+                        sites[lock] = (mod.path, node.value.lineno)
+    return class_locks, module_locks, sites
 
 
 class _Edges:
@@ -275,8 +285,29 @@ def _find_cycles(edges: Dict[Tuple[LockNode, LockNode], List[Site]]
     return cycles
 
 
-def check_project(project: Project) -> List[Finding]:
-    class_locks, module_locks = _collect_locks(project)
+@dataclasses.dataclass
+class LockGraph:
+    """The static lock model: every lock in the walked set plus the
+    acquisition-order edges derived over the call graph. Consumed by
+    :func:`check_project` below AND by the runtime sanitizer
+    (``analysis/sanitizer``), whose witnessed edges must stay a subset
+    of ``edges`` — the static/dynamic cross-check ISSUE 8 is built on."""
+
+    class_locks: Dict[Tuple[str, str, str], LockNode]
+    module_locks: Dict[Tuple[str, str], LockNode]
+    #: LockNode -> (path, line) of the ``threading.Lock()`` call
+    creation_sites: Dict[LockNode, Tuple[str, int]]
+    edges: Dict[Tuple[LockNode, LockNode], List[Site]]
+
+    def edge_names(self) -> Set[Tuple[str, str]]:
+        return {(a.name, b.name) for (a, b) in self.edges}
+
+
+def build_lock_graph(project: Project) -> LockGraph:
+    """Collect every lock and every statically-derivable acquisition
+    edge (direct ``with`` nesting + transitive-acquire call summaries
+    run to a fixed point)."""
+    class_locks, module_locks, sites = _collect_locks(project)
 
     def summarize(fn: FunctionInfo, summaries):
         return _FnScan(fn, project, class_locks, module_locks,
@@ -287,6 +318,13 @@ def check_project(project: Project) -> List[Finding]:
     for fn in project.iter_functions():
         _FnScan(fn, project, class_locks, module_locks, summaries,
                 edges).run()
+    return LockGraph(class_locks=class_locks, module_locks=module_locks,
+                     creation_sites=sites, edges=edges.edges)
+
+
+def check_project(project: Project) -> List[Finding]:
+    edges = _Edges()
+    edges.edges = build_lock_graph(project).edges
 
     findings: List[Finding] = []
     # self-edges: non-reentrant re-acquisition (RLocks filtered above)

@@ -1,0 +1,64 @@
+"""pytest plugin: sanitize a whole test run.
+
+Opt-in via ``--corrosan`` or ``CORROSAN=1`` (the tier-1 command stays
+un-instrumented; ``scripts/check.sh`` runs the threaded test modules a
+second time under this plugin). One session-wide window opens at
+configure time — before test modules import, so module-level locks in
+late-imported code are instrumented too — and gates at session finish:
+
+- unsuppressed findings are printed and FAIL the run (exit status 1);
+- the run section of the report lands in ``CORROSAN_REPORT`` (default
+  ``artifacts/san_r08.json``), alongside the fixture-replay section the
+  ``corrosion-tpu san`` CLI writes.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def pytest_addoption(parser):
+    group = parser.getgroup("corrosan")
+    group.addoption(
+        "--corrosan", action="store_true", default=False,
+        help="instrument threading/locks/files with the corrosan "
+             "runtime sanitizer and gate the session on its findings",
+    )
+
+
+def _enabled(config) -> bool:
+    return bool(config.getoption("--corrosan")
+                or os.environ.get("CORROSAN") == "1")
+
+
+def pytest_configure(config):
+    if not _enabled(config):
+        return
+    from corrosion_tpu.analysis.sanitizer.runtime import Sanitizer
+
+    san = Sanitizer()
+    san.install()
+    config._corrosan = san
+
+
+def pytest_sessionfinish(session, exitstatus):
+    san = getattr(session.config, "_corrosan", None)
+    if san is None:
+        return
+    session.config._corrosan = None
+    san.uninstall()
+    findings = san.gate()
+    payload = san.report_payload(findings)
+    payload["pytest_exitstatus"] = int(exitstatus)
+    report_path = os.environ.get("CORROSAN_REPORT",
+                                 os.path.join("artifacts", "san_r08.json"))
+    from corrosion_tpu.analysis.sanitizer.report import write_section
+
+    write_section(report_path, "pytest", payload)
+    print(f"\ncorrosan: {len(payload['witnessed_edges'])} witnessed lock "
+          f"edges, {payload['threads_spawned']} threads spawned, "
+          f"{len(findings)} finding(s) (report: {report_path})")
+    if findings:
+        for f in findings:
+            print(f"corrosan: {f.render()}")
+        session.exitstatus = 1

@@ -99,10 +99,14 @@ class ApiServer:
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> "ApiServer":
-        self._thread = threading.Thread(
-            target=self.httpd.serve_forever, name="api-http", daemon=True
+        from corrosion_tpu.utils.lifecycle import spawn_counted
+
+        # counted + corro- named (ISSUE 8): stop() drains serve_forever
+        # and joins, so the shutdown barrier and the sanitizer's leak
+        # gate both see an attributable, finishing thread
+        self._thread = spawn_counted(
+            self.httpd.serve_forever, name="corro-api-http"
         )
-        self._thread.start()
         return self
 
     def stop(self) -> None:

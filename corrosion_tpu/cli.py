@@ -444,6 +444,23 @@ def cmd_lint(args) -> int:
     return lint_main(argv)
 
 
+def cmd_san(args) -> int:
+    """corrosan fixture replay (same engine as
+    ``python -m corrosion_tpu.analysis.sanitizer``): seeded
+    race/leak/inversion scenarios the runtime sanitizer must detect,
+    with verdicts published to the shared report artifact."""
+    from corrosion_tpu.analysis.sanitizer.__main__ import main as san_main
+
+    argv = list(args.fixtures or [])
+    if args.list_fixtures:
+        argv = ["--list-fixtures"] + argv
+    if args.format != "text":
+        argv = ["--format", args.format] + argv
+    if args.output_json is not None:
+        argv = ["--output-json", args.output_json] + argv
+    return san_main(argv)
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="corrosion-tpu",
@@ -589,6 +606,20 @@ def build_parser() -> argparse.ArgumentParser:
     lint.add_argument("--output-json", metavar="PATH", default=None,
                       help="write a machine-readable findings report")
     lint.set_defaults(fn=cmd_lint)
+
+    san = sub.add_parser(
+        "san", help="corrosan runtime sanitizer: replay seeded "
+                    "race/leak fixtures (detector true-positive guard); "
+                    "the sanitized pytest run itself is CORROSAN=1 / "
+                    "--corrosan on the test command")
+    san.add_argument("fixtures", nargs="*", default=None,
+                     help="fixture names (default: all)")
+    san.add_argument("--list-fixtures", action="store_true")
+    san.add_argument("--format", choices=("text", "json"), default="text")
+    san.add_argument("--output-json", metavar="PATH", default=None,
+                     help="write the fixtures section of the corrosan "
+                          "report artifact")
+    san.set_defaults(fn=cmd_san)
 
     d = sub.add_parser("default-config", help="print an example config file")
     d.set_defaults(fn=cmd_default_config)

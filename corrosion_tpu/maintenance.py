@@ -68,10 +68,9 @@ class MaintenanceLoop:
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> "MaintenanceLoop":
-        self._thread = threading.Thread(
-            target=self._loop, name="db-maintenance", daemon=True
-        )
-        self._thread.start()
+        from corrosion_tpu.utils.lifecycle import spawn_counted
+
+        self._thread = spawn_counted(self._loop, name="corro-db-maintenance")
         return self
 
     def _loop(self) -> None:

@@ -508,10 +508,11 @@ class PgServer:
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> "PgServer":
-        self._thread = threading.Thread(
-            target=self.server.serve_forever, name="pg-wire", daemon=True
+        from corrosion_tpu.utils.lifecycle import spawn_counted
+
+        self._thread = spawn_counted(
+            self.server.serve_forever, name="corro-pg-wire"
         )
-        self._thread.start()
         return self
 
     def stop(self) -> None:

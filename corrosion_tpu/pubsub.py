@@ -454,18 +454,37 @@ class SubsManager:
         self._persist_thread: Optional[threading.Thread] = None
         db.agent.add_round_listener(self._on_round)
         if persist_dir:
+            import sys
+
+            from corrosion_tpu.utils.lifecycle import spawn_counted
+
             os.makedirs(persist_dir, exist_ok=True)
+            # a corrosan session (if one is active) witnesses manifest
+            # write/delete ordering under this root — the PR-5
+            # unsubscribe-vs-persist resurrection is detected here.
+            # Resolved via sys.modules so the production path never
+            # imports the sanitizer: any live session necessarily
+            # already imported the hooks module.
+            san_hooks = sys.modules.get(
+                "corrosion_tpu.analysis.sanitizer.hooks")
+            if san_hooks is not None:
+                san_hooks.watch_dir(persist_dir)
             # manifests are written off-thread: a large materialized state
-            # must not stall the agent round loop
-            self._persist_thread = threading.Thread(
-                target=self._persist_worker, name="subs-persist", daemon=True
+            # must not stall the agent round loop. Counted + corro- named:
+            # close() joins it, and leak reports name the owner.
+            self._persist_thread = spawn_counted(
+                self._persist_worker, name="corro-subs-persist"
             )
-            self._persist_thread.start()
 
     PERSIST_EVERY = 16  # rounds between manifest re-writes per dirty matcher
 
     def _on_round(self, round_no: int) -> None:
-        matchers = list(self._matchers.values())
+        # snapshot under _mu: subscribe() publishes freshly-built
+        # matchers through this dict, and an unlocked read would hand
+        # the round thread a matcher with no happens-before edge to its
+        # construction (corrosan attr-race on the init attrs)
+        with self._mu:
+            matchers = list(self._matchers.values())
         # one delta computation per observed node, shared by all its
         # matchers (None on the node's first round = full re-query)
         cands: Dict[int, Optional[Dict[str, set]]] = {}
@@ -487,8 +506,11 @@ class SubsManager:
         # resumes the change-id sequence close to where it stopped; a
         # stale manifest is safe: restore re-diffs from the persisted
         # state, skips a max_log id alias gap, and attach() treats
-        # from>last_change_id as backlog-lost
-        if self._dirty and round_no % self.PERSIST_EVERY == 0:
+        # from>last_change_id as backlog-lost. The dirty check runs
+        # under _mu (corrosan attr-race: the old unlocked `if
+        # self._dirty` fast path raced close()'s swap) — the cadence
+        # check alone keeps the common round lock-free.
+        if round_no % self.PERSIST_EVERY == 0:
             with self._mu:
                 dirty, self._dirty = self._dirty, set()
             for mid in dirty:

@@ -120,10 +120,9 @@ class AsyncCheckpointWriter:
         self.io_seconds = 0.0
         self.written = 0
         self.overlapped = 0
-        self._thread = threading.Thread(
-            target=self._run, name="async-ckpt-writer", daemon=True
-        )
-        self._thread.start()
+        from corrosion_tpu.utils.lifecycle import spawn_counted
+
+        self._thread = spawn_counted(self._run, name="corro-async-ckpt")
 
     def _raise_pending(self) -> None:
         with self._mu:

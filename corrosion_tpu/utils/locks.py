@@ -104,6 +104,7 @@ class LockRegistry:
             while not stop.wait(interval):
                 self.check()
 
-        t = threading.Thread(target=loop, daemon=True, name="lock-watchdog")
+        t = threading.Thread(target=loop, daemon=True,
+                             name="corro-lock-watchdog")
         t.start()
         return stop

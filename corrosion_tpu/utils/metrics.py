@@ -180,7 +180,8 @@ def start_prometheus_listener(registry: Registry, addr: str = "127.0.0.1",
     metrics on a dedicated telemetry address, ``command/agent.rs:114-139``).
     Returns the HTTPServer; call ``.shutdown()`` to stop."""
     import http.server
-    import threading
+
+    from corrosion_tpu.utils.lifecycle import spawn_counted
 
     class Handler(http.server.BaseHTTPRequestHandler):
         def do_GET(self):
@@ -196,6 +197,7 @@ def start_prometheus_listener(registry: Registry, addr: str = "127.0.0.1",
 
     httpd = http.server.ThreadingHTTPServer((addr, port), Handler)
     httpd.daemon_threads = True
-    threading.Thread(target=httpd.serve_forever, name="prometheus",
-                     daemon=True).start()
+    # counted + corro- named: .shutdown() drains serve_forever, so the
+    # lifecycle barrier sees it finish, and leak reports name the owner
+    spawn_counted(httpd.serve_forever, name="corro-prometheus")
     return httpd

@@ -1,18 +1,27 @@
 #!/usr/bin/env bash
-# The local CI gate: corrolint static analysis + tier-1 tests.
+# The local CI gate: corrolint static analysis + corrosan runtime
+# sanitizer + tier-1 tests.
 #
-#   scripts/check.sh            # lint + tier-1
+#   scripts/check.sh            # lint + corrosan + tier-1
 #   scripts/check.sh --lint     # lint only (fast, no jax compile)
+#   scripts/check.sh --san      # lint + corrosan (skip plain tier-1)
 #
 # Lint scope since corrolint v2: the package PLUS bench.py and
 # scripts/ — everything that drives the hot entry points. Findings are
-# also published machine-readably (rule counts + per-finding records)
-# to artifacts/lint_r06.json for trend tracking across PRs.
+# published machine-readably to artifacts/lint_r06.json.
 #
-# The same analyzer also rides tier-1 itself
-# (tests/test_analysis.py::test_repo_is_clean), so running the pytest
-# command alone still enforces the lint gate; this script just fails
-# faster and prints findings directly.
+# corrosan (ISSUE 8) publishes artifacts/san_r08.json with two
+# sections: "fixtures" (seeded-race replay verdicts via
+# `corrosion-tpu san`) and "pytest" (the threaded test modules re-run
+# under CORROSAN=1: witnessed lock edges diffed against corrolint's
+# static graph, race/leak findings — the run FAILS on any unsuppressed
+# finding).
+#
+# The same analyzers also ride tier-1 itself
+# (tests/test_analysis.py::test_repo_is_clean, tests/test_corrosan.py),
+# so running the pytest command alone still enforces both gates; this
+# script fails faster, prints findings directly, and exercises the
+# full sanitized module sweep.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -22,6 +31,23 @@ python -m corrosion_tpu.analysis corrosion_tpu bench.py scripts \
 echo "corrolint: clean (report: artifacts/lint_r06.json)"
 
 if [[ "${1:-}" == "--lint" ]]; then
+    exit 0
+fi
+
+echo "== corrosan: seeded-fixture replay =="
+env JAX_PLATFORMS=cpu python -m corrosion_tpu.analysis.sanitizer \
+    --output-json artifacts/san_r08.json
+
+echo "== corrosan: sanitized threaded-module sweep =="
+env CORROSAN=1 CORROSAN_REPORT=artifacts/san_r08.json JAX_PLATFORMS=cpu \
+    python -m pytest \
+    tests/test_pubsub_incremental.py tests/test_resilience.py \
+    tests/test_agent.py tests/test_http_api.py tests/test_pg.py \
+    tests/test_maintenance.py \
+    -q -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+echo "corrosan: clean (report: artifacts/san_r08.json)"
+
+if [[ "${1:-}" == "--san" ]]; then
     exit 0
 fi
 

@@ -44,7 +44,15 @@ enable_compile_cache()
 # (observed twice at the same index, in backend_compile_and_load).
 # Dropping the in-memory jit caches between modules caps the
 # accumulation; the persistent disk cache makes the recompiles cheap.
+import re  # noqa: E402
+import threading  # noqa: E402
+
 import pytest  # noqa: E402
+
+# corrosan (ISSUE 8): the runtime sanitizer rides every run as an
+# inert plugin; `--corrosan` / CORROSAN=1 arms it (scripts/check.sh
+# runs the threaded modules under it and publishes artifacts/san_r08.json)
+pytest_plugins = ("corrosion_tpu.analysis.sanitizer.plugin",)
 
 
 def pytest_configure(config):
@@ -60,6 +68,33 @@ def pytest_configure(config):
 def _clear_jax_caches_between_modules():
     yield
     jax.clear_caches()
+
+
+# every thread this repo spawns is daemonic AND carries a corro-* (or
+# at least an explicit) name, so sanitizer/leak reports stay
+# attributable (ISSUE 8 satellite). A surviving unnamed non-daemon
+# thread is a shutdown bug: it would block interpreter exit and nobody
+# can tell whose it is. "Thread-N"/"Thread-N (target)" are the
+# interpreter's auto-names, i.e. a spawn nobody bothered to label.
+_AUTO_THREAD_NAME = re.compile(r"Thread-\d+( \(.*\))?$")
+
+
+@pytest.fixture(autouse=True)
+def _no_unnamed_nondaemon_thread_survives():
+    # snapshot Thread OBJECTS, not idents: the OS reuses idents after a
+    # thread dies, so an offender could hide behind a recycled ident
+    before = set(threading.enumerate())
+    yield
+    offenders = [
+        t for t in threading.enumerate()
+        if t not in before and t.is_alive() and not t.daemon
+        and _AUTO_THREAD_NAME.fullmatch(t.name or "")
+    ]
+    if offenders:
+        pytest.fail(
+            "unnamed non-daemon thread(s) survived the test: "
+            + ", ".join(repr(t) for t in offenders)
+        )
 
 
 @pytest.fixture(autouse=True, scope="session")

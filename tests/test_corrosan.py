@@ -1,0 +1,254 @@
+"""corrosan: runtime sanitizer + leak gate (ISSUE 8).
+
+Three layers of guarantees pinned here:
+
+1. **fixture verdicts** — every seeded race/leak fixture is detected
+   and every clean twin passes (no false negatives on fixtures, no
+   false positives on the fixed shapes), including the PR-5 pubsub
+   unsubscribe-vs-persist regression pair against the real
+   ``SubsManager``;
+2. **witnessed ⊆ static** — a sanitized battery driving the real
+   threaded stack (agent round loop, subscriptions, updates feeds,
+   HTTP API, persist worker) runs sanitizer-clean, actually witnesses
+   the static graph's cross-class edge, and every named witnessed edge
+   is inside corrolint's static lock-order graph ∪ the reasoned
+   allowlist — the two models cannot silently drift;
+3. **plumbing** — locks born at registered creation sites get their
+   static names (otherwise the subset check would be vacuously green),
+   spawned threads carry the ``corro-`` prefix, the report artifact
+   has its schema, and the allowlist can never go stale against the
+   static graph.
+"""
+
+import json
+import os
+import threading
+
+import pytest
+
+from corrosion_tpu.analysis.sanitizer import (
+    KINDS,
+    run_all_fixtures,
+    run_fixture,
+    sanitized,
+    static_lock_graph,
+)
+from corrosion_tpu.analysis.sanitizer.allowlist import (
+    ALLOWED_ATTR_RACES,
+    ALLOWED_LOCK_EDGES,
+    ALLOWED_LEAK_PREFIXES,
+)
+from corrosion_tpu.config import Config
+
+
+def small_config():
+    cfg = Config()
+    cfg.sim.n_nodes = 16
+    cfg.sim.m_slots = 8
+    cfg.sim.n_origins = 4
+    cfg.sim.n_rows = 8
+    cfg.sim.n_cols = 2
+    cfg.gossip.drop_prob = 0.0
+    return cfg
+
+
+# --- 1. fixture verdicts ---------------------------------------------------
+
+def test_seeded_fixtures_detected():
+    """Every non-jax seeded fixture: bugs flagged, clean twins pass."""
+    results = run_all_fixtures([
+        "race-unlocked", "race-locked", "lock-inversion",
+        "lock-nested-clean", "thread-leak", "fd-leak", "executor-leak",
+    ])
+    bad = [r for r in results if not r.ok]
+    assert not bad, "fixture verdict mismatches:\n" + "\n".join(
+        f"{r.name}: expected {r.expect or ('clean',)}, got "
+        f"{r.found or ('clean',)}\n  " + "\n  ".join(r.details)
+        for r in bad
+    )
+
+
+def test_pubsub_unsub_vs_persist_regression():
+    """The PR-5 race, re-provoked under corrosan with a forced
+    interleaving: the reverted worker must be flagged (true-positive
+    guard for the whole detector), the shipped worker must pass."""
+    reverted = run_fixture("pubsub-resurrect-reverted")
+    assert reverted.ok, (
+        "sanitizer MISSED the reverted unsubscribe-vs-persist race "
+        f"(found only: {reverted.found})"
+    )
+    assert "fs-resurrect" in reverted.found
+    fixed = run_fixture("pubsub-resurrect-fixed")
+    assert fixed.ok, (
+        "sanitizer flagged the FIXED persist worker:\n"
+        + "\n".join(fixed.details)
+    )
+
+
+# --- 2. witnessed ⊆ static -------------------------------------------------
+
+def test_sanitized_battery_clean_and_witness_subset_of_static(tmp_path):
+    """Drive the real threaded stack under one sanitized window: the
+    run must be sanitizer-clean, must actually witness the static
+    graph's SubsManager -> Matcher edge (proof the pairing observes
+    something), and every named witnessed edge must be in the static
+    graph ∪ ALLOWED_LOCK_EDGES."""
+    import urllib.request
+
+    with sanitized() as san:
+        from corrosion_tpu.agent import Agent
+        from corrosion_tpu.api import ApiServer
+        from corrosion_tpu.db import Database
+        from corrosion_tpu.pubsub import SubsManager, UpdatesManager
+        from corrosion_tpu.resilience import Supervisor
+
+        sup = Supervisor(deadline_seconds=300.0)
+        agent = Agent(small_config()).start(supervisor=sup)
+        try:
+            db = Database(agent)
+            db.apply_schema_sql(
+                "CREATE TABLE t (pk INTEGER PRIMARY KEY, v INTEGER);"
+            )
+            mgr = SubsManager(db, persist_dir=str(tmp_path / "subs"))
+            matcher, _ = mgr.subscribe(0, "SELECT pk, v FROM t")
+            live_q = matcher.attach()
+            upd = UpdatesManager(db)
+            feed_q = upd.attach("t")
+            api = ApiServer(db, subs=mgr, updates=upd).start()
+            for i in range(4):
+                db.execute(
+                    0, [(f"INSERT INTO t (pk, v) VALUES ({i}, {i * 7})",)]
+                )
+            assert agent.wait_rounds(3, timeout=300)
+            with urllib.request.urlopen(
+                f"http://{api.addr}:{api.port}/v1/health", timeout=30
+            ) as resp:
+                assert json.load(resp)["round"] >= 0
+            mgr.unsubscribe(matcher.id)
+            assert agent.wait_rounds(2, timeout=300)
+            upd.detach("t", feed_q)
+            api.stop()
+            mgr.close()
+        finally:
+            agent.shutdown()
+
+    findings = san.gate()
+    assert not findings, (
+        "sanitized battery is not clean:\n"
+        + "\n".join(f.render() for f in findings)
+    )
+    named = san.witness.named_edges()
+    assert (
+        "corrosion_tpu.pubsub.SubsManager._mu",
+        "corrosion_tpu.pubsub.Matcher._mu",
+    ) in named, f"the static cross-class edge was never witnessed: {named}"
+    static_names = static_lock_graph().edge_names()
+    extra = named - static_names - set(ALLOWED_LOCK_EDGES)
+    assert not extra, (
+        f"witnessed lock edges outside static graph + allowlist: {extra}"
+    )
+    # the battery exercised real spawns, and all of them wound down
+    assert san.leaks.spawned_count() > 10
+
+
+# --- 3. plumbing -----------------------------------------------------------
+
+def test_runtime_locks_get_static_names():
+    """Locks born at registered creation sites must resolve to their
+    static nodes — if this breaks, the subset check silently degrades
+    to comparing nothing."""
+    with sanitized():
+        from corrosion_tpu.resilience.supervisor import Supervisor
+        from corrosion_tpu.utils.locks import LockRegistry
+
+        sup = Supervisor()
+        registry = LockRegistry()
+        tracked = registry.lock("probe")
+        anon = threading.Lock()
+    sup_node = getattr(sup._mu, "san_node", None)
+    assert sup_node is not None and sup_node.name == (
+        "corrosion_tpu.resilience.supervisor.Supervisor._mu"
+    )
+    reg_node = getattr(registry._mu, "san_node", None)
+    assert reg_node is not None and reg_node.name == (
+        "corrosion_tpu.utils.locks.LockRegistry._mu"
+    )
+    inner = getattr(tracked._lock, "san_node", None)
+    assert inner is not None and inner.name == (
+        "corrosion_tpu.utils.locks.TrackedLock._lock"
+    )
+    assert getattr(anon, "san_node", None) is None
+
+
+def test_allowlists_cannot_go_stale():
+    """Every allow-listed lock node must still EXIST in the static
+    graph (a renamed/moved lock must invalidate its entry), and every
+    entry of every allowlist must carry a reason."""
+    nodes = {n.name for n in static_lock_graph().creation_sites}
+    for (frm, to), reason in ALLOWED_LOCK_EDGES.items():
+        assert frm in nodes, f"allowlisted lock {frm} no longer exists"
+        assert to in nodes, f"allowlisted lock {to} no longer exists"
+        assert reason.strip()
+    for table in (ALLOWED_ATTR_RACES, ALLOWED_LEAK_PREFIXES):
+        for key, reason in table.items():
+            assert str(reason).strip(), f"{key} has no reason"
+
+
+def test_spawns_carry_corro_prefix():
+    """ISSUE 8 satellite: the host plane's background threads are
+    attributable by name in sanitizer and leak reports."""
+    from corrosion_tpu.agent import Agent
+    from corrosion_tpu.api import ApiServer
+    from corrosion_tpu.db import Database
+
+    agent = Agent(small_config()).start()
+    try:
+        db = Database(agent)
+        api = ApiServer(db).start()
+        try:
+            names = {t.name for t in threading.enumerate()}
+            assert "corro-agent-round-loop" in names
+            assert "corro-api-http" in names
+        finally:
+            api.stop()
+    finally:
+        agent.shutdown()
+
+
+def test_report_artifact_schema(tmp_path):
+    """The CLI's fixture replay writes the shared report artifact with
+    the documented shape (docs/corrosan.md JSON schema section)."""
+    from corrosion_tpu.analysis.sanitizer.__main__ import main as san_main
+    from corrosion_tpu.analysis.sanitizer.report import load_section
+
+    out = str(tmp_path / "san.json")
+    rc = san_main(["race-unlocked", "race-locked", "--output-json", out,
+                   "--format", "json"])
+    assert rc == 0
+    with open(out) as f:
+        doc = json.load(f)
+    assert doc["tool"] == "corrosan"
+    section = doc["sections"]["fixtures"]
+    assert load_section(out, "fixtures") == section
+    assert load_section(out, "pytest") is None
+    assert section["ok"] is True
+    names = {r["name"] for r in section["results"]}
+    assert names == {"race-unlocked", "race-locked"}
+    for r in section["results"]:
+        assert set(r) >= {"name", "expect", "found", "ok", "details"}
+
+
+def test_finding_kinds_documented():
+    """Every corrosan finding kind appears in docs/corrosan.md — the
+    human catalog cannot drift from the code (the corrolint doc
+    meta-test pattern)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    doc_path = os.path.join(repo, "docs", "corrosan.md")
+    if not os.path.exists(doc_path):
+        pytest.skip("docs/ not shipped in this environment")
+    with open(doc_path) as f:
+        doc = f.read()
+    missing = [kind for kind in KINDS if kind not in doc]
+    assert not missing, f"kinds missing from docs/corrosan.md: {missing}"
+    for fixture_name in ("pubsub-resurrect-reverted", "race-unlocked"):
+        assert fixture_name in doc
