@@ -393,12 +393,26 @@ def _smoke() -> None:
     rps = rounds / (time.perf_counter() - t0)
 
     # --- (b) segmented soak, overlapped checkpointing --------------------
+    # sharded over every available device when the process has more
+    # than one, so the record shows the per-shard checkpoint drain:
+    # ckpt_shard_bytes_max must be a per-device slice of the carry, not
+    # the whole state funneled through one host (ISSUE 9)
     soak_rounds = int(os.environ.get("BENCH_SMOKE_SOAK_ROUNDS", "12"))
     soak_inputs = make_soak_inputs(cfg, jr.key(3), soak_rounds,
                                    write_frac=0.25)
+    soak_st = ScaleSimState.create(cfg)
+    soak_net = net
+    n_devices = len(jax.devices())
+    if n_devices > 1:
+        from corrosion_tpu.parallel.mesh import make_mesh, shard_state
+
+        mesh = make_mesh()
+        soak_st = shard_state(mesh, n_nodes, soak_st)
+        soak_net = shard_state(mesh, n_nodes, soak_net)
+        soak_inputs = shard_state(mesh, n_nodes, soak_inputs)
     with tempfile.TemporaryDirectory() as tmp:
         res = run_segmented(
-            cfg, ScaleSimState.create(cfg), net, jr.key(4), soak_inputs,
+            cfg, soak_st, soak_net, jr.key(4), soak_inputs,
             segment_rounds=max(1, soak_rounds // 4), checkpoint_root=tmp,
         )
     stats = res.stats
@@ -414,6 +428,21 @@ def _smoke() -> None:
         # the check the smoke exists for: serialization/hash/IO crept
         # back onto the hot loop (stall should be the memcpy drain only)
         problems.append("checkpoint stall not overlapped (stall >= io)")
+    if n_devices > 1:
+        if stats.get("ckpt_shards", 0) != n_devices:
+            problems.append(
+                f"checkpoint drained {stats.get('ckpt_shards', 0)} "
+                f"shard(s) on a {n_devices}-device mesh"
+            )
+        else:
+            # the whole point of the per-shard drain: no single shard
+            # holds a whole checkpoint's state. drain_bytes accumulates
+            # over ALL checkpoints while shard_bytes_max is per-segment,
+            # so normalize to one checkpoint's drain before comparing
+            per_ckpt = stats.get("ckpt_drain_bytes", 0) / max(
+                1, stats.get("ckpt_written", 1))
+            if stats.get("ckpt_shard_bytes_max", 0) >= per_ckpt > 0:
+                problems.append("checkpoint drain did not split per shard")
     if elapsed > deadline_s:
         problems.append(f"deadline exceeded: {elapsed:.0f}s > {deadline_s:.0f}s")
     rec = {
@@ -434,6 +463,14 @@ def _smoke() -> None:
             "ckpt_written": stats.get("ckpt_written", 0),
             "ckpt_overlapped_segments": stats.get(
                 "ckpt_overlapped_segments", 0),
+            # per-shard drain telemetry (ISSUE 9): the largest single
+            # shard's drained bytes vs the total — a per-device slice
+            # of the carry, not the whole state through one host
+            "ckpt_shards": stats.get("ckpt_shards", 0),
+            "ckpt_drain_bytes": stats.get("ckpt_drain_bytes", 0),
+            "ckpt_shard_bytes_max": stats.get("ckpt_shard_bytes_max", 0),
+            "ckpt_serialize_s": round(
+                stats.get("ckpt_serialize_s", 0.0), 4),
         },
     }
     if problems:

@@ -23,6 +23,7 @@ device state exclusively.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from typing import Optional
@@ -39,6 +40,14 @@ from corrosion_tpu.utils.lifecycle import Tripwire, spawn_counted
 from corrosion_tpu.utils.locks import LockRegistry
 from corrosion_tpu.utils.metrics import Registry, RoundTimer, record_round_info
 from corrosion_tpu.utils.tracing import logger
+
+
+class _CarryConsumed(Exception):
+    """A donated round dispatch failed AFTER consuming the carry
+    buffers: there is nothing on-device left to retry with. Deliberately
+    a plain ``Exception`` (NOT RuntimeError) so the supervisor's retry
+    set never re-runs it — it propagates to the round loop, whose
+    checkpoint rollback is the generation-fenced re-upload story."""
 
 
 class Agent:
@@ -66,17 +75,20 @@ class Agent:
 
             self._state = ScaleSimState.create(self.cfg)
             self._quiet = ScaleRoundInput.quiet(self.cfg)
-            self._step = jax.jit(
-                lambda st, net, key, inp: scale_sim_step(self.cfg, st, net, key, inp)
+            self._step_fn = (
+                lambda st, net, key, inp:
+                scale_sim_step(self.cfg, st, net, key, inp)
             )
         else:
             from corrosion_tpu.sim.step import RoundInput, SimState, sim_step
 
             self._state = SimState.create(self.cfg)
             self._quiet = RoundInput.quiet(self.cfg)
-            self._step = jax.jit(
-                lambda st, net, key, inp: sim_step(self.cfg, st, net, key, inp)
+            self._step_fn = (
+                lambda st, net, key, inp:
+                sim_step(self.cfg, st, net, key, inp)
             )
+        self._step = jax.jit(self._step_fn)
 
         from corrosion_tpu.sim.transport import NetModel
 
@@ -124,6 +136,21 @@ class Agent:
         self._snapshot_host = None  # (round_no, store planes, heads, alive)
         self._thread = None
         self._listeners = []  # subscription manager hooks
+
+        # --- round-carry donation (ISSUE 9 satellite) -------------------
+        # with donation the round dispatch CONSUMES self._state's
+        # buffers (the scan carry is the HBM working set at flagship
+        # scale — an un-donated dispatch holds two copies). Readers and
+        # the donated dispatch are therefore mutually exclusive: a
+        # reader holds the state lease while copying, the round thread
+        # waits for zero leases before a donated dispatch and marks the
+        # state busy until the new carry is committed.
+        self._donate_rounds = bool(
+            getattr(self.config.perf, "donate_rounds", True))
+        self._donate_effective = False  # decided at start()
+        self._state_cv = threading.Condition()
+        self._state_readers = 0
+        self._state_busy = False
 
         # --- recovery / supervision (resilience subsystem) --------------
         # generation fences stale state: every applied restore bumps it,
@@ -186,14 +213,18 @@ class Agent:
         import json
         import os
 
-        st = self._state
-        alive = np.asarray(st.swim.alive)
-        inc = np.asarray(
-            getattr(st.swim, "inc", getattr(st.swim, "incarnation", None))
-        )
-        members = [
-            [int(i), int(inc[i])] for i in np.nonzero(alive)[0]
-        ]
+        with self._state_lease():
+            st = self._state
+            alive = np.asarray(st.swim.alive)
+            inc = np.asarray(
+                getattr(st.swim, "inc",
+                        getattr(st.swim, "incarnation", None))
+            )
+            # materialized to python ints INSIDE the lease: under round
+            # donation the views above die with the next dispatch
+            members = [
+                [int(i), int(inc[i])] for i in np.nonzero(alive)[0]
+            ]
         tmp = f"{path}.tmp"
         with open(tmp, "w") as f:
             json.dump({"round": self.round_no, "members": members}, f)
@@ -220,6 +251,18 @@ class Agent:
                 lambda: self.tripwire.tripped, sleep=self.tripwire.wait
             )
         self._auto_recover = auto_recover
+        # donate the round carry (config.perf.donate_rounds) when a
+        # failed donated dispatch has a re-upload story: either no
+        # supervisor retries it (failures already kill or roll back the
+        # loop) or auto_recover's checkpoint rollback restores the carry
+        # — the same rule the segmented runner applies (a supervised run
+        # without a snapshot keeps donation off)
+        self._donate_effective = (
+            self._donate_rounds
+            and (self._supervisor is None or auto_recover)
+        )
+        if self._donate_effective:
+            self._step = jax.jit(self._step_fn, donate_argnums=(0,))
         if auto_recover:
             self.recover_latest()
         self._thread = spawn_counted(
@@ -331,6 +374,10 @@ class Agent:
                             "down", self.config.db.path,
                         )
                         raise
+                    # the rollback re-uploaded a valid state — reopen
+                    # the reader window a consumed-carry failure left
+                    # closed (the generation fence discards the round)
+                    self._set_state_busy(False)
                     continue
                 if pace_seconds > 0:
                     left = pace_seconds - (time.perf_counter() - t0)
@@ -340,6 +387,10 @@ class Agent:
             logger.exception("round loop crashed; tripping shutdown")
         finally:
             self.tripwire.trip()
+            # never leave readers parked on a dead loop's busy window; a
+            # reader that then copies a consumed carry gets a loud
+            # deleted-buffer error from a dying agent, not a deadlock
+            self._set_state_busy(False)
             # wake everything parked on us: queued writers, round waiters,
             # and any restore staged after the last round started
             with self._input_lock:
@@ -363,12 +414,54 @@ class Agent:
             return
         state, ev, box = self._pend_restore
         self._pend_restore = None
-        self._state = jax.tree.map(jnp.asarray, state)
+        # jnp.array, NOT asarray: the upload must be an owned device
+        # copy. asarray zero-copy-adopts 64-byte-aligned numpy buffers
+        # (npz-loaded checkpoint leaves routinely are), and the next
+        # DONATED round dispatch would then free numpy-owned memory —
+        # observed as glibc heap corruption, not a clean error
+        self._state = jax.tree.map(jnp.array, state)
         # fence: any round result computed against the pre-restore state
         # is now stale and must not commit over this one
         self.generation += 1
         box["applied"] = True
         ev.set()
+
+    def _set_state_busy(self, value: bool) -> None:
+        with self._state_cv:
+            self._state_busy = value
+            if not value:
+                self._state_cv.notify_all()
+
+    def _carry_consumed(self) -> bool:
+        """True when a donated dispatch consumed ``self._state``'s
+        buffers (the tree then holds deleted arrays until a restore or
+        commit replaces it)."""
+        from corrosion_tpu.parallel.mesh import buffers_donated
+
+        return buffers_donated(self._state)
+
+    @contextlib.contextmanager
+    def _state_lease(self):
+        """Reader lease on the live device state.
+
+        With round-carry donation the dispatch CONSUMES ``self._state``'s
+        buffers mid-round; a reader that copied concurrently would read
+        freed device memory. The lease excludes readers from the donated
+        dispatch window (and vice versa) — readers must take OWNED
+        copies before releasing it. Un-donated agents skip the gate
+        entirely (immutable old buffers stay valid, today's behavior)."""
+        if not self._donate_effective:
+            yield
+            return
+        with self._state_cv:
+            self._state_cv.wait_for(lambda: not self._state_busy)
+            self._state_readers += 1
+        try:
+            yield
+        finally:
+            with self._state_cv:
+                self._state_readers -= 1
+                self._state_cv.notify_all()
 
     def _run_step(self, st, net, sub, inp):
         new_state, info = self._step(st, net, sub, inp)
@@ -378,11 +471,28 @@ class Agent:
         return new_state, info
 
     def _dispatch(self, st, net, sub, inp):
-        if self._supervisor is not None:
+        if self._supervisor is None:
+            return self._run_step(st, net, sub, inp)
+        if not self._donate_effective:
             return self._supervisor.call(
                 self._run_step, st, net, sub, inp, label="round-dispatch"
             )
-        return self._run_step(st, net, sub, inp)
+
+        def attempt():
+            from corrosion_tpu.parallel.mesh import buffers_donated
+
+            if buffers_donated(st):
+                # the failed donated attempt consumed the carry — there
+                # is nothing on-device to retry with. Propagate (non-
+                # retryable) to the round loop, whose checkpoint
+                # rollback + generation fence is the re-upload story
+                # (start() only arms donation when that story exists).
+                raise _CarryConsumed(
+                    "donated round carry consumed by a failed dispatch"
+                )
+            return self._run_step(st, net, sub, inp)
+
+        return self._supervisor.call(attempt, label="round-dispatch")
 
     def _one_round(self):
         with self._input_lock:
@@ -450,8 +560,19 @@ class Agent:
         with RoundTimer("round", warn_seconds=1.0, registry=self.metrics,
                         logger=logger):
             self._key, sub = jr.split(self._key)
+            if self._donate_effective:
+                # the dispatch is about to consume self._state's buffers
+                # — wait out in-flight readers, then close the reader
+                # window until the new carry is committed (the window
+                # stays closed on a consumed-carry failure; _run_loop
+                # reopens it once recovery put a valid state back)
+                with self._state_cv:
+                    self._state_cv.wait_for(
+                        lambda: self._state_readers == 0)
+                    self._state_busy = True
             try:
-                new_state, info = self._dispatch(self._state, net, sub, inp)
+                new_state, info = self._dispatch(
+                    self._state, net, sub, inp)
             except BaseException:
                 # the drained writes die with the failed round (recovery
                 # rolls back past them like any post-checkpoint write) —
@@ -463,6 +584,8 @@ class Agent:
                 for ev in waiters:
                     ev.dropped = True
                     ev.set()
+                if self._donate_effective and not self._carry_consumed():
+                    self._set_state_busy(False)
                 raise
 
         with self._input_lock:
@@ -483,8 +606,13 @@ class Agent:
                 for ev in waiters:
                     ev.dropped = True
                     ev.set()
+                # self._state is the restored (valid) tree — reopen the
+                # reader window the donated dispatch closed
+                self._set_state_busy(False)
                 return
             self._state = new_state
+        # the new carry is committed: readers may copy again
+        self._set_state_busy(False)
 
         vals = {k: float(v) for k, v in info.items()}
         record_round_info(vals, registry=self.metrics)
@@ -664,9 +792,20 @@ class Agent:
 
     # --- checkpoint / restore -------------------------------------------
     def device_state(self):
-        """The current device-state pytree (read-only view for
-        checkpointing; the round thread owns the live copy)."""
-        return self._state
+        """The current device-state pytree (read-only for checkpointing;
+        the round thread owns the live copy).
+
+        While the donated round loop is live there is only ONE device
+        copy of the state and the next dispatch consumes it — a raw
+        reference would read freed buffers mid-serialization — so this
+        returns an OWNED host copy taken under the state lease. With
+        the loop stopped (or donation off) the immutable device tree is
+        returned directly, as before."""
+        if not (self._donate_effective and self._thread is not None
+                and self._thread.is_alive()):
+            return self._state
+        with self._state_lease():
+            return jax.tree.map(lambda a: np.array(a), self._state)
 
     def restore_state(self, state, timeout: float = 60.0) -> bool:
         """Swap in a new device-state pytree under a live round loop —
@@ -706,7 +845,7 @@ class Agent:
              checkpoint_root: Optional[str] = None, keep_last: int = 3,
              write_frac: float = 0.0, resume: bool = False,
              donate: bool = True, async_checkpoint: bool = True,
-             supervisor=None, inputs=None):
+             supervisor=None, inputs=None, mesh=None):
         """Throughput soak dispatch: run ``rounds`` rounds from the
         agent's current state through the segmented runner
         (:func:`corrosion_tpu.resilience.segments.run_segmented`) — the
@@ -722,6 +861,12 @@ class Agent:
         so an aborted soak leaves the agent usable at the runner's last
         good carry. ``resume=True`` continues from the newest valid
         checkpoint under ``checkpoint_root`` instead of the live state.
+
+        ``mesh`` shards the soak over a device mesh: state, net and
+        inputs are placed with ``P("node")`` specs, checkpoints drain
+        per shard, and a resume re-places the recorded slices against
+        THIS mesh whatever topology the interrupted run had (elastic
+        restore, docs/checkpoints.md).
         """
         # real errors, not asserts (python -O strips asserts, and a live
         # round's in-flight carry racing the donated segment buffers
@@ -741,6 +886,17 @@ class Agent:
                 self.cfg, jr.key(self.config.sim.seed + 1), rounds,
                 write_frac=write_frac, mode=self.mode,
             )
+        net = self._net
+        st = self._state
+        if mesh is not None:
+            from corrosion_tpu.parallel.mesh import shard_state
+
+            # placement copies: the agent's own buffers stay valid (and
+            # un-donated) whatever happens to the sharded run
+            inputs = shard_state(mesh, self.n_nodes, inputs)
+            net = shard_state(mesh, self.n_nodes, net)
+            if not resume:
+                st = shard_state(mesh, self.n_nodes, st)
         common = dict(
             mode=self.mode, checkpoint_root=checkpoint_root,
             keep_last=keep_last, db=self.recovery_db,
@@ -749,15 +905,23 @@ class Agent:
         )
         if resume:
             result = resume_segmented(
-                self.cfg, self._net, inputs, segment_rounds, **common
+                self.cfg, net, inputs, segment_rounds, mesh=mesh, **common
             )
         else:
             result = run_segmented(
-                self.cfg, self._state, self._net, self._key, inputs,
+                self.cfg, st, net, self._key, inputs,
                 segment_rounds, **common,
             )
+        adopted = result.state
+        if any(isinstance(leaf, np.ndarray)
+               for leaf in jax.tree.leaves(adopted)):
+            # host-resident leaves (a resume that had nothing left to
+            # run returns the loaded checkpoint as-is): upload as OWNED
+            # device copies — a restarted donated round loop must never
+            # donate an adopted numpy buffer (see _apply_pend_restore)
+            adopted = jax.tree.map(jnp.array, adopted)
         with self._input_lock:
-            self._state = result.state
+            self._state = adopted
             self._key = result.key
             if resume:
                 # completed_rounds is ABSOLUTE within the input stack
@@ -821,22 +985,29 @@ class Agent:
         with self._snap_lock:
             if self._snapshot_host is not None:
                 return self._snapshot_host
-            st = self._state
             round_no = self.round_no
-        # device->host transfer happens OUTSIDE the lock so the round
-        # thread's invalidation never stalls behind a large copy
-        store = tuple(np.asarray(p) for p in st.crdt.store)
-        snap = {
-            "round": round_no,
-            "store": store,  # (ver, val, site, dbv) planes [N, n_cells]
-            "head": np.asarray(st.crdt.book.head),
-            "known_max": np.asarray(st.crdt.book.known_max),
-            "hlc": np.asarray(st.crdt.hlc),
-            "alive": np.asarray(st.swim.alive),
-            "incarnation": np.asarray(
-                getattr(st.swim, "inc", getattr(st.swim, "incarnation", None))
-            ),
-        }
+        # device->host transfer happens OUTSIDE the snapshot lock so the
+        # round thread's invalidation never stalls behind a large copy.
+        # Under round-carry donation the copies ride the state lease and
+        # must be OWNED (np.array): the cached snapshot outlives the
+        # lease, and a CPU-backend asarray view would read freed memory
+        # once the next dispatch consumes the buffers.
+        copy = np.array if self._donate_effective else np.asarray
+        with self._state_lease():
+            st = self._state
+            store = tuple(copy(p) for p in st.crdt.store)
+            snap = {
+                "round": round_no,
+                "store": store,  # (ver, val, site, dbv) planes [N, n_cells]
+                "head": copy(st.crdt.book.head),
+                "known_max": copy(st.crdt.book.known_max),
+                "hlc": copy(st.crdt.hlc),
+                "alive": copy(st.swim.alive),
+                "incarnation": copy(
+                    getattr(st.swim, "inc",
+                            getattr(st.swim, "incarnation", None))
+                ),
+            }
         with self._snap_lock:
             if self._snapshot_host is None and self.round_no == round_no:
                 self._snapshot_host = snap

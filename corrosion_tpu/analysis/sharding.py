@@ -6,8 +6,10 @@ node axis; two cross-function mistakes silently collapse that story:
 - **shard-gather** — host-materializing sharded state. A
   ``jax.device_get``/``np.asarray`` on a value that derives from the
   sharded mesh entry points funnels the whole working set through one
-  host (doubling host memory and serializing the drain — the exact
-  debt the per-shard-checkpoint ROADMAP item exists to pay). Flagged
+  host, doubling host memory and serializing the drain — the failure
+  mode the per-shard checkpoint pipeline
+  (``parallel.mesh.host_shard_copy``, docs/checkpoints.md) exists to
+  avoid. Flagged
   both **at the call site** when tainted state flows into a
   materializer — including a helper that materializes its argument
   somewhere down the call graph (the interprocedural part) — and **at
@@ -86,11 +88,29 @@ MATERIALIZER_METHODS = {"item", "tolist"}
 #: carries its reason; anything else doing a tree-wide materialization
 #: is a finding.
 DRAIN_REGISTRY: Dict[str, str] = {
-    # checkpoint serialization: operates on carry copies its callers
-    # already staged host-side (segments._host_copy is the one device
-    # drain, tracked separately as suppressed debt)
-    "save_checkpoint": "serializes host-staged copies for the "
+    # checkpoint serialization: operates on host-staged slices from the
+    # per-shard drain (soak path) or drains the SINGLE-DEVICE agent
+    # state whole-leaf (the live-agent checkpoint path, never a mesh)
+    "save_checkpoint": "serializes host-staged shard slices (soak) or "
+                       "the single-device agent state for the "
                        "crash-consistent commit path",
+    # save_checkpoint's whole-leaf branch for shards=None saves: drains
+    # the SINGLE-DEVICE agent state (the sharded soak path stages
+    # HostLeafShards and never reaches this comprehension)
+    "_normalized_leaf_records": "whole-leaf drain of the single-device "
+                                "agent state when no per-shard drain "
+                                "was staged (shards=None saves)",
+    # the ISSUE 9 per-shard drain: each device's addressable shard
+    # materializes its own slice (copy_to_host_async per shard) — the
+    # sanctioned replacement for the old _host_copy whole-tree gather
+    "host_shard_copy": "per-shard slice drain: owned host copies of "
+                       "each device's addressable shard, no replicated "
+                       "whole-tree intermediate (docs/checkpoints.md)",
+    # the live donated round loop holds ONE device copy of the state;
+    # checkpoint/backup readers take an owned host copy under the
+    # agent's state lease (single-device serving path, never a mesh)
+    "device_state": "owned host copy under the Agent state lease while "
+                    "the round carry is donated (single-device path)",
     # trace-stability probe: deliberately exercises the checkpoint
     # resume drain on tiny probe state
     "_host_roundtrip": "tracecount probe of the resume path on "

@@ -32,16 +32,22 @@ from typing import Optional, Tuple
 import jax
 import numpy as np
 
-# format 2 adds per-file SHA-256 content hashes to the manifest
+# format 2 added per-file SHA-256 content hashes to the manifest
 # (``files``) and an optional ``extra`` payload (the segmented soak
-# runner records its PRNG key + completed-round counter there). Format-1
-# checkpoints (no hashes) still PARSE — integrity checking is simply
-# unavailable for them — but any checkpoint predating a state-schema
-# change (new pytree leaves, e.g. ``CrdtState.sync_defer``) is rejected
-# loudly at the leaf-count gate below; recovery then falls back to the
+# runner records its PRNG key + completed-round counter there). Format 3
+# (docs/checkpoints.md) makes the state SHARDED: leaves are stored as
+# per-shard slice files (``shard-%05d.npz``) each hashed independently,
+# and the manifest records the saving mesh, a per-leaf partition spec
+# (``leaves``), and where every slice lives (``slices``) — so each
+# device drains/writes only its own slice and restore can re-place the
+# slices against a DIFFERENT mesh (elastic restore). Formats 1 and 2
+# still load; format-1 checkpoints (no hashes) simply can't be
+# integrity-checked. Any checkpoint predating a state-schema change
+# (new pytree leaves, e.g. ``CrdtState.sync_defer``) is rejected loudly
+# at the leaf-count gate below; recovery then falls back to the
 # next-newest candidate or boots fresh with the rejection logged.
-FORMAT_VERSION = 2
-_SUPPORTED_FORMATS = (1, 2)
+FORMAT_VERSION = 3
+_SUPPORTED_FORMATS = (1, 2, 3)
 
 
 class CheckpointIntegrityError(ValueError):
@@ -78,22 +84,103 @@ def _verify_files(path: str, manifest: dict) -> None:
             )
 
 
-def _serialize_state(leaves: list) -> bytes:
-    """Compress the state leaves into npz bytes in memory, so the
-    content hash is computed over the bytes once instead of re-reading
-    the file from disk after the write (the old shape paid a full file
-    re-read per checkpoint — a hidden extra IO pass in the soak hot
-    loop)."""
+def _serialize_arrays(arrays: dict) -> bytes:
+    """Compress named arrays into npz bytes in memory, so the content
+    hash is computed over the bytes once instead of re-reading the file
+    from disk after the write (the old shape paid a full file re-read
+    per checkpoint — a hidden extra IO pass in the soak hot loop)."""
     buf = io.BytesIO()
-    np.savez_compressed(
-        buf, **{f"leaf_{i}": a for i, a in enumerate(leaves)}
-    )
+    np.savez_compressed(buf, **arrays)
     return buf.getvalue()
 
 
 def _write_bytes(path: str, data: bytes) -> None:
     with open(path, "wb") as f:
         f.write(data)
+
+
+def _shard_filename(ordinal: int) -> str:
+    return f"shard-{ordinal:05d}.npz"
+
+
+def _slice_key(leaf: int, start: int) -> str:
+    return f"leaf_{leaf}_{start}"
+
+
+def _normalized_leaf_records(agent, shards):
+    """-> (leaf_records, mesh_meta). Each record is ``(dim, axes, shape,
+    dtype, parts)`` with ``parts`` = ((start, owned ndarray), ...) —
+    one whole part at start 0 when the leaf is unsharded. ``shards`` is
+    a pytree of :class:`~corrosion_tpu.parallel.mesh.HostLeafShards`
+    (the per-shard drain); with ``shards=None`` the agent's device
+    state drains whole-leaf (the single-device agent path)."""
+    if shards is None:
+        leaves = [np.asarray(x) for x in _leaves(agent.device_state())]
+        return (
+            [(None, None, a.shape, a.dtype, ((0, a),)) for a in leaves],
+            None,
+        )
+    from corrosion_tpu.parallel.mesh import drained_mesh_meta
+
+    records = [
+        (hs.dim, hs.axes, hs.shape, hs.dtype, hs.parts)
+        for hs in _leaves(shards)
+    ]
+    return records, drained_mesh_meta(shards)
+
+
+def _slice_groups(leaf_records) -> dict:
+    """Group slices into shard files: the k-th window of every sharded
+    leaf lands in ``shard-%05d.npz`` number k (one file per saving
+    device, matching the mesh device order), unsharded/replicated
+    leaves in shard 0. -> {ordinal: [(leaf, start, stop, array), ...]}"""
+    groups: dict = {}
+    for i, (dim, _axes, _shape, _dtype, parts) in enumerate(leaf_records):
+        for k, (start, arr) in enumerate(parts):
+            if dim is None:
+                ordinal, stop = 0, None
+            else:
+                ordinal, stop = k, start + arr.shape[dim]
+            groups.setdefault(ordinal, []).append((i, start, stop, arr))
+    return groups
+
+
+def _write_state_files(path: str, groups: dict,
+                       io_stats: Optional[dict] = None) -> dict:
+    """Serialize + hash + write every shard file, in parallel when there
+    is more than one (zlib and SHA-256 both release the GIL, so the
+    per-shard work genuinely overlaps). -> {filename: sha256}."""
+    import time
+
+    t0 = time.perf_counter()
+
+    def one(ordinal: int, entries: list):
+        blob = _serialize_arrays({
+            _slice_key(leaf, start): arr
+            for leaf, start, _stop, arr in entries
+        })
+        name = _shard_filename(ordinal)
+        _write_bytes(os.path.join(path, name), blob)
+        return name, hashlib.sha256(blob).hexdigest()
+
+    items = sorted(groups.items())
+    if len(items) > 1:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(
+            max_workers=min(len(items), 8),
+            thread_name_prefix="corro-ckpt-shard",
+        ) as pool:
+            futures = [pool.submit(one, k, entries) for k, entries in items]
+            files = dict(f.result() for f in futures)
+    else:
+        files = dict(one(k, entries) for k, entries in items)
+    if io_stats is not None:
+        io_stats["serialize_s"] = (
+            io_stats.get("serialize_s", 0.0) + time.perf_counter() - t0
+        )
+        io_stats["shard_files"] = len(items)
+    return files
 
 
 def _state_template(mode: str, cfg):
@@ -107,37 +194,69 @@ def _state_template(mode: str, cfg):
 
 
 def save_checkpoint(agent, db=None, path: str = "./checkpoint",
-                    extra: Optional[dict] = None) -> str:
+                    extra: Optional[dict] = None, shards=None,
+                    io_stats: Optional[dict] = None) -> str:
     """Write the full cluster state to ``path`` (a directory).
 
     Crash-safe ordering: the manifest is removed first and (re)written
     LAST via an atomic rename — a directory without a valid manifest is
     incomplete by definition, so a crash mid-write can never leave a
-    side that looks restorable but is not. Every leaf file's SHA-256 is
-    recorded in the manifest, so post-commit corruption (bit rot, a
-    truncating copy) is detected on load instead of silently restoring
-    garbage.
+    side that looks restorable but is not. Every state file's SHA-256
+    is recorded in the manifest, so post-commit corruption (bit rot, a
+    truncating copy, a single damaged shard slice) is detected on load
+    instead of silently restoring garbage.
+
+    ``shards`` (a pytree of
+    :class:`~corrosion_tpu.parallel.mesh.HostLeafShards` from
+    ``host_shard_copy``) writes the per-shard v3 layout: one slice file
+    per saving device, serialized/hashed in parallel, with the mesh and
+    per-leaf partition specs recorded for elastic restore. Without it
+    the agent's device state drains whole-leaf into a single shard file
+    (the single-device agent path).
 
     ``extra`` is an arbitrary JSON-able payload stored in the manifest —
     the segmented soak runner records its scan carry (PRNG key data +
-    completed rounds) there."""
+    completed rounds) there. ``io_stats`` (optional dict) receives
+    ``serialize_s`` / ``shard_files`` for pipeline telemetry."""
     os.makedirs(path, exist_ok=True)
     manifest_path = os.path.join(path, "manifest.json")
     if os.path.exists(manifest_path):
         os.unlink(manifest_path)
-    state = agent.device_state()
-    leaves = [np.asarray(x) for x in _leaves(state)]
-    state_path = os.path.join(path, "state.npz")
-    blob = _serialize_state(leaves)
-    sha = hashlib.sha256(blob).hexdigest()
-    _write_bytes(state_path, blob)
+    # stale state files from a previous (possibly differently-sharded)
+    # occupant of this directory: remove them AFTER the manifest — the
+    # side is already invalid, and rotation reuses side dirs
+    for name in os.listdir(path):
+        if name == "state.npz" or (
+                name.startswith("shard-") and name.endswith(".npz")):
+            os.unlink(os.path.join(path, name))
+    leaf_records, mesh_meta = _normalized_leaf_records(agent, shards)
+    groups = _slice_groups(leaf_records)
+    files = _write_state_files(path, groups, io_stats)
     manifest = {
         "format": FORMAT_VERSION,
         "mode": agent.mode,
         "round": agent.round_no,
         "sim_config": dataclasses.asdict(agent.cfg),
-        "n_leaves": len(leaves),
-        "files": {"state.npz": sha},
+        "n_leaves": len(leaf_records),
+        "mesh": mesh_meta,
+        "leaves": [
+            {
+                "dim": dim,
+                "axes": list(axes) if axes else None,
+                "shape": [int(s) for s in shape],
+                "dtype": str(dtype),
+            }
+            for dim, axes, shape, dtype, _parts in leaf_records
+        ],
+        "slices": {
+            _shard_filename(ordinal): [
+                {"leaf": leaf, "start": int(start),
+                 "stop": None if stop is None else int(stop)}
+                for leaf, start, stop, _arr in entries
+            ]
+            for ordinal, entries in sorted(groups.items())
+        },
+        "files": files,
         "db": db.state_dict() if db is not None else None,
     }
     if extra is not None:
@@ -149,11 +268,126 @@ def save_checkpoint(agent, db=None, path: str = "./checkpoint",
     return path
 
 
-def load_checkpoint(path: str, verify: bool = True) -> Tuple[dict, object]:
-    """-> (manifest, device-state pytree). The pytree is rebuilt against
-    a template constructed from the saved config, so leaf order/shape
-    mismatches fail loudly; leaf-file content hashes are verified against
-    the manifest before anything is deserialized."""
+def _load_slices_v3(path: str, manifest: dict) -> list:
+    """Reassemble the v3 per-shard slice files into full host leaves.
+
+    Every slice's shape/dtype is validated against the manifest record
+    and the sharded dim's coverage must tile ``[0, shape[dim])`` exactly
+    — a missing, duplicated, or overlapping slice is corruption, not a
+    silent partial restore."""
+    metas = manifest["leaves"]
+    out: list = [None] * manifest["n_leaves"]
+    windows: dict = {i: [] for i in range(manifest["n_leaves"])}
+    for fname, entries in (manifest.get("slices") or {}).items():
+        fp = os.path.join(path, fname)
+        if not os.path.exists(fp):
+            raise CheckpointIntegrityError(
+                f"checkpoint {path}: slice file {fname} is missing"
+            )
+        with np.load(fp) as z:
+            for e in entries:
+                i, start, stop = int(e["leaf"]), int(e["start"]), e["stop"]
+                meta = metas[i]
+                shape, dim = tuple(meta["shape"]), meta["dim"]
+                arr = z[_slice_key(i, start)]
+                if str(arr.dtype) != meta["dtype"]:
+                    raise CheckpointIntegrityError(
+                        f"checkpoint {path}: slice {fname}:{i}@{start} "
+                        f"dtype {arr.dtype} != manifest {meta['dtype']}"
+                    )
+                if dim is None:
+                    if tuple(arr.shape) != shape:
+                        raise CheckpointIntegrityError(
+                            f"checkpoint {path}: leaf {i} shape "
+                            f"{arr.shape} != manifest {shape}"
+                        )
+                    out[i] = arr
+                    windows[i].append((0, shape[0] if shape else 1))
+                    continue
+                stop = int(stop)
+                want = shape[:dim] + (stop - start,) + shape[dim + 1:]
+                if tuple(arr.shape) != want:
+                    raise CheckpointIntegrityError(
+                        f"checkpoint {path}: slice {fname}:{i}@{start} "
+                        f"shape {arr.shape} != manifest window {want}"
+                    )
+                if out[i] is None:
+                    out[i] = np.empty(shape, dtype=arr.dtype)
+                sl = (slice(None),) * dim + (slice(start, stop),)
+                out[i][sl] = arr
+                windows[i].append((start, stop))
+    for i, meta in enumerate(metas):
+        if out[i] is None:
+            raise CheckpointIntegrityError(
+                f"checkpoint {path}: no slices recorded for leaf {i}"
+            )
+        dim = meta["dim"]
+        if dim is None:
+            if len(windows[i]) != 1:
+                raise CheckpointIntegrityError(
+                    f"checkpoint {path}: unsharded leaf {i} has "
+                    f"{len(windows[i])} slices"
+                )
+            continue
+        seen = sorted(windows[i])
+        cursor = 0
+        for start, stop in seen:
+            if start != cursor:
+                raise CheckpointIntegrityError(
+                    f"checkpoint {path}: leaf {i} slice coverage has a "
+                    f"gap/overlap at index {cursor} (next slice starts "
+                    f"at {start})"
+                )
+            cursor = stop
+        if cursor != meta["shape"][dim]:
+            raise CheckpointIntegrityError(
+                f"checkpoint {path}: leaf {i} slices cover only "
+                f"[0, {cursor}) of dim {dim} (size {meta['shape'][dim]})"
+            )
+    return out
+
+
+def _place_leaves(loaded: list, manifest: dict, cfg, mesh) -> list:
+    """Elastic restore placement: put every reassembled leaf directly at
+    its TARGET sharding on the resuming process's mesh — whatever shape
+    the saving mesh had (different device count, 1-D↔2-D, or none).
+    With no mesh the host arrays are returned as-is (single-device
+    callers upload them on first use, exactly the v2 behavior)."""
+    if mesh is None:
+        return loaded
+    import jax.numpy as jnp
+
+    from corrosion_tpu.parallel.mesh import elastic_sharding
+
+    metas = manifest.get("leaves") or [{"dim": None}] * len(loaded)
+    # jnp.array first (copy semantics), THEN re-place: a bare
+    # device_put zero-copy-adopts 64-byte-aligned numpy buffers on the
+    # CPU backend, and restored state can reach a DONATED dispatch
+    # (e.g. adopted by an agent whose round loop donates the carry) —
+    # donating an adopted buffer frees numpy-owned memory (glibc heap
+    # corruption, see parallel.mesh.device_put_shards)
+    return [
+        jax.device_put(
+            jnp.array(arr),
+            elastic_sharding(mesh, cfg.n_nodes, arr, meta.get("dim")),
+        )
+        for arr, meta in zip(loaded, metas)
+    ]
+
+
+def load_checkpoint(path: str, verify: bool = True,
+                    mesh=None) -> Tuple[dict, object]:
+    """-> (manifest, state pytree). The pytree is rebuilt against a
+    template constructed from the saved config, so leaf order/shape
+    mismatches fail loudly; state-file content hashes are verified
+    against the manifest before anything is deserialized.
+
+    ``mesh`` makes the restore **mesh-shape-agnostic**: the recorded
+    slices are reassembled and every leaf is placed directly with its
+    target sharding on the CURRENT mesh — resuming an 8-chip soak on 4
+    chips, folding a 1-D mesh into 2-D ``(dcn, node)`` (or back), or
+    collapsing to a single device all produce bitwise-identical state
+    (see docs/checkpoints.md)."""
     manifest_path = os.path.join(path, "manifest.json")
     if not os.path.exists(manifest_path):
         raise CheckpointIntegrityError(
@@ -172,8 +406,11 @@ def load_checkpoint(path: str, verify: bool = True) -> Tuple[dict, object]:
         from corrosion_tpu.sim.config import SimConfig as CfgCls
     cfg = CfgCls(**manifest["sim_config"])
     template = _state_template(manifest["mode"], cfg)
-    with np.load(os.path.join(path, "state.npz")) as z:
-        loaded = [z[f"leaf_{i}"] for i in range(manifest["n_leaves"])]
+    if manifest["format"] >= 3:
+        loaded = _load_slices_v3(path, manifest)
+    else:  # v1/v2: one whole-state npz
+        with np.load(os.path.join(path, "state.npz")) as z:
+            loaded = [z[f"leaf_{i}"] for i in range(manifest["n_leaves"])]
     tmpl_leaves, treedef = jax.tree.flatten(template)
     if len(tmpl_leaves) != len(loaded):
         raise ValueError(
@@ -190,6 +427,7 @@ def load_checkpoint(path: str, verify: bool = True) -> Tuple[dict, object]:
                 f"leaf dtype mismatch: checkpoint {l.dtype} vs config "
                 f"{t.dtype}"
             )
+    loaded = _place_leaves(loaded, manifest, cfg, mesh)
     state = jax.tree.unflatten(treedef, loaded)
     return manifest, state
 
@@ -208,6 +446,10 @@ def verify_checkpoint(path: str) -> dict:
         "mode": manifest["mode"],
         "round": manifest["round"],
         "n_leaves": manifest["n_leaves"],
+        # sharded (v3) checkpoints: how many per-device slice files the
+        # state is split over (1 = v2 whole-state or single-device save)
+        "shards": len(manifest["slices"]) if manifest.get("slices") else 1,
+        "mesh": manifest.get("mesh"),
         "hashed_files": sorted((manifest.get("files") or {})),
         "extra": manifest.get("extra"),
     }

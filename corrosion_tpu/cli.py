@@ -254,8 +254,11 @@ def cmd_checkpoint(args) -> int:
 
 def cmd_verify_checkpoint(args) -> int:
     """Offline integrity check of a checkpoint directory: manifest,
-    format, SHA-256 leaf-file hashes, and state deserialization against
-    the saved config. Exits non-zero on any defect."""
+    format, SHA-256 state-file hashes (every per-shard slice file of a
+    sharded v3 checkpoint is hashed independently — one damaged slice
+    fails the whole verify), slice-coverage validation, and state
+    deserialization against the saved config. Exits non-zero on any
+    defect."""
     from corrosion_tpu.checkpoint import verify_checkpoint
 
     try:
@@ -302,6 +305,31 @@ def cmd_soak(args) -> int:
         cfg, jr.key(cfg_file.sim.seed + 1), args.rounds,
         write_frac=args.write_frac,
     )
+    mesh = None
+    if args.shard:
+        # shard the soak over a device mesh: checkpoints drain one
+        # slice per device, and --resume re-places a checkpoint written
+        # on ANY topology against this one (elastic restore,
+        # docs/checkpoints.md)
+        import jax
+
+        from corrosion_tpu.parallel.mesh import (
+            make_mesh,
+            make_multihost_mesh,
+            shard_state,
+        )
+
+        devices = jax.devices()
+        if args.shard > len(devices):
+            raise SystemExit(
+                f"--shard {args.shard} exceeds the {len(devices)} "
+                f"available devices"
+            )
+        devices = devices[:args.shard]
+        mesh = (make_multihost_mesh(args.mesh_hosts, devices)
+                if args.mesh_hosts else make_mesh(devices))
+        net = shard_state(mesh, cfg.n_nodes, net)
+        inputs = shard_state(mesh, cfg.n_nodes, inputs)
     supervisor = Supervisor(deadline_seconds=args.deadline or None)
     common = dict(
         checkpoint_root=args.checkpoint_dir, keep_last=args.keep_last,
@@ -309,14 +337,20 @@ def cmd_soak(args) -> int:
         async_checkpoint=not args.sync_checkpoint,
     )
     if args.resume:
-        result = resume_segmented(cfg, net, inputs, args.segment, **common)
+        result = resume_segmented(cfg, net, inputs, args.segment,
+                                  mesh=mesh, **common)
     else:
         if cfg_file.sim.mode == "scale":
             from corrosion_tpu.sim.scale_step import ScaleSimState as StCls
         else:
             from corrosion_tpu.sim.step import SimState as StCls
+        st = StCls.create(cfg)
+        if mesh is not None:
+            from corrosion_tpu.parallel.mesh import shard_state
+
+            st = shard_state(mesh, cfg.n_nodes, st)
         result = run_segmented(
-            cfg, StCls.create(cfg), net, jr.key(cfg_file.sim.seed), inputs,
+            cfg, st, net, jr.key(cfg_file.sim.seed), inputs,
             args.segment, **common,
         )
     summary = {
@@ -562,6 +596,14 @@ def build_parser() -> argparse.ArgumentParser:
                     help="write checkpoints synchronously on the hot "
                          "loop instead of the overlapped background "
                          "writer")
+    sk.add_argument("--shard", type=int, default=0,
+                    help="shard the soak over an N-device mesh: per-"
+                         "shard checkpoint drains, and --resume "
+                         "reshards a checkpoint from ANY topology onto "
+                         "this one (0 = single device)")
+    sk.add_argument("--mesh-hosts", type=int, default=0,
+                    help="with --shard: fold the devices into a 2-D "
+                         "(dcn, node) mesh over this many hosts")
     sk.set_defaults(fn=cmd_soak)
 
     t = sub.add_parser("template", help="render templates (re-render on change)")

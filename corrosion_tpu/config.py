@@ -79,6 +79,13 @@ class PerfConfig:
     sync_peers: int = 2
     bcast_fanout: int = 5
     bcast_max_transmissions: int = 4
+    # donate the live round loop's scan carry to each dispatch (the
+    # boundary never holds two device copies of the state — at flagship
+    # scale the carry IS the HBM working set). Readers copy under the
+    # agent's state lease; a supervised agent without auto_recover
+    # keeps donation off (no re-upload story). Debug switch: False
+    # restores the double-buffered (two-copy) round loop.
+    donate_rounds: bool = True
 
 
 @dataclasses.dataclass

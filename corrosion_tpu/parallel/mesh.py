@@ -17,11 +17,13 @@ mesh interconnect.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
-from typing import Any
+from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from corrosion_tpu.sim.config import SimConfig
@@ -203,3 +205,178 @@ def sharded_scale_run_carry(cfg, mesh, st, net, key, inputs):
     with zero duplicate carry allocations at segment boundaries."""
     del mesh  # sharding travels on the arguments
     return _scale_run_carry(cfg, st, key, net, inputs)
+
+
+# --- per-shard host drain + elastic re-placement ---------------------------
+#
+# The checkpoint pipeline's device<->host boundary (docs/checkpoints.md).
+# A mesh-sharded carry must NEVER funnel through a replicated host view:
+# each device's addressable shard drains its own slice
+# (``host_shard_copy``), the manifest records where every slice lives
+# (``HostLeafShards``), and restore re-places the recorded slices
+# against whatever mesh the resuming process has (``elastic_sharding``)
+# — 8 chips, 4 chips, a 2-D (dcn, node) fold, or a single device.
+
+
+def _joint_node_axis(mesh: Mesh):
+    """The axis (or axis tuple) ``node_sharding`` shards the node
+    dimension over on this mesh."""
+    return (
+        (DCN_AXIS, NODE_AXIS) if DCN_AXIS in mesh.axis_names else NODE_AXIS
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class HostLeafShards:
+    """One leaf of a carry pytree, drained per device shard.
+
+    ``parts`` holds OWNED numpy slices ``(start, array)`` ordered by
+    their start index along ``dim`` (``dim is None`` = the leaf was
+    unsharded/replicated and ``parts`` is one full copy). ``axes`` is
+    the JSON-able record of the mesh axes the sharded dim rode (for the
+    checkpoint manifest); ``sharding`` keeps the LIVE sharding object so
+    a same-process re-upload (donated-retry, abort handback) can put the
+    slices back exactly where they came from. A plain class, not a
+    NamedTuple, so ``jax.tree`` treats it as a LEAF — a tree.map over a
+    drained carry must not recurse into the slice bookkeeping."""
+
+    shape: Tuple[int, ...]
+    dtype: Any
+    dim: Optional[int]
+    parts: Tuple[Tuple[int, Any], ...]
+    axes: Optional[Tuple[str, ...]] = None
+    sharding: Any = None
+
+    def nbytes(self) -> int:
+        return sum(int(a.nbytes) for _start, a in self.parts)
+
+
+def _leaf_shard_layout(leaf):
+    """-> (dim, shards): the single dimension ``leaf``'s addressable
+    shards slice it along, or ``(None, None)`` when the leaf is
+    unsharded / fully replicated / not decomposable along one axis
+    (those drain as one whole copy)."""
+    shards = getattr(leaf, "addressable_shards", None)
+    if not shards or len(shards) == 1:
+        return None, None
+    sliced_dims = set()
+    for s in shards:
+        for d, (sl, n) in enumerate(zip(s.index, leaf.shape)):
+            start, stop = sl.start or 0, n if sl.stop is None else sl.stop
+            if (start, stop) != (0, n):
+                sliced_dims.add(d)
+    if len(sliced_dims) != 1:
+        return None, None
+    return sliced_dims.pop(), shards
+
+
+def _spec_axes(leaf, dim: Optional[int]) -> Optional[Tuple[str, ...]]:
+    """JSON-able mesh-axis names the sharded dim rides (manifest record)."""
+    sharding = getattr(leaf, "sharding", None)
+    spec = getattr(sharding, "spec", None)
+    if dim is None or spec is None or dim >= len(spec):
+        return None
+    entry = spec[dim]
+    if entry is None:
+        return None
+    return tuple(entry) if isinstance(entry, tuple) else (entry,)
+
+
+def host_shard_copy(tree: Any) -> Any:
+    """Per-shard host drain of a (possibly mesh-sharded) pytree.
+
+    Every addressable shard's D2H transfer is enqueued asynchronously
+    first (on TPU the per-device DMAs run in parallel), then each slice
+    materializes as an OWNED numpy copy — ``np.array``, never a view:
+    the next segment's dispatch donates the device buffers, and a view
+    of a donated buffer would read freed memory. No shard is ever
+    gathered into a replicated whole-tree intermediate, so the host
+    cost is per-shard state, not total state."""
+    leaves, treedef = jax.tree.flatten(tree)
+    layouts = [_leaf_shard_layout(leaf) for leaf in leaves]
+    for leaf, (dim, shards) in zip(leaves, layouts):
+        if dim is None:
+            copy_async = getattr(leaf, "copy_to_host_async", None)
+            if copy_async is not None:
+                copy_async()
+        else:
+            for s in shards:
+                s.data.copy_to_host_async()
+    out = []
+    for leaf, (dim, shards) in zip(leaves, layouts):
+        if dim is None:
+            parts = ((0, np.array(leaf)),)
+        else:
+            by_start = {}
+            for s in shards:  # replicas of a window drain once
+                start = s.index[dim].start or 0
+                if start not in by_start:
+                    by_start[start] = np.array(s.data)
+            parts = tuple(sorted(by_start.items()))
+        out.append(HostLeafShards(
+            shape=tuple(np.shape(leaf)),
+            dtype=parts[0][1].dtype,
+            dim=dim,
+            parts=parts,
+            axes=_spec_axes(leaf, dim),
+            sharding=getattr(leaf, "sharding", None),
+        ))
+    return jax.tree.unflatten(treedef, out)
+
+
+def assemble_shards(hs: HostLeafShards):
+    """One leaf's slices -> a full host array (restore / re-upload)."""
+    if hs.dim is None:
+        return hs.parts[0][1]
+    return np.concatenate([a for _start, a in hs.parts], axis=hs.dim)
+
+
+def device_put_shards(tree: Any) -> Any:
+    """Re-upload a ``host_shard_copy`` tree to its ORIGINAL placement —
+    the donated-retry / abort-handback path: a consumed carry comes back
+    bitwise-identical, on the same devices with the same specs.
+
+    The upload MUST be an owned device copy (``jnp.array``, copy
+    semantics — never ``asarray``/bare ``device_put``): the CPU backend
+    zero-copy-adopts 64-byte-aligned numpy buffers, and the re-uploaded
+    carry goes straight back into a DONATED dispatch, which would then
+    free numpy-owned memory (observed as glibc heap corruption)."""
+
+    def put(hs: HostLeafShards):
+        owned = jnp.array(assemble_shards(hs))
+        if hs.sharding is not None:
+            return jax.device_put(owned, hs.sharding)
+        return owned
+
+    return jax.tree.map(put, tree)
+
+
+def drained_mesh_meta(tree: Any) -> Optional[dict]:
+    """The saving mesh, JSON-ably, from a drained carry (or None when
+    nothing was mesh-placed): recorded in the v3 manifest so restore can
+    report what it reshards FROM."""
+    for hs in jax.tree.leaves(tree):
+        mesh = getattr(getattr(hs, "sharding", None), "mesh", None)
+        if mesh is not None and getattr(mesh, "axis_names", None):
+            return {
+                "axis_names": list(mesh.axis_names),
+                "shape": [int(s) for s in mesh.devices.shape],
+            }
+    return None
+
+
+def elastic_sharding(mesh: Mesh, n_nodes: int, arr,
+                     dim: Optional[int] = None) -> NamedSharding:
+    """Target sharding for one RESTORED leaf on the CURRENT mesh.
+
+    A leaf whose manifest records a sharded dim re-maps that dim onto
+    this mesh's joint node axis — the recorded axis names need not
+    exist here, which is exactly what makes restore mesh-shape-agnostic
+    (8→4 chips, 1-D↔2-D ``(dcn, node)``). Leaves with no recorded spec
+    (v2 checkpoints, single-device saves) fall back to the
+    ``node_sharding`` placement rule."""
+    if dim is None:
+        return node_sharding(mesh, n_nodes)(arr)
+    spec = [None] * np.ndim(arr)
+    spec[dim] = _joint_node_axis(mesh)
+    return NamedSharding(mesh, P(*spec))

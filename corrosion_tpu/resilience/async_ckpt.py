@@ -10,14 +10,17 @@ stepping); this module is that shape for the soak runner.
 Split of work per segment boundary:
 
 - **hot loop (synchronous)** — enqueue ``copy_to_host_async`` on every
-  leaf, then materialize owned numpy copies. This is the only stall and
-  it is bounded by the D2H transfer, NOT by hashing/compression/IO. The
-  copies must be owned (``np.array``, not ``np.asarray`` views): the
-  next segment's dispatch donates the device buffers, and a numpy view
-  of a donated buffer would both block the donation and read freed
-  memory.
-- **worker thread (overlapped)** — serialize + SHA-256 + manifest write
-  + ``LATEST`` pointer + retention pruning, via the exact same
+  addressable SHARD, then materialize owned per-shard numpy slices
+  (``parallel.mesh.host_shard_copy``). This is the only stall; it is
+  bounded by the D2H transfer of each device's own slice — never a
+  replicated whole-tree gather — so under a mesh it scales with
+  per-shard state, not total state. The copies must be owned
+  (``np.array``, not ``np.asarray`` views): the next segment's dispatch
+  donates the device buffers, and a numpy view of a donated buffer
+  would both block the donation and read freed memory.
+- **worker thread (overlapped)** — per-shard slice serialization +
+  SHA-256 (in parallel across shard files) + manifest write +
+  ``LATEST`` pointer + retention pruning, via the exact same
   crash-consistent path as the synchronous writer
   (:func:`write_segment_checkpoint`), while the next segment's
   ``lax.scan`` runs.
@@ -37,6 +40,8 @@ import queue
 import threading
 import time
 from typing import Callable, NamedTuple, Optional
+
+import jax
 
 from corrosion_tpu.checkpoint import save_checkpoint
 from corrosion_tpu.resilience.retention import (
@@ -62,12 +67,20 @@ class _SegmentView:
 
 def write_segment_checkpoint(cfg, mode: str, state, key_json: dict,
                              completed: int, root: str, keep_last: int,
-                             db=None) -> str:
+                             db=None, io_stats=None) -> str:
     """Commit one segment checkpoint (crash-consistent ordering).
 
-    ``state`` may be a device pytree or host numpy copies — the save
-    path ``np.asarray``'s either. ``key_json`` is the serialized carried
-    PRNG key (``segments._key_to_json``)."""
+    ``state`` may be a per-shard drained tree (leaves are
+    ``parallel.mesh.HostLeafShards`` — the soak runner's shape, written
+    as the sharded v3 slice layout), a device pytree, or host numpy
+    copies. ``key_json`` is the serialized carried PRNG key
+    (``segments._key_to_json``). ``io_stats`` receives the save path's
+    ``serialize_s``/``shard_files`` telemetry."""
+    from corrosion_tpu.parallel.mesh import HostLeafShards
+
+    leaves = jax.tree.leaves(state)
+    shards = state if (
+        leaves and isinstance(leaves[0], HostLeafShards)) else None
     name = f"seg-{completed:08d}"
     view = _SegmentView(mode, cfg, state, completed)
     path = save_checkpoint(
@@ -76,6 +89,7 @@ def write_segment_checkpoint(cfg, mode: str, state, key_json: dict,
             "completed_rounds": completed,
             "key": key_json,
         }},
+        shards=shards, io_stats=io_stats,
     )
     # pointer moves only AFTER the directory is fully committed; pruning
     # runs last so the recovery point is never the one being deleted
@@ -118,6 +132,8 @@ class AsyncCheckpointWriter:
         self._error: Optional[BaseException] = None
         self.last_path: Optional[str] = None
         self.io_seconds = 0.0
+        self.serialize_seconds = 0.0  # parallel per-shard serialize+hash
+        self.shard_files = 0  # slice files in the newest written ckpt
         self.written = 0
         self.overlapped = 0
         from corrosion_tpu.utils.lifecycle import spawn_counted
@@ -156,11 +172,16 @@ class AsyncCheckpointWriter:
                 return
             try:
                 t0 = time.perf_counter()
+                io_stats: dict = {}
                 self.last_path = write_segment_checkpoint(
                     self._cfg, self._mode, job.state, job.key_json,
                     job.completed, self._root, self._keep_last, self._db,
+                    io_stats=io_stats,
                 )
                 self.io_seconds += time.perf_counter() - t0
+                self.serialize_seconds += io_stats.get("serialize_s", 0.0)
+                self.shard_files = io_stats.get("shard_files",
+                                                self.shard_files)
                 self.written += 1
                 if self._progress() > job.seg_index:
                     self.overlapped += 1

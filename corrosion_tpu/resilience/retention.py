@@ -2,7 +2,9 @@
 pointer.
 
 A soak run's checkpoint root accumulates one directory per segment
-(``seg-<completed rounds>``). Two invariants:
+(``seg-<completed rounds>`` — since manifest v3 each holds one slice
+file per saving device plus the manifest; retention operates on whole
+directories, so the unit of keep/prune is unchanged). Two invariants:
 
 - ``LATEST`` is a one-line file naming the newest *committed* checkpoint
   directory, updated via write-tmp + ``os.replace`` — readers never see

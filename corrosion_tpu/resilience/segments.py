@@ -8,10 +8,13 @@ across them, so the segmented run is **bitwise identical** to the
 straight-through one (the per-round key is split off the carried key
 inside the scan body; chaining carries reproduces the same key
 sequence). After every segment it writes a crash-consistent checkpoint
-(manifest-last + SHA-256 leaf hashes, ``checkpoint.py``), updates the
+(manifest-last + SHA-256 hashes, ``checkpoint.py``), updates the
 atomic ``LATEST`` pointer, and prunes to the retention budget — a
 preempted run resumes from the newest committed segment, losing at most
-K rounds of work. The same shape transfers directly to a training
+K rounds of work. Under a mesh the checkpoint drain is PER SHARD
+(each device's addressable slice, no replicated host intermediate) and
+restore is mesh-shape-agnostic — resume on fewer chips or a different
+mesh rank and the run stays bitwise identical (docs/checkpoints.md). The same shape transfers directly to a training
 stack: segment = accumulation window, checkpoint = optimizer state.
 
 Segments dispatch through an optional :class:`~corrosion_tpu.resilience
@@ -107,24 +110,54 @@ def _pipeline_stats(donate: bool, async_checkpoint: bool) -> dict:
         "ckpt_io_s": 0.0,
         "ckpt_written": 0,
         "ckpt_overlapped_segments": 0,
+        # per-shard drain telemetry (ISSUE 9): how many slices each
+        # checkpoint drains, how many bytes total, the largest single
+        # shard's bytes (the quantity that must NOT scale with total
+        # state under a mesh), and the writer's parallel serialize+hash
+        # wall time
+        "ckpt_shards": 0,
+        "ckpt_drain_bytes": 0,
+        "ckpt_shard_bytes_max": 0,
+        "ckpt_serialize_s": 0.0,
     }
 
 
-def _host_copy(tree):
-    """Owned host copies of a pytree's leaves.
+def _shard_drain(tree):
+    """Per-shard host drain of the carry (the ONLY hot-loop stall).
 
-    The D2H transfers are enqueued asynchronously for every leaf first
-    (on TPU they DMA in parallel while the host walks the tree), then
-    materialized as OWNED numpy arrays — ``np.array``, never
-    ``np.asarray``: on the CPU backend ``asarray`` returns a view of the
-    device buffer, which would silently block the next segment's buffer
-    donation AND read freed memory once the donated buffer is reused."""
-    for leaf in jax.tree.leaves(tree):
-        copy_async = getattr(leaf, "copy_to_host_async", None)
-        if copy_async is not None:
-            copy_async()
-    # corrolint: disable=shard-gather -- tracked debt: drains a replicated view of the whole carry through one host; the per-shard-checkpoint ROADMAP item replaces this with per-shard slice writes
-    return jax.tree.map(lambda a: np.array(a), tree)
+    Under a mesh each device's addressable shard drains its own slice
+    via ``copy_to_host_async`` into owned numpy copies — no replicated
+    whole-tree intermediate, so the stall scales with PER-SHARD state,
+    not total state (this replaced the old ``_host_copy`` whole-tree
+    gather, the suppressed corrolint ``shard-gather`` debt). The
+    returned tree's leaves are
+    :class:`~corrosion_tpu.parallel.mesh.HostLeafShards`; the async
+    writer serializes the slices in parallel and ``_reupload`` puts
+    them back at their original placement for donated retries."""
+    from corrosion_tpu.parallel.mesh import host_shard_copy
+
+    return host_shard_copy(tree)
+
+
+def _reupload(host_shards):
+    """Donated-retry / abort-handback: the consumed carry comes back
+    bitwise-identical from the host slices, at its original placement."""
+    from corrosion_tpu.parallel.mesh import device_put_shards
+
+    return device_put_shards(host_shards)
+
+
+def _drain_stats(host_shards):
+    """-> (n_shards, total_bytes, max_shard_bytes) of one drained carry
+    — the facts that prove the drain splits per shard instead of
+    scaling with total state."""
+    per_shard: dict = {}
+    for hs in jax.tree.leaves(host_shards):
+        for k, (_start, arr) in enumerate(hs.parts):
+            ordinal = 0 if hs.dim is None else k
+            per_shard[ordinal] = per_shard.get(ordinal, 0) + int(arr.nbytes)
+    total = sum(per_shard.values())
+    return len(per_shard), total, max(per_shard.values(), default=0)
 
 
 def _carry_deleted(st) -> bool:
@@ -247,10 +280,11 @@ def run_segmented(
                 nonlocal st, key
                 if donate_now and _carry_deleted(st):
                     # a failed donated attempt consumed the carry — the
-                    # retry re-uploads the host snapshot of the same
-                    # boundary (bitwise-identical values; re-sharding is
-                    # the driver's concern on a genuine device loss)
-                    st = jax.tree.map(jnp.asarray, host_carry[0])
+                    # retry re-uploads the host shard slices of the same
+                    # boundary at their original placement (bitwise-
+                    # identical values; re-sharding is the driver's
+                    # concern on a genuine device loss)
+                    st = _reupload(host_carry[0])
                     key = _key_from_json(host_carry[1])
                     stats["carry_reuploads"] += 1
                     logger.warning(
@@ -275,7 +309,7 @@ def run_segmented(
                     # hand back the last boundary's values so the caller
                     # (e.g. Agent.soak) adopts a USABLE state, not
                     # deleted buffers
-                    st = jax.tree.map(jnp.asarray, host_carry[0])
+                    st = _reupload(host_carry[0])
                     key = _key_from_json(host_carry[1])
                 logger.exception(
                     "soak aborted at round %d; last good checkpoint: %s",
@@ -290,24 +324,33 @@ def run_segmented(
                 stats["donated_segments"] += 1
             info_parts.append(infos)
             if checkpoint_root:
-                # the only synchronous cost on the hot loop: owned host
-                # copies of the carry (plus writer backpressure when the
-                # PREVIOUS segment's checkpoint is still being written)
+                # the only synchronous cost on the hot loop: the
+                # per-shard slice drain of the carry (plus writer
+                # backpressure when the PREVIOUS segment's checkpoint is
+                # still being written)
                 t0 = time.perf_counter()
-                host_carry = (_host_copy(st), _key_to_json(key))
+                host_carry = (_shard_drain(st), _key_to_json(key))
                 if writer is not None:
                     writer.submit(host_carry[0], host_carry[1],
                                   start_round + completed,
                                   seg_box["index"])
                 stats["ckpt_stall_s"] += time.perf_counter() - t0
+                n_sh, total_b, max_b = _drain_stats(host_carry[0])
+                stats["ckpt_shards"] = max(stats["ckpt_shards"], n_sh)
+                stats["ckpt_drain_bytes"] += total_b
+                stats["ckpt_shard_bytes_max"] = max(
+                    stats["ckpt_shard_bytes_max"], max_b)
                 if writer is None:
                     t0 = time.perf_counter()
+                    io_stats: dict = {}
                     last_ckpt = write_segment_checkpoint(
                         cfg, mode, host_carry[0], host_carry[1],
                         start_round + completed, checkpoint_root,
-                        keep_last, db,
+                        keep_last, db, io_stats=io_stats,
                     )
                     stats["ckpt_stall_s"] += time.perf_counter() - t0
+                    stats["ckpt_serialize_s"] += io_stats.get(
+                        "serialize_s", 0.0)
     finally:
         if writer is not None:
             # drain overlapped writes; a write failure surfaces here
@@ -322,6 +365,7 @@ def run_segmented(
             stats["ckpt_io_s"] = writer.io_seconds
             stats["ckpt_written"] = writer.written
             stats["ckpt_overlapped_segments"] = writer.overlapped
+            stats["ckpt_serialize_s"] = writer.serialize_seconds
         elif checkpoint_root:
             stats["ckpt_written"] = stats["segments"]
     return SoakResult(
@@ -350,6 +394,7 @@ def resume_segmented(
     mode: Optional[str] = None,
     donate: bool = True,
     async_checkpoint: bool = True,
+    mesh=None,
 ) -> SoakResult:
     """Resume a segmented run from the newest valid checkpoint under
     ``checkpoint_root``.
@@ -360,6 +405,14 @@ def resume_segmented(
     original scan bit for bit, so straight / interrupted-and-resumed
     runs converge to identical final state. Returned ``infos`` cover
     only the rounds run by THIS call.
+
+    ``mesh`` is the RESUMING process's mesh: the checkpoint's recorded
+    slices are re-placed against it whatever shape the saving mesh had
+    (8→4 chips, 1-D↔2-D ``(dcn, node)``, mesh↔single-device), so a soak
+    preempted on one topology continues bit for bit on another. Pass
+    ``net``/``inputs`` already placed for that mesh (``shard_state``);
+    with ``mesh=None`` the restored state is host-resident and the
+    first dispatch places it on the default device.
 
     Raises ``FileNotFoundError`` when no restorable checkpoint exists
     and ``ValueError`` on config drift (the checkpoint was written by a
@@ -372,7 +425,7 @@ def resume_segmented(
         )
     # latest_valid_checkpoint just ran the full hash pass on this path —
     # skip re-hashing the state it already proved clean
-    manifest, state = load_checkpoint(path, verify=False)
+    manifest, state = load_checkpoint(path, verify=False, mesh=mesh)
     if manifest["mode"] != mode:
         raise ValueError(
             f"checkpoint mode {manifest['mode']!r} != run mode {mode!r}"

@@ -10,6 +10,10 @@
 # scripts/ — everything that drives the hot entry points. Findings are
 # published machine-readably to artifacts/lint_r06.json.
 #
+# The sharded-checkpoint probe (ISSUE 9) publishes
+# artifacts/ckpt_r09.json: per-shard drain stall vs overlapped IO vs
+# shard count, plus the 8->4 resharded-restore bitwise check.
+#
 # corrosan (ISSUE 8) publishes artifacts/san_r08.json with two
 # sections: "fixtures" (seeded-race replay verdicts via
 # `corrosion-tpu san`) and "pytest" (the threaded test modules re-run
@@ -50,6 +54,12 @@ echo "corrosan: clean (report: artifacts/san_r08.json)"
 if [[ "${1:-}" == "--san" ]]; then
     exit 0
 fi
+
+echo "== sharded checkpoint probe =="
+# per-shard drain + elastic 8->4 resharded restore, published next to
+# the lint/san artifacts (stall vs overlapped IO vs shard count)
+python scripts/ckpt_probe.py --output artifacts/ckpt_r09.json
+echo "ckpt probe: ok (report: artifacts/ckpt_r09.json)"
 
 echo "== tier-1 tests =="
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \

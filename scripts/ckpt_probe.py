@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""Sharded-checkpoint pipeline probe -> artifacts/ckpt_r09.json.
+
+A CPU-budget end-to-end check of the ISSUE 9 story, published as a
+machine-readable artifact next to the lint/san reports:
+
+- **stall vs overlapped IO vs shard count**: one segmented soak runs
+  synchronously un-sharded (the baseline that pays serialize+hash+IO on
+  the hot loop) and one runs sharded over the 8 virtual devices with
+  the async writer — the sharded arm must drain one slice per device
+  (``ckpt_shards == 8``, largest shard a fraction of the total) with
+  the hot-loop stall under the overlapped IO time;
+- **elastic restore**: the sharded run's checkpoint resumes on a
+  4-device mesh and must finish bitwise identical to an uninterrupted
+  straight scan (the resharded-restore acceptance bar).
+
+Exit 0 with ``"ok": true`` when every claim holds; exit 1 otherwise
+(the artifact is written either way).
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# must be set before jax initializes; conftest does the same for tests
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+
+def main() -> int:
+    import jax
+
+    # sitecustomize may register a TPU-tunnel plugin; force CPU like
+    # the test harness does
+    jax.config.update("jax_platforms", "cpu")
+    from corrosion_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+
+    import jax.random as jr
+    import numpy as np
+
+    from corrosion_tpu.parallel.mesh import make_mesh, shard_state
+    from corrosion_tpu.resilience.segments import (
+        make_soak_inputs,
+        resume_segmented,
+        run_segmented,
+    )
+    from corrosion_tpu.sim.scale_step import (
+        ScaleSimState,
+        scale_run_rounds,
+        scale_sim_config,
+    )
+    from corrosion_tpu.sim.transport import NetModel
+
+    import tempfile
+
+    # tests/test_resilience.py's scale rig shapes — persistent-cache hits
+    cfg = scale_sim_config(
+        24, m_slots=8, n_origins=4, n_rows=4, n_cols=2, sync_interval=4
+    )
+    net = NetModel.create(cfg.n_nodes, drop_prob=0.02)
+    st0 = ScaleSimState.create(cfg)
+    key0 = jr.key(3)
+    inputs = make_soak_inputs(cfg, jr.key(5), 16, write_frac=0.25,
+                              mode="scale")
+    st_ref, _ = jax.jit(
+        lambda s, k, i: scale_run_rounds(cfg, s, net, k, i)
+    )(st0, key0, inputs)
+    jax.block_until_ready(st_ref)
+
+    problems = []
+
+    # --- arm 1: synchronous, un-sharded (hot-loop baseline) --------------
+    with tempfile.TemporaryDirectory() as tmp:
+        r_sync = run_segmented(
+            cfg, st0, net, key0, inputs, segment_rounds=8, mode="scale",
+            checkpoint_root=tmp, donate=False, async_checkpoint=False,
+        )
+    if r_sync.stats["ckpt_shards"] != 1:
+        problems.append("un-sharded arm drained more than one shard")
+
+    # --- arm 2: sharded + overlapped writer ------------------------------
+    import shutil
+
+    mesh8 = make_mesh(jax.devices()[:8])
+    st_s = shard_state(mesh8, cfg.n_nodes, st0)
+    net_s = shard_state(mesh8, cfg.n_nodes, net)
+    in_s = shard_state(mesh8, cfg.n_nodes, inputs)
+    tmp_root = tempfile.mkdtemp(prefix="ckpt_probe_")
+    try:
+        r_shard = run_segmented(
+            cfg, st_s, net_s, key0,
+            jax.tree.map(lambda a: a[:8], in_s), segment_rounds=8,
+            mode="scale", checkpoint_root=tmp_root,
+        )
+        s = r_shard.stats
+        if s["ckpt_shards"] != 8:
+            problems.append(
+                f"sharded arm drained {s['ckpt_shards']} shards")
+        if s["ckpt_shard_bytes_max"] * 2 > s["ckpt_drain_bytes"]:
+            problems.append("largest shard holds over half the drain bytes")
+        # stall vs io is recorded but not gated here: at probe size (24
+        # nodes, ~40 KB of carry) per-shard Python overhead dominates
+        # both numbers; BENCH_SMOKE=1 enforces stall < io at bench scale
+
+        # --- elastic restore: resume the 8-way checkpoint on 4 devices ---
+        mesh4 = make_mesh(jax.devices()[:4])
+        res = resume_segmented(
+            cfg, shard_state(mesh4, cfg.n_nodes, net),
+            shard_state(mesh4, cfg.n_nodes, inputs), segment_rounds=8,
+            mode="scale", checkpoint_root=tmp_root, mesh=mesh4,
+        )
+    finally:
+        shutil.rmtree(tmp_root, ignore_errors=True)
+    resharded_ok = res.completed_rounds == 16 and not res.aborted and all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree.leaves(st_ref), jax.tree.leaves(res.state))
+    )
+    if not resharded_ok:
+        problems.append("8->4 resharded resume is not bitwise identical")
+
+    record = {
+        "metric": "ckpt_probe_cpu",
+        "ok": not problems,
+        "devices": len(jax.devices()),
+        "resharded_restore_ok": resharded_ok,
+        "sync_unsharded": {
+            "ckpt_stall_s": round(r_sync.stats["ckpt_stall_s"], 4),
+            "ckpt_shards": r_sync.stats["ckpt_shards"],
+            "ckpt_drain_bytes": r_sync.stats["ckpt_drain_bytes"],
+        },
+        "async_sharded": {
+            "ckpt_stall_s": round(s["ckpt_stall_s"], 4),
+            "ckpt_io_s": round(s["ckpt_io_s"], 4),
+            "ckpt_serialize_s": round(s["ckpt_serialize_s"], 4),
+            "ckpt_shards": s["ckpt_shards"],
+            "ckpt_drain_bytes": s["ckpt_drain_bytes"],
+            "ckpt_shard_bytes_max": s["ckpt_shard_bytes_max"],
+        },
+        "resume_4dev": {
+            "ckpt_shards": res.stats["ckpt_shards"],
+            "completed_rounds": res.completed_rounds,
+        },
+    }
+    if problems:
+        record["problems"] = problems
+    out = sys.argv[sys.argv.index("--output") + 1] if (
+        "--output" in sys.argv) else "artifacts/ckpt_r09.json"
+    os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+    with open(out, "w") as f:
+        json.dump(record, f, indent=2)
+    print(json.dumps(record))
+    return 0 if not problems else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

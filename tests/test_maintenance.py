@@ -71,9 +71,12 @@ def test_resume_falls_back_past_corrupt_side(tmp_path, rig):
     b = maint.tick()
     assert a and b and a != b
     # corrupt the newest side's state file; its manifest still exists
+    import json
     import os
     newest = MaintenanceLoop.latest_auto_checkpoint(str(tmp_path))
-    with open(os.path.join(newest, "state.npz"), "wb") as f:
+    with open(os.path.join(newest, "manifest.json")) as f:
+        name = sorted(json.load(f)["files"])[0]
+    with open(os.path.join(newest, name), "wb") as f:
         f.write(b"garbage")
     man = MaintenanceLoop.resume_latest(rig.agent, str(tmp_path), db=rig.db)
     assert man is not None and man["path"] != newest  # fell back

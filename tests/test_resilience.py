@@ -74,6 +74,16 @@ def fresh_state(cfg, mode):
     return St.create(cfg)
 
 
+def state_file(path):
+    """The first state file a checkpoint's manifest records (v3:
+    ``shard-00000.npz``; legacy v2: ``state.npz``) — corruption tests
+    stay layout-agnostic."""
+    with open(os.path.join(path, "manifest.json")) as f:
+        files = sorted(json.load(f)["files"])
+    assert files
+    return os.path.join(path, files[0])
+
+
 def assert_trees_equal(a, b, what=""):
     la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
     assert len(la) == len(lb)
@@ -255,13 +265,13 @@ def test_crash_mid_overwrite_rejects_the_side(tmp_path, monkeypatch):
 
 
 def test_tampered_leaf_file_refused(tmp_path):
-    """Flip one byte in the committed ``state.npz``: load_checkpoint
-    must refuse with a clear integrity error and verify-checkpoint must
+    """Flip one byte in a committed state file: load_checkpoint must
+    refuse with a clear integrity error and verify-checkpoint must
     exit non-zero."""
     cfg = scale_cfg()
     view = _AgentView(cfg, fresh_state(cfg, "scale"))
     path = save_checkpoint(view, path=str(tmp_path / "ckpt"))
-    npz = os.path.join(path, "state.npz")
+    npz = state_file(path)
     blob = bytearray(open(npz, "rb").read())
     blob[len(blob) // 2] ^= 0xFF
     with open(npz, "wb") as f:
@@ -690,7 +700,7 @@ def test_checkpoint_extra_payload_roundtrip(tmp_path):
                            extra={"soak": {"completed_rounds": 7}})
     manifest, _ = load_checkpoint(path)
     assert manifest["extra"]["soak"]["completed_rounds"] == 7
-    assert manifest["files"]["state.npz"]
+    assert manifest["files"]  # every state file carries a content hash
     # manifest survives a json round-trip (the CLI prints it)
     json.dumps(verify_checkpoint(path))
 
@@ -737,7 +747,7 @@ def test_async_checkpoint_overlaps_io_and_keeps_parity(tmp_path, scale16):
     newest = r_async.checkpoint
     assert newest and latest_valid_checkpoint(root) == newest
     verify_checkpoint(newest)
-    p = os.path.join(newest, "state.npz")
+    p = state_file(newest)
     with open(p, "rb") as f:
         blob = bytearray(f.read())
     blob[len(blob) // 2] ^= 0xFF
@@ -766,6 +776,98 @@ def test_async_write_failure_surfaces(tmp_path, monkeypatch):
         run_segmented(cfg, fresh_state(cfg, "scale"), net, jr.key(19),
                       inputs, segment_rounds=8,
                       checkpoint_root=str(tmp_path))
+
+
+# --- donation-aware agent round loop (ISSUE 9 satellite) ------------------
+
+
+def test_agent_round_loop_donates_carry(tmp_path):
+    """The live round dispatch donates the carry: a pre-round state
+    reference is CONSUMED by the next dispatch (no boundary holds two
+    device copies), while concurrent readers — snapshot, live
+    checkpoint — stay safe behind the state lease with owned copies."""
+    from corrosion_tpu.agent import Agent
+    from corrosion_tpu.parallel.mesh import buffers_donated
+
+    cfg = agent_config(tmp_path)
+    agent = Agent(cfg)
+    try:
+        agent.start(auto_recover=True)
+        assert agent._donate_effective
+        assert agent.wait_rounds(2, timeout=120)
+        probe = agent._state  # raw ref, NOT the lease-protected copy
+        assert agent.wait_rounds(2, timeout=120)
+        assert buffers_donated(probe), (
+            "round dispatch ran un-donated: the old carry survived"
+        )
+        # concurrent readers while rounds keep running: owned copies,
+        # never a deleted-buffer error, values stay sane
+        for _ in range(20):
+            snap = agent.snapshot()
+            assert snap["store"][1].flags.owndata
+            assert int(snap["alive"].sum()) >= 0
+            agent.read_cell(0, 0)
+        # a LIVE checkpoint rides device_state()'s leased host copy and
+        # verifies clean
+        path = save_checkpoint(agent, path=os.path.join(cfg.db.path,
+                                                        "live-ckpt"))
+        assert agent.wait_rounds(2, timeout=120)
+        verify_checkpoint(path)
+    finally:
+        agent.shutdown()
+
+
+def test_supervised_agent_without_recovery_keeps_donation_off(tmp_path):
+    """A supervised agent with no checkpoint rollback has no re-upload
+    story for a consumed carry — donation must stay off (the segmented
+    runner applies the same rule), and the dispatch still works."""
+    from corrosion_tpu.agent import Agent
+
+    cfg = agent_config(tmp_path)
+    agent = Agent(cfg)
+    sup = Supervisor(backoff=Backoff(0.01, max_retries=1),
+                     sleep=lambda _d: None)
+    try:
+        agent.start(supervisor=sup)  # auto_recover=False
+        assert not agent._donate_effective
+        assert agent.wait_rounds(2, timeout=120)
+        probe = agent._state
+        assert agent.wait_rounds(2, timeout=120)
+        from corrosion_tpu.parallel.mesh import buffers_donated
+
+        assert not buffers_donated(probe)
+    finally:
+        agent.shutdown()
+
+    # ... and supervised WITH auto_recover donates
+    agent2 = Agent(cfg)
+    sup2 = Supervisor(backoff=Backoff(0.01, max_retries=1),
+                      sleep=lambda _d: None)
+    try:
+        agent2.start(auto_recover=True, supervisor=sup2)
+        assert agent2._donate_effective
+        assert agent2.wait_rounds(2, timeout=120)
+    finally:
+        agent2.shutdown()
+
+
+def test_donate_rounds_config_switch(tmp_path):
+    """config.perf.donate_rounds=False restores the two-copy loop."""
+    from corrosion_tpu.agent import Agent
+    from corrosion_tpu.parallel.mesh import buffers_donated
+
+    cfg = agent_config(tmp_path)
+    cfg.perf.donate_rounds = False
+    agent = Agent(cfg)
+    try:
+        agent.start()
+        assert not agent._donate_effective
+        assert agent.wait_rounds(2, timeout=120)
+        probe = agent._state
+        assert agent.wait_rounds(2, timeout=120)
+        assert not buffers_donated(probe)
+    finally:
+        agent.shutdown()
 
 
 def test_agent_soak_dispatch_adopts_carry(tmp_path):
