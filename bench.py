@@ -204,6 +204,11 @@ def _worker() -> None:
         # >1 routes writes through K-cell chunked transactions (the
         # partial-buffer path, change.rs:66-178 + util.rs:1061-1194)
         overrides["tx_max_cells"] = int(os.environ["BENCH_TX_CELLS"])
+    if os.environ.get("BENCH_FUSED"):
+        # fused-path arm (ISSUE 10): auto/on/off/interpret — the
+        # execution knob the sim config threads to ops/megakernel.py;
+        # "interpret" is the CPU-parity arm, "off" the XLA A/B arm
+        overrides["fused"] = os.environ["BENCH_FUSED"]
     unknown = [k for k in overrides if k not in fields]
     for k in unknown:
         del overrides[k]
@@ -258,6 +263,12 @@ def _worker() -> None:
         inputs = shard_state(mesh, n_nodes, inputs)
 
     from corrosion_tpu.parallel.mesh import buffers_donated
+    from corrosion_tpu.ops import megakernel
+
+    # hoist the fused-path probes out of the warm call's trace: under
+    # "auto" on TPU this runs the tiny differential + width probes once,
+    # eagerly, BEFORE the sharded dispatch compiles (docs/fused.md)
+    fused_dec = megakernel.prime_fused(cfg)
 
     run = jax.jit(functools.partial(scale_run_rounds, cfg), donate_argnums=(0,))
     probe = st  # donation probe: the warm call must consume these buffers
@@ -272,8 +283,6 @@ def _worker() -> None:
     dt = time.perf_counter() - t0
 
     rps = reps * rounds / dt
-    from corrosion_tpu.ops import megakernel
-
     rec = {
                 "metric": (
                     f"gossip_rounds_per_sec_n{n_nodes}_"
@@ -297,14 +306,15 @@ def _worker() -> None:
                 # loud fused-path visibility (VERDICT r2 weak #2): a TPU
                 # record measured on the XLA fallback is flagged, not
                 # silently reported as if it were the pallas path —
-                # shape-aware, so a width-lowering failure shows here too
-                "pallas_fused": bool(
-                    megakernel.use_fused_ingest(cfg, 4 * cfg.pig_changes)
-                    and megakernel.use_fused_swim(
-                        cfg.n_nodes, cfg.m_slots, cfg.pig_members,
-                        narrow=cfg.narrow_dtypes,
-                    )
-                ),
+                # shape-aware (prime_fused probed the real widths), and
+                # carried truthfully through the sharded runner: these
+                # are the SAME gate decisions the traced step consulted
+                "pallas_fused": megakernel.fused_engaged(fused_dec),
+                # the knob + whether the kernels ran interpreted — an
+                # interpret-mode record must never read as a real
+                # pallas-lowered number
+                "fused_mode": cfg.fused,
+                "fused_interpret": fused_dec["interpret"],
     }
     if unknown:
         rec["dropped_overrides"] = unknown
@@ -368,8 +378,21 @@ def _smoke() -> None:
 
     n_nodes = int(os.environ.get("BENCH_NODES", "768"))
     rounds = int(os.environ.get("BENCH_ROUNDS", "4"))
-    cfg = scale_sim_config(n_nodes)
+    overrides = {}
+    if os.environ.get("BENCH_FUSED"):
+        # fused-path smoke arm (ISSUE 10): BENCH_FUSED=interpret runs
+        # the pallas megakernels interpreted through the WHOLE pipeline
+        # below (donated scan, sharded segmented soak, per-shard
+        # checkpoint drain) and additionally gates fused==unfused
+        # parity on this run's workload
+        overrides["fused"] = os.environ["BENCH_FUSED"]
+    cfg = scale_sim_config(n_nodes, **overrides)
     net = NetModel.create(n_nodes, drop_prob=0.01)
+
+    from corrosion_tpu.ops import megakernel
+
+    fused_dec = megakernel.prime_fused(cfg)  # probes hoisted pre-trace
+    pallas_fused = megakernel.fused_engaged(fused_dec)
 
     # --- (a) the bench hot path, donation probed -------------------------
     k1, k2 = jr.split(jr.key(1))
@@ -391,6 +414,29 @@ def _smoke() -> None:
     st, _ = run(st, net, jr.key(2), inputs)
     jax.block_until_ready(st)
     rps = rounds / (time.perf_counter() - t0)
+
+    # --- (a') fused == unfused parity on this very workload --------------
+    # only when a fused kernel actually engaged: replay the same
+    # warm+timed sequence on the pinned XLA path and require bitwise
+    # identity — the interpret-mode smoke (BENCH_FUSED=interpret) gates
+    # the whole record on it
+    fused_parity = None
+    if pallas_fused:
+        import dataclasses
+
+        import numpy as np
+
+        cfg_off = dataclasses.replace(cfg, fused="off").validate()
+        run_off = jax.jit(functools.partial(scale_run_rounds, cfg_off),
+                          donate_argnums=(0,))
+        st_off = run_off(ScaleSimState.create(cfg_off), net, jr.key(0),
+                         inputs)[0]
+        st_off, _ = run_off(st_off, net, jr.key(2), inputs)
+        jax.block_until_ready(st_off)
+        fused_parity = all(
+            np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(jax.tree.leaves(st), jax.tree.leaves(st_off))
+        )
 
     # --- (b) segmented soak, overlapped checkpointing --------------------
     # sharded over every available device when the process has more
@@ -443,6 +489,15 @@ def _smoke() -> None:
                 1, stats.get("ckpt_written", 1))
             if stats.get("ckpt_shard_bytes_max", 0) >= per_ckpt > 0:
                 problems.append("checkpoint drain did not split per shard")
+    if fused_parity is False:
+        # the gate the fused smoke exists for: the pallas kernels
+        # diverged from the XLA path on this workload
+        problems.append("fused != unfused on the smoke workload")
+    if pallas_fused != bool(stats.get("pallas_fused")):
+        problems.append(
+            "segmented soak and bench path disagree about the fused "
+            f"gate ({stats.get('pallas_fused')} vs {pallas_fused})"
+        )
     if elapsed > deadline_s:
         problems.append(f"deadline exceeded: {elapsed:.0f}s > {deadline_s:.0f}s")
     rec = {
@@ -451,13 +506,27 @@ def _smoke() -> None:
         "unit": "rounds/s",
         "ok": not problems,
         "donated": donated,
-        "sharded": 1,
+        # the device count the SOAK leg ran on (the bench leg is
+        # single-device by construction): with >1 devices the soak
+        # shards over the whole mesh and the per-shard drain telemetry
+        # below must show it
+        "sharded": n_devices,
+        # fused-path provenance (ISSUE 10): knob, engagement, interpret
+        # mode, and the parity verdict (null = no fused kernel engaged)
+        "pallas_fused": pallas_fused,
+        "fused_mode": cfg.fused,
+        "fused_interpret": fused_dec["interpret"],
+        "fused_parity": fused_parity,
         "elapsed_s": round(elapsed, 2),
         "deadline_s": deadline_s,
         "soak": {
             "segments": stats.get("segments", 0),
             "donated_segments": stats.get("donated_segments", 0),
             "async_checkpoint": bool(stats.get("async_checkpoint")),
+            # the segment dispatch's own fused-gate record: the soak leg
+            # must ride the same path the bench leg reported
+            "fused_mode": stats.get("fused_mode", "auto"),
+            "pallas_fused": bool(stats.get("pallas_fused")),
             "ckpt_stall_s": round(stats.get("ckpt_stall_s", 0.0), 4),
             "ckpt_io_s": round(stats.get("ckpt_io_s", 0.0), 4),
             "ckpt_written": stats.get("ckpt_written", 0),

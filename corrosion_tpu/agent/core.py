@@ -263,6 +263,12 @@ class Agent:
         )
         if self._donate_effective:
             self._step = jax.jit(self._step_fn, donate_argnums=(0,))
+        # hoist the fused-path probes out of the first round's trace
+        # (docs/fused.md): path selection must never spawn an eager
+        # probe from inside the (possibly donated) round dispatch
+        from corrosion_tpu.ops import megakernel
+
+        megakernel.prime_fused(self.cfg)
         if auto_recover:
             self.recover_latest()
         self._thread = spawn_counted(
@@ -282,11 +288,13 @@ class Agent:
         restorable exists. This is the ONE recovery path: boot-time
         resume (``MaintenanceLoop.resume_latest``) and mid-run crash
         rollback both land here."""
-        import dataclasses
         import json
         import os
 
-        from corrosion_tpu.checkpoint import restore_checkpoint
+        from corrosion_tpu.checkpoint import (
+            config_identity,
+            restore_checkpoint,
+        )
         from corrosion_tpu.resilience.retention import (
             iter_valid_checkpoints,
         )
@@ -298,10 +306,14 @@ class Agent:
             for path in iter_valid_checkpoints(root):
                 # manifest-only read for the config gate: verification
                 # already deserialized the full state once and the
-                # restore will again — don't pay a third decode here
+                # restore will again — don't pay a third decode here.
+                # Identity excludes execution-only keys (``fused``): a
+                # checkpoint written under another execution mode is
+                # bitwise-compatible state
                 with open(os.path.join(path, "manifest.json")) as f:
                     manifest = json.load(f)
-                if manifest["sim_config"] != dataclasses.asdict(self.cfg):
+                if (config_identity(manifest["sim_config"])
+                        != config_identity(self.cfg)):
                     logger.error(
                         "checkpoint %s has a different sim config than "
                         "this agent; trying the next-newest", path,

@@ -46,9 +46,17 @@ NARROW_LEAVES: Dict[str, int] = {
     "last_sync": 16,
 }
 
-#: kernel out-ref spellings of the same planes (``ops/megakernel.py``)
+#: kernel out-ref spellings of the same planes (``ops/megakernel.py``):
+#: the swim kernel's timer/budget stores (``o_timer``/``o_tx``) and the
+#: fused ingest kernel's narrowed queue-plane stores
+#: (``o_q_cell``/``o_q_tx`` — the seq/nseq planes stay at their
+#: constant 0/1 on the single-cell fused path and never re-store).
+#: Every one of these must cast back at the store
+#: (``.astype(ref.dtype)``): a widened store changes the donated
+#: carry's aval and retraces every consumer (ISSUE 10).
 NARROW_REFS: Dict[str, int] = {
     "o_timer": 16, "o_tx": 16, "m_timer": 16, "m_tx": 16,
+    "o_q_cell": 16, "o_q_tx": 16,
 }
 NARROW_REFS.update(NARROW_LEAVES)
 

@@ -115,6 +115,10 @@ DRAIN_REGISTRY: Dict[str, str] = {
     # resume drain on tiny probe state
     "_host_roundtrip": "tracecount probe of the resume path on "
                        "probe-sized state",
+    # the fused probe's donate-safe variant of the same roundtrip
+    # (owned jnp.array re-upload; the chained dispatch donates)
+    "_host_roundtrip_owned": "tracecount probe of the donated resume "
+                             "path on probe-sized state",
 }
 
 

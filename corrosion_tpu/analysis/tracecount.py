@@ -52,6 +52,15 @@ def _host_roundtrip(tree):
     return jax.tree.map(jnp.asarray, host)
 
 
+def _host_roundtrip_owned(tree):
+    """Resume shape for DONATION-bound probes: the re-upload must be an
+    OWNED device copy (``jnp.array``, never ``asarray``) — the next
+    dispatch donates these buffers, and donating a zero-copy-adopted
+    numpy buffer corrupts the heap on CPU (PR 8)."""
+    host = jax.tree.map(lambda a: np.array(a), tree)
+    return jax.tree.map(lambda a: jnp.array(a), host)
+
+
 # tiny CPU-sized configs, matching shapes tier-1 already compiles
 # (tests/test_resilience.py) so the persistent cache is shared
 def _full_cfg():
@@ -240,6 +249,41 @@ def _probe_segmented_soak(repeats: int, rounds_per_segment: int = 8) -> int:
     return counter["traces"] - 1
 
 
+def _probe_fused_scale_run(repeats: int, rounds_per_segment: int = 2) -> int:
+    """The fused megakernel path (ISSUE 10): ``scale_run_rounds_carry``
+    under ``fused="interpret"`` with the FULL carry DONATED and chained
+    back in — the exact shape of a fused segmented-soak dispatch. The
+    eager probes are hoisted (``prime_fused``) before the first trace,
+    so a retrace here means the fused gates or the pallas lowering
+    destabilized the steady state, with donation active."""
+    import dataclasses
+
+    from corrosion_tpu.ops import megakernel
+    from corrosion_tpu.resilience.segments import make_soak_inputs
+    from corrosion_tpu.sim.scale_step import (
+        ScaleSimState,
+        scale_run_rounds_carry,
+    )
+    from corrosion_tpu.sim.transport import NetModel
+
+    cfg = dataclasses.replace(_scale_cfg(), fused="interpret").validate()
+    megakernel.prime_fused(cfg)  # probes run HERE, never inside a trace
+    net = NetModel.create(cfg.n_nodes)
+    fn, traces = counting_jit(
+        lambda s, k, i: scale_run_rounds_carry(cfg, s, net, k, i),
+        donate_argnums=(0, 1),
+    )
+    st, key = ScaleSimState.create(cfg), jr.key(0)
+    for i in range(repeats):
+        seg = make_soak_inputs(cfg, jr.key(i), rounds_per_segment,
+                               write_frac=0.25)
+        (st, key), _infos = fn(st, key, seg)
+        if i == 0:
+            st = _host_roundtrip_owned(st)  # resume shape, donate-safe
+    jax.block_until_ready(st)
+    return traces()
+
+
 #: name -> probe(repeats) -> observed trace count
 HOT_ENTRY_POINTS: Dict[str, Callable[[int], int]] = {
     "full_sim_step": _probe_full_step,
@@ -247,6 +291,7 @@ HOT_ENTRY_POINTS: Dict[str, Callable[[int], int]] = {
     "segment_dispatch": _probe_segment_dispatch,
     "sharded_scale_run": _probe_sharded_scale_run,
     "segmented_soak": _probe_segmented_soak,
+    "fused_scale_run": _probe_fused_scale_run,
 }
 
 

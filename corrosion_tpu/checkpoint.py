@@ -54,6 +54,26 @@ class CheckpointIntegrityError(ValueError):
     """A checkpoint directory is incomplete, tampered with, or corrupt."""
 
 
+#: sim-config keys that select an execution path without changing what
+#: the simulation computes (fused == unfused is pinned bit for bit) —
+#: excluded from checkpoint config-identity checks so a run may resume
+#: under a different execution mode (e.g. a TPU soak's checkpoint
+#: restored under ``fused="interpret"`` on CPU), and so manifests
+#: written before the key existed keep restoring.
+EXECUTION_ONLY_CONFIG_KEYS = ("fused",)
+
+
+def config_identity(cfg_or_dict) -> dict:
+    """The portion of a sim config that checkpoint compatibility is
+    judged on: the ``dataclasses.asdict`` dict minus
+    :data:`EXECUTION_ONLY_CONFIG_KEYS`. Accepts a config dataclass or
+    an already-serialized manifest ``sim_config`` dict."""
+    d = (cfg_or_dict if isinstance(cfg_or_dict, dict)
+         else dataclasses.asdict(cfg_or_dict))
+    return {k: v for k, v in d.items()
+            if k not in EXECUTION_ONLY_CONFIG_KEYS}
+
+
 def _leaves(state) -> list:
     return jax.tree.leaves(state)
 

@@ -296,6 +296,13 @@ def cmd_soak(args) -> int:
 
     cfg_file = load_config(args.config) if args.config else Config()
     cfg = cfg_file.sim_config()
+    if getattr(args, "fused", None):
+        # execution-path override on top of [perf] fused: same state,
+        # same results (fused parity is pinned), different kernels —
+        # checkpoint identity ignores it, so --resume composes freely
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, fused=args.fused).validate()
     net = NetModel.create(
         cfg.n_nodes,
         drop_prob=cfg_file.gossip.drop_prob,
@@ -604,6 +611,15 @@ def build_parser() -> argparse.ArgumentParser:
     sk.add_argument("--mesh-hosts", type=int, default=0,
                     help="with --shard: fold the devices into a 2-D "
                          "(dcn, node) mesh over this many hosts")
+    from corrosion_tpu.sim.config import FUSED_MODES
+
+    sk.add_argument("--fused", choices=list(FUSED_MODES),
+                    default=None,
+                    help="fused megakernel path override (default: the "
+                         "[perf] fused config key; docs/fused.md). "
+                         "'interpret' runs the pallas kernels "
+                         "interpreted on any backend — the parity/"
+                         "debug mode")
     sk.set_defaults(fn=cmd_soak)
 
     t = sub.add_parser("template", help="render templates (re-render on change)")

@@ -86,6 +86,13 @@ class PerfConfig:
     # keeps donation off (no re-upload story). Debug switch: False
     # restores the double-buffered (two-copy) round loop.
     donate_rounds: bool = True
+    # fused megakernel path (ops/megakernel.py, docs/fused.md):
+    # "auto" = pallas kernels on non-CPU backends when the eager probes
+    # pass; "on"/"off" pin the fused/XLA path; "interpret" runs the
+    # fused kernels in pallas interpret mode on any backend (the
+    # tier-1 parity/testing mode). Threaded onto the sim config as
+    # ``cfg.fused`` — execution only, results are bitwise identical
+    fused: str = "auto"
 
 
 @dataclasses.dataclass
@@ -173,6 +180,7 @@ class Config:
             sync_peers=self.perf.sync_peers,
             bcast_max_transmissions=self.perf.bcast_max_transmissions,
             announce_interval=self.gossip.idle_rounds,
+            fused=self.perf.fused,
         )
 
     def to_full_config(self):
@@ -192,6 +200,7 @@ class Config:
             bcast_fanout=self.perf.bcast_fanout,
             bcast_max_transmissions=self.perf.bcast_max_transmissions,
             announce_interval=self.gossip.idle_rounds,
+            fused=self.perf.fused,
         )
 
     def sim_config(self):

@@ -20,9 +20,15 @@ unfused exactly. Only the single-cell fast path is fused (``nseq == 1``,
 ``process_complete_version``, reference ``util.rs:1197``); configs with
 multi-cell transactions keep the XLA partial-buffer path.
 
-CPU/tests run the kernel in pallas interpret mode; the scale simulator
-uses the fused path automatically on TPU backends (``FORCE_FUSED``
-overrides for tests, mirroring ``dense.FORCE_DENSE``).
+Path selection is the ``fused`` config knob (``config.perf.fused`` ->
+``cfg.fused`` on the sim configs, docs/fused.md): ``auto`` takes the
+fused path on non-CPU backends when the eager differential/width probes
+pass; ``on``/``off`` pin the fused/XLA path; ``interpret`` runs the
+fused kernels in pallas interpret mode on ANY backend — which is how
+tier-1 exercises fused==unfused parity on CPU, through the sharded mesh
+and the segmented soak included. Production dispatchers hoist the eager
+probes with :func:`prime_fused` so they run once per (backend, shape)
+BEFORE trace time instead of inside a sharded dispatch.
 """
 
 from __future__ import annotations
@@ -34,14 +40,41 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-# None = fused on non-CPU backends; True/False pin (tests)
-FORCE_FUSED: Optional[bool] = None
+# the legal knob values live with the configs that validate them
+# (sim/config.py is import-light; this re-export keeps the gates' home
+# module the natural place to look them up)
+from corrosion_tpu.sim.config import FUSED_MODES
 
 _pallas_ok_cache: dict = {}  # backend -> tiny differential probes passed
 _width_ok_cache: dict = {}  # (backend, kernel, shape key) -> lowers + runs
 # jax._src.core.trace_state_clean, resolved once on first use; False
 # once the private API is found missing (thread path used from then on)
 _trace_state_clean = None
+
+
+def _backend() -> str:
+    """The backend name the gates/probes key on — a seam so tests can
+    exercise TPU-shaped gating without a TPU (monkeypatch this, never
+    ``jax.default_backend`` itself: the jit machinery uses that too)."""
+    return jax.default_backend()
+
+
+def fused_mode(cfg) -> str:
+    """The ``fused`` knob of ``cfg`` (``auto`` for configs that predate
+    the field — e.g. checkpoint manifests written before it existed)."""
+    mode = getattr(cfg, "fused", "auto") or "auto"
+    if mode not in FUSED_MODES:
+        raise ValueError(
+            f"fused mode {mode!r} not one of {FUSED_MODES} (docs/fused.md)"
+        )
+    return mode
+
+
+def fused_interpret(cfg) -> Optional[bool]:
+    """``interpret=`` argument for a fused kernel call under ``cfg``:
+    True pins pallas interpret mode, None defers to the backend default
+    (interpret on CPU, compiled elsewhere)."""
+    return True if fused_mode(cfg) == "interpret" else None
 
 
 def _eager(fn):
@@ -73,7 +106,7 @@ def _eager(fn):
         clean = False
     if clean:
         return fn()
-    import threading
+    from corrosion_tpu.utils.lifecycle import spawn_counted
 
     box: dict = {}
 
@@ -83,8 +116,11 @@ def _eager(fn):
         except BaseException as e:  # noqa: BLE001 — re-raised below
             box["e"] = e
 
-    t = threading.Thread(target=run, name="pallas-probe")
-    t.start()
+    # a counted corro-* spawn (not a raw Thread) so corrosan's leak
+    # gate and the conftest corro-prefix liveness check attribute it
+    # like every other thread this repo starts; it joins before this
+    # returns, so it can never survive a sanitizer window
+    t = spawn_counted(run, name="corro-pallas-probe")
     t.join()
     if "e" in box:
         raise box["e"]
@@ -96,7 +132,7 @@ def _warn_degrade(stage: str, detail: str = "") -> None:
 
     print(
         f"WARNING: pallas megakernel {stage} probe failed on backend "
-        f"{jax.default_backend()!r}; callers degrade to the (much "
+        f"{_backend()!r}; callers degrade to the (much "
         f"slower) XLA form. {detail}",
         file=sys.stderr, flush=True,
     )
@@ -144,7 +180,7 @@ def _pallas_works() -> bool:
     backend's pallas lowering can't handle them (experimental tunnel
     plugins), every caller degrades to the XLA path instead of failing
     the bench."""
-    backend = jax.default_backend()
+    backend = _backend()
     if backend not in _pallas_ok_cache:
         def _run_probe() -> bool:
             import jax.random as jr
@@ -223,7 +259,7 @@ def _width_ok_ingest(cfg, msgs: int, emit: bool = False) -> bool:
     small compile instead of a full-N bench attempt. ``emit`` probes the
     payload-emitting variant (extra outputs + selection loops) so the
     probed kernel matches the kernel actually run."""
-    backend = jax.default_backend()
+    backend = _backend()
     blk = _block_size(cfg.n_nodes)
     seen_w = max(1, -(-cfg.buf_slots // 32))
     # narrow_dtypes changes the probed kernel's lowering (int16 q
@@ -281,7 +317,7 @@ def _width_ok_swim(n_nodes: int, m_slots: int, pig_k: int = 0,
     aligned-row and bounded-piggyback channel forms). ``narrow`` probes
     with int16 timer/budget planes so the probed kernel matches a
     ``narrow_dtypes`` caller's lowering."""
-    backend = jax.default_backend()
+    backend = _backend()
     blk = _block_size(n_nodes)
     key = (backend, "swim", blk, m_slots, pig_k, narrow)
     if key not in _width_ok_cache:
@@ -318,30 +354,109 @@ def _width_ok_swim(n_nodes: int, m_slots: int, pig_k: int = 0,
     return _width_ok_cache[key]
 
 
-def use_fused() -> bool:
+def use_fused(mode: str = "auto") -> bool:
     """Backend-level answer (tiny differential probes only)."""
-    if FORCE_FUSED is not None:
-        return FORCE_FUSED
-    return jax.default_backend() != "cpu" and _pallas_works()
+    if mode != "auto":
+        return mode in ("on", "interpret")
+    return _backend() != "cpu" and _pallas_works()
 
 
 def use_fused_ingest(cfg, msgs: int = 16, emit: bool = False) -> bool:
     """Shape-aware answer for the ingest kernel at ``cfg``'s widths."""
     if getattr(cfg, "bcast_wire_budget", False):
         # the wire-budget payload lane predates the kernel's ref layout
-        # — flagged configs take the XLA path (round-6 kernel work)
+        # — flagged configs take the XLA path even when the knob pins
+        # the fused path (round-6 kernel work)
         return False
-    if FORCE_FUSED is not None:
-        return FORCE_FUSED
+    mode = fused_mode(cfg)
+    if mode != "auto":
+        return mode in ("on", "interpret")
     return use_fused() and _width_ok_ingest(cfg, msgs, emit)
 
 
 def use_fused_swim(n_nodes: int, m_slots: int, pig_k: int = 0,
-                   narrow: bool = False) -> bool:
-    """Shape-aware answer for the swim kernel at the caller's widths."""
-    if FORCE_FUSED is not None:
-        return FORCE_FUSED
+                   narrow: bool = False, mode: str = "auto") -> bool:
+    """Shape-aware answer for the swim kernel at the caller's widths;
+    ``mode`` is the caller's ``fused_mode(cfg)`` (the swim tables carry
+    no config object of their own)."""
+    if mode not in FUSED_MODES:
+        raise ValueError(
+            f"fused mode {mode!r} not one of {FUSED_MODES} (docs/fused.md)"
+        )
+    if mode != "auto":
+        return mode in ("on", "interpret")
     return use_fused() and _width_ok_swim(n_nodes, m_slots, pig_k, narrow)
+
+
+def prime_fused(cfg) -> dict:
+    """Hoisted gate evaluation: run the eager pallas probes for every
+    (kernel, width) the round step under ``cfg`` will consult, OUTSIDE
+    any trace, and return the decisions.
+
+    The gates below are consulted at TRACE time (the step chooses
+    fused-vs-XLA while being traced) and, under ``auto`` on a real
+    backend, would otherwise run their differential/width probes from
+    inside a sharded dispatch via the ``_eager`` escape-hatch thread.
+    Production dispatchers (``parallel/mesh.sharded_scale_run*``,
+    ``resilience/segments.run_segmented``, ``Agent``, ``bench.py``) call
+    this first so the probes run exactly once per (backend, shape) at
+    Python level; the in-trace gate calls then hit the warm caches.
+    Repeat calls are cheap cache lookups.
+
+    Returns ``{"mode", "interpret", "ingest", "ingest_emit", "swim"}``
+    — the knob, whether engaged kernels run interpreted (False when
+    none engage), and the per-kernel decisions (``None`` for a kernel
+    the config never dispatches)."""
+    mode = fused_mode(cfg)
+    out = {
+        "mode": mode,
+        "ingest": None,
+        "ingest_emit": None,
+        "swim": None,
+    }
+    single_cell = getattr(cfg, "tx_max_cells", 1) <= 1
+    pig = int(getattr(cfg, "pig_changes", 0))
+    if hasattr(cfg, "bcast_queue") and single_cell:
+        # every ingest width the round step will consult, each probed
+        # UNCONDITIONALLY (no short-circuit: a failing width must not
+        # leave a later width's cache cold, or the trace-time gate
+        # would run that probe from inside the dispatch — the exact
+        # thing hoisting exists to prevent): the local-write width
+        # (msgs=1, emitting the piggyback payload when the scale step
+        # will), the piggyback receive batch (4 SWIM channels x pig
+        # slots), and the full sim's apply-mailbox width
+        gates = [use_fused_ingest(cfg, msgs=1)]
+        if pig > 0:
+            out["ingest_emit"] = use_fused_ingest(cfg, msgs=1, emit=True)
+            gates.append(use_fused_ingest(cfg, msgs=4 * pig))
+        recv = int(getattr(cfg, "recv_slots", 0))
+        if recv > 0:
+            gates.append(use_fused_ingest(cfg, msgs=recv))
+        out["ingest"] = all(gates)
+    if hasattr(cfg, "m_slots"):
+        out["swim"] = use_fused_swim(
+            cfg.n_nodes, cfg.m_slots,
+            int(getattr(cfg, "pig_members", 0)),
+            narrow=bool(getattr(cfg, "narrow_dtypes", False)),
+            mode=mode,
+        )
+    # interpret is a statement about the kernels that RUN: False when
+    # nothing engaged (an XLA-only record must never claim
+    # interpret-mode execution)
+    out["interpret"] = (
+        (mode == "interpret" or _backend() == "cpu") and fused_engaged(out)
+    )
+    return out
+
+
+def fused_engaged(decisions: dict) -> bool:
+    """True when EVERY kernel the probed config dispatches engaged —
+    the one definition of the ``pallas_fused`` provenance bit, shared
+    by ``SoakResult.stats`` and the bench records so the two can never
+    disagree about the same run."""
+    vals = [decisions.get(k) for k in ("ingest", "ingest_emit", "swim")]
+    vals = [v for v in vals if v is not None]
+    return bool(vals) and all(vals)
 
 
 def _cols(table, idx, fill=0):
@@ -683,6 +798,18 @@ def ingest_changes_fused(cfg, cst, live, m_origin, m_dbv, m_cell, m_ver,
     piggyback payload selection from the post-update queue planes it
     already holds in VMEM (returning ``(cst, info, (payload, sel_slots,
     sel_ok))``) — the XLA selection phase then disappears.
+
+    Donated-carry contract (the mesh donation comment block,
+    ``parallel/mesh.py`` "Changing donate_argnums here REQUIRES..."):
+    inside a donating dispatch the ``cst`` planes ARE the donated carry
+    buffers. Every input ref is fully consumed by the single
+    ``pallas_call`` below — nothing captures a ref past the dispatch —
+    so XLA may alias kernel outputs onto the donated inputs; the
+    narrowed planes (``analysis/dtypes.py::NARROW_REFS``) keep their
+    int16 dtype at the out-ref store (``.astype(ref.dtype)``), which is
+    what keeps the donated carry's aval stable across fused and XLA
+    rounds (a widened store would both break aliasing and retrace every
+    consumer).
     """
     from corrosion_tpu.sim.broadcast import (
         CHANGE_WIRE_BYTES as _CHANGE_WIRE_BYTES,
@@ -692,7 +819,9 @@ def ingest_changes_fused(cfg, cst, live, m_origin, m_dbv, m_cell, m_ver,
     )
 
     if interpret is None:
-        interpret = jax.default_backend() == "cpu"
+        # the config knob may pin interpret mode on any backend
+        # (docs/fused.md); otherwise CPU interprets, real backends lower
+        interpret = fused_mode(cfg) == "interpret" or _backend() == "cpu"
 
     n = live.shape[0]
     o_cnt = cst.book.head.shape[1]
@@ -928,9 +1057,14 @@ def swim_tables_fused(
     *, interpret: Optional[bool] = None,
 ):
     """Pallas-fused form of ``sim.scale.swim_tables_update`` (same
-    argument order; channel groups as length-4 lists)."""
+    argument order; channel groups as length-4 lists). No config object
+    reaches this layer: callers resolve the knob and pass
+    ``interpret=fused_interpret(cfg)`` (None = backend default). The
+    donated-carry/narrow-dtype contract is the same as
+    :func:`ingest_changes_fused` — the timer/budget out-ref stores cast
+    back to the plane dtype (see ``_swim_kernel``)."""
     if interpret is None:
-        interpret = jax.default_backend() == "cpu"
+        interpret = _backend() == "cpu"
     n, m = mem_id.shape
     blk = _block_size(n)
 

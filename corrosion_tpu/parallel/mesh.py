@@ -172,7 +172,20 @@ def sharded_run(cfg: SimConfig, mesh: Mesh, st, net, key, inputs):
 # registry. These wrappers are also the sharding-contract checker's
 # taint sources (``analysis/sharding.py``): their state args must come
 # placed through ``shard_state`` and their outputs must never be
-# host-materialized outside the drain registry.
+# host-materialized outside the drain registry. Under ``cfg.fused``
+# the scanned step dispatches the pallas megakernels INSIDE these
+# donated programs — the kernels' donated-carry/narrow-dtype contract
+# lives at ``ops/megakernel.ingest_changes_fused`` (every in-ref
+# consumed within the dispatch, int16 planes re-narrowed at the
+# out-ref store), and the wrappers hoist the eager fused probes
+# (``megakernel.prime_fused``) so path selection never runs a probe
+# thread from inside a traced/sharded dispatch.
+
+
+def _prime_fused(cfg) -> None:
+    from corrosion_tpu.ops import megakernel
+
+    megakernel.prime_fused(cfg)
 
 
 @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))
@@ -188,6 +201,7 @@ def sharded_scale_run(cfg, mesh, st, net, key, inputs):
     returned state in a loop never holds two device copies. The caller's
     ``st`` is consumed — keep a host copy if it must survive."""
     del mesh  # sharding travels on the arguments
+    _prime_fused(cfg)  # eager probes BEFORE the trace, never inside it
     return _scale_run(cfg, st, net, key, inputs)
 
 
@@ -204,6 +218,7 @@ def sharded_scale_run_carry(cfg, mesh, st, net, key, inputs):
     ``(state, key)`` back in reproduces the straight scan bit for bit
     with zero duplicate carry allocations at segment boundaries."""
     del mesh  # sharding travels on the arguments
+    _prime_fused(cfg)  # eager probes BEFORE the trace, never inside it
     return _scale_run_carry(cfg, st, key, net, inputs)
 
 

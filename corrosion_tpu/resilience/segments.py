@@ -98,11 +98,26 @@ def _n_rounds(inputs) -> int:
 _jit = jax.jit
 
 
-def _pipeline_stats(donate: bool, async_checkpoint: bool) -> dict:
-    """A zeroed stats record (the keys every SoakResult.stats carries)."""
+def _pipeline_stats(donate: bool, async_checkpoint: bool,
+                    fused: Optional[dict] = None) -> dict:
+    """A zeroed stats record (the keys every SoakResult.stats carries).
+
+    ``fused`` is the :func:`corrosion_tpu.ops.megakernel.prime_fused`
+    decision dict for the run's config (None = probes not run, e.g. a
+    resume that had nothing left to do)."""
+    from corrosion_tpu.ops.megakernel import fused_engaged
+
+    fused = fused or {}
     return {
         "donate": donate,
         "async_checkpoint": async_checkpoint,
+        # which execution path the segments dispatch (ISSUE 10): the
+        # knob, and whether the pallas megakernels actually engage —
+        # the SAME ``fused_engaged`` bit the bench records, surfaced
+        # as ``pallas_fused`` next to ``donated``/``sharded``
+        "fused_mode": fused.get("mode", "auto"),
+        "pallas_fused": fused_engaged(fused),
+        "fused_interpret": bool(fused.get("interpret")),
         "segments": 0,
         "donated_segments": 0,
         "carry_reuploads": 0,
@@ -234,6 +249,12 @@ def run_segmented(
     mode = mode or _infer_mode(cfg)
     run_carry = _run_carry_fn(cfg, mode)
     rounds = _n_rounds(inputs)
+    # fused-path selection happens at trace time inside the dispatch
+    # below — hoist the eager pallas probes out of it (once per
+    # (backend, shape); docs/fused.md) and record what engaged
+    from corrosion_tpu.ops import megakernel
+
+    fused_decisions = megakernel.prime_fused(cfg)
     # one jitted program per distinct (segment length, donation) pair —
     # at most K and the final partial segment, donated and not
     jitted: dict = {}
@@ -258,7 +279,8 @@ def run_segmented(
             cfg, mode, checkpoint_root, keep_last, db,
             progress=lambda: seg_box["index"],
         )
-    stats = _pipeline_stats(donate, writer is not None)
+    stats = _pipeline_stats(donate, writer is not None,
+                            fused=fused_decisions)
     host_carry = None  # (numpy state pytree, key json) at the last boundary
     info_parts: list = []
     completed = 0
@@ -430,7 +452,13 @@ def resume_segmented(
         raise ValueError(
             f"checkpoint mode {manifest['mode']!r} != run mode {mode!r}"
         )
-    if manifest["sim_config"] != dataclasses.asdict(cfg):
+    from corrosion_tpu.checkpoint import config_identity
+
+    # identity minus execution-only keys: a soak checkpointed on the
+    # fused path resumes on the XLA path (or interpret mode) bit for
+    # bit — fused parity is pinned — while any SEMANTIC drift still
+    # refuses loudly
+    if config_identity(manifest["sim_config"]) != config_identity(cfg):
         raise ValueError(
             "checkpoint sim config differs from the resuming run's — "
             "resuming would not reproduce the original scan"

@@ -17,6 +17,12 @@ from __future__ import annotations
 import dataclasses
 import math
 
+#: legal values of the ``fused`` execution knob (docs/fused.md) — the
+#: ONE canonical tuple; the sim configs validate against it and
+#: ``ops.megakernel``/the CLI re-export it (this module is import-light,
+#: so the CLI parser can use it without pulling in jax)
+FUSED_MODES = ("auto", "on", "off", "interpret")
+
 
 @dataclasses.dataclass(frozen=True)
 class SimConfig:
@@ -81,6 +87,15 @@ class SimConfig:
     # convergence tests keep exercising the granted-range sync path
     # undiluted (a sweep would mask range-grant regressions)
     sync_sweep_every: int = 0
+    # --- fused megakernel path (execution knob, config.perf.fused) -------
+    # "auto": pallas kernels on non-CPU backends when the eager probes
+    # pass; "on": pin the fused path (interpret-mode on CPU); "off":
+    # pin the XLA path; "interpret": fused kernels in pallas interpret
+    # mode on ANY backend (the tier-1 parity/testing mode). Execution
+    # only — fused == unfused bit for bit (docs/fused.md), so this key
+    # is excluded from checkpoint config identity
+    # (checkpoint.config_identity)
+    fused: str = "auto"
 
     @property
     def n_cells(self) -> int:
@@ -108,6 +123,11 @@ class SimConfig:
             raise ValueError(
                 f"tx_max_cells {self.tx_max_cells} not in 1..30 "
                 f"(seq bitmask lives in an int32)"
+            )
+        if self.fused not in FUSED_MODES:
+            raise ValueError(
+                f"fused {self.fused!r} not one of {FUSED_MODES} "
+                f"(docs/fused.md)"
             )
         return self
 

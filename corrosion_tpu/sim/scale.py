@@ -102,6 +102,9 @@ class ScaleConfig:
     # round-step narrows once on carry-out, halving those planes' HBM
     # read+write traffic
     narrow_dtypes: bool = False
+    # fused megakernel path: auto/on/off/interpret (see docs/fused.md
+    # and ScaleSimConfig.fused — execution knob, never changes results)
+    fused: str = "auto"
 
     def validate(self) -> "ScaleConfig":
         # real errors, not bare asserts (stripped under ``python -O``)
@@ -128,6 +131,13 @@ class ScaleConfig:
             raise ValueError(
                 "narrow_dtypes stores timers/budgets as int16; a "
                 "timer/budget bound exceeds int16 range"
+            )
+        from corrosion_tpu.sim.config import FUSED_MODES
+
+        if self.fused not in FUSED_MODES:
+            raise ValueError(
+                f"fused {self.fused!r} not one of {FUSED_MODES} "
+                f"(docs/fused.md)"
             )
         return self
 
@@ -620,9 +630,13 @@ def scale_swim_step(
 
     if megakernel.use_fused_swim(
             cfg.n_nodes, cfg.m_slots, pig_k,
-            narrow=bool(getattr(cfg, "narrow_dtypes", False))):
+            narrow=bool(getattr(cfg, "narrow_dtypes", False)),
+            mode=megakernel.fused_mode(cfg)):
         mem_id, mem_view, timer, mem_tx, inc, refute = (
-            megakernel.swim_tables_fused(consts, *args)
+            megakernel.swim_tables_fused(
+                consts, *args,
+                interpret=megakernel.fused_interpret(cfg),
+            )
         )
     else:
         mem_id, mem_view, timer, mem_tx, inc, refute = swim_tables_update(

@@ -149,6 +149,20 @@ class ScaleSimConfig:
     # favors it slightly, and the TPU traffic model halves those
     # planes' HBM bytes; BENCH_NARROW=0 measures the wide arm
     narrow_dtypes: bool = True
+    # --- fused megakernel path (ops/megakernel.py, docs/fused.md) --------
+    # the production execution knob, fed from ``config.perf.fused``:
+    #   "auto"      — pallas kernels on non-CPU backends when the eager
+    #                 probes pass (hoist them with
+    #                 ``megakernel.prime_fused`` before trace time);
+    #   "on"        — pin the fused path (interpret-mode on CPU);
+    #   "off"       — pin the XLA path;
+    #   "interpret" — fused kernels in pallas interpret mode on ANY
+    #                 backend: the tier-1 testing mode (fused==unfused
+    #                 parity runs on CPU).
+    # Execution only: fused == unfused bit for bit, so checkpoints
+    # written under one mode resume under another
+    # (checkpoint.config_identity excludes this key).
+    fused: str = "auto"
 
     @property
     def n_cells(self) -> int:
@@ -194,6 +208,13 @@ class ScaleSimConfig:
                     "narrow_dtypes stores these planes as int16; a "
                     "plane bound exceeds int16 range"
                 )
+        from corrosion_tpu.sim.config import FUSED_MODES
+
+        if self.fused not in FUSED_MODES:
+            raise ValueError(
+                f"fused {self.fused!r} not one of {FUSED_MODES} "
+                f"(docs/fused.md)"
+            )
         return self
 
     @property

@@ -154,8 +154,10 @@ def main() -> None:
                 megakernel.use_fused_ingest(cfg, 4 * cfg.pig_changes)
                 and megakernel.use_fused_swim(
                     cfg.n_nodes, cfg.m_slots, cfg.pig_members,
-                    narrow=cfg.narrow_dtypes)
+                    narrow=cfg.narrow_dtypes,
+                    mode=megakernel.fused_mode(cfg))
             ),
+            "fused_mode": megakernel.fused_mode(cfg),
         })
 
     # the control arm runs an IDENTICAL config to default: their median

@@ -32,6 +32,27 @@ cd "$(dirname "$0")/.."
 echo "== corrolint =="
 python -m corrosion_tpu.analysis corrosion_tpu bench.py scripts \
     --output-json artifacts/lint_r06.json
+# the fused path's files must be IN lint scope (ISSUE 10): lint them
+# explicitly (missing paths exit 2) and require the focused report to
+# have actually walked all four — an accidental walk/scope regression
+# would otherwise silently stop checking the kernel boundaries the
+# dtype-flow/donation rules exist for
+python -m corrosion_tpu.analysis \
+    corrosion_tpu/ops/megakernel.py corrosion_tpu/sim/scale_step.py \
+    corrosion_tpu/parallel/mesh.py corrosion_tpu/resilience/segments.py \
+    --output-json /tmp/lint_fused_scope.json
+python - <<'PY'
+import json
+scoped = json.load(open("/tmp/lint_fused_scope.json"))
+if scoped["files_checked"] != 4 or not scoped["clean"]:
+    raise SystemExit(f"fused-path lint scope regressed: {scoped}")
+full = json.load(open("artifacts/lint_r06.json"))
+assert "rule_counts" in full, "lint report lost rule_counts"
+if full["files_checked"] < scoped["files_checked"]:
+    raise SystemExit("repo lint walk smaller than the fused file set")
+print(f"corrolint scope: fused-path files covered "
+      f"({full['files_checked']} files in the repo walk)")
+PY
 echo "corrolint: clean (report: artifacts/lint_r06.json)"
 
 if [[ "${1:-}" == "--lint" ]]; then
@@ -54,6 +75,38 @@ echo "corrosan: clean (report: artifacts/san_r08.json)"
 if [[ "${1:-}" == "--san" ]]; then
     exit 0
 fi
+
+echo "== fused-interpret pipeline smoke =="
+# the fused megakernel path through the WHOLE pipeline on CPU
+# (ISSUE 10, docs/fused.md): BENCH_SMOKE with the pallas kernels in
+# interpret mode — gated on fused==unfused parity, donated segments,
+# and the per-shard checkpoint-drain telemetry, published as
+# artifacts/fused_r10.json
+# 8 virtual devices so the soak leg shards and the record proves the
+# per-shard drain under the fused path (matches the tier-1 harness)
+env BENCH_SMOKE=1 BENCH_FUSED=interpret JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python bench.py | tail -n 1 > artifacts/fused_r10.json
+python - <<'PY'
+import json
+rec = json.load(open("artifacts/fused_r10.json"))
+problems = rec.get("problems", [])
+if not rec.get("ok"):
+    raise SystemExit(f"fused smoke not ok: {problems}")
+if rec.get("fused_mode") != "interpret" or not rec.get("pallas_fused"):
+    raise SystemExit("fused smoke did not ride the fused path: "
+                     f"{rec.get('fused_mode')}/{rec.get('pallas_fused')}")
+if rec.get("fused_parity") is not True:
+    raise SystemExit("fused==unfused parity not verified on the smoke")
+soak = rec["soak"]
+if soak.get("donated_segments", 0) < 1 or not soak.get("pallas_fused"):
+    raise SystemExit(f"fused soak leg lost donation or the kernels: {soak}")
+if soak.get("ckpt_shards", 0) < 1 or soak.get("ckpt_drain_bytes", 0) <= 0:
+    raise SystemExit(f"fused soak leg lost shard-drain telemetry: {soak}")
+print("fused smoke:", rec["metric"], rec["value"], rec["unit"],
+      f"(parity ok, {soak['ckpt_shards']} ckpt shard(s))")
+PY
+echo "fused smoke: ok (report: artifacts/fused_r10.json)"
 
 echo "== sharded checkpoint probe =="
 # per-shard drain + elastic 8->4 resharded restore, published next to

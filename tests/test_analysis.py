@@ -518,7 +518,7 @@ def test_hot_entry_points_compile_once():
     counts = assert_trace_stable(repeats=3)
     assert set(counts) == {
         "full_sim_step", "scale_sim_step", "segment_dispatch",
-        "sharded_scale_run", "segmented_soak",
+        "sharded_scale_run", "segmented_soak", "fused_scale_run",
     }
 
 

@@ -201,6 +201,40 @@ def test_dtype_widen_kernel_ref_store():
     assert rules_of(findings) == ["dtype-widen"]
 
 
+def test_dtype_widen_fused_ingest_queue_refs_registered():
+    """ISSUE 10: the fused ingest kernel's narrowed queue out-refs are
+    in ``NARROW_REFS`` — a widened store into the fused path's q planes
+    (the donated carry's aval!) must flag exactly like the swim
+    kernel's timer store. The registry entries are derived from the
+    narrowed carry leaves, so they can't drift apart silently."""
+    from corrosion_tpu.analysis.dtypes import NARROW_LEAVES, NARROW_REFS
+
+    # the single-cell fused kernel re-stores exactly these narrowed
+    # queue planes (q_seq/q_nseq stay at constant 0/1 on that path and
+    # have no out-ref); each must carry an o_-spelled registry entry
+    # at the leaf's declared width
+    for leaf in ("q_cell", "q_tx"):
+        assert NARROW_REFS[f"o_{leaf}"] == NARROW_LEAVES[leaf]
+    findings = lint("""
+        import jax.numpy as jnp
+
+        def ingest_kernel(cfg_tuple, q_tx, o_q_cell, o_q_tx):
+            decremented = q_tx - jnp.arange(4, dtype=jnp.int32)
+            o_q_tx[:] = decremented
+    """, ["dtype-flow"])
+    assert rules_of(findings) == ["dtype-widen"]
+    assert "o_q_tx" in findings[0].message
+    # the shape the real kernel uses — cast back at the store — is clean
+    clean = lint("""
+        import jax.numpy as jnp
+
+        def ingest_kernel(cfg_tuple, q_tx, o_q_tx):
+            decremented = q_tx - jnp.arange(4, dtype=jnp.int32)
+            o_q_tx[:] = decremented.astype(o_q_tx.dtype)
+    """, ["dtype-flow"])
+    assert clean == []
+
+
 def test_dtype_widen_sum_and_clip_promote():
     """jnp.sum accumulates at int32 and clip/mod promote with their
     operands — widenings through them must not slip by (verified
@@ -580,12 +614,19 @@ def test_donation_flow_ambiguous_names_carry_no_facts():
 # --- registry-sync meta-tests ---------------------------------------------
 
 
-def test_known_donating_matches_runtime():
+@pytest.mark.parametrize("fused", ["auto", "interpret"])
+def test_known_donating_matches_runtime(fused):
     """``KNOWN_DONATING`` must match what the real ``parallel/mesh.py``
     jits actually donate: trace each entry point abstractly and compare
     the traced donated-leaf set against the registry's positions mapped
     through the wrapper signature. A donation added/removed in mesh.py
-    without a registry update fails here, not in production."""
+    without a registry update fails here, not in production.
+
+    Parametrized over the ``fused`` knob (ISSUE 10): the donated-carry
+    contract must survive the pallas megakernel path — tracing the
+    SAME entry points with the fused kernels in the scanned body must
+    donate the SAME leaf set."""
+    import dataclasses
     import inspect
 
     import jax
@@ -598,7 +639,7 @@ def test_known_donating_matches_runtime():
     from corrosion_tpu.sim.scale_step import ScaleSimState
     from corrosion_tpu.sim.transport import NetModel
 
-    cfg = _scale_cfg()
+    cfg = dataclasses.replace(_scale_cfg(), fused=fused).validate()
     values = {
         "cfg": cfg,
         "mesh": pmesh.make_mesh(),
@@ -656,7 +697,7 @@ def test_hot_entry_registry_matches_runtime():
 
     assert set(HOT_ENTRY_POINTS) == {
         "full_sim_step", "scale_sim_step", "segment_dispatch",
-        "sharded_scale_run", "segmented_soak",
+        "sharded_scale_run", "segmented_soak", "fused_scale_run",
     }
     for fn in (sim_step, scale_sim_step):
         assert list(inspect.signature(fn).parameters)[:4] == [

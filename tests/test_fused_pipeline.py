@@ -1,0 +1,382 @@
+"""ISSUE 10: the fused megakernel path as a first-class citizen of the
+sharded / donated / segmented pipeline.
+
+The ``fused`` config knob (``config.perf.fused`` -> ``cfg.fused``,
+docs/fused.md) replaced the old module-global test pin; these tests
+cover the knob's gate matrix, interpret-mode fused == unfused bitwise
+parity through every production dispatcher (single step, 1-D and 2-D
+sharded mesh runs, a crash-injected segmented soak resume), and the
+pipeline telemetry the segments runner / bench record.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+import numpy as np
+import pytest
+
+from corrosion_tpu.ops import megakernel
+from corrosion_tpu.resilience.segments import (
+    make_soak_inputs,
+    resume_segmented,
+    run_segmented,
+)
+from corrosion_tpu.sim.scale_step import (
+    ScaleSimState,
+    scale_run_rounds,
+    scale_sim_config,
+    scale_sim_step,
+)
+from corrosion_tpu.sim.transport import NetModel
+
+
+def _cfg(**overrides):
+    return scale_sim_config(
+        32, m_slots=8, n_origins=4, n_rows=4, n_cols=2, sync_interval=4,
+        **overrides,
+    )
+
+
+def _trees_equal(a, b):
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+
+
+# --- the knob itself ------------------------------------------------------
+
+
+def test_fused_knob_validates():
+    with pytest.raises(ValueError, match="fused"):
+        _cfg(fused="pallas-please")
+    for mode in megakernel.FUSED_MODES:
+        assert _cfg(fused=mode).fused == mode
+    # ONE canonical mode tuple: the configs, the gates, and the CLI all
+    # share sim.config.FUSED_MODES (megakernel re-exports it)
+    from corrosion_tpu.sim.config import FUSED_MODES, SimConfig
+
+    assert megakernel.FUSED_MODES is FUSED_MODES
+    with pytest.raises(ValueError, match="fused"):
+        SimConfig(n_nodes=8, fused="bogus").validate()
+    from corrosion_tpu.sim.scale import scale_config
+
+    with pytest.raises(ValueError, match="fused"):
+        scale_config(8, fused="bogus")
+
+
+def test_perf_config_threads_fused():
+    """config.perf.fused reaches the sim config — file key and env
+    overlay both (the production plumbing the CLI/Agent ride)."""
+    from corrosion_tpu.config import Config, load_config
+
+    cfg_file = Config()
+    cfg_file.perf.fused = "interpret"
+    assert cfg_file.to_scale_config().fused == "interpret"
+    assert cfg_file.to_full_config().fused == "interpret"
+    overlaid = load_config(
+        None, environ={"CORRO_TPU__PERF__FUSED": "off"}
+    )
+    assert overlaid.sim_config().fused == "off"
+
+
+def test_prime_fused_decisions_cpu():
+    """The hoisted probe entry: pinned modes decide without probing;
+    auto on CPU stays on the XLA path."""
+    assert megakernel.prime_fused(_cfg(fused="interpret")) == {
+        "mode": "interpret", "interpret": True,
+        "ingest": True, "ingest_emit": True, "swim": True,
+    }
+    off = megakernel.prime_fused(_cfg(fused="off"))
+    assert off["mode"] == "off"
+    assert not (off["ingest"] or off["ingest_emit"] or off["swim"])
+    # an XLA-only run must never claim interpret-mode execution
+    assert off["interpret"] is False
+    assert megakernel.fused_engaged(off) is False
+    auto = megakernel.prime_fused(_cfg(fused="auto"))
+    assert auto["ingest"] is False and auto["swim"] is False
+    assert auto["interpret"] is False
+
+
+# --- gate matrix: shape-keyed caching, no re-probe inside a trace ---------
+
+
+@pytest.fixture
+def mock_tpu(monkeypatch):
+    """A TPU-shaped backend for the gates only (``megakernel._backend``
+    is a seam precisely so the jit machinery keeps its real backend)."""
+    monkeypatch.setattr(megakernel, "_backend", lambda: "mock-tpu")
+    saved_ok = dict(megakernel._pallas_ok_cache)
+    saved_width = dict(megakernel._width_ok_cache)
+    yield
+    megakernel._pallas_ok_cache.clear()
+    megakernel._pallas_ok_cache.update(saved_ok)
+    megakernel._width_ok_cache.clear()
+    megakernel._width_ok_cache.update(saved_width)
+
+
+def test_width_probe_cache_is_shape_keyed_and_never_reprobes_in_trace(
+        mock_tpu, monkeypatch):
+    """Satellite (ISSUE 10): under ``auto`` the width probes run once
+    per (backend, shape) via the ``_eager`` escape hatch; an identical
+    shape consulted from INSIDE a jit trace must hit the cache, and a
+    different shape must key a fresh probe."""
+    calls = []
+
+    def stub_eager(fn):
+        calls.append(fn)
+        return True
+
+    monkeypatch.setattr(megakernel, "_eager", stub_eager)
+    megakernel._pallas_ok_cache["mock-tpu"] = True
+    # n chosen so a cheaper representative block exists (see _probe_n):
+    # blk(4096) = 1024, probe n = 3072 < 4096 — the probe actually runs
+    n, m = 4096, 64
+    assert megakernel.use_fused_swim(n, m, 0, mode="auto")
+    assert len(calls) == 1
+    # same shape, from inside a trace: cache hit, no new probe
+    def traced(x):
+        assert megakernel.use_fused_swim(n, m, 0, mode="auto")
+        return x + 1
+
+    jax.jit(traced)(jnp.zeros(3))
+    assert len(calls) == 1
+    # a different width is a different cache key -> one fresh probe
+    assert megakernel.use_fused_swim(n, 2 * m, 0, mode="auto")
+    assert len(calls) == 2
+    # narrow-dtype lowering keys separately too (int16 planes lower
+    # differently)
+    assert megakernel.use_fused_swim(n, m, 0, narrow=True, mode="auto")
+    assert len(calls) == 3
+
+
+def test_fused_off_pins_xla_under_tpu_backend(mock_tpu, monkeypatch):
+    """Satellite (ISSUE 10): ``fused="off"`` provably takes the XLA
+    path on a TPU-shaped backend — the gates answer False without ever
+    spawning a probe."""
+
+    def exploding_eager(fn):
+        raise AssertionError("fused='off' must never probe")
+
+    monkeypatch.setattr(megakernel, "_eager", exploding_eager)
+    cfg = _cfg(fused="off")
+    assert megakernel.use_fused_ingest(cfg, msgs=1) is False
+    assert megakernel.use_fused_ingest(cfg, msgs=16, emit=True) is False
+    assert megakernel.use_fused_swim(
+        cfg.n_nodes, cfg.m_slots, 0, mode="off") is False
+    dec = megakernel.prime_fused(cfg)
+    assert not (dec["ingest"] or dec["swim"])
+    # pinned-on modes skip the probes symmetrically (no eager calls)
+    assert megakernel.use_fused_ingest(_cfg(fused="on"), msgs=1) is True
+
+
+def test_eager_probe_thread_is_counted_and_corro_named(monkeypatch):
+    """Satellite (ISSUE 10): the probe escape-hatch thread rides
+    ``spawn_counted`` under a ``corro-`` name, so corrosan's leak gate
+    and the conftest liveness check attribute it like every other
+    spawn in this repo."""
+    import threading
+
+    monkeypatch.setattr(megakernel, "_trace_state_clean", False)
+    info = megakernel._eager(
+        lambda: (threading.current_thread().name,
+                 threading.current_thread().daemon)
+    )
+    assert info == ("corro-pallas-probe", True)
+
+
+# --- interpret-mode parity through the pipeline ---------------------------
+
+
+def test_single_step_parity_interpret():
+    """fused(interpret) == unfused bitwise for the jitted single step."""
+    import functools
+
+    net = NetModel.create(32, drop_prob=0.02)
+    outs = {}
+    for mode in ("interpret", "off"):
+        cfg = _cfg(fused=mode)
+        step = jax.jit(functools.partial(scale_sim_step, cfg))
+        st = ScaleSimState.create(cfg)
+        inp = make_soak_inputs(cfg, jr.key(1), 6, write_frac=0.3)
+        for r in range(6):
+            st, _ = step(st, net, jr.fold_in(jr.key(2), r),
+                         jax.tree.map(lambda a: a[r], inp))
+        outs[mode] = jax.block_until_ready(st)
+    assert _trees_equal(outs["interpret"], outs["off"])
+
+
+@pytest.mark.parametrize("mesh_kind", ["1d", "2d"])
+def test_sharded_mesh_parity_interpret(mesh_kind):
+    """fused(interpret) == unfused bitwise through the REAL donated
+    sharded entry point (``parallel/mesh.sharded_scale_run``), on the
+    1-D node mesh and the 2-D (dcn, node) fold."""
+    from corrosion_tpu.parallel.mesh import (
+        buffers_donated,
+        make_mesh,
+        make_multihost_mesh,
+        shard_state,
+        sharded_scale_run,
+    )
+
+    n, rounds = 64, 4
+    net = NetModel.create(n, drop_prob=0.02)
+    cfg_off = scale_sim_config(
+        n, m_slots=8, n_origins=4, n_rows=4, n_cols=2, sync_interval=4,
+        fused="off")
+    # shapes are fused-independent: one input stack serves both arms
+    inputs = make_soak_inputs(cfg_off, jr.key(7), rounds, write_frac=0.25)
+    key = jr.key(9)
+
+    # unfused single-device reference
+    st_ref, _ = jax.jit(
+        lambda s, k, i: scale_run_rounds(cfg_off, s, net, k, i)
+    )(ScaleSimState.create(cfg_off), key, inputs)
+    st_ref = jax.block_until_ready(st_ref)
+
+    cfg_f = dataclasses.replace(cfg_off, fused="interpret").validate()
+    mesh = make_multihost_mesh(2) if mesh_kind == "2d" else make_mesh()
+    st = shard_state(mesh, n, ScaleSimState.create(cfg_f))
+    probe = st
+    st_f, _ = sharded_scale_run(
+        cfg_f, mesh, st, shard_state(mesh, n, net), key,
+        shard_state(mesh, n, inputs))
+    st_f = jax.block_until_ready(st_f)
+    # the fused path rode the donated dispatch for real
+    assert buffers_donated(probe)
+    assert _trees_equal(st_ref, st_f)
+
+
+def test_fused_segmented_soak_crash_injected_resume(tmp_path, monkeypatch):
+    """The acceptance scenario in one: a fused(interpret) segmented
+    soak with per-segment checkpoints, a crash injected mid-save, a
+    resume from the surviving checkpoint — final state bitwise equal to
+    the straight UNFUSED scan, with the stats recording the fused
+    pipeline (donation + pallas engagement)."""
+    import corrosion_tpu.checkpoint as ckpt_mod
+    from corrosion_tpu.resilience.retention import latest_valid_checkpoint
+
+    rounds = 16
+    cfg_off = _cfg(fused="off")
+    cfg_f = _cfg(fused="interpret")
+    net = NetModel.create(cfg_off.n_nodes, drop_prob=0.02)
+    st0 = ScaleSimState.create(cfg_off)
+    key0 = jr.key(3)
+    inputs = make_soak_inputs(cfg_off, jr.key(5), rounds, write_frac=0.25)
+    st_ref, _ = jax.jit(
+        lambda s, k, i: scale_run_rounds(cfg_off, s, net, k, i)
+    )(st0, key0, inputs)
+    st_ref = jax.block_until_ready(st_ref)
+
+    root = str(tmp_path / "soak")
+    # fused run of the first half: 2 donated-pipeline segments,
+    # checkpoints at rounds 4 and 8
+    r1 = run_segmented(cfg_f, ScaleSimState.create(cfg_f), net, key0,
+                       jax.tree.map(lambda a: a[:8], inputs),
+                       segment_rounds=4, checkpoint_root=root)
+    assert not r1.aborted and r1.completed_rounds == 8
+    assert r1.stats["pallas_fused"] and r1.stats["fused_mode"] == "interpret"
+    assert r1.stats["donated_segments"] >= 1
+    good = latest_valid_checkpoint(root)
+
+    # crash mid-save of the NEXT checkpoint: the half-written side must
+    # not poison recovery (sync writer so the failure fires at the save)
+    def exploding_write(path, data):
+        with open(path, "wb") as f:
+            f.write(b"PK\x03\x04 partial garbage")
+        raise OSError("simulated preemption mid-checkpoint")
+
+    monkeypatch.setattr(ckpt_mod, "_write_bytes", exploding_write)
+    with pytest.raises(OSError):
+        resume_segmented(cfg_f, net,
+                         jax.tree.map(lambda a: a[:12], inputs),
+                         segment_rounds=4, checkpoint_root=root,
+                         async_checkpoint=False)
+    monkeypatch.undo()
+    assert latest_valid_checkpoint(root) == good  # seg-8 survived
+
+    # resume the FULL run from the surviving checkpoint — still fused
+    r2 = resume_segmented(cfg_f, net, inputs, segment_rounds=4,
+                          checkpoint_root=root)
+    assert not r2.aborted and r2.completed_rounds == rounds
+    assert r2.stats["pallas_fused"]
+    assert _trees_equal(st_ref, r2.state)
+
+
+def test_fused_checkpoint_resumes_across_modes(tmp_path):
+    """``fused`` is execution-only: a checkpoint written by a fused
+    soak resumes under ``fused="off"`` (and vice versa) bit for bit —
+    ``checkpoint.config_identity`` excludes the knob, while genuine
+    sim-config drift still refuses."""
+    rounds = 12
+    cfg_f = _cfg(fused="interpret")
+    cfg_off = _cfg(fused="off")
+    net = NetModel.create(cfg_f.n_nodes, drop_prob=0.02)
+    key0 = jr.key(21)
+    inputs = make_soak_inputs(cfg_f, jr.key(23), rounds, write_frac=0.25)
+    st_ref, _ = jax.jit(
+        lambda s, k, i: scale_run_rounds(cfg_off, s, net, k, i)
+    )(ScaleSimState.create(cfg_off), key0, inputs)
+    st_ref = jax.block_until_ready(st_ref)
+
+    root = str(tmp_path / "soak")
+    run_segmented(cfg_f, ScaleSimState.create(cfg_f), net, key0,
+                  jax.tree.map(lambda a: a[:6], inputs),
+                  segment_rounds=6, checkpoint_root=root)
+    res = resume_segmented(cfg_off, net, inputs, segment_rounds=6,
+                           checkpoint_root=root)
+    assert res.completed_rounds == rounds
+    assert _trees_equal(st_ref, res.state)
+    # semantic drift is still refused
+    drifted = dataclasses.replace(
+        _cfg(fused="off"), sync_interval=8).validate()
+    with pytest.raises(ValueError, match="differs"):
+        resume_segmented(drifted, net, inputs, segment_rounds=6,
+                         checkpoint_root=root)
+
+
+# --- telemetry ------------------------------------------------------------
+
+
+def test_soak_stats_record_fused_pipeline():
+    """SoakResult.stats carries the fused-gate record next to the
+    donation/checkpoint facts (what bench smoke and the TPU capture
+    surface as one JSON record)."""
+    cfg = _cfg(fused="interpret")
+    net = NetModel.create(cfg.n_nodes, drop_prob=0.0)
+    inputs = make_soak_inputs(cfg, jr.key(31), 8, write_frac=0.2)
+    res = run_segmented(cfg, ScaleSimState.create(cfg), net, jr.key(33),
+                        inputs, segment_rounds=4)
+    assert res.stats["fused_mode"] == "interpret"
+    assert res.stats["pallas_fused"] is True
+    assert res.stats["fused_interpret"] is True
+    off = run_segmented(_cfg(fused="off"),
+                        ScaleSimState.create(cfg), net, jr.key(33),
+                        inputs, segment_rounds=4)
+    assert off.stats["pallas_fused"] is False
+    assert off.stats["fused_mode"] == "off"
+
+
+def test_known_donating_covers_fused_trace():
+    """Registry meta-test (ISSUE 10): tracing the donated mesh entry
+    point with the fused kernels in the scanned body donates exactly
+    the registered leaf set — the megakernel introduces no new
+    un-donatable inputs and drops none."""
+    from corrosion_tpu.analysis.donation import KNOWN_DONATING
+    from corrosion_tpu.parallel import mesh as pmesh
+
+    cfg = _cfg(fused="interpret")
+    megakernel.prime_fused(cfg)
+    values = dict(
+        st=ScaleSimState.create(cfg),
+        net=NetModel.create(cfg.n_nodes),
+        key=jr.key(0),
+        inputs=make_soak_inputs(cfg, jr.key(0), 2, write_frac=0.25),
+    )
+    traced = pmesh._scale_run.trace(
+        cfg, values["st"], values["net"], values["key"], values["inputs"])
+    n_st = len(jax.tree.leaves(values["st"]))
+    assert KNOWN_DONATING["sharded_scale_run"] == (2,)
+    assert set(traced.donate_argnums) == set(range(n_st))

@@ -1,5 +1,11 @@
 """The fused pallas ingest must equal the unfused XLA path exactly
-(ops/megakernel.py vs sim/broadcast.ingest_changes)."""
+(ops/megakernel.py vs sim/broadcast.ingest_changes).
+
+Path selection rides the ``fused`` config knob (docs/fused.md):
+``fused="interpret"`` pins the pallas kernels (interpret mode — these
+tests run on CPU), ``fused="off"`` pins the XLA form."""
+
+import dataclasses
 
 import jax
 import jax.numpy as jnp
@@ -7,9 +13,14 @@ import jax.random as jr
 import numpy as np
 import pytest
 
-from corrosion_tpu.ops import megakernel
 from corrosion_tpu.sim.broadcast import CrdtState, ingest_changes, local_write
 from corrosion_tpu.sim.config import SimConfig
+
+
+def _arms(cfg):
+    """(fused, unfused) variants of ``cfg``."""
+    return (dataclasses.replace(cfg, fused="interpret").validate(),
+            dataclasses.replace(cfg, fused="off").validate())
 
 
 def _random_batch(key, n, m, cfg):
@@ -30,39 +41,37 @@ def _random_batch(key, n, m, cfg):
 @pytest.mark.parametrize("rounds", [3])
 def test_fused_ingest_matches_unfused(rounds):
     n, m = 64, 12
-    cfg = SimConfig(n_nodes=n, n_origins=4, tx_max_cells=1).validate()
+    base = SimConfig(n_nodes=n, n_origins=4, tx_max_cells=1).validate()
+    cfg_f, cfg_u = _arms(base)
     key = jr.key(5)
 
-    st_a = CrdtState.create(cfg)  # unfused
-    st_b = CrdtState.create(cfg)  # fused
+    st_a = CrdtState.create(base)  # unfused
+    st_b = CrdtState.create(base)  # fused
     for r in range(rounds):
         key, kb, kw = jr.split(key, 3)
         live, origin, dbv, cell, ver, val, site, clp, ts = _random_batch(
-            kb, n, m, cfg
+            kb, n, m, base
         )
-        # seed some queue state via local writes so eviction paths differ
+        # seed some queue state via local writes so eviction paths
+        # differ — each arm seeds through its own path (fused local
+        # writes ride the same kernel)
         wmask = jr.uniform(kw, (n,)) < 0.3
-        wcell = jr.randint(jr.fold_in(kw, 1), (n,), 0, cfg.n_cells,
+        wcell = jr.randint(jr.fold_in(kw, 1), (n,), 0, base.n_cells,
                            dtype=jnp.int32)
         wval = jr.randint(jr.fold_in(kw, 2), (n,), 0, 99, dtype=jnp.int32)
-        st_a = local_write(cfg, st_a._replace(now=st_a.now + 1), wmask,
+        st_a = local_write(cfg_u, st_a._replace(now=st_a.now + 1), wmask,
                            wcell, wval)
-        st_b = local_write(cfg, st_b._replace(now=st_b.now + 1), wmask,
+        st_b = local_write(cfg_f, st_b._replace(now=st_b.now + 1), wmask,
                            wcell, wval)
 
-        try:
-            megakernel.FORCE_FUSED = False
-            st_a, info_a = ingest_changes(
-                cfg, st_a, live, origin, dbv, cell, ver, val, site, clp,
-                m_ts=ts,
-            )
-            megakernel.FORCE_FUSED = True
-            st_b, info_b = ingest_changes(
-                cfg, st_b, live, origin, dbv, cell, ver, val, site, clp,
-                m_ts=ts,
-            )
-        finally:
-            megakernel.FORCE_FUSED = None
+        st_a, info_a = ingest_changes(
+            cfg_u, st_a, live, origin, dbv, cell, ver, val, site, clp,
+            m_ts=ts,
+        )
+        st_b, info_b = ingest_changes(
+            cfg_f, st_b, live, origin, dbv, cell, ver, val, site, clp,
+            m_ts=ts,
+        )
 
         for a, b in zip(jax.tree.leaves(st_a), jax.tree.leaves(st_b)):
             assert np.array_equal(np.asarray(a), np.asarray(b))
@@ -72,18 +81,15 @@ def test_fused_ingest_matches_unfused(rounds):
 
 def test_fused_flag_respects_config():
     # multi-cell configs must NOT take the fused path (partials live in
-    # the XLA branch)
-    cfg = SimConfig(n_nodes=16, n_origins=4, tx_max_cells=4).validate()
+    # the XLA branch) — even when the knob pins fused "on"
+    cfg = SimConfig(n_nodes=16, n_origins=4, tx_max_cells=4,
+                    fused="on").validate()
     st = CrdtState.create(cfg)
     z = jnp.zeros((16, 2), jnp.int32)
-    try:
-        megakernel.FORCE_FUSED = True
-        st2, info = ingest_changes(
-            cfg, st, jnp.zeros((16, 2), bool), z, z, z, z, z, z, z,
-            m_seq=z, m_nseq=jnp.ones((16, 2), jnp.int32),
-        )
-    finally:
-        megakernel.FORCE_FUSED = None
+    st2, info = ingest_changes(
+        cfg, st, jnp.zeros((16, 2), bool), z, z, z, z, z, z, z,
+        m_seq=z, m_nseq=jnp.ones((16, 2), jnp.int32),
+    )
     assert int(info["delivered"]) == 0
 
 
@@ -100,34 +106,31 @@ def test_fused_scale_round_matches_unfused():
     from corrosion_tpu.sim.transport import NetModel
 
     n, rounds = 128, 4
-    cfg = scale_sim_config(n, n_origins=8)
+    base = scale_sim_config(n, n_origins=8)
     net = NetModel.create(n, drop_prob=0.05)
     key = jr.key(3)
-    quiet = ScaleRoundInput.quiet(cfg)
+    quiet = ScaleRoundInput.quiet(base)
     inputs = jax.tree.map(
         lambda a: jnp.broadcast_to(a, (rounds,) + a.shape), quiet
     )
     k1, k2, k3 = jr.split(jr.key(4), 3)
     w = (jr.uniform(k1, (rounds, n)) < 0.3) & (
-        jnp.arange(n)[None, :] < cfg.n_origins
+        jnp.arange(n)[None, :] < base.n_origins
     )
     inputs = inputs._replace(
         write_mask=w,
-        write_cell=jr.randint(k2, (rounds, n), 0, cfg.n_cells,
+        write_cell=jr.randint(k2, (rounds, n), 0, base.n_cells,
                               dtype=jnp.int32),
         write_val=jr.randint(k3, (rounds, n), 0, 1 << 20, dtype=jnp.int32),
     )
 
     outs = {}
-    for fused in (False, True):
-        try:
-            megakernel.FORCE_FUSED = fused
-            st = ScaleSimState.create(cfg)
-            st, infos = scale_run_rounds(cfg, st, net, key, inputs)
-            outs[fused] = (st, infos)
-        finally:
-            megakernel.FORCE_FUSED = None
-    for a, b in zip(jax.tree.leaves(outs[False]), jax.tree.leaves(outs[True])):
+    for cfg in _arms(base):
+        st = ScaleSimState.create(cfg)
+        st, infos = scale_run_rounds(cfg, st, net, key, inputs)
+        outs[cfg.fused] = (st, infos)
+    for a, b in zip(jax.tree.leaves(outs["off"]),
+                    jax.tree.leaves(outs["interpret"])):
         assert np.array_equal(np.asarray(a), np.asarray(b))
 
 
@@ -143,25 +146,22 @@ def test_fused_kernels_multi_block():
     from corrosion_tpu.sim.transport import NetModel
 
     n = 2048  # _block_size -> 1024, grid (2,)
-    cfg = scale_config(n)
+    base = scale_config(n)
     net = NetModel.create(n, drop_prob=0.05)
     key = jr.key(11)
     outs = {}
-    for fused in (False, True):
-        try:
-            megakernel.FORCE_FUSED = fused
-            st = ScaleSwimState.create(cfg)
-            for r in range(3):
-                st, info, channels, _sends = scale_swim_step(
-                    cfg, st, net, jr.fold_in(key, r)
-                )
-            outs[fused] = st
-        finally:
-            megakernel.FORCE_FUSED = None
-    for a, b in zip(jax.tree.leaves(outs[False]), jax.tree.leaves(outs[True])):
+    for cfg in _arms(base):
+        st = ScaleSwimState.create(cfg)
+        for r in range(3):
+            st, info, channels, _sends = scale_swim_step(
+                cfg, st, net, jr.fold_in(key, r)
+            )
+        outs[cfg.fused] = st
+    for a, b in zip(jax.tree.leaves(outs["off"]),
+                    jax.tree.leaves(outs["interpret"])):
         assert np.array_equal(np.asarray(a), np.asarray(b))
     # every node's self slot still names the node itself (global ids)
-    st = outs[True]
+    st = outs["interpret"]
     iarr = np.arange(n)
     self_ids = np.asarray(st.mem_id)[iarr, iarr % cfg.m_slots]
     assert (self_ids == iarr).all()
@@ -179,22 +179,19 @@ def test_fused_swim_matches_unfused_bounded_piggyback():
     from corrosion_tpu.sim.transport import NetModel
 
     n = 2048
-    cfg = scale_config(n, pig_members=8)
+    base = scale_config(n, pig_members=8)
     net = NetModel.create(n, drop_prob=0.05)
     key = jr.key(17)
     outs = {}
-    for fused in (False, True):
-        try:
-            megakernel.FORCE_FUSED = fused
-            st = ScaleSwimState.create(cfg)
-            for r in range(3):
-                st, info, channels, _c = scale_swim_step(
-                    cfg, st, net, jr.fold_in(key, r)
-                )
-            outs[fused] = st
-        finally:
-            megakernel.FORCE_FUSED = None
-    for a, b in zip(jax.tree.leaves(outs[False]), jax.tree.leaves(outs[True])):
+    for cfg in _arms(base):
+        st = ScaleSwimState.create(cfg)
+        for r in range(3):
+            st, info, channels, _c = scale_swim_step(
+                cfg, st, net, jr.fold_in(key, r)
+            )
+        outs[cfg.fused] = st
+    for a, b in zip(jax.tree.leaves(outs["off"]),
+                    jax.tree.leaves(outs["interpret"])):
         assert np.array_equal(np.asarray(a), np.asarray(b))
 
 
@@ -215,28 +212,25 @@ def test_fused_round_matches_unfused_with_kernel_features(pig_members):
     from corrosion_tpu.sim.transport import NetModel
 
     n = 256
-    cfg = scale_sim_config(
+    base = scale_sim_config(
         n, n_origins=8, sync_interval=4, pig_members=pig_members
     )
     net = NetModel.create(n, drop_prob=0.02)
-    inp0 = ScaleRoundInput.quiet(cfg)
+    inp0 = ScaleRoundInput.quiet(base)
     w = inp0._replace(
         write_mask=jnp.arange(n) < 8,
-        write_cell=jnp.arange(n) % cfg.n_cells,
+        write_cell=jnp.arange(n) % base.n_cells,
         write_val=jnp.full(n, 7, jnp.int32),
     )
     key = jr.key(9)
     outs = {}
-    for fused in (False, True):
-        try:
-            megakernel.FORCE_FUSED = fused
-            step = jax.jit(functools.partial(scale_sim_step, cfg))
-            st = ScaleSimState.create(cfg)
-            st, _ = step(st, net, key, w)
-            for r in range(5):
-                st, _ = step(st, net, jr.fold_in(key, r), inp0)
-            outs[fused] = jax.block_until_ready(st)
-        finally:
-            megakernel.FORCE_FUSED = None
-    for a, b in zip(jax.tree.leaves(outs[False]), jax.tree.leaves(outs[True])):
+    for cfg in _arms(base):
+        step = jax.jit(functools.partial(scale_sim_step, cfg))
+        st = ScaleSimState.create(cfg)
+        st, _ = step(st, net, key, w)
+        for r in range(5):
+            st, _ = step(st, net, jr.fold_in(key, r), inp0)
+        outs[cfg.fused] = jax.block_until_ready(st)
+    for a, b in zip(jax.tree.leaves(outs["off"]),
+                    jax.tree.leaves(outs["interpret"])):
         assert np.array_equal(np.asarray(a), np.asarray(b))

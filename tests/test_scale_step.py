@@ -259,8 +259,6 @@ def test_narrow_dtypes_fused_matches_unfused():
     re-narrow on store) with identical results."""
     import dataclasses
 
-    from corrosion_tpu.ops import megakernel
-
     base = scale_sim_config(
         32, m_slots=8, n_origins=4, n_rows=4, n_cols=2, sync_interval=4,
         pig_members=4, narrow_dtypes=False,  # pin the wide arm
@@ -280,16 +278,12 @@ def test_narrow_dtypes_fused_matches_unfused():
                               dtype=jnp.int32),
         write_val=jr.randint(k3, (rounds, n), 1, 1 << 15, dtype=jnp.int32),
     )
-    old = megakernel.FORCE_FUSED
-    try:
-        megakernel.FORCE_FUSED = True
-        st_f, info_f = run(narrow, ScaleSimState.create(narrow), net,
-                           jr.key(7), inp)
-        megakernel.FORCE_FUSED = False
-        st_u, info_u = run(narrow, ScaleSimState.create(narrow), net,
-                           jr.key(7), inp)
-    finally:
-        megakernel.FORCE_FUSED = old
+    fused = dataclasses.replace(narrow, fused="interpret").validate()
+    unfused = dataclasses.replace(narrow, fused="off").validate()
+    st_f, info_f = run(fused, ScaleSimState.create(fused), net,
+                       jr.key(7), inp)
+    st_u, info_u = run(unfused, ScaleSimState.create(unfused), net,
+                       jr.key(7), inp)
     for a, b in zip(jax.tree.leaves(st_f), jax.tree.leaves(st_u)):
         assert jnp.array_equal(a, b), "fused narrow state diverged"
     for k in info_f:
@@ -468,7 +462,7 @@ def test_slot_eviction_idle_owner_loses():
 
 def test_any_writer_fused_matches_unfused():
     """The ingest kernel's claim/evict path must equal the XLA form."""
-    from corrosion_tpu.ops import megakernel
+    import dataclasses
 
     cfg = scale_sim_config(
         32, m_slots=8, n_origins=4, n_rows=4, n_cols=2, sync_interval=4,
@@ -487,16 +481,12 @@ def test_any_writer_fused_matches_unfused():
                               dtype=jnp.int32),
         write_val=jr.randint(k3, (rounds, n), 1, 1 << 15, dtype=jnp.int32),
     )
-    old = megakernel.FORCE_FUSED
-    try:
-        megakernel.FORCE_FUSED = True
-        st_f, info_f = run(cfg, ScaleSimState.create(cfg), net,
-                           jr.key(9), inp)
-        megakernel.FORCE_FUSED = False
-        st_u, info_u = run(cfg, ScaleSimState.create(cfg), net,
-                           jr.key(9), inp)
-    finally:
-        megakernel.FORCE_FUSED = old
+    fused = dataclasses.replace(cfg, fused="interpret").validate()
+    unfused = dataclasses.replace(cfg, fused="off").validate()
+    st_f, info_f = run(fused, ScaleSimState.create(fused), net,
+                       jr.key(9), inp)
+    st_u, info_u = run(unfused, ScaleSimState.create(unfused), net,
+                       jr.key(9), inp)
     for a, b in zip(jax.tree.leaves(st_f), jax.tree.leaves(st_u)):
         assert jnp.array_equal(a, b), "fused any-writer state diverged"
     for k in info_f:
@@ -543,7 +533,7 @@ def test_colliding_active_writers_store_converges_via_sweep():
 def test_flagship_combination_narrow_pig_anywriter_fused():
     """The full bench configuration in one: narrow dtypes + bounded
     piggyback + unbounded writers, fused == unfused, and converges."""
-    from corrosion_tpu.ops import megakernel
+    import dataclasses
 
     cfg = scale_sim_config(
         32, m_slots=8, n_origins=4, n_rows=4, n_cols=2, sync_interval=4,
@@ -562,14 +552,11 @@ def test_flagship_combination_narrow_pig_anywriter_fused():
                               dtype=jnp.int32),
         write_val=jr.randint(k3, (rounds, n), 1, 1 << 15, dtype=jnp.int32),
     )
-    old = megakernel.FORCE_FUSED
-    try:
-        megakernel.FORCE_FUSED = True
-        st_f, _ = run(cfg, ScaleSimState.create(cfg), net, jr.key(11), inp)
-        megakernel.FORCE_FUSED = False
-        st_u, _ = run(cfg, ScaleSimState.create(cfg), net, jr.key(11), inp)
-    finally:
-        megakernel.FORCE_FUSED = old
+    fused = dataclasses.replace(cfg, fused="interpret").validate()
+    unfused = dataclasses.replace(cfg, fused="off").validate()
+    st_f, _ = run(fused, ScaleSimState.create(fused), net, jr.key(11), inp)
+    st_u, _ = run(unfused, ScaleSimState.create(unfused), net,
+                  jr.key(11), inp)
     for a, b in zip(jax.tree.leaves(st_f), jax.tree.leaves(st_u)):
         assert jnp.array_equal(a, b), "flagship-combination fused diverged"
     # drain and converge (on the unfused state; they are equal anyway).
