@@ -269,6 +269,17 @@ class Agent:
         from corrosion_tpu.ops import megakernel
 
         megakernel.prime_fused(self.cfg)
+        # HBM-footprint gauges (ISSUE 11): the per-table audit is array
+        # metadata only — no device transfer — and gives /metrics the
+        # corro.mem.* series from boot
+        from corrosion_tpu.obs.memory import (
+            memory_report,
+            publish_memory_gauges,
+        )
+
+        publish_memory_gauges(
+            memory_report(self._state, self.n_nodes), self.metrics
+        )
         if auto_recover:
             self.recover_latest()
         self._thread = spawn_counted(
@@ -857,7 +868,7 @@ class Agent:
              checkpoint_root: Optional[str] = None, keep_last: int = 3,
              write_frac: float = 0.0, resume: bool = False,
              donate: bool = True, async_checkpoint: bool = True,
-             supervisor=None, inputs=None, mesh=None):
+             supervisor=None, inputs=None, mesh=None, obs=None):
         """Throughput soak dispatch: run ``rounds`` rounds from the
         agent's current state through the segmented runner
         (:func:`corrosion_tpu.resilience.segments.run_segmented`) — the
@@ -879,6 +890,15 @@ class Agent:
         per shard, and a resume re-places the recorded slices against
         THIS mesh whatever topology the interrupted run had (elastic
         restore, docs/checkpoints.md).
+
+        ``obs`` is a :class:`corrosion_tpu.obs.flight.SoakObserver`
+        (caller-owned). With ``obs=None`` one is built from
+        ``config.obs`` ([obs] flight_path / prometheus_port /
+        jax_profile) — or, with that section idle, a bridge-only
+        observer onto the agent's OWN metrics registry, so a soak
+        always advances ``corro.soak.rounds_total`` on this agent's
+        ``/metrics`` route; an agent-built observer is closed before
+        returning.
         """
         # real errors, not asserts (python -O strips asserts, and a live
         # round's in-flight carry racing the donated segment buffers
@@ -909,21 +929,34 @@ class Agent:
             net = shard_state(mesh, self.n_nodes, net)
             if not resume:
                 st = shard_state(mesh, self.n_nodes, st)
+        owned_obs = None
+        if obs is None:
+            from corrosion_tpu.obs.flight import SoakObserver, make_observer
+
+            owned_obs = (make_observer(self.config.obs,
+                                       registry=self.metrics)
+                         or SoakObserver(registry=self.metrics))
+            obs = owned_obs
         common = dict(
             mode=self.mode, checkpoint_root=checkpoint_root,
             keep_last=keep_last, db=self.recovery_db,
             supervisor=supervisor or self._supervisor,
-            donate=donate, async_checkpoint=async_checkpoint,
+            donate=donate, async_checkpoint=async_checkpoint, obs=obs,
         )
-        if resume:
-            result = resume_segmented(
-                self.cfg, net, inputs, segment_rounds, mesh=mesh, **common
-            )
-        else:
-            result = run_segmented(
-                self.cfg, st, net, self._key, inputs,
-                segment_rounds, **common,
-            )
+        try:
+            if resume:
+                result = resume_segmented(
+                    self.cfg, net, inputs, segment_rounds, mesh=mesh,
+                    **common
+                )
+            else:
+                result = run_segmented(
+                    self.cfg, st, net, self._key, inputs,
+                    segment_rounds, **common,
+                )
+        finally:
+            if owned_obs is not None:
+                owned_obs.close()
         adopted = result.state
         if any(isinstance(leaf, np.ndarray)
                for leaf in jax.tree.leaves(adopted)):
@@ -947,6 +980,17 @@ class Agent:
         with self._snap_lock:
             self._snapshot_host = None
         return result
+
+    def memory_report(self) -> dict:
+        """Per-table nbytes audit of the live device state
+        (``obs/memory.py``) — metadata only, no device transfer. Taken
+        under the state lease so a donated round dispatch never
+        invalidates the leaves mid-walk. Served at ``/v1/obs/memory``;
+        the same audit feeds the boot-time ``corro.mem.*`` gauges."""
+        from corrosion_tpu.obs.memory import memory_report
+
+        with self._state_lease():
+            return memory_report(self._state, self.n_nodes)
 
     # --- health / readiness (feeds /v1/health + /v1/ready) ---------------
     def health(self) -> dict:

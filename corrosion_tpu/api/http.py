@@ -21,8 +21,11 @@ router at ``agent/util.rs:182-294``), same routes and event shapes:
   (``execute_schema``, ``public/mod.rs:540-593``).
 - ``GET /v1/table_stats``, ``GET /v1/members``, ``GET /v1/sync`` —
   introspection (admin surface exposes the same data over UDS).
+- ``GET /v1/obs/memory`` — per-table HBM audit of the live device state
+  (``obs/memory.py``; metadata only, docs/observability.md).
 - ``GET /metrics`` — Prometheus exposition (the reference serves this on
-  the telemetry listener, ``command/agent.rs:114-139``).
+  the telemetry listener, ``command/agent.rs:114-139``); a running
+  soak advances the ``corro.soak.*`` series here live (ISSUE 11).
 
 Statement values ride JSON; blobs are not representable in JSON and use
 ``{"blob": "<hex>"}`` wrappers on both paths.
@@ -227,6 +230,11 @@ def _make_handler(server: ApiServer):
                         state = server.agent.sync_state(node)
                         state["traceparent"] = inject_traceparent()
                     self._reply_json(200, state)
+                elif path == "/v1/obs/memory":
+                    # per-table HBM audit of the live state (ISSUE 11):
+                    # array metadata only, never a device transfer —
+                    # cheap enough to poll while a 1M-node soak runs
+                    self._reply_json(200, server.agent.memory_report())
                 elif path == "/metrics":
                     data = server.agent.metrics.render().encode()
                     self.send_response(200)

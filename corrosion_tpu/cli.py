@@ -14,6 +14,8 @@ Mirrors the reference binary's command surface (``Command`` enum,
 - ``backup`` / ``restore`` — portable node backup & full checkpoint
   (``main.rs:160-330``);
 - ``locks`` — lock-registry dump;
+- ``mem-report`` — per-table HBM audit of the configured sim state
+  (``obs/memory.py``, docs/observability.md);
 - ``template`` — render templates that re-render on subscription change;
 - ``consul sync`` — Consul bridge loop.
 
@@ -295,6 +297,13 @@ def cmd_soak(args) -> int:
     from corrosion_tpu.sim.transport import NetModel
 
     cfg_file = load_config(args.config) if args.config else Config()
+    # the pipeline spans (segment dispatch / shard drain / serialize,
+    # docs/observability.md) need the OTLP exporter installed to land
+    # anywhere — the agent command wires this; a soak must too
+    if cfg_file.telemetry.otlp_path:
+        from corrosion_tpu.utils.tracing import configure_otlp_file
+
+        configure_otlp_file(cfg_file.telemetry.otlp_path)
     cfg = cfg_file.sim_config()
     if getattr(args, "fused", None):
         # execution-path override on top of [perf] fused: same state,
@@ -338,28 +347,52 @@ def cmd_soak(args) -> int:
         net = shard_state(mesh, cfg.n_nodes, net)
         inputs = shard_state(mesh, cfg.n_nodes, inputs)
     supervisor = Supervisor(deadline_seconds=args.deadline or None)
+    # observability plane (ISSUE 11, docs/observability.md): CLI flags
+    # override the [obs] config section, then one observer covers the
+    # whole run — NDJSON flight record, live /metrics listener, spans
+    from corrosion_tpu.obs import make_observer
+
+    if getattr(args, "flight", None):
+        cfg_file.obs.flight_path = args.flight
+    if getattr(args, "prom_port", None) is not None:
+        cfg_file.obs.prometheus_port = args.prom_port
+    if getattr(args, "jax_profile", False):
+        cfg_file.obs.jax_profile = True
+    obs = make_observer(cfg_file.obs)
+    if obs is not None and obs.listener is not None:
+        print(json.dumps({"prometheus_port": obs.listener.bound_port}),
+              flush=True)
     common = dict(
         checkpoint_root=args.checkpoint_dir, keep_last=args.keep_last,
         supervisor=supervisor, donate=not args.no_donate,
-        async_checkpoint=not args.sync_checkpoint,
+        async_checkpoint=not args.sync_checkpoint, obs=obs,
     )
-    if args.resume:
-        result = resume_segmented(cfg, net, inputs, args.segment,
-                                  mesh=mesh, **common)
-    else:
-        if cfg_file.sim.mode == "scale":
-            from corrosion_tpu.sim.scale_step import ScaleSimState as StCls
+    try:
+        if args.resume:
+            result = resume_segmented(cfg, net, inputs, args.segment,
+                                      mesh=mesh, **common)
         else:
-            from corrosion_tpu.sim.step import SimState as StCls
-        st = StCls.create(cfg)
-        if mesh is not None:
-            from corrosion_tpu.parallel.mesh import shard_state
+            if cfg_file.sim.mode == "scale":
+                from corrosion_tpu.sim.scale_step import (
+                    ScaleSimState as StCls,
+                )
+            else:
+                from corrosion_tpu.sim.step import SimState as StCls
+            st = StCls.create(cfg)
+            if mesh is not None:
+                from corrosion_tpu.parallel.mesh import shard_state
 
-            st = shard_state(mesh, cfg.n_nodes, st)
-        result = run_segmented(
-            cfg, st, net, jr.key(cfg_file.sim.seed), inputs,
-            args.segment, **common,
-        )
+                st = shard_state(mesh, cfg.n_nodes, st)
+            result = run_segmented(
+                cfg, st, net, jr.key(cfg_file.sim.seed), inputs,
+                args.segment, **common,
+            )
+    finally:
+        if obs is not None:
+            obs.close()
+        from corrosion_tpu.utils.tracing import flush_otlp
+
+        flush_otlp()
     summary = {
         "completed_rounds": result.completed_rounds,
         "aborted": result.aborted,
@@ -371,6 +404,8 @@ def cmd_soak(args) -> int:
             k: float(np.asarray(v).sum()) for k, v in result.infos.items()
         },
     }
+    if cfg_file.obs.flight_path:
+        summary["flight"] = cfg_file.obs.flight_path
     print(json.dumps(summary, indent=2))
     return 1 if result.aborted else 0
 
@@ -385,6 +420,15 @@ def cmd_consul(args) -> int:
     from corrosion_tpu.consul import consul_sync_cli
 
     return consul_sync_cli(args)
+
+
+def cmd_mem_report(args) -> int:
+    """Per-table nbytes audit of the configured simulator state — the
+    CLI face of ``obs/memory.py`` (which table is O(N·M) vs O(N), and
+    what the HBM budget at [sim] n_nodes actually is)."""
+    from corrosion_tpu.obs.memory import mem_report_cli
+
+    return mem_report_cli(args)
 
 
 def cmd_default_config(args) -> int:
@@ -620,6 +664,18 @@ def build_parser() -> argparse.ArgumentParser:
                          "'interpret' runs the pallas kernels "
                          "interpreted on any backend — the parity/"
                          "debug mode")
+    sk.add_argument("--flight", default=None, metavar="PATH",
+                    help="flight-recorder NDJSON path (overrides [obs] "
+                         "flight_path): crash-safe per-segment records "
+                         "a dead soak leaves behind "
+                         "(docs/observability.md)")
+    sk.add_argument("--prom-port", type=int, default=None,
+                    help="serve live /metrics for this soak on this "
+                         "port (0 = ephemeral; overrides [obs] "
+                         "prometheus_port)")
+    sk.add_argument("--jax-profile", action="store_true",
+                    help="annotate pipeline spans for jax.profiler "
+                         "device traces (overrides [obs] jax_profile)")
     sk.set_defaults(fn=cmd_soak)
 
     t = sub.add_parser("template", help="render templates (re-render on change)")
@@ -678,6 +734,16 @@ def build_parser() -> argparse.ArgumentParser:
                      help="write the fixtures section of the corrosan "
                           "report artifact")
     san.set_defaults(fn=cmd_san)
+
+    mr = sub.add_parser(
+        "mem-report",
+        help="per-table HBM audit of the configured sim state "
+             "(O(N·M) vs O(N) classification — the 1M memory-budget "
+             "probe, docs/observability.md)")
+    mr.add_argument("-c", "--config", default=None)
+    mr.add_argument("--n-nodes", type=int, default=0,
+                    help="override [sim] n_nodes for the audit")
+    mr.set_defaults(fn=cmd_mem_report)
 
     d = sub.add_parser("default-config", help="print an example config file")
     d.set_defaults(fn=cmd_default_config)

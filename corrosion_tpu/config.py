@@ -136,6 +136,26 @@ class TelemetryConfig:
 
 
 @dataclasses.dataclass
+class ObsConfig:
+    """Flight-recorder observability plane for the soak pipeline
+    (``corrosion_tpu/obs/``, docs/observability.md).
+
+    Distinct from ``[telemetry]`` (the host agent's always-on
+    Prometheus/OTLP endpoints): ``[obs]`` arms the PER-RUN soak plane —
+    NDJSON flight records, a dedicated soak metrics listener, and
+    device-profiler span annotation."""
+
+    # NDJSON flight-record path ("" = off): crash-safe per-segment
+    # records a dead soak leaves behind (obs.replay_flight_record)
+    flight_path: str = ""
+    # standalone Prometheus listener for the soak registry: -1 = off,
+    # 0 = ephemeral (bound port on the server's ``bound_port``), >0 fixed
+    prometheus_port: int = -1
+    # annotate pipeline spans for jax.profiler device traces
+    jax_profile: bool = False
+
+
+@dataclasses.dataclass
 class LogConfig:
     colors: bool = False
     format: str = "plaintext"  # or "json"
@@ -159,6 +179,7 @@ class Config:
     pg: PgConfig = dataclasses.field(default_factory=PgConfig)
     admin: AdminConfig = dataclasses.field(default_factory=AdminConfig)
     telemetry: TelemetryConfig = dataclasses.field(default_factory=TelemetryConfig)
+    obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
     log: LogConfig = dataclasses.field(default_factory=LogConfig)
     consul: ConsulConfig = dataclasses.field(default_factory=ConsulConfig)
 

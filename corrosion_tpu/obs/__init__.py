@@ -1,0 +1,65 @@
+"""Flight-recorder observability plane for the soak pipeline (ISSUE 11).
+
+The reference agent records ~100 Prometheus series and streams OTLP
+traces continuously (``doc/telemetry/prometheus.md``,
+``command/agent.rs:114-139``); our flagship execution path — the
+sharded/donated/fused segmented soak — was a black box while running:
+per-round ``infos`` and pipeline ``stats`` only surfaced in
+``SoakResult`` after the run ended. This package is the live telemetry
+plane threaded through ``resilience.segments.run_segmented`` /
+``Agent.soak``:
+
+- :mod:`~corrosion_tpu.obs.flight` — the **FlightRecorder** (crash-safe
+  line-atomic NDJSON segment records + :func:`replay_flight_record`)
+  and the **SoakObserver** that bundles recorder + metrics bridge +
+  optional standalone Prometheus listener per run;
+- :mod:`~corrosion_tpu.obs.bridge` — the **live metrics bridge**
+  draining each segment's infos into a ``utils.metrics.Registry``
+  (reusing the ``record_round_info`` mapping) plus the ``corro.soak.*``
+  series, so ``/metrics`` shows a soak advancing in real time;
+- :mod:`~corrosion_tpu.obs.memory` — per-table nbytes audit of
+  ``ScaleSimState``/``SimState`` (O(N·M) vs O(N) classification),
+  memory gauges, and the bench ``hbm_bytes`` field — the measurement
+  substrate of the 1M-node memory-budget audit;
+- :mod:`~corrosion_tpu.obs.spans` — pipeline spans (+ optional
+  ``jax.profiler`` annotations) around segment dispatch, shard drain,
+  and checkpoint serialize.
+
+Activity-occupancy telemetry (the quiescence oracle's masks) lives
+device-side in :func:`corrosion_tpu.sim.scale_step.activity_masks`; the
+``active_*`` info keys it emits flow through this plane like every
+other round counter.
+
+Config surface: ``[obs] flight_path / prometheus_port / jax_profile``
+(``config.ObsConfig``), threaded config → ``run_segmented`` → ``Agent``
+→ CLI ``soak --flight`` → bench. Series catalog + NDJSON schema:
+``docs/observability.md``.
+"""
+
+from corrosion_tpu.obs.bridge import MetricsBridge
+from corrosion_tpu.obs.flight import (
+    FLIGHT_SCHEMA_VERSION,
+    FlightRecorder,
+    SoakObserver,
+    make_observer,
+    replay_flight_record,
+)
+from corrosion_tpu.obs.memory import (
+    memory_report,
+    publish_memory_gauges,
+    state_bytes,
+)
+from corrosion_tpu.obs.spans import pipeline_span
+
+__all__ = [
+    "FLIGHT_SCHEMA_VERSION",
+    "FlightRecorder",
+    "MetricsBridge",
+    "SoakObserver",
+    "make_observer",
+    "memory_report",
+    "pipeline_span",
+    "publish_memory_gauges",
+    "replay_flight_record",
+    "state_bytes",
+]

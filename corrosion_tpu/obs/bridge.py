@@ -1,0 +1,64 @@
+"""Live metrics bridge: segment infos -> the Prometheus registry.
+
+Before ISSUE 11 the soak pipeline's per-round infos only surfaced in
+``SoakResult`` after the run ended; a multi-hour soak showed nothing on
+``/metrics``. The bridge drains each completed segment's infos into a
+``utils.metrics.Registry`` mid-run — reusing the exact
+``record_round_info`` key -> ``corro.*`` mapping the live agent round
+loop uses — plus the ``corro.soak.*`` progress series, so both the
+standalone Prometheus listener and the HTTP API's ``/metrics`` show a
+soak advancing in real time.
+
+Semantics per info kind (``utils.metrics.info_series``): counter keys
+fold their PER-SEGMENT SUM into the counter (the cumulative scrape
+value equals the straight per-round accumulation); gauge keys (queue
+occupancy, activity levels) take the segment's LAST round — a gauge is
+a level, not a total.
+"""
+
+from __future__ import annotations
+
+from corrosion_tpu.utils.metrics import info_series, record_round_info
+
+
+class MetricsBridge:
+    """Per-run bridge onto one registry (the agent's, or a standalone
+    one for CLI/bench soaks)."""
+
+    def __init__(self, registry):
+        self.registry = registry
+
+    def on_segment(self, *, completed_rounds: int, rounds: int,
+                   seconds: float, info_sum: dict, info_last: dict,
+                   stats_delta: dict) -> None:
+        reg = self.registry
+        reg.counter("corro.soak.rounds_total", rounds)
+        reg.counter("corro.soak.segments_total", 1)
+        if seconds > 0:
+            reg.gauge("corro.soak.rounds_per_s", rounds / seconds)
+        reg.histogram("corro.soak.segment.seconds", seconds)
+        # checkpoint pipeline deltas for THIS segment (the cumulative
+        # stats dict is the run's; the scrape wants rates/levels)
+        stall = stats_delta.get("ckpt_stall_s", 0.0)
+        if stall > 0:
+            reg.histogram("corro.soak.ckpt.stall.seconds", stall)
+        drained = stats_delta.get("ckpt_drain_bytes", 0)
+        if drained > 0:
+            reg.counter("corro.soak.ckpt.drain.bytes", drained)
+        if stats_delta.get("donated_segments", 0) > 0:
+            reg.counter("corro.soak.segments.donated", 1)
+        # round-info series: one merged record_round_info call — counter
+        # keys carry the segment sum, gauge keys the last-round level
+        merged = {}
+        kinds = info_series()
+        for key, (_name, kind) in kinds.items():
+            if kind == "counter" and key in info_sum:
+                merged[key] = info_sum[key]
+            elif kind == "gauge" and key in info_last:
+                merged[key] = info_last[key]
+        record_round_info(merged, registry=reg)
+
+    def on_end(self, *, completed_rounds: int, aborted: bool) -> None:
+        reg = self.registry
+        reg.gauge("corro.soak.completed.rounds", completed_rounds)
+        reg.gauge("corro.soak.aborted", 1.0 if aborted else 0.0)

@@ -1,0 +1,136 @@
+"""Memory accounting: per-table nbytes audit of the simulator state.
+
+The ROADMAP's million-node flagship item starts with a question this
+module answers mechanically: *which tables of ``ScaleSimState`` are
+O(N·M) and which are O(N)?* The audit walks the state pytree by FIELD
+NAME (``swim.mem_id``, ``crdt.q_val``, ``crdt.store[1]`` …), records
+each leaf's shape/dtype/nbytes, and classifies its scaling against the
+cluster size — all from array METADATA, so auditing a live sharded
+state moves zero device bytes (the sharding-contract checker treats
+``.nbytes``/``.shape``/``.dtype`` as metadata, not a gather).
+
+Exposed three ways: ``corro.mem.*`` gauges
+(:func:`publish_memory_gauges`), the ``corrosion-tpu mem-report`` CLI,
+and the ``hbm_bytes`` field every bench record now carries. The
+invariant the obs smoke pins: the per-table audit SUMS to the measured
+total state size — a table the walk misses would silently undercount
+the 1M budget.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+
+def _walk_leaves(obj, prefix: str, out: dict) -> None:
+    """NamedTuple-aware named walk (jax's keypaths render NamedTuples as
+    positional ``[i]`` entries; the audit wants ``swim.mem_id``)."""
+    if hasattr(obj, "_fields"):  # NamedTuple state containers
+        for f in obj._fields:
+            _walk_leaves(getattr(obj, f),
+                         f"{prefix}.{f}" if prefix else f, out)
+    elif isinstance(obj, (tuple, list)):
+        for i, v in enumerate(obj):
+            _walk_leaves(v, f"{prefix}[{i}]", out)
+    elif isinstance(obj, dict):
+        for k in sorted(obj):
+            _walk_leaves(obj[k], f"{prefix}.{k}" if prefix else str(k), out)
+    elif obj is None:
+        return
+    else:
+        out[prefix or "<leaf>"] = obj
+
+
+def _classify(shape, n_nodes: Optional[int]) -> str:
+    """Scaling class against the cluster size: the leading axis of every
+    per-node table is N, so ``[N]`` is O(N), ``[N, ...]`` is O(N·M)
+    (M = the trailing extent), anything else is O(1) bookkeeping."""
+    if not n_nodes or not shape or shape[0] != n_nodes:
+        return "O(1)"
+    return "O(N)" if math.prod(shape[1:]) == 1 else "O(N*M)"
+
+
+def state_bytes(state) -> int:
+    """Total nbytes of every array leaf — METADATA only, deliberately
+    not a leaves-materializing drain (``.nbytes`` never moves device
+    bytes; corrolint's shard-gather rule agrees)."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree.leaves(state):
+        total += getattr(leaf, "nbytes", 0)
+    return int(total)
+
+
+def memory_report(state, n_nodes: Optional[int] = None) -> dict:
+    """Per-table audit of a state pytree.
+
+    Returns ``{"total_bytes", "n_nodes", "tables": {name: {"shape",
+    "dtype", "nbytes", "class", "per_node_bytes"}}, "by_class": {cls:
+    bytes}}``. ``per_node_bytes`` (O(N)/O(N·M) tables only) is the
+    quantity the 1M budget multiplies: total = Σ per_node_bytes · N
+    over the N-scaled tables, plus the O(1) remainder."""
+    leaves: dict = {}
+    _walk_leaves(state, "", leaves)
+    tables = {}
+    by_class: dict = {}
+    total = 0
+    for name, leaf in leaves.items():
+        shape = tuple(int(s) for s in getattr(leaf, "shape", ()))
+        nbytes = int(getattr(leaf, "nbytes", 0))
+        cls = _classify(shape, n_nodes)
+        entry = {
+            "shape": list(shape),
+            "dtype": str(getattr(leaf, "dtype", "?")),
+            "nbytes": nbytes,
+            "class": cls,
+        }
+        if cls != "O(1)" and n_nodes:
+            entry["per_node_bytes"] = nbytes // n_nodes
+        tables[name] = entry
+        by_class[cls] = by_class.get(cls, 0) + nbytes
+        total += nbytes
+    return {
+        "total_bytes": total,
+        "n_nodes": n_nodes,
+        "tables": tables,
+        "by_class": by_class,
+    }
+
+
+def publish_memory_gauges(report: dict, registry) -> None:
+    """Fold an audit into ``corro.mem.*`` gauges: the total, one gauge
+    per table (labelled), and the per-class rollup — what a dashboard
+    watches while the N sweep climbs toward 1M."""
+    registry.gauge("corro.mem.state.bytes", report["total_bytes"])
+    for name, entry in report["tables"].items():
+        registry.gauge("corro.mem.table.bytes", entry["nbytes"],
+                       labels={"table": name, "class": entry["class"]})
+    for cls, nbytes in report["by_class"].items():
+        registry.gauge("corro.mem.class.bytes", nbytes,
+                       labels={"class": cls})
+
+
+def mem_report_cli(args) -> int:
+    """``corrosion-tpu mem-report``: build the configured sim state and
+    print the audit as JSON — the first step of the 1M memory-budget
+    audit, runnable against any config without touching a device-sized
+    cluster (state CREATION at the configured N is the only cost)."""
+    import json
+
+    from corrosion_tpu.config import Config, load_config
+
+    cfg_file = load_config(args.config) if args.config else Config()
+    if args.n_nodes:
+        cfg_file.sim.n_nodes = args.n_nodes
+    cfg = cfg_file.sim_config()
+    if cfg_file.sim.mode == "scale":
+        from corrosion_tpu.sim.scale_step import ScaleSimState as StCls
+    else:
+        from corrosion_tpu.sim.step import SimState as StCls
+    state = StCls.create(cfg)
+    report = memory_report(state, cfg.n_nodes)
+    report["mode"] = cfg_file.sim.mode
+    print(json.dumps(report, indent=2))
+    return 0

@@ -1,0 +1,34 @@
+"""Pipeline spans: host tracing + optional device-profiler annotation.
+
+The soak pipeline's interesting overlap — checkpoint serialize/IO
+riding the background writer while the next segment's scan runs — is
+invisible in a plain log. Wrapping the three phases (segment dispatch,
+shard drain, serialize) in spans makes it visible twice over: the OTLP
+file export (``utils.tracing.configure_otlp_file``) shows the
+wall-clock overlap to any OTLP viewer, and — when ``[obs]
+jax_profile`` asks — a ``jax.profiler.TraceAnnotation`` labels the same
+region in a device profile so XLA tracer timelines line up with the
+host-side story.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from corrosion_tpu.utils import tracing
+
+
+@contextlib.contextmanager
+def pipeline_span(name: str, jax_profile: bool = False, **attrs):
+    """A :func:`corrosion_tpu.utils.tracing.span` that, with
+    ``jax_profile=True``, also annotates the region for ``jax.profiler``
+    traces. The annotation import is deferred so the common
+    (profile-off) path never touches the profiler machinery."""
+    with tracing.span(name, **attrs) as ctx:
+        if jax_profile:
+            import jax.profiler
+
+            with jax.profiler.TraceAnnotation(name):
+                yield ctx
+        else:
+            yield ctx

@@ -77,20 +77,25 @@ def write_segment_checkpoint(cfg, mode: str, state, key_json: dict,
     (``segments._key_to_json``). ``io_stats`` receives the save path's
     ``serialize_s``/``shard_files`` telemetry."""
     from corrosion_tpu.parallel.mesh import HostLeafShards
+    from corrosion_tpu.utils.tracing import span
 
     leaves = jax.tree.leaves(state)
     shards = state if (
         leaves and isinstance(leaves[0], HostLeafShards)) else None
     name = f"seg-{completed:08d}"
     view = _SegmentView(mode, cfg, state, completed)
-    path = save_checkpoint(
-        view, db=db, path=os.path.join(root, name),
-        extra={"soak": {
-            "completed_rounds": completed,
-            "key": key_json,
-        }},
-        shards=shards, io_stats=io_stats,
-    )
+    # pipeline span (ISSUE 11): on the async writer this runs OVERLAPPED
+    # with the next segment's dispatch — the OTLP export shows the
+    # serialize span riding under soak.segment.dispatch wall time
+    with span("soak.ckpt.serialize", warn_seconds=30.0, round=completed):
+        path = save_checkpoint(
+            view, db=db, path=os.path.join(root, name),
+            extra={"soak": {
+                "completed_rounds": completed,
+                "key": key_json,
+            }},
+            shards=shards, io_stats=io_stats,
+        )
     # pointer moves only AFTER the directory is fully committed; pruning
     # runs last so the recovery point is never the one being deleted
     update_latest(root, name)

@@ -137,6 +137,18 @@ def _pipeline_stats(donate: bool, async_checkpoint: bool,
     }
 
 
+def _obs_hook(obs, name: str, **kwargs) -> None:
+    """Drive one observer hook, guarded: the observability plane must
+    never kill (or change the result of) the soak it observes — a
+    raising hook is logged and the run proceeds unobserved."""
+    if obs is None:
+        return
+    try:
+        getattr(obs, name)(**kwargs)
+    except Exception:  # noqa: BLE001 — observers are caller-supplied
+        logger.exception("soak observer hook %s failed; continuing", name)
+
+
 def _shard_drain(tree):
     """Per-shard host drain of the carry (the ONLY hot-loop stall).
 
@@ -211,6 +223,7 @@ def run_segmented(
     start_round: int = 0,
     donate: bool = True,
     async_checkpoint: bool = True,
+    obs=None,
 ) -> SoakResult:
     """Run ``inputs`` (stacked per-round, leading axis = rounds) in
     K-round segments, checkpointing after each.
@@ -243,6 +256,17 @@ def run_segmented(
     window grows by at most the one in-flight checkpoint. ``stats`` on
     the result records what the pipeline actually did (donated segment
     count, checkpoint stall vs overlapped IO seconds, retry re-uploads).
+
+    **Observability** (``obs``, an :class:`corrosion_tpu.obs.flight
+    .SoakObserver` or None): each completed segment appends a
+    crash-safe flight-record line and drains its infos into the live
+    metrics registry (``corro.soak.*`` + the round-info series), so a
+    running soak is visible on ``/metrics`` and a dead one leaves a
+    replayable NDJSON black box. The observer's lifetime belongs to the
+    CALLER; this function only drives its run hooks. Pipeline spans
+    (segment dispatch, shard drain — plus checkpoint serialize in the
+    writer) export through the OTLP file exporter when one is
+    configured, with ``jax.profiler`` annotation when the observer asks.
     """
     if segment_rounds <= 0:
         raise ValueError("segment_rounds must be positive")
@@ -273,21 +297,34 @@ def run_segmented(
         return (st2, key2), infos
 
     seg_box = {"index": 0}  # read by the async writer's overlap probe
+    use_writer = bool(checkpoint_root and async_checkpoint)
+    stats = _pipeline_stats(donate, use_writer, fused=fused_decisions)
+    from corrosion_tpu.obs.spans import pipeline_span
+
+    jax_prof = bool(obs is not None and getattr(obs, "jax_profile", False))
+    # observer hooks run guarded AND before the writer thread exists: a
+    # broken caller-supplied observer must neither kill the soak it only
+    # observes nor leak an already-spawned corro-async-ckpt thread
+    _obs_hook(obs, "open_run",
+              cfg=cfg, mode=mode, total_rounds=rounds,
+              start_round=start_round, segment_rounds=segment_rounds,
+              stats=stats, state=st)
     writer = None
-    if checkpoint_root and async_checkpoint:
+    if use_writer:
         writer = AsyncCheckpointWriter(
             cfg, mode, checkpoint_root, keep_last, db,
             progress=lambda: seg_box["index"],
         )
-    stats = _pipeline_stats(donate, writer is not None,
-                            fused=fused_decisions)
     host_carry = None  # (numpy state pytree, key json) at the last boundary
     info_parts: list = []
     completed = 0
     aborted = False
+    crashed = False  # an exception unwound THIS run (not an outer handler)
     last_ckpt = None
     try:
         while completed < rounds:
+            lo = completed
+            seg_no = seg_box["index"] + 1  # 1-based, shared by span+record
             hi = min(completed + segment_rounds, rounds)
             seg = _slice_inputs(inputs, completed, hi)
             # never donate the caller's carry; supervised donated
@@ -317,14 +354,22 @@ def run_segmented(
                 return dispatch(st, key, seg, donate_now)
 
             try:
-                if supervisor is not None:
-                    (st, key), infos = supervisor.call(
-                        seg_dispatch,
-                        label=f"segment[{start_round + completed}:"
-                              f"{start_round + hi}]",
-                    )
-                else:
-                    (st, key), infos = seg_dispatch()
+                with pipeline_span(
+                    "soak.segment.dispatch", jax_profile=jax_prof,
+                    # segments legitimately run for minutes — the slow-
+                    # span warning is for the drain/serialize phases
+                    warn_seconds=float("inf"),
+                    seg=seg_no, lo=start_round + lo,
+                    hi=start_round + hi,
+                ):
+                    if supervisor is not None:
+                        (st, key), infos = supervisor.call(
+                            seg_dispatch,
+                            label=f"segment[{start_round + completed}:"
+                                  f"{start_round + hi}]",
+                        )
+                    else:
+                        (st, key), infos = seg_dispatch()
             except SupervisorAborted:
                 if host_carry is not None and _carry_deleted(st):
                     # the exhausted donated attempts consumed the carry —
@@ -351,7 +396,10 @@ def run_segmented(
                 # backpressure when the PREVIOUS segment's checkpoint is
                 # still being written)
                 t0 = time.perf_counter()
-                host_carry = (_shard_drain(st), _key_to_json(key))
+                with pipeline_span("soak.ckpt.drain",
+                                   jax_profile=jax_prof,
+                                   warn_seconds=30.0):
+                    host_carry = (_shard_drain(st), _key_to_json(key))
                 if writer is not None:
                     writer.submit(host_carry[0], host_carry[1],
                                   start_round + completed,
@@ -373,23 +421,47 @@ def run_segmented(
                     stats["ckpt_stall_s"] += time.perf_counter() - t0
                     stats["ckpt_serialize_s"] += io_stats.get(
                         "serialize_s", 0.0)
+            # AFTER the checkpoint block: the segment record carries
+            # this segment's checkpoint facts, not the previous one's
+            _obs_hook(obs, "on_segment",
+                      seg_index=seg_no, lo=start_round + lo,
+                      hi=start_round + completed, infos=infos,
+                      stats=stats, state=st)
+    except BaseException:
+        # local crash detection for the flight record: sys.exc_info()
+        # would also be non-None when a CALLER invokes this function
+        # from inside an except handler, mislabeling a clean run
+        crashed = True
+        raise
     finally:
-        if writer is not None:
-            # drain overlapped writes; a write failure surfaces here
-            # (or earlier, on submit) rather than being silently lost
-            try:
-                last_ckpt = writer.close() or last_ckpt
-            except BaseException:
-                if aborted:  # don't mask the abort path's result
-                    logger.exception("async checkpoint drain failed")
-                else:
-                    raise
-            stats["ckpt_io_s"] = writer.io_seconds
-            stats["ckpt_written"] = writer.written
-            stats["ckpt_overlapped_segments"] = writer.overlapped
-            stats["ckpt_serialize_s"] = writer.serialize_seconds
-        elif checkpoint_root:
-            stats["ckpt_written"] = stats["segments"]
+        try:
+            if writer is not None:
+                # drain overlapped writes; a write failure surfaces here
+                # (or earlier, on submit) rather than being silently lost
+                try:
+                    last_ckpt = writer.close() or last_ckpt
+                except BaseException:
+                    if aborted:  # don't mask the abort path's result
+                        logger.exception("async checkpoint drain failed")
+                    else:
+                        crashed = True
+                        raise
+                stats["ckpt_io_s"] = writer.io_seconds
+                stats["ckpt_written"] = writer.written
+                stats["ckpt_overlapped_segments"] = writer.overlapped
+                stats["ckpt_serialize_s"] = writer.serialize_seconds
+            elif checkpoint_root:
+                stats["ckpt_written"] = stats["segments"]
+        finally:
+            # the end record lands whatever killed the run (writer
+            # failure, crash mid-dispatch, graceful abort) — the
+            # black box's whole point
+            _obs_hook(obs, "end_run",
+                      stats=stats,
+                      completed_rounds=start_round + completed,
+                      aborted=aborted,
+                      crashed=crashed and not aborted,
+                      checkpoint=last_ckpt)
     return SoakResult(
         state=st,
         key=key,
@@ -417,6 +489,7 @@ def resume_segmented(
     donate: bool = True,
     async_checkpoint: bool = True,
     mesh=None,
+    obs=None,
 ) -> SoakResult:
     """Resume a segmented run from the newest valid checkpoint under
     ``checkpoint_root``.
@@ -484,7 +557,7 @@ def resume_segmented(
         segment_rounds, mode=mode, checkpoint_root=checkpoint_root,
         keep_last=keep_last, db=db, supervisor=supervisor,
         start_round=completed, donate=donate,
-        async_checkpoint=async_checkpoint,
+        async_checkpoint=async_checkpoint, obs=obs,
     )
 
 

@@ -579,8 +579,52 @@ def scale_sim_step(
     else:
         cst, s_info = run_sync(cst)
 
-    info = {**swim_info, **b_info, **s_info}
-    return _narrow_carry(cfg, ScaleSimState(swim, cst)), info
+    st_out = _narrow_carry(cfg, ScaleSimState(swim, cst))
+    info = {**swim_info, **b_info, **s_info, **activity_info(cfg, st_out)}
+    return st_out, info
+
+
+def activity_masks(cfg: ScaleSimConfig, st: ScaleSimState) -> dict:
+    """Per-node activity masks, computed on device from the round's
+    carry-out state (ISSUE 11 / ROADMAP quiescence item).
+
+    These are EXACTLY the occupancy bits a future active-set round
+    variant would gate on to cheap-path inactive shards: a node is
+    "active" on a channel when it still owes the cluster work —
+
+    - ``bcast``: any live broadcast-queue slot (changesets awaiting
+      further transmissions);
+    - ``partials``: any buffered incomplete multi-cell version;
+    - ``sync``: any outstanding version need (heard-of-but-unseen,
+      ``ops.versions.needs_count``) that anti-entropy must pull;
+    - ``probes``: any running SWIM suspicion / down-purge timer
+      (membership churn in flight; steady-state probing of a healthy
+      quiet cluster keeps all timers at zero).
+
+    The quiet-trace oracle rides on this: zero traffic (no writes, no
+    kills) ⇒ every mask all-False ⇒ every ``active_*`` info count is
+    zero. Each mask is one cheap reduce over an existing state plane —
+    no new HBM tables, no extra gathers."""
+    from corrosion_tpu.ops.partials import NO_SLOT
+
+    return {
+        "bcast": jnp.any(st.crdt.q_origin != NO_Q, axis=1),
+        "partials": jnp.any(st.crdt.partials.origin != NO_SLOT, axis=1),
+        "sync": jnp.any(needs_count(st.crdt.book) > 0, axis=1),
+        "probes": jnp.any(st.swim.mem_timer > 0, axis=1),
+    }
+
+
+def activity_info(cfg: ScaleSimConfig, st: ScaleSimState) -> dict:
+    """Fold the activity masks into round-info counts (``active_*``
+    keys, mapped onto ``corro.activity.*.nodes`` gauges by
+    ``utils.metrics._INFO_MAP``). Under a mesh the masks shard with the
+    node axis and the sums reduce across shards like every other info
+    value."""
+    return {
+        f"active_{k}": jnp.sum(v.astype(jnp.int32))
+        for k, v in activity_masks(cfg, st).items()
+    }
 
 
 def _narrow_carry(cfg: ScaleSimConfig, st: ScaleSimState) -> ScaleSimState:

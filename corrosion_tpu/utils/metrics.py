@@ -29,6 +29,29 @@ def _key(name: str, labels: Optional[dict]) -> Tuple[str, Tuple[Tuple[str, str],
     return name, tuple(sorted((labels or {}).items()))
 
 
+def _escape_label_value(v) -> str:
+    """Exposition-format label escaping: a raw ``"``, ``\\`` or newline
+    in a label value corrupts the whole scrape (the parser sees a torn
+    line), so they must be escaped exactly per the text format v0.0.4
+    spec: ``\\`` -> ``\\\\``, ``"`` -> ``\\"``, LF -> ``\\n``."""
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _fmt_le(b) -> str:
+    """Canonical ``le`` bucket-bound rendering: raw ``str(float)`` emits
+    forms like ``1.0`` where the canonical exposition (and every
+    upstream client library) writes ``1``. Shortest ROUND-TRIP form
+    (``repr``), not ``%g`` — ``%g``'s 6-significant-digit truncation
+    could collide two distinct bounds into duplicate ``le`` labels."""
+    s = repr(float(b))
+    return s[:-2] if s.endswith(".0") else s
+
+
 class Registry:
     """Thread-safe metrics store (one per agent; a global default)."""
 
@@ -88,26 +111,42 @@ class Registry:
             items = list(lab) + list(extra)
             if not items:
                 return ""
-            inner = ",".join(f'{k}="{v}"' for k, v in items)
+            inner = ",".join(
+                f'{k}="{_escape_label_value(v)}"' for k, v in items
+            )
             return "{" + inner + "}"
 
         out = []
+        # ONE TYPE line per metric name: labeled samples of the same
+        # metric (e.g. the per-table corro.mem.table.bytes gauges) share
+        # it — a repeated TYPE line makes strict expfmt parsers reject
+        # the whole scrape
+        typed: set = set()
+
+        def type_line(pname: str, kind: str) -> None:
+            if pname not in typed:
+                typed.add(pname)
+                out.append(f"# TYPE {pname} {kind}")
+
         snap = self.snapshot()
         for (name, lab), v in sorted(snap["counters"].items()):
             pname = name.replace(".", "_")
-            out.append(f"# TYPE {pname} counter")
+            type_line(pname, "counter")
             out.append(f"{pname}{fmt_labels(lab)} {v}")
         for (name, lab), v in sorted(snap["gauges"].items()):
             pname = name.replace(".", "_")
-            out.append(f"# TYPE {pname} gauge")
+            type_line(pname, "gauge")
             out.append(f"{pname}{fmt_labels(lab)} {v}")
         for (name, lab), h in sorted(snap["histograms"].items()):
             pname = name.replace(".", "_")
-            out.append(f"# TYPE {pname} histogram")
+            type_line(pname, "histogram")
             acc = 0
             for b, c in zip(h["buckets"], h["counts"]):
                 acc += c
-                out.append(f"{pname}_bucket{fmt_labels(lab, [('le', b)])} {acc}")
+                out.append(
+                    f"{pname}_bucket"
+                    f"{fmt_labels(lab, [('le', _fmt_le(b))])} {acc}"
+                )
             out.append(f"{pname}_bucket{fmt_labels(lab, [('le', '+Inf')])} {h['count']}")
             out.append(f"{pname}_sum{fmt_labels(lab)} {h['sum']}")
             out.append(f"{pname}_count{fmt_labels(lab)} {h['count']}")
@@ -116,7 +155,12 @@ class Registry:
 
 REGISTRY = Registry()
 
-# round-info key -> corro.* series (reference names where one exists)
+# round-info key -> corro.* series (reference names where one exists).
+# MUST cover every key ``sim_step``/``scale_sim_step`` emit — an
+# unmapped key silently vanishes from /metrics; the drift guard
+# (tests/test_obs.py::test_info_map_covers_every_emitted_key) diffs
+# this table against the live info dicts so a new sim counter cannot
+# disappear unnoticed.
 _INFO_MAP = {
     "acked": ("corro.gossip.probe.acked", "counter"),
     "failed_probes": ("corro.gossip.probe.failed", "counter"),
@@ -125,10 +169,27 @@ _INFO_MAP = {
     "delivered": ("corro.broadcast.recv.count", "counter"),
     "fresh": ("corro.broadcast.processed.count", "counter"),
     "queued": ("corro.broadcast.pending.count", "gauge"),
+    "tx_completed": ("corro.broadcast.tx.completed", "counter"),
+    "clock_drift_rejects": ("corro.broadcast.drift.rejects", "counter"),
     "syncs": ("corro.sync.client.count", "counter"),
     "cells_pulled": ("corro.sync.changes.recv", "counter"),
     "versions_granted": ("corro.sync.chunk.sent.versions", "counter"),
+    "serve_rejects": ("corro.sync.server.rejects", "counter"),
+    # per-shard activity occupancy (ISSUE 11): node counts of the
+    # device-computed masks the active-set round variant will gate on
+    # (sim/scale_step.activity_masks) — gauges, they are occupancy
+    # levels, not monotone totals
+    "active_bcast": ("corro.activity.bcast.nodes", "gauge"),
+    "active_partials": ("corro.activity.partials.nodes", "gauge"),
+    "active_sync": ("corro.activity.sync.nodes", "gauge"),
+    "active_probes": ("corro.activity.swim.nodes", "gauge"),
 }
+
+
+def info_series() -> dict:
+    """The info-key -> (series, kind) table (read-only copy) — the obs
+    metrics bridge folds per-segment info sums/lasts through it."""
+    return dict(_INFO_MAP)
 
 
 def record_round_info(info: dict, registry: Registry = REGISTRY):
@@ -178,7 +239,13 @@ def start_prometheus_listener(registry: Registry, addr: str = "127.0.0.1",
                               port: int = 9090):
     """Standalone Prometheus exposition listener (the reference serves
     metrics on a dedicated telemetry address, ``command/agent.rs:114-139``).
-    Returns the HTTPServer; call ``.shutdown()`` to stop."""
+
+    ``port=0`` binds an ephemeral port; the actually-bound port is on
+    the returned server as ``bound_port`` (tests and the obs soak
+    observer scrape it without racing for a fixed port). Returns the
+    HTTPServer; ``.shutdown()`` stops the loop, JOINS the counted
+    ``corro-prometheus`` thread (so the leak gate sees it exit), and
+    closes the listening socket."""
     import http.server
 
     from corrosion_tpu.utils.lifecycle import spawn_counted
@@ -197,7 +264,16 @@ def start_prometheus_listener(registry: Registry, addr: str = "127.0.0.1",
 
     httpd = http.server.ThreadingHTTPServer((addr, port), Handler)
     httpd.daemon_threads = True
+    httpd.bound_port = httpd.server_address[1]
     # counted + corro- named: .shutdown() drains serve_forever, so the
     # lifecycle barrier sees it finish, and leak reports name the owner
-    spawn_counted(httpd.serve_forever, name="corro-prometheus")
+    thread = spawn_counted(httpd.serve_forever, name="corro-prometheus")
+    orig_shutdown = httpd.shutdown
+
+    def _shutdown():
+        orig_shutdown()
+        thread.join(timeout=10)
+        httpd.server_close()
+
+    httpd.shutdown = _shutdown
     return httpd

@@ -14,6 +14,11 @@
 # artifacts/ckpt_r09.json: per-shard drain stall vs overlapped IO vs
 # shard count, plus the 8->4 resharded-restore bitwise check.
 #
+# The observability smoke (ISSUE 11) publishes artifacts/obs_r11.json:
+# flight-record replay consistency, live mid-soak /metrics advance,
+# the quiet-trace activity oracle, and the memory-audit closure —
+# under CORROSAN=1.
+#
 # corrosan (ISSUE 8) publishes artifacts/san_r08.json with two
 # sections: "fixtures" (seeded-race replay verdicts via
 # `corrosion-tpu san`) and "pytest" (the threaded test modules re-run
@@ -107,6 +112,30 @@ print("fused smoke:", rec["metric"], rec["value"], rec["unit"],
       f"(parity ok, {soak['ckpt_shards']} ckpt shard(s))")
 PY
 echo "fused smoke: ok (report: artifacts/fused_r10.json)"
+
+echo "== observability smoke =="
+# the flight-recorder plane (ISSUE 11): small segmented soak with the
+# recorder + live /metrics listener on, mid-soak scrape asserted
+# advancing, flight replay matched against the run's stats, the
+# quiet-trace activity oracle, and the memory-audit closure — all
+# inside a corrosan sanitized window (the obs threads must come and go
+# without a race/leak finding). Published as artifacts/obs_r11.json.
+env CORROSAN=1 JAX_PLATFORMS=cpu \
+    python scripts/obs_probe.py --output artifacts/obs_r11.json > /dev/null
+python - <<'PY'
+import json
+rec = json.load(open("artifacts/obs_r11.json"))
+if not rec.get("ok"):
+    raise SystemExit(f"obs smoke not ok: {rec.get('problems')}")
+if not rec.get("corrosan"):
+    raise SystemExit("obs smoke did not run under the sanitizer")
+if len(rec["scrape"]["distinct_mid_run"]) < 2:
+    raise SystemExit(f"mid-soak scrape not advancing: {rec['scrape']}")
+print("obs smoke:", rec["flight"]["segments"], "segment(s) replayed,",
+      len(rec["scrape"]["distinct_mid_run"]), "distinct mid-run scrapes,",
+      rec["hbm_bytes"], "hbm bytes")
+PY
+echo "obs smoke: ok (report: artifacts/obs_r11.json)"
 
 echo "== sharded checkpoint probe =="
 # per-shard drain + elastic 8->4 resharded restore, published next to

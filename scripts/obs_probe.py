@@ -1,0 +1,232 @@
+#!/usr/bin/env python
+"""Observability smoke probe (ISSUE 11) -> artifacts/obs_r11.json.
+
+A small segmented soak under the full flight-recorder plane, gated on
+the acceptance criteria the plane exists for:
+
+1. **live scrape advancing** — a scraper thread polls the standalone
+   Prometheus listener WHILE the soak runs and the sampled
+   ``corro_soak_rounds_total`` values must be non-decreasing with at
+   least two distinct mid-run values (a soak visible only after the
+   fact is the bug this PR removes);
+2. **flight replay consistency** — the NDJSON record replays to the
+   same segment count / completed rounds / checkpoint facts the run's
+   own ``SoakResult.stats`` reports;
+3. **quiet-trace activity oracle** — a zero-traffic trace reports zero
+   per-shard activity on every ``active_*`` channel, a seeded traffic
+   trace reports non-zero (the masks the future active-set round
+   variant will gate on);
+4. **memory audit closure** — the per-table audit sums to the measured
+   state size, and ``O(N*M)`` tables dominate at scale sim shapes.
+
+Under ``CORROSAN=1`` the whole probe runs inside a sanitized window
+(race/lock-order/fs/leak detectors armed): the obs plane's flush and
+listener threads must come and go without a finding.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _probe(rec: dict) -> list:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from corrosion_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+    import tempfile
+
+    import jax.numpy as jnp
+    import jax.random as jr
+    import numpy as np
+
+    from corrosion_tpu.obs import (
+        FlightRecorder,
+        SoakObserver,
+        memory_report,
+        replay_flight_record,
+        state_bytes,
+    )
+    from corrosion_tpu.resilience.segments import (
+        make_soak_inputs,
+        run_segmented,
+    )
+    from corrosion_tpu.sim.scale_step import (
+        ScaleSimState,
+        make_write_inputs,
+        scale_run_rounds_carry,
+        scale_sim_config,
+    )
+    from corrosion_tpu.sim.transport import NetModel
+    from corrosion_tpu.utils.metrics import (
+        Registry,
+        start_prometheus_listener,
+    )
+
+    problems: list = []
+    n_nodes = int(os.environ.get("OBS_PROBE_NODES", "256"))
+    rounds = int(os.environ.get("OBS_PROBE_ROUNDS", "10"))
+    cfg = scale_sim_config(n_nodes)
+    net = NetModel.create(n_nodes, drop_prob=0.01)
+    st = ScaleSimState.create(cfg)
+
+    # --- (4) memory audit closure ---------------------------------------
+    report = memory_report(st, n_nodes)
+    table_sum = sum(t["nbytes"] for t in report["tables"].values())
+    measured = state_bytes(st)
+    rec["hbm_bytes"] = measured
+    rec["mem_by_class"] = report["by_class"]
+    if not (table_sum == report["total_bytes"] == measured > 0):
+        problems.append(
+            f"memory audit does not sum to the measured state size: "
+            f"{table_sum} vs {report['total_bytes']} vs {measured}"
+        )
+    if report["by_class"].get("O(N*M)", 0) <= report["by_class"].get(
+            "O(N)", 0):
+        problems.append("O(N*M) tables do not dominate the scale state")
+
+    # --- (1)+(2) soak under the plane, scraped live ---------------------
+    registry = Registry()
+    listener = start_prometheus_listener(registry, port=0)
+    samples: list = []
+    stop = threading.Event()
+
+    def scrape_loop():
+        url = f"http://127.0.0.1:{listener.bound_port}/metrics"
+        while not stop.is_set():
+            try:
+                text = urllib.request.urlopen(url, timeout=2).read().decode()
+            except OSError:
+                continue
+            for line in text.splitlines():
+                if line.startswith("corro_soak_rounds_total "):
+                    samples.append(float(line.split()[1]))
+            stop.wait(0.02)
+
+    from corrosion_tpu.utils.lifecycle import spawn_counted
+
+    scraper = spawn_counted(scrape_loop, name="corro-obs-probe-scraper")
+    inputs = make_soak_inputs(cfg, jr.key(1), rounds, write_frac=0.25)
+    with tempfile.TemporaryDirectory() as tmp:
+        flight_path = os.path.join(tmp, "flight.ndjson")
+        obs = SoakObserver(flight=FlightRecorder(flight_path),
+                           registry=registry, listener=listener)
+        try:
+            res = run_segmented(
+                cfg, st, net, jr.key(0), inputs,
+                segment_rounds=max(1, rounds // 5),
+                checkpoint_root=os.path.join(tmp, "ck"), obs=obs,
+            )
+        finally:
+            stop.set()
+            scraper.join(timeout=10)
+            obs.close()  # joins corro-obs-flight, shuts the listener down
+        # replay only AFTER close(): the flush thread owns the file until
+        # the drain+join — reading earlier races the tail records
+        replay = replay_flight_record(flight_path)
+
+    mid = [s for s in samples if 0 < s < res.completed_rounds]
+    if any(b < a for a, b in zip(samples, samples[1:])):
+        problems.append("scraped corro_soak_rounds_total decreased")
+    if len(set(mid)) < 2:
+        problems.append(
+            f"mid-soak scrape saw {sorted(set(mid))} — the series did "
+            f"not visibly advance while the soak ran"
+        )
+    rec["scrape"] = {
+        "samples": len(samples),
+        "distinct_mid_run": sorted(set(mid)),
+        "final": samples[-1] if samples else None,
+    }
+    rec["flight"] = {
+        "segments": replay["segments"],
+        "completed_rounds": replay["completed_rounds"],
+        "rounds_per_s": replay["rounds_per_s"],
+        "ended": replay["ended"],
+        "skipped_lines": replay["skipped_lines"],
+    }
+    if replay["segments"] != res.stats["segments"]:
+        problems.append(
+            f"flight replay segments {replay['segments']} != run "
+            f"stats {res.stats['segments']}"
+        )
+    if replay["completed_rounds"] != res.completed_rounds:
+        problems.append("flight replay completed_rounds != run")
+    for k in ("ckpt_written", "donated_segments", "ckpt_drain_bytes"):
+        if replay["stats"].get(k) != res.stats.get(k):
+            problems.append(
+                f"flight replay stats[{k!r}] {replay['stats'].get(k)} "
+                f"!= run {res.stats.get(k)}"
+            )
+
+    # --- (3) quiescence oracle ------------------------------------------
+    quiet_rounds = 6
+    quiet = make_soak_inputs(cfg, jr.key(2), quiet_rounds, write_frac=0.0)
+    run = jax.jit(
+        lambda s, k, i: scale_run_rounds_carry(cfg, s, net, k, i))
+    (_, _), q_infos = run(ScaleSimState.create(cfg), jr.key(3), quiet)
+    q_act = {k: float(np.asarray(v).sum()) for k, v in q_infos.items()
+             if k.startswith("active_")}
+    w = jnp.zeros((quiet_rounds, n_nodes), bool).at[:, :32].set(True)
+    seeded = make_write_inputs(cfg, jr.key(4), quiet_rounds, w)
+    (_, _), s_infos = run(ScaleSimState.create(cfg), jr.key(3), seeded)
+    s_act = {k: float(np.asarray(v).sum()) for k, v in s_infos.items()
+             if k.startswith("active_")}
+    rec["activity"] = {"quiet": q_act, "seeded": s_act}
+    if not q_act or any(v != 0.0 for v in q_act.values()):
+        problems.append(
+            f"quiet trace reported non-zero activity: {q_act}"
+        )
+    if sum(s_act.values()) <= 0:
+        problems.append(
+            f"seeded trace reported zero activity: {s_act}"
+        )
+    return problems
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--output", default="artifacts/obs_r11.json")
+    args = ap.parse_args()
+    rec: dict = {"metric": "obs_smoke", "corrosan": False}
+    t0 = time.perf_counter()
+    if os.environ.get("CORROSAN") == "1":
+        # the probe's own window: flush/listener/scraper threads and the
+        # obs locks run under the race + leak detectors
+        from corrosion_tpu.analysis.sanitizer import sanitized
+
+        rec["corrosan"] = True
+        with sanitized() as san:
+            problems = _probe(rec)
+        findings = san.gate()
+        if findings:
+            problems += [f"corrosan: {f.kind} {f.subject}"
+                         for f in findings]
+    else:
+        problems = _probe(rec)
+    rec["elapsed_s"] = round(time.perf_counter() - t0, 2)
+    rec["ok"] = not problems
+    if problems:
+        rec["problems"] = problems
+    os.makedirs(os.path.dirname(os.path.abspath(args.output)),
+                exist_ok=True)
+    with open(args.output, "w") as f:
+        json.dump(rec, f, indent=2)
+    print(json.dumps(rec, indent=2))
+    return 0 if rec["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,533 @@
+"""Observability plane (ISSUE 11): flight recorder, live metrics
+bridge, activity-occupancy oracle, memory accounting, and the
+series-catalog / info-map drift guards.
+
+The headline contract: a crash-injected segmented soak leaves a
+parseable flight-record NDJSON whose replayed summary matches the
+resumed run's final ``SoakResult.stats`` on the overlapping segments;
+mid-soak ``/metrics`` shows ``corro.soak.rounds_total`` strictly
+increasing; a zero-traffic trace reports zero per-shard activity while
+a seeded one reports non-zero; the per-table memory audit sums to the
+measured state size.
+"""
+
+import json
+import os
+import re
+import threading
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+import numpy as np
+import pytest
+
+import corrosion_tpu.resilience.segments as segments
+from corrosion_tpu.obs import (
+    FlightRecorder,
+    SoakObserver,
+    memory_report,
+    publish_memory_gauges,
+    replay_flight_record,
+    state_bytes,
+)
+from corrosion_tpu.resilience.segments import (
+    make_soak_inputs,
+    resume_segmented,
+    run_segmented,
+)
+from corrosion_tpu.sim.scale_step import (
+    ScaleSimState,
+    make_write_inputs,
+    scale_sim_config,
+    scale_sim_step,
+)
+from corrosion_tpu.sim.transport import NetModel
+from corrosion_tpu.utils.metrics import Registry, start_prometheus_listener
+
+N = 48
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return scale_sim_config(N, m_slots=8, n_origins=4, n_rows=8, n_cols=4,
+                            sync_interval=2)
+
+
+@pytest.fixture(scope="module")
+def net():
+    return NetModel.create(N, drop_prob=0.0)
+
+
+# --- flight recorder -----------------------------------------------------
+
+
+def test_flight_recorder_appends_and_replays(tmp_path):
+    path = str(tmp_path / "flight.ndjson")
+    rec = FlightRecorder(path)
+    rec.record("header", schema=1, mode="scale", n_nodes=N,
+               start_round=0, total_rounds=4, segment_rounds=2,
+               hbm_bytes=123)
+    rec.record("segment", seg=1, lo=0, hi=2, rounds=2, seconds=0.5,
+               rounds_per_s=4.0, donated=False, info_sum={"acked": 3.0},
+               info_last={"queued": 1.0},
+               stats={"segments": 1, "ckpt_written": 0}, hbm_bytes=123)
+    rec.record("end", completed_rounds=2, aborted=False, crashed=False,
+               checkpoint=None, stats={"segments": 1, "ckpt_written": 0})
+    rec.close()
+    lines = open(path).read().splitlines()
+    assert len(lines) == 3
+    assert all(json.loads(ln)["kind"] for ln in lines)  # every line parses
+    summary = replay_flight_record(path)
+    assert summary["runs"] == 1
+    assert summary["segments"] == 1
+    assert summary["completed_rounds"] == 2
+    assert summary["rounds"] == 2
+    assert summary["info_sum"] == {"acked": 3.0}
+    assert summary["ended"] and summary["aborted"] is False
+    assert summary["skipped_lines"] == 0
+    # records after close are dropped, not errors
+    rec.record("segment", seg=2)
+    assert replay_flight_record(path)["segments"] == 1
+
+
+def test_flight_replay_skips_torn_tail(tmp_path):
+    """A crash mid-append tears at most the final line; everything
+    before it replays."""
+    path = str(tmp_path / "flight.ndjson")
+    rec = FlightRecorder(path)
+    rec.record("header", schema=1, start_round=0)
+    rec.record("segment", seg=1, lo=0, hi=3, rounds=3, seconds=1.0,
+               stats={"segments": 1})
+    rec.close()
+    with open(path, "a") as f:
+        f.write('{"kind":"segment","seg":2,"lo":3,"hi"')  # torn mid-write
+    summary = replay_flight_record(path)
+    assert summary["skipped_lines"] == 1
+    assert summary["segments"] == 1
+    assert summary["completed_rounds"] == 3
+
+
+def test_flight_recorder_io_failure_degrades(tmp_path):
+    """A broken path drops records with a logged exception — telemetry
+    must never kill the soak it observes."""
+    rec = FlightRecorder(str(tmp_path / "flight.ndjson"))
+    rec.path = str(tmp_path)  # a directory: os.open(O_WRONLY) fails
+    rec.record("header", schema=1)
+    rec.close()  # drains without raising
+
+
+def test_flight_recorder_thread_counted_and_joined(tmp_path):
+    rec = FlightRecorder(str(tmp_path / "f.ndjson"))
+    assert any(t.name == "corro-obs-flight" and t.is_alive()
+               for t in threading.enumerate())
+    rec.close()
+    assert not any(t.name == "corro-obs-flight" and t.is_alive()
+                   for t in threading.enumerate())
+
+
+# --- the headline: crash-injected soak, replay vs resume ------------------
+
+
+def test_crash_injected_soak_flight_matches_resume(tmp_path, cfg, net,
+                                                   monkeypatch):
+    rounds, seg = 6, 2
+    inputs = make_soak_inputs(cfg, jr.key(1), rounds, write_frac=0.25)
+    ck = str(tmp_path / "ck")
+    flight_a = str(tmp_path / "crashed.ndjson")
+    flight_b = str(tmp_path / "resumed.ndjson")
+
+    # crash the THIRD segment dispatch (after two committed segments)
+    real_jit = segments._jit
+    calls = {"n": 0}
+
+    def crashing_jit(fn, **kw):
+        jitted = real_jit(fn, **kw)
+
+        def wrapped(*a, **k):
+            calls["n"] += 1
+            if calls["n"] == 3:
+                raise RuntimeError("injected mid-soak crash")
+            return jitted(*a, **k)
+
+        return wrapped
+
+    monkeypatch.setattr(segments, "_jit", crashing_jit)
+    obs_a = SoakObserver(flight=FlightRecorder(flight_a),
+                         registry=Registry())
+    with pytest.raises(RuntimeError, match="injected"):
+        run_segmented(cfg, ScaleSimState.create(cfg), net, jr.key(0),
+                      inputs, seg, checkpoint_root=ck, obs=obs_a)
+    obs_a.close()
+    monkeypatch.setattr(segments, "_jit", real_jit)
+
+    # the black box: a parseable NDJSON with the pre-crash segments and
+    # an end record marking the crash
+    replay_a = replay_flight_record(flight_a)
+    assert replay_a["skipped_lines"] == 0
+    assert replay_a["segments"] == 2
+    assert replay_a["completed_rounds"] == 4
+    assert replay_a["ended"] and replay_a["crashed"] is True
+    assert replay_a["aborted"] is False
+
+    # resume continues exactly where the flight record says the run died
+    obs_b = SoakObserver(flight=FlightRecorder(flight_b),
+                         registry=Registry())
+    res = resume_segmented(cfg, net, inputs, seg, checkpoint_root=ck,
+                           obs=obs_b)
+    obs_b.close()
+    assert res.completed_rounds == rounds and not res.aborted
+    replay_b = replay_flight_record(flight_b)
+    assert (replay_a["completed_rounds"]
+            == res.completed_rounds - replay_b["rounds"]
+            == replay_b["header"]["start_round"])
+    # the replayed summary matches the resumed run's final stats on the
+    # overlapping segments — field for field on the pipeline facts
+    for key in ("segments", "donated_segments", "ckpt_written",
+                "ckpt_shards", "ckpt_drain_bytes", "carry_reuploads"):
+        assert replay_b["stats"][key] == res.stats[key], key
+    for key in ("ckpt_stall_s", "ckpt_io_s", "ckpt_serialize_s"):
+        assert replay_b["stats"][key] == pytest.approx(res.stats[key]), key
+    assert replay_b["ended"] and replay_b["crashed"] is False
+    # both runs' headers carry the same config digest (same scan)
+    assert (replay_a["header"]["config_digest"]
+            == replay_b["header"]["config_digest"])
+
+
+def test_end_record_clean_inside_outer_except_handler(tmp_path, cfg, net):
+    """A clean run invoked from INSIDE an except handler (the designed
+    crash -> recover-in-handler pattern) must not be stamped crashed:
+    crash detection is local to the runner, not sys.exc_info()."""
+    flight = str(tmp_path / "clean.ndjson")
+    obs = SoakObserver(flight=FlightRecorder(flight))
+    inputs = make_soak_inputs(cfg, jr.key(1), 2, write_frac=0.0)
+    try:
+        raise ValueError("outer failure being handled")
+    except ValueError:
+        res = run_segmented(cfg, ScaleSimState.create(cfg), net,
+                            jr.key(0), inputs, 2, obs=obs)
+    obs.close()
+    summary = replay_flight_record(flight)
+    assert res.completed_rounds == 2
+    assert summary["crashed"] is False and summary["aborted"] is False
+
+
+# --- live metrics bridge --------------------------------------------------
+
+
+def test_mid_soak_metrics_scrape_advances(tmp_path, cfg, net):
+    """corro.soak.rounds_total on a live /metrics listener strictly
+    increases WHILE the soak runs (scraped deterministically at each
+    segment boundary; the async-scrape variant rides scripts/
+    obs_probe.py in check.sh)."""
+    registry = Registry()
+    listener = start_prometheus_listener(registry, port=0)
+    url = f"http://127.0.0.1:{listener.bound_port}/metrics"
+    samples = []
+
+    def scrape() -> dict:
+        text = urllib.request.urlopen(url, timeout=5).read().decode()
+        return {
+            line.split()[0]: float(line.split()[1])
+            for line in text.splitlines()
+            if line and not line.startswith("#")
+        }
+
+    class ScrapingObserver(SoakObserver):
+        def on_segment(self, **kw):
+            super().on_segment(**kw)
+            samples.append(scrape())
+
+    rounds = 6
+    inputs = make_soak_inputs(cfg, jr.key(1), rounds, write_frac=0.25)
+    obs = ScrapingObserver(registry=registry, listener=listener)
+    try:
+        res = run_segmented(cfg, ScaleSimState.create(cfg), net,
+                            jr.key(0), inputs, 2,
+                            checkpoint_root=str(tmp_path / "ck"), obs=obs)
+    finally:
+        obs.close()  # shuts the listener down and joins its thread
+    totals = [s["corro_soak_rounds_total"] for s in samples]
+    assert totals == [2.0, 4.0, 6.0]  # strictly increasing, mid-run
+    assert res.completed_rounds == rounds
+    last = samples[-1]
+    assert last["corro_soak_segments_total"] == 3.0
+    assert last["corro_soak_rounds_per_s"] > 0
+    assert last["corro_soak_segment_seconds_count"] == 3.0
+    # the round-info series advanced through the bridge's merged
+    # record_round_info path (counter = segment sums)
+    assert last["corro_gossip_probe_acked"] > 0
+    # activity gauges: seeded traffic reports non-zero occupancy
+    assert last["corro_activity_bcast_nodes"] > 0
+    # memory gauges published at open_run
+    assert last["corro_mem_state_bytes"] == state_bytes(res.state)
+    assert not any(t.name == "corro-prometheus" and t.is_alive()
+                   for t in threading.enumerate())
+
+
+def test_agent_soak_bridges_own_metrics(tmp_path):
+    """Agent.soak with no observer still advances corro.soak.* on the
+    agent's own registry (the /metrics route's view)."""
+    from corrosion_tpu.agent import Agent
+    from corrosion_tpu.testing import cluster_config
+
+    agent = Agent(cluster_config())
+    res = agent.soak(4, segment_rounds=2,
+                     checkpoint_root=str(tmp_path / "ck"))
+    assert res.completed_rounds == 4
+    assert agent.metrics.get_counter("corro.soak.rounds_total") == 4.0
+    assert agent.metrics.get_gauge("corro.soak.completed.rounds") == 4.0
+    assert agent.metrics.get_gauge("corro.soak.aborted") == 0.0
+    # boot-time memory gauges ride the same registry
+    assert agent.metrics.get_gauge("corro.mem.state.bytes") == \
+        state_bytes(agent.device_state())
+
+
+def test_obs_config_section_and_env_overlay(tmp_path):
+    from corrosion_tpu.config import Config, load_config
+    from corrosion_tpu.obs import make_observer
+
+    cfg = load_config(environ={
+        "CORRO_TPU__OBS__FLIGHT_PATH": str(tmp_path / "f.ndjson"),
+        "CORRO_TPU__OBS__PROMETHEUS_PORT": "0",
+        "CORRO_TPU__OBS__JAX_PROFILE": "1",
+    })
+    assert cfg.obs.flight_path.endswith("f.ndjson")
+    assert cfg.obs.prometheus_port == 0 and cfg.obs.jax_profile
+    obs = make_observer(cfg.obs)
+    try:
+        assert obs.flight is not None and obs.jax_profile
+        assert obs.listener is not None and obs.listener.bound_port > 0
+    finally:
+        obs.close()
+    # an idle section builds no observer
+    assert make_observer(Config().obs) is None
+    # a recorder-init failure must not strand a bound listener (socket
+    # + corro-prometheus thread with no handle) — recorder comes first
+    blocker = tmp_path / "blocker"
+    blocker.write_text("")  # a FILE where the parent dir must go
+    cfg.obs.flight_path = str(blocker / "x.ndjson")
+    with pytest.raises(OSError):
+        make_observer(cfg.obs)
+    assert not any(t.name == "corro-prometheus" and t.is_alive()
+                   for t in threading.enumerate())
+
+
+# --- activity occupancy (the quiescence oracle) ---------------------------
+
+
+def test_activity_quiet_trace_reports_zero(cfg, net):
+    """Zero traffic ⇒ zero reported activity on every channel — the
+    oracle the active-set round variant will be gated against."""
+    rounds = 6
+    quiet = make_soak_inputs(cfg, jr.key(2), rounds, write_frac=0.0)
+    from corrosion_tpu.sim.scale_step import scale_run_rounds_carry
+
+    (_, _), infos = jax.jit(
+        lambda s, k, i: scale_run_rounds_carry(cfg, s, net, k, i)
+    )(ScaleSimState.create(cfg), jr.key(3), quiet)
+    act = {k: np.asarray(v) for k, v in infos.items()
+           if k.startswith("active_")}
+    assert set(act) == {"active_bcast", "active_partials",
+                        "active_sync", "active_probes"}
+    for k, v in act.items():
+        assert v.sum() == 0, f"{k} non-zero on a quiet trace: {v}"
+
+
+def test_activity_traffic_and_churn_report_nonzero(cfg, net):
+    """The other half of the oracle, one trace: seeded writes light the
+    bcast/sync channels, and a killed SEED node (a fresh bounded member
+    table only tracks the seeds + self, so only a seed's death is
+    observable this early) lights the SWIM suspicion-timer channel."""
+    from corrosion_tpu.sim.scale_step import scale_run_rounds_carry
+
+    rounds = 6
+    w = jnp.zeros((rounds, N), bool).at[:, : cfg.n_origins].set(True)
+    inputs = make_write_inputs(cfg, jr.key(4), rounds, w)
+    inputs = inputs._replace(
+        kill=jnp.zeros((rounds, N), bool).at[0, 0].set(True)
+    )
+    (_, _), infos = jax.jit(
+        lambda s, k, i: scale_run_rounds_carry(cfg, s, net, k, i)
+    )(ScaleSimState.create(cfg), jr.key(3), inputs)
+    assert np.asarray(infos["active_bcast"]).sum() > 0
+    assert np.asarray(infos["active_sync"]).sum() > 0
+    assert np.asarray(infos["active_probes"]).sum() > 0
+
+
+def test_activity_masks_shapes(cfg):
+    from corrosion_tpu.sim.scale_step import activity_masks
+
+    masks = activity_masks(cfg, ScaleSimState.create(cfg))
+    assert set(masks) == {"bcast", "partials", "sync", "probes"}
+    for k, m in masks.items():
+        assert m.shape == (N,) and m.dtype == jnp.bool_, k
+        assert not bool(m.any()), f"{k} active on a fresh state"
+
+
+# --- memory accounting ----------------------------------------------------
+
+
+def test_memory_report_sums_and_classifies(cfg):
+    st = ScaleSimState.create(cfg)
+    report = memory_report(st, cfg.n_nodes)
+    # the audit must sum to the measured state size — a table the walk
+    # missed would undercount the 1M budget
+    table_sum = sum(t["nbytes"] for t in report["tables"].values())
+    leaves_sum = sum(int(leaf.nbytes) for leaf in jax.tree.leaves(st))
+    assert table_sum == report["total_bytes"] == leaves_sum > 0
+    assert sum(report["by_class"].values()) == report["total_bytes"]
+    t = report["tables"]
+    assert t["swim.mem_id"]["class"] == "O(N*M)"
+    assert t["swim.alive"]["class"] == "O(N)"
+    assert t["crdt.now"]["class"] == "O(1)"
+    assert t["crdt.store[0]"]["class"] == "O(N*M)"
+    assert t["swim.mem_id"]["per_node_bytes"] == cfg.m_slots * 4
+    assert t["swim.mem_id"]["dtype"] == "int32"
+    # narrow planes audit at their narrowed width (the int16 saving is
+    # visible per table)
+    assert t["crdt.q_tx"]["dtype"] == "int16"
+    # scale state is dominated by the O(N·M) tables
+    assert report["by_class"]["O(N*M)"] > report["by_class"]["O(N)"]
+
+
+def test_memory_report_full_sim_state():
+    from corrosion_tpu.sim.config import wan_config
+    from corrosion_tpu.sim.step import SimState
+
+    cfg = wan_config(16)
+    st = SimState.create(cfg)
+    report = memory_report(st, 16)
+    assert report["total_bytes"] == state_bytes(st) > 0
+
+
+def test_memory_gauges_render(cfg):
+    reg = Registry()
+    publish_memory_gauges(memory_report(ScaleSimState.create(cfg),
+                                        cfg.n_nodes), reg)
+    text = reg.render()
+    assert re.search(
+        r'corro_mem_table_bytes\{class="O\(N\*M\)",table="swim.mem_id"\} ',
+        text,
+    )
+    assert "corro_mem_state_bytes" in text
+    assert 'corro_mem_class_bytes{class="O(N)"}' in text
+
+
+def test_mem_report_cli(capsys):
+    from corrosion_tpu.cli import main
+
+    assert main(["mem-report", "--n-nodes", "64"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["n_nodes"] == 64 and report["total_bytes"] > 0
+    assert report["mode"] == "scale"
+    assert any(t["class"] == "O(N*M)" for t in report["tables"].values())
+
+
+def test_http_obs_memory_route():
+    from corrosion_tpu.agent import Agent
+    from corrosion_tpu.api import ApiServer
+    from corrosion_tpu.db import Database
+    from corrosion_tpu.testing import cluster_config
+
+    with Agent(cluster_config()) as agent:
+        api = ApiServer(Database(agent)).start()
+        try:
+            base = f"http://{api.addr}:{api.port}"
+            report = json.loads(urllib.request.urlopen(
+                base + "/v1/obs/memory", timeout=10).read())
+            assert report["total_bytes"] > 0
+            assert report["n_nodes"] == agent.n_nodes
+            assert any(t["class"] == "O(N*M)"
+                       for t in report["tables"].values())
+            # the boot-time memory gauges show on /metrics
+            text = urllib.request.urlopen(
+                base + "/metrics", timeout=10).read().decode()
+            assert "corro_mem_state_bytes" in text
+        finally:
+            api.stop()
+
+
+# --- drift guards ---------------------------------------------------------
+
+
+def test_info_map_covers_every_emitted_key(cfg, net):
+    """Unknown info keys are silently dropped by record_round_info — a
+    new sim counter would vanish from /metrics unnoticed. Pin _INFO_MAP
+    ⊇ the keys both sim steps actually emit (traced abstractly: no
+    compile)."""
+    from corrosion_tpu.sim.config import wan_config
+    from corrosion_tpu.sim.step import RoundInput, SimState, sim_step
+    from corrosion_tpu.sim.scale_step import ScaleRoundInput
+    from corrosion_tpu.utils.metrics import info_series
+
+    mapped = set(info_series())
+    scale_infos = jax.eval_shape(
+        lambda st, key, inp: scale_sim_step(cfg, st, net, key, inp)[1],
+        ScaleSimState.create(cfg), jr.key(0), ScaleRoundInput.quiet(cfg),
+    )
+    fcfg = wan_config(16)
+    fnet = NetModel.create(16)
+    full_infos = jax.eval_shape(
+        lambda st, key, inp: sim_step(fcfg, st, fnet, key, inp)[1],
+        SimState.create(fcfg), jr.key(0), RoundInput.quiet(fcfg),
+    )
+    emitted = set(scale_infos) | set(full_infos)
+    missing = emitted - mapped
+    assert not missing, (
+        f"info keys invisible on /metrics (add them to "
+        f"utils.metrics._INFO_MAP): {sorted(missing)}"
+    )
+
+
+def _package_series() -> set:
+    """Every corro.* series name the package emits: string literals
+    plus the RoundTimer dynamic pair."""
+    root = os.path.join(os.path.dirname(__file__), "..", "corrosion_tpu")
+    names, timers = set(), set()
+    for dirpath, _dirs, files in os.walk(root):
+        if "__pycache__" in dirpath:
+            continue
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            src = open(os.path.join(dirpath, fn)).read()
+            names.update(re.findall(r'"(corro\.[a-z0-9_.]+)"', src))
+            timers.update(re.findall(r'RoundTimer\(\s*"([a-z_]+)"', src))
+    for t in timers:
+        names.add(f"corro.{t}.seconds")
+        names.add(f"corro.{t}.slow")
+    return names
+
+
+def test_series_catalog_matches_code():
+    """docs/observability.md catalogs EVERY corro.* series the code
+    emits, and lists nothing the code does not emit — the corrolint-
+    style docs-sync gate."""
+    doc_path = os.path.join(os.path.dirname(__file__), "..", "docs",
+                            "observability.md")
+    doc = open(doc_path).read()
+    doc_names = set(re.findall(r"`(corro\.[a-z0-9_.]+)`", doc))
+    code_names = _package_series()
+    undocumented = code_names - doc_names
+    assert not undocumented, (
+        f"series emitted but missing from docs/observability.md: "
+        f"{sorted(undocumented)}"
+    )
+    phantom = doc_names - code_names
+    assert not phantom, (
+        f"series documented but emitted nowhere: {sorted(phantom)}"
+    )
+
+
+def test_flight_schema_documented():
+    """Every field the recorder writes into header/segment/end records
+    appears in the NDJSON schema section of docs/observability.md."""
+    doc = open(os.path.join(os.path.dirname(__file__), "..", "docs",
+                            "observability.md")).read()
+    for field in ("config_digest", "hbm_bytes", "info_sum", "info_last",
+                  "rounds_per_s", "completed_rounds", "aborted",
+                  "crashed", "checkpoint", "segment_rounds",
+                  "skipped_lines"):
+        assert f"`{field}`" in doc, f"flight field {field} undocumented"

@@ -38,6 +38,76 @@ def test_record_round_info():
     assert r.get_gauge("corro.broadcast.pending.count") == 3
 
 
+def test_prometheus_label_value_escaping():
+    """Exposition-format spec: `"`, `\\` and newline in label values
+    must be escaped — raw they corrupt the whole scrape."""
+    r = Registry()
+    r.gauge("corro.test.series", 1, labels={"q": 'say "hi"',
+                                            "b": "a\\b",
+                                            "n": "line1\nline2"})
+    text = r.render()
+    assert '\\"hi\\"' in text
+    assert 'b="a\\\\b"' in text
+    assert 'n="line1\\nline2"' in text
+    assert "\nline2" not in text  # no raw newline inside a label value
+
+
+def test_prometheus_one_type_line_per_metric_name():
+    """Labeled samples of one metric share a single `# TYPE` line —
+    strict expfmt parsers reject a scrape with a repeated TYPE line."""
+    r = Registry()
+    r.gauge("corro.mem.table.bytes", 1, labels={"table": "a"})
+    r.gauge("corro.mem.table.bytes", 2, labels={"table": "b"})
+    r.counter("corro.test.c", 1, labels={"x": "1"})
+    r.counter("corro.test.c", 1, labels={"x": "2"})
+    text = r.render()
+    assert text.count("# TYPE corro_mem_table_bytes gauge") == 1
+    assert text.count("# TYPE corro_test_c counter") == 1
+    assert text.count("corro_mem_table_bytes{") == 2
+
+
+def test_prometheus_le_formatting():
+    """Bucket bounds render canonically (`le="1"`, never `le="1.0"`)."""
+    r = Registry()
+    r.histogram("corro.test.hist", 0.7, buckets=(0.5, 1.0, 2.5, 10.0))
+    text = r.render()
+    assert 'le="0.5"' in text and 'le="1"' in text
+    assert 'le="2.5"' in text and 'le="10"' in text
+    assert 'le="+Inf"' in text
+    assert 'le="1.0"' not in text and 'le="10.0"' not in text
+    # shortest round-trip, not %g: >6-significant-digit bounds must not
+    # collide into one duplicate le label
+    r2 = Registry()
+    r2.histogram("corro.test.hp", 1.0, buckets=(1234567.0, 1234568.0))
+    t2 = r2.render()
+    assert 'le="1234567"' in t2 and 'le="1234568"' in t2
+
+
+def test_prometheus_listener_ephemeral_port_and_join():
+    """port=0 binds an ephemeral port exposed as `bound_port`, and
+    shutdown() joins the counted corro-prometheus thread (the leak gate
+    must see it exit) and closes the socket."""
+    import threading
+    import urllib.request
+
+    from corrosion_tpu.utils.metrics import start_prometheus_listener
+
+    r = Registry()
+    r.counter("corro.test.up", 1)
+    srv = start_prometheus_listener(r, port=0)
+    assert srv.bound_port > 0
+    text = urllib.request.urlopen(
+        f"http://127.0.0.1:{srv.bound_port}/metrics", timeout=5
+    ).read().decode()
+    assert "corro_test_up 1" in text
+    srv.shutdown()
+    assert not any(t.name == "corro-prometheus" and t.is_alive()
+                   for t in threading.enumerate())
+    # listening socket closed (fd released) — without racing another
+    # process for the freed ephemeral port
+    assert srv.socket.fileno() == -1
+
+
 def test_round_timer_slow_warn():
     r = Registry()
     with RoundTimer("round", warn_seconds=0.0, registry=r):
@@ -131,6 +201,62 @@ def test_otlp_file_exporter(tmp_path):
     assert "parentSpanId" not in outer  # trace root
     assert int(inner["endTimeUnixNano"]) >= int(inner["startTimeUnixNano"])
     assert inner["attributes"][0]["key"] == "step"
+
+
+def test_otlp_exporter_failed_flush_retains_batch(tmp_path):
+    """A failed flush keeps the batch for the next attempt — spans are
+    not lost to a transient IO error."""
+    from corrosion_tpu.utils.tracing import OtlpFileExporter
+
+    ex = OtlpFileExporter(str(tmp_path / "no_such_dir" / "s.jsonl"),
+                         flush_every=1)
+    ex.export({"spanId": "a" * 16, "name": "one"})  # flush fails, retained
+    assert len(ex._buf) == 1
+    ex.path = str(tmp_path / "s.jsonl")  # path heals
+    ex.flush()
+    assert ex._buf == []
+    import json
+
+    batch = json.loads(open(ex.path).readline())
+    spans = batch["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    assert [s["name"] for s in spans] == ["one"]
+
+
+def test_otlp_exporter_buffer_cap_under_broken_path(tmp_path, monkeypatch):
+    """A permanently broken path cannot grow the retained buffer beyond
+    MAX_BUFFERED — newest spans win, oldest are shed."""
+    from corrosion_tpu.utils.tracing import OtlpFileExporter
+
+    monkeypatch.setattr(OtlpFileExporter, "MAX_BUFFERED", 8)
+    ex = OtlpFileExporter(str(tmp_path / "missing" / "s.jsonl"),
+                         flush_every=1)
+    for i in range(20):
+        ex.export({"name": f"s{i}"})  # every flush fails
+    assert len(ex._buf) == 8
+    assert [s["name"] for s in ex._buf] == [f"s{i}" for i in range(12, 20)]
+
+
+def test_from_traceparent_rejects_malformed():
+    """Malformed inbound trace context must parse to None (a poisoned
+    id would corrupt strict OTLP consumers downstream)."""
+    from corrosion_tpu.utils.tracing import SpanContext
+
+    good = "00-" + "a" * 32 + "-" + "b" * 16 + "-01"
+    assert SpanContext.from_traceparent(good) is not None
+    bad = [
+        None,
+        "",
+        "garbage",
+        "00-short-span-01",
+        "00-" + "a" * 31 + "-" + "b" * 16 + "-01",  # trace id too short
+        "00-" + "a" * 32 + "-" + "b" * 15 + "-01",  # span id too short
+        "00-" + "g" * 32 + "-" + "b" * 16 + "-01",  # non-hex trace id
+        "00-" + "a" * 32 + "-" + "z" * 16 + "-01",  # non-hex span id
+        "00-" + "a" * 32 + "-" + "b" * 16,  # missing flags field
+        good + "-extra",  # too many fields
+    ]
+    for tp in bad:
+        assert SpanContext.from_traceparent(tp) is None, tp
 
 
 def test_admin_sync_trace_propagation(tmp_path):
