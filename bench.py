@@ -200,6 +200,10 @@ def _worker() -> None:
     if os.environ.get("BENCH_NARROW"):
         # =0 keeps wide int32 planes
         overrides["narrow_dtypes"] = os.environ["BENCH_NARROW"] != "0"
+    if os.environ.get("BENCH_NARROW8"):
+        # =1 stores mem_tx as int8 (ISSUE 12, the corrobudget shrink;
+        # requires the narrow arm — docs/memory-budget.md)
+        overrides["narrow_int8"] = os.environ["BENCH_NARROW8"] == "1"
     if os.environ.get("BENCH_TX_CELLS"):
         # >1 routes writes through K-cell chunked transactions (the
         # partial-buffer path, change.rs:66-178 + util.rs:1061-1194)
@@ -218,10 +222,14 @@ def _worker() -> None:
     net = NetModel.create(n_nodes, drop_prob=0.01)
     # HBM footprint of the scan carry (ISSUE 11): array metadata only —
     # the first number of the 1M memory-budget audit, carried on every
-    # bench record so N sweeps chart bytes next to rounds/s
-    from corrosion_tpu.obs.memory import state_bytes
+    # bench record so N sweeps chart bytes next to rounds/s. The
+    # _projected_1m twin (ISSUE 12) is corrobudget's STATIC projection
+    # of the SAME config's table set at N=1M (docs/memory-budget.md),
+    # so every record also prices the run against the flagship point
+    from corrosion_tpu.obs.memory import projected_bytes, state_bytes
 
     hbm_bytes = state_bytes(st)
+    hbm_bytes_projected_1m = projected_bytes(cfg, 1_000_000)
 
     # node-axis sharding over every visible device (the flagship
     # multi-chip path): state/net/inputs get P("node") placements and
@@ -310,8 +318,10 @@ def _worker() -> None:
                 "donated": donated,
                 "sharded": sharded,
                 # the scan carry's HBM bytes (per-table audit:
-                # `corrosion-tpu mem-report`; obs/memory.py)
+                # `corrosion-tpu mem-report`; obs/memory.py) + the
+                # static 1M projection of the same table set
                 "hbm_bytes": hbm_bytes,
+                "hbm_bytes_projected_1m": hbm_bytes_projected_1m,
                 # loud fused-path visibility (VERDICT r2 weak #2): a TPU
                 # record measured on the XLA fallback is flagged, not
                 # silently reported as if it were the pallas path —
@@ -456,9 +466,10 @@ def _smoke() -> None:
     soak_inputs = make_soak_inputs(cfg, jr.key(3), soak_rounds,
                                    write_frac=0.25)
     soak_st = ScaleSimState.create(cfg)
-    from corrosion_tpu.obs.memory import state_bytes
+    from corrosion_tpu.obs.memory import projected_bytes, state_bytes
 
     hbm_bytes = state_bytes(soak_st)
+    hbm_bytes_projected_1m = projected_bytes(cfg, 1_000_000)
     soak_net = net
     n_devices = len(jax.devices())
     if n_devices > 1:
@@ -563,6 +574,7 @@ def _smoke() -> None:
         "fused_interpret": fused_dec["interpret"],
         "fused_parity": fused_parity,
         "hbm_bytes": hbm_bytes,
+        "hbm_bytes_projected_1m": hbm_bytes_projected_1m,
         # flight-record replay facts (ISSUE 11): proves the soak leg
         # left a parseable NDJSON whose summary matches the live stats
         "flight": {

@@ -77,9 +77,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m corrosion_tpu.analysis",
         description="corrolint: donation-safety, lock-discipline, "
-                    "strippable-assert, trace-hygiene, and the v2 "
+                    "strippable-assert, trace-hygiene, the v2 "
                     "interprocedural sharding-contract / dtype-flow / "
-                    "lock-order / donation-flow checks",
+                    "lock-order / donation-flow checks, and the v3 "
+                    "corrobudget mem-budget / densify symbolic-shape "
+                    "gate (docs/memory-budget.md)",
     )
     parser.add_argument(
         "paths", nargs="*", default=None,

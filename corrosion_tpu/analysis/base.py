@@ -80,6 +80,16 @@ RULES: Dict[str, str] = {
         "two locks acquired in opposite orders on two code paths "
         "(ABBA deadlock across threads)"
     ),
+    # --- v3 corrobudget rules (symbolic shape/memory interpreter) ---
+    "mem-budget": (
+        "statically-projected state footprint at the declared N=1M "
+        "point exceeds its per-complexity-class HBM budget (or a state "
+        "leaf's shape is no longer statically priceable)"
+    ),
+    "densify": (
+        "trace-time intermediate whose N-degree exceeds every input's "
+        "(an N x N pairwise broadcast: fits at 100k, OOMs at 1M)"
+    ),
 }
 
 

@@ -35,10 +35,16 @@ RULE = "dtype-widen"
 
 #: declared-narrow state leaves -> bit width (the ``narrow_dtypes``
 #: registry, seeded from ``sim/scale_step.py`` + ``ops/megakernel.py``
-#: boundaries; keep in sync with ``ScaleSimConfig.timer_dtype``)
+#: boundaries; keep in sync with ``ScaleSimConfig.timer_dtype``).
+#: ``mem_tx`` is 8 since ISSUE 12: under ``narrow_int8`` (the
+#: corrobudget-identified shrink, docs/memory-budget.md) the budget
+#: plane lives as int8, so its boundaries must never receive a
+#: concretely-wider store — dynamic ``.astype(<plane>.dtype)`` casts
+#: stay the contract at every boundary, which is also why the int16
+#: default config needs no code change
 NARROW_LEAVES: Dict[str, int] = {
     "mem_timer": 16,
-    "mem_tx": 16,
+    "mem_tx": 8,
     "q_cell": 16,
     "q_seq": 16,
     "q_nseq": 16,
@@ -55,7 +61,7 @@ NARROW_LEAVES: Dict[str, int] = {
 #: (``.astype(ref.dtype)``): a widened store changes the donated
 #: carry's aval and retraces every consumer (ISSUE 10).
 NARROW_REFS: Dict[str, int] = {
-    "o_timer": 16, "o_tx": 16, "m_timer": 16, "m_tx": 16,
+    "o_timer": 16, "o_tx": 8, "m_timer": 16, "m_tx": 8,
     "o_q_cell": 16, "o_q_tx": 16,
 }
 NARROW_REFS.update(NARROW_LEAVES)

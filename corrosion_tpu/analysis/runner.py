@@ -40,6 +40,7 @@ from corrosion_tpu.analysis import (
     dtypes,
     locks,
     lockorder,
+    shapes,
     sharding,
     trace,
 )
@@ -64,6 +65,9 @@ PROJECT_CHECKERS: Dict[str, Callable] = {
     "sharding-contract": sharding.check_project,
     "dtype-flow": dtypes.check_project,
     "lock-order": lockorder.check_project,
+    # corrobudget (v3, ISSUE 12): symbolic shape/memory interpreter
+    "mem-budget": shapes.check_budget,
+    "densify": shapes.check_densify,
 }
 
 _SKIP_DIRS = {"__pycache__", ".git", ".jax_cache", "node_modules"}

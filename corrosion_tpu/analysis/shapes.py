@@ -1,0 +1,1648 @@
+"""corrobudget: symbolic shape/memory abstract interpreter (tier 3).
+
+The ROADMAP's million-node flagship opens with a question PR 10's
+``obs/memory.py`` answers only at RUNTIME: *which tables of
+``ScaleSimState`` are O(N·M) vs O(N), and what do they cost at N=1M?*
+Nothing stopped a PR from landing a new O(N·M) table, a silent dtype
+widening, or an N×N trace-time intermediate that fits at 100k and OOMs
+at 1M. corrobudget closes that gap the way the reference's CR-SQLite
+clock tables make CRDT storage cost a schema-level, statically-knowable
+quantity (PAPER.md §1): the state *constructors* are the schema, so the
+HBM bill is decidable at lint time.
+
+Built on the PR-6 dataflow engine (:class:`ForwardAnalysis`), this
+module interprets the state constructors in ``sim/scale.py`` /
+``sim/scale_step.py`` / ``sim/step.py`` (and their ``ops/`` table
+classes) with **symbolic shapes**: every dimension is a polynomial in
+the ``ScaleSimConfig`` extents —
+
+    N = n_nodes      M = m_slots      Q = bcast_queue   O = n_origins
+    C = n_cells      B = buf_slots    P = partial_slots K = tx_max_cells
+
+From the interpretation come three deliverables:
+
+- a **static table inventory** (:func:`build_inventory`): every
+  ``ScaleSimState``/``SimState`` leaf with symbolic shape, dtype and
+  projected nbytes at arbitrary (N, M) — cross-checked leaf-for-leaf
+  against the runtime ``obs/memory.py`` audit and ``jax.eval_shape``
+  ground truth by ``tests/test_membudget.py``;
+- the **``mem-budget`` rule** (:func:`check_budget`): evaluates the
+  walked tree's OWN constructor ASTs at the declared N=1M point
+  (:data:`HBM_BUDGET`) and fails lint when a PR's projection exceeds a
+  per-complexity-class budget;
+- the **``densify`` rule** (:func:`check_densify`): flags trace-time
+  intermediates whose N-degree exceeds every input's (the N×N pairwise
+  broadcast), with the usual reasoned-suppression pipeline.
+
+Projection methodology, the declared budget, and the ranked offender
+table live in ``docs/memory-budget.md``. The per-leaf complexity
+classification is shared with the runtime audit through
+``obs.memory.classify_leaf`` — one source, two enforcement planes.
+
+Like the rest of the analysis package this module never imports jax:
+the interpreter runs on ASTs and arithmetic only, which is also why it
+can project past runtime walls (``ScaleConfig.validate`` refuses
+N > 2^19 until the sender-election packing is widened — the budget
+gate prices N=1M anyway).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from corrosion_tpu.analysis.base import Finding, dotted_name
+from corrosion_tpu.analysis.callgraph import (
+    FunctionInfo,
+    ModuleInfo,
+    Project,
+    module_name_for,
+)
+from corrosion_tpu.analysis.dataflow import Env, ForwardAnalysis, TupleVal
+from corrosion_tpu.obs.memory import classify_leaf
+
+BUDGET_RULE = "mem-budget"
+DENSIFY_RULE = "densify"
+
+# --- symbolic integers ----------------------------------------------------
+
+
+class Poly:
+    """Integer polynomial over the config extents: ``{monomial: coeff}``
+    with each monomial a sorted tuple of symbol names (with repetition,
+    so N·M is ``("M", "N")`` and N² is ``("N", "N")``)."""
+
+    __slots__ = ("terms",)
+
+    def __init__(self, terms: Dict[Tuple[str, ...], int]):
+        self.terms = {m: c for m, c in terms.items() if c}
+
+    @staticmethod
+    def const(c: int) -> "Poly":
+        return Poly({(): int(c)})
+
+    @staticmethod
+    def var(name: str) -> "Poly":
+        return Poly({(name,): 1})
+
+    def __add__(self, other):
+        if isinstance(other, int):
+            other = Poly.const(other)
+        if not isinstance(other, Poly):
+            return SymOp("add", (self, other))
+        out = dict(self.terms)
+        for m, c in other.terms.items():
+            out[m] = out.get(m, 0) + c
+        return Poly(out)
+
+    def __neg__(self):
+        return Poly({m: -c for m, c in self.terms.items()})
+
+    def __sub__(self, other):
+        if isinstance(other, int):
+            other = Poly.const(other)
+        if not isinstance(other, Poly):
+            return SymOp("sub", (self, other))
+        return self + (-other)
+
+    def __mul__(self, other):
+        if isinstance(other, int):
+            other = Poly.const(other)
+        if not isinstance(other, Poly):
+            return SymOp("mul", (self, other))
+        out: Dict[Tuple[str, ...], int] = {}
+        for ma, ca in self.terms.items():
+            for mb, cb in other.terms.items():
+                mono = tuple(sorted(ma + mb))
+                out[mono] = out.get(mono, 0) + ca * cb
+        return Poly(out)
+
+    def evaluate(self, env: Dict[str, int]) -> int:
+        total = 0
+        for mono, c in self.terms.items():
+            v = c
+            for s in mono:
+                v *= env[s]  # KeyError = missing binding, caller handles
+            total += v
+        return total
+
+    def degree(self, name: str) -> int:
+        return max((m.count(name) for m in self.terms), default=0)
+
+    def is_const(self) -> bool:
+        return all(m == () for m in self.terms)
+
+    def render(self) -> str:
+        if not self.terms:
+            return "0"
+        parts = []
+        for mono, c in sorted(self.terms.items(),
+                              key=lambda kv: (-len(kv[0]), kv[0])):
+            body = "*".join(mono)
+            if not mono:
+                parts.append(str(c))
+            elif c == 1:
+                parts.append(body)
+            else:
+                parts.append(f"{c}*{body}")
+        return " + ".join(parts)
+
+    def __eq__(self, other):
+        return isinstance(other, Poly) and self.terms == other.terms
+
+    def __hash__(self):
+        return hash(frozenset(self.terms.items()))
+
+    def __repr__(self):
+        return f"Poly({self.render()})"
+
+
+_OP_EVAL = {
+    "add": lambda a, b: a + b,
+    "sub": lambda a, b: a - b,
+    "mul": lambda a, b: a * b,
+    "floordiv": lambda a, b: a // b,
+    "mod": lambda a, b: a % b,
+    "max": max,
+    "min": min,
+    "neg": lambda a: -a,
+}
+
+
+class SymOp:
+    """Opaque symbolic integer (``max``/``min``/``//``/``%``/mixed
+    arithmetic) — still evaluable and degree-bounded, just not a
+    polynomial normal form."""
+
+    __slots__ = ("op", "args")
+
+    def __init__(self, op: str, args):
+        self.op = op
+        self.args = tuple(
+            Poly.const(a) if isinstance(a, int) else a for a in args
+        )
+
+    def evaluate(self, env: Dict[str, int]) -> int:
+        return _OP_EVAL[self.op](*(a.evaluate(env) for a in self.args))
+
+    def degree(self, name: str) -> int:
+        degs = [a.degree(name) for a in self.args]
+        if self.op in ("floordiv", "mod"):
+            # //k keeps the numerator's growth; %k is bounded by the
+            # divisor, which carries its own degree
+            return degs[0] if self.op == "floordiv" else (
+                self.args[1].degree(name))
+        return max(degs, default=0)
+
+    def render(self) -> str:
+        inner = ", ".join(sym_render(a) for a in self.args)
+        if self.op in ("max", "min"):
+            return f"{self.op}({inner})"
+        if self.op == "neg":
+            return f"-({sym_render(self.args[0])})"
+        sign = {"add": "+", "sub": "-", "mul": "*", "floordiv": "//",
+                "mod": "%"}[self.op]
+        return f"({sym_render(self.args[0])} {sign} "\
+               f"{sym_render(self.args[1])})"
+
+    def __eq__(self, other):
+        return (isinstance(other, SymOp) and self.op == other.op
+                and self.args == other.args)
+
+    def __hash__(self):
+        return hash((self.op, self.args))
+
+    def __repr__(self):
+        return f"SymOp({self.render()})"
+
+
+def is_sym(v) -> bool:
+    return isinstance(v, (Poly, SymOp))
+
+
+def sym_render(v) -> str:
+    return v.render() if is_sym(v) else str(v)
+
+
+def sym_eval(v, env: Dict[str, int]) -> Optional[int]:
+    try:
+        return v.evaluate(env)
+    except KeyError:
+        return None
+
+
+def sym_binop(op: str, a, b):
+    if isinstance(a, int):
+        a = Poly.const(a)
+    if isinstance(b, int):
+        b = Poly.const(b)
+    if not (is_sym(a) and is_sym(b)):
+        return None
+    if isinstance(a, Poly) and isinstance(b, Poly):
+        if op == "add":
+            return a + b
+        if op == "sub":
+            return a - b
+        if op == "mul":
+            return a * b
+    if op in _OP_EVAL:
+        return SymOp(op, (a, b))
+    return None
+
+
+# --- abstract values ------------------------------------------------------
+
+_DTYPE_SIZES = {
+    "bool": 1, "int8": 1, "uint8": 1, "int16": 2, "uint16": 2,
+    "bfloat16": 2, "float16": 2, "int32": 4, "uint32": 4, "float32": 4,
+    "int64": 8, "uint64": 8, "float64": 8,
+}
+
+#: dotted spellings that denote a concrete dtype in this codebase
+_DTYPE_BASES = ("jnp", "np", "numpy", "jax.numpy")
+
+
+class DtypeVal:
+    __slots__ = ("name",)
+
+    def __init__(self, name: str):
+        self.name = "bool" if name == "bool_" else name
+
+    def __eq__(self, other):
+        return isinstance(other, DtypeVal) and self.name == other.name
+
+    def __hash__(self):
+        return hash(("dtype", self.name))
+
+    def __repr__(self):
+        return f"DtypeVal({self.name})"
+
+
+class BoolVal:
+    __slots__ = ("value",)
+
+    def __init__(self, value: bool):
+        self.value = bool(value)
+
+    def __eq__(self, other):
+        return isinstance(other, BoolVal) and self.value == other.value
+
+    def __hash__(self):
+        return hash(("bool", self.value))
+
+    def __repr__(self):
+        return f"BoolVal({self.value})"
+
+
+class ArrayVal:
+    """Abstract array: symbolic dims + dtype + creation site. A dim may
+    be ``None`` (unknown) — such arrays grow no budget/densify facts."""
+
+    __slots__ = ("dims", "dtype", "site")
+
+    def __init__(self, dims, dtype: Optional[str],
+                 site: Optional[Tuple[str, int]] = None):
+        self.dims = tuple(dims)
+        self.dtype = dtype
+        self.site = site
+
+    def known(self) -> bool:
+        return all(d is not None for d in self.dims)
+
+    def key(self):
+        return (tuple(sym_render(d) if d is not None else "?"
+                      for d in self.dims), self.dtype)
+
+    def __eq__(self, other):
+        return isinstance(other, ArrayVal) and self.key() == other.key()
+
+    def __hash__(self):
+        return hash(self.key())
+
+    def __repr__(self):
+        dims = ", ".join(sym_render(d) if d is not None else "?"
+                         for d in self.dims)
+        return f"ArrayVal([{dims}], {self.dtype})"
+
+
+class StructVal:
+    """Abstract NamedTuple state: field name -> abstract value, ordered
+    by the class definition (so flattening matches the runtime walk)."""
+
+    __slots__ = ("cls_name", "field_order", "fields")
+
+    def __init__(self, cls_name: str, field_order, fields: Dict[str, Any]):
+        self.cls_name = cls_name
+        self.field_order = tuple(field_order)
+        self.fields = fields
+
+    def replace(self, updates: Dict[str, Any]) -> "StructVal":
+        out = dict(self.fields)
+        out.update(updates)
+        return StructVal(self.cls_name, self.field_order, out)
+
+    def __eq__(self, other):
+        return (isinstance(other, StructVal)
+                and self.cls_name == other.cls_name
+                and self.fields == other.fields)
+
+    def __hash__(self):
+        return hash(self.cls_name)
+
+    def __repr__(self):
+        return f"StructVal({self.cls_name})"
+
+
+class LambdaVal:
+    """A local ``lambda`` with its definition-time environment — the
+    ``z = lambda *s: jnp.zeros(s, jnp.int32)`` constructor idiom."""
+
+    __slots__ = ("node", "env")
+
+    def __init__(self, node: ast.Lambda, env: Env):
+        self.node = node
+        self.env = dict(env)
+
+
+class AtVal:
+    """``arr.at[...]`` chain marker: ``.set/.add/.max/...`` returns the
+    base array's shape unchanged."""
+
+    __slots__ = ("array",)
+
+    def __init__(self, array: ArrayVal):
+        self.array = array
+
+
+class ClassRef:
+    __slots__ = ("info",)
+
+    def __init__(self, info: "ClassInfo"):
+        self.info = info
+
+
+class FnRef:
+    __slots__ = ("fn",)
+
+    def __init__(self, fn: FunctionInfo):
+        self.fn = fn
+
+
+# --- config abstraction ---------------------------------------------------
+
+#: config attr -> shape symbol (the polynomial variables)
+SYMBOLS: Dict[str, str] = {
+    "n_nodes": "N",
+    "m_slots": "M",
+    "bcast_queue": "Q",
+    "n_origins": "O",
+    "buf_slots": "B",
+    "partial_slots": "P",
+    "tx_max_cells": "K",
+}
+#: derived properties that get their own symbol (bound from the live
+#: property value)
+PROPERTY_SYMBOLS: Dict[str, str] = {"n_cells": "C"}
+
+#: the lint gate's template extents: the FLAGSHIP scale config
+#: (``scale_sim_config(100_000)`` — ``tests/test_membudget.py``'s
+#: registry-sync meta-test pins these against the real dataclass, so
+#: they cannot drift silently)
+DEFAULT_EXTENTS: Dict[str, int] = {
+    "N": 100_000, "M": 64, "Q": 32, "O": 16, "B": 32, "P": 8, "K": 1,
+    "C": 64,
+}
+#: flagship structure flags (same meta-test pins them)
+DEFAULT_FLAGS: Dict[str, bool] = {
+    "narrow_dtypes": True,
+    "narrow_int8": False,
+    "any_writer": True,
+}
+
+#: The declared 1M budget (docs/memory-budget.md): per-complexity-class
+#: HBM bytes for one replica of the scale state at N=1M, M=64 under the
+#: flagship dtype set. Current audited footprint: 3648 B/node O(N·M),
+#: 53 B/node O(N) — the headroom (~52 B/node O(N·M)) is deliberately
+#: smaller than one int32 [N, M] plane (256 B/node), so landing a new
+#: full-width table without re-pricing the budget FAILS the gate.
+HBM_BUDGET: Dict[str, Any] = {
+    "root": "ScaleSimState",
+    "point": {"N": 1_000_000, "M": 64},
+    "per_class_bytes": {
+        "O(N*M)": 3_700_000_000,
+        "O(N)": 64_000_000,
+        "O(1)": 1_000_000,
+    },
+}
+
+
+class ConfigVal:
+    """Abstract sim config: extent attrs evaluate to their polynomial
+    symbols (with a concrete binding for branch decisions and budget
+    evaluation), bool fields to concrete :class:`BoolVal`, dtype
+    properties to the dtype the real property would pick."""
+
+    __slots__ = ("bindings", "flags", "extras", "sync_tracks_sym")
+
+    def __init__(self, bindings: Dict[str, int], flags: Dict[str, bool],
+                 extras: Optional[Dict[str, int]] = None,
+                 sync_tracks_sym: str = "M"):
+        self.bindings = dict(bindings)
+        self.flags = dict(flags)
+        self.extras = dict(extras or {})
+        self.sync_tracks_sym = sync_tracks_sym
+
+    @staticmethod
+    def default() -> "ConfigVal":
+        return ConfigVal(DEFAULT_EXTENTS, DEFAULT_FLAGS)
+
+    @staticmethod
+    def from_config(cfg) -> "ConfigVal":
+        """Bindings from a live dataclass config (obs/CLI projection
+        path). ``sync_tracks`` follows the class's own property: the
+        full-view sim tracks per peer id (N), the scale sim per member
+        slot (M)."""
+        bindings: Dict[str, int] = {}
+        extras: Dict[str, int] = {}
+        flags: Dict[str, bool] = {}
+        for field in dataclasses.fields(cfg):
+            v = getattr(cfg, field.name)
+            if isinstance(v, bool):
+                flags[field.name] = v
+            elif isinstance(v, int):
+                if field.name in SYMBOLS:
+                    bindings[SYMBOLS[field.name]] = v
+                else:
+                    extras[field.name] = v
+        for prop, symbol in PROPERTY_SYMBOLS.items():
+            if hasattr(cfg, prop):
+                bindings[symbol] = int(getattr(cfg, prop))
+        sync_sym = "N" if type(cfg).__name__ == "SimConfig" else "M"
+        flags.setdefault("narrow_dtypes", False)
+        flags.setdefault("narrow_int8", False)
+        return ConfigVal(bindings, flags, extras, sync_tracks_sym=sync_sym)
+
+    def has(self, name: str) -> bool:
+        return (name in SYMBOLS or name in PROPERTY_SYMBOLS
+                or name in self.flags or name in self.extras
+                or name in ("sync_tracks", "timer_dtype", "tx_dtype"))
+
+    def attr(self, name: str):
+        if name in SYMBOLS:
+            return Poly.var(SYMBOLS[name])
+        if name in PROPERTY_SYMBOLS:
+            return Poly.var(PROPERTY_SYMBOLS[name])
+        if name == "sync_tracks":
+            return Poly.var(self.sync_tracks_sym)
+        if name == "timer_dtype":
+            # mirrors ScaleConfig/ScaleSimConfig.timer_dtype
+            return DtypeVal(
+                "int16" if self.flags.get("narrow_dtypes") else "int32")
+        if name == "tx_dtype":
+            # mirrors ScaleConfig/ScaleSimConfig.tx_dtype (ISSUE 12
+            # int8 shrink): int8 budget planes under narrow_int8
+            if self.flags.get("narrow_int8"):
+                return DtypeVal("int8")
+            return self.attr("timer_dtype")
+        if name in self.flags:
+            return BoolVal(self.flags[name])
+        if name in self.extras:
+            return Poly.const(self.extras[name])
+        return None
+
+
+# --- class index ----------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    name: str
+    module: ModuleInfo
+    node: ast.ClassDef
+    fields: Tuple[str, ...]  # AnnAssign field order (NamedTuple schema)
+
+
+def _class_has_create(node: ast.ClassDef) -> bool:
+    return any(isinstance(b, (ast.FunctionDef, ast.AsyncFunctionDef))
+               and b.name == "create" for b in node.body)
+
+
+def index_classes(project: Project) -> Dict[str, ClassInfo]:
+    """Top-level classes with annotated fields, keyed by bare name. A
+    name defined in several modules keeps the first *state-like* one
+    (has a ``create`` — checked on the class body itself, NOT the
+    project-wide (class, method) table, which can't tell two same-named
+    classes apart) — precision over recall, same as call resolution."""
+    out: Dict[str, ClassInfo] = {}
+    for mod in project.modules:
+        for top in mod.tree.body:
+            if not isinstance(top, ast.ClassDef):
+                continue
+            fields = tuple(
+                t.target.id for t in top.body
+                if isinstance(t, ast.AnnAssign)
+                and isinstance(t.target, ast.Name)
+            )
+            if not fields:
+                continue
+            if top.name in out:
+                if (_class_has_create(out[top.name].node)
+                        or not _class_has_create(top)):
+                    continue
+            out[top.name] = ClassInfo(top.name, mod, top, fields)
+    return out
+
+
+# --- the interpreter ------------------------------------------------------
+
+_CREATION_FNS = {"zeros", "ones", "empty", "full"}
+_LIKE_FNS = {"zeros_like", "ones_like", "full_like", "empty_like"}
+_ELEMENTWISE_FNS = {
+    "where", "minimum", "maximum", "add", "multiply", "remainder", "mod",
+    "power", "clip", "floor_divide", "bitwise_and", "bitwise_or",
+    "bitwise_xor", "logical_and", "logical_or", "logical_not", "equal",
+    "not_equal", "abs", "negative", "sign", "astype",
+}
+_PASS_FIRST_FNS = {"clip", "abs", "negative", "sign", "sort", "flip",
+                   "roll", "cumsum", "asarray", "optimization_barrier",
+                   "stop_gradient"}
+_REDUCTION_FNS = {"sum", "prod", "max", "min", "any", "all", "mean",
+                  "argmax", "argmin", "count_nonzero"}
+_AT_METHODS = {"set", "add", "max", "min", "mul", "divide", "power",
+               "apply", "or_", "and_"}
+
+#: shape summaries for the dense-op helpers the step bodies lean on —
+#: a registry, not interpretation: their bodies are backend-conditional
+#: (``ops/dense.py``) and their SHAPES are contractual
+_HELPER_SHAPES = {
+    # (table, idx, ...) -> idx-shaped gather of table values
+    "select_cols": "gather",
+    "lookup_cols": "gather",
+    # (dest, idx, vals, valid) -> dest-shaped scatter
+    "scatter_cols_set": "dest",
+    "scatter_cols_max": "dest",
+    "scatter_cols_add": "dest",
+    "scatter_cols_or": "dest",
+    # (mask, k, key) -> ([N, k] int32 slots, [N, k] bool ok)
+    "sample_k": "sample_k",
+    # (mask, weight, k, key) -> same
+    "sample_k_biased": "sample_k_biased",
+    # (mask, key) -> ([N] int32, [N] bool)
+    "sample_one": "sample_one",
+    # (card, idx) -> idx.shape + card.shape[1:]
+    "card_at": "card_at",
+    # (a, b) -> broadcast int32
+    "pack_inc_state": "pack_int32",
+}
+
+
+class ShapeContext:
+    """Shared interpretation state: project, class index, bindings for
+    branch decisions, call stack, per-class inventory cache."""
+
+    def __init__(self, project: Project, config: ConfigVal,
+                 interprocedural: bool = True):
+        self.project = project
+        self.classes = index_classes(project)
+        self.config = config
+        self.interprocedural = interprocedural
+        self.stack: List[str] = []
+        self.struct_cache: Dict[str, Any] = {}
+
+    def bindings(self) -> Dict[str, int]:
+        return self.config.bindings
+
+
+class ShapeAnalysis(ForwardAnalysis):
+    """Forward shape interpretation of one function body."""
+
+    def __init__(self, ctx: ShapeContext, fn: Optional[FunctionInfo],
+                 path: str, findings: Optional[List[Finding]] = None,
+                 densify: bool = False, depth: int = 0):
+        super().__init__(fn, path, findings)
+        self.ctx = ctx
+        self.densify = densify
+        self.depth = depth
+
+    # -- joins -------------------------------------------------------------
+
+    def join(self, a, b):
+        if isinstance(a, ArrayVal) and isinstance(b, ArrayVal):
+            return a if a == b else None
+        if isinstance(a, StructVal) and isinstance(b, StructVal) and (
+                a.cls_name == b.cls_name):
+            fields = {
+                f: self.join(a.fields.get(f), b.fields.get(f))
+                for f in set(a.fields) | set(b.fields)
+            }
+            return StructVal(a.cls_name, a.field_order, fields)
+        if is_sym(a) and is_sym(b):
+            return a if sym_render(a) == sym_render(b) else None
+        return super().join(a, b)
+
+    # -- leaves ------------------------------------------------------------
+
+    def eval_constant(self, node, env):
+        if isinstance(node.value, bool):
+            return BoolVal(node.value)
+        if isinstance(node.value, int):
+            return Poly.const(node.value)
+        if isinstance(node.value, str):
+            return node.value
+        return None
+
+    def eval_expr(self, node, env):
+        if isinstance(node, ast.Name) and node.id not in env:
+            if node.id == "bool":
+                return DtypeVal("bool")
+            if node.id in self.ctx.classes:
+                return ClassRef(self.ctx.classes[node.id])
+            return None
+        if isinstance(node, ast.Compare):
+            return self._eval_compare(node, env)
+        if isinstance(node, ast.IfExp):
+            test = self.eval_expr(node.test, env)
+            if isinstance(test, BoolVal):
+                return self.eval_expr(
+                    node.body if test.value else node.orelse, env)
+            return self.join(self.eval_expr(node.body, env),
+                             self.eval_expr(node.orelse, env))
+        if isinstance(node, ast.UnaryOp):
+            v = self.eval_expr(node.operand, env)
+            if isinstance(node.op, ast.Not):
+                return BoolVal(not v.value) if isinstance(v, BoolVal) \
+                    else None
+            if isinstance(node.op, ast.USub):
+                if isinstance(v, Poly):
+                    return -v
+                if isinstance(v, SymOp):
+                    return SymOp("neg", (v,))
+                return v if isinstance(v, ArrayVal) else None
+            return v
+        if isinstance(node, ast.Lambda):
+            self.on_nested_def(node, env)
+            return LambdaVal(node, env)
+        return super().eval_expr(node, env)
+
+    def _eval_compare(self, node: ast.Compare, env):
+        vals = [self.eval_expr(node.left, env)] + [
+            self.eval_expr(c, env) for c in node.comparators
+        ]
+        arrays = [v for v in vals if isinstance(v, ArrayVal)]
+        if arrays:
+            out = self._broadcast(vals, "bool", node)
+            self._check_dense(node, out, vals)
+            return out
+        # concrete decision for config-extent guards (branch picking)
+        concrete = []
+        for v in vals:
+            if isinstance(v, BoolVal):
+                concrete.append(int(v.value))
+                continue
+            if not is_sym(v):
+                return None
+            ev = sym_eval(v, self.ctx.bindings())
+            if ev is None:
+                return None
+            concrete.append(ev)
+        ok = True
+        for op, a, b in zip(node.ops, concrete, concrete[1:]):
+            table = {
+                ast.Lt: a < b, ast.LtE: a <= b, ast.Gt: a > b,
+                ast.GtE: a >= b, ast.Eq: a == b, ast.NotEq: a != b,
+            }
+            res = table.get(type(op))
+            if res is None:
+                return None
+            ok = ok and res
+        return BoolVal(ok)
+
+    # -- attributes / subscripts -------------------------------------------
+
+    def eval_attr(self, node, base, env):
+        name = node.attr
+        if isinstance(base, ConfigVal):
+            return base.attr(name)
+        if isinstance(base, StructVal):
+            return base.fields.get(name)
+        if isinstance(base, ArrayVal):
+            if name == "at":
+                return AtVal(base)
+            if name == "shape":
+                return TupleVal(base.dims)
+            if name == "dtype":
+                return DtypeVal(base.dtype) if base.dtype else None
+            if name == "T":
+                return ArrayVal(tuple(reversed(base.dims)), base.dtype,
+                                base.site)
+            if name == "ndim":
+                return Poly.const(len(base.dims))
+            if name == "size":
+                out = Poly.const(1)
+                for d in base.dims:
+                    if d is None:
+                        return None
+                    out = sym_binop("mul", out, d)
+                return out
+            return None
+        if isinstance(base, ClassRef):
+            cands = self.ctx.project.methods.get((base.info.name, name), [])
+            own = [c for c in cands if c.module is base.info.module]
+            if len(own) == 1:
+                return FnRef(own[0])
+            return FnRef(cands[0]) if len(cands) == 1 else None
+        # dtype literal spellings (jnp.int32, np.uint8, ...)
+        dotted = dotted_name(node)
+        if "." in dotted:
+            head, leaf = dotted.rsplit(".", 1)
+            canon = "bool" if leaf == "bool_" else leaf
+            if head in _DTYPE_BASES and canon in _DTYPE_SIZES:
+                return DtypeVal(canon)
+        return None
+
+    def eval_subscript(self, node, base, env):
+        if isinstance(base, AtVal):
+            return base  # .at[ix] keeps the base shape for the updater
+        if isinstance(base, ArrayVal):
+            return self._index(node, base, env)
+        return super().eval_subscript(node, base, env)
+
+    def _index(self, node: ast.Subscript, base: ArrayVal, env):
+        elts = (list(node.slice.elts)
+                if isinstance(node.slice, ast.Tuple) else [node.slice])
+        out_dims: List[Any] = []
+        adv: List[ArrayVal] = []
+        adv_pos: Optional[int] = None
+        dim_i = 0
+        for elt in elts:
+            if isinstance(elt, ast.Slice):
+                if dim_i >= len(base.dims):
+                    return None
+                out_dims.append(self._slice_dim(elt, base.dims[dim_i], env))
+                dim_i += 1
+                continue
+            if isinstance(elt, ast.Constant) and elt.value is None:
+                out_dims.append(Poly.const(1))  # newaxis
+                continue
+            v = self.eval_expr(elt, env)
+            if dim_i >= len(base.dims):
+                return None
+            if isinstance(v, ArrayVal):
+                if v.dims == ():
+                    dim_i += 1  # scalar-array index drops the dim
+                    continue
+                if adv_pos is None:
+                    adv_pos = len(out_dims)
+                adv.append(v)
+                dim_i += 1
+                continue
+            if is_sym(v) or isinstance(elt, ast.Constant):
+                dim_i += 1  # integer index drops the dim
+                continue
+            return None  # unknown index form
+        out_dims.extend(base.dims[dim_i:])
+        if adv:
+            bc = self._broadcast_dims([a.dims for a in adv])
+            if bc is None:
+                return None
+            out_dims[adv_pos:adv_pos] = list(bc)
+        out = ArrayVal(tuple(out_dims), base.dtype, base.site)
+        self._check_dense(node, out, [base] + adv)
+        return out
+
+    def _slice_dim(self, s: ast.Slice, dim, env):
+        if s.step is not None:
+            return None
+        lo = self.eval_expr(s.lower, env) if s.lower is not None else None
+        hi = self.eval_expr(s.upper, env) if s.upper is not None else None
+        if s.lower is None and s.upper is None:
+            return dim
+        if s.lower is None and is_sym(hi):
+            return hi  # [:k] — k elements (k <= dim by contract)
+        if s.upper is None and is_sym(lo) and dim is not None:
+            return sym_binop("sub", dim, lo)
+        if is_sym(lo) and is_sym(hi):
+            return sym_binop("sub", hi, lo)
+        return None
+
+    # -- operators ---------------------------------------------------------
+
+    def eval_binop(self, node, left, right, env):
+        if isinstance(left, TupleVal) and isinstance(right, TupleVal) \
+                and isinstance(getattr(node, "op", None), ast.Add):
+            return TupleVal(left.elements + right.elements)
+        if isinstance(left, ArrayVal) or isinstance(right, ArrayVal):
+            out = self._broadcast([left, right], None, node)
+            self._check_dense(node, out, [left, right])
+            return out
+        if is_sym(left) and is_sym(right):
+            op = {
+                ast.Add: "add", ast.Sub: "sub", ast.Mult: "mul",
+                ast.FloorDiv: "floordiv", ast.Mod: "mod",
+            }.get(type(getattr(node, "op", None)))
+            if op is None:
+                return None
+            return sym_binop(op, left, right)
+        return None
+
+    def _broadcast_dims(self, dim_lists):
+        """Right-aligned numpy broadcast over symbolic dims; ``None``
+        on an unknown or provably mismatched pairing."""
+        rank = max(len(d) for d in dim_lists)
+        out = []
+        for i in range(rank):
+            cur = None
+            for dims in dim_lists:
+                j = i - (rank - len(dims))
+                if j < 0:
+                    continue
+                d = dims[j]
+                if d is None:
+                    return None
+                if isinstance(d, Poly) and d.is_const() and (
+                        d.evaluate({}) == 1):
+                    continue
+                if cur is None:
+                    cur = d
+                elif sym_render(cur) != sym_render(d):
+                    return None  # can't prove compatible
+            out.append(cur if cur is not None else Poly.const(1))
+        return tuple(out)
+
+    def _broadcast(self, vals, dtype: Optional[str], node) -> Optional[
+            ArrayVal]:
+        arrays = [v for v in vals if isinstance(v, ArrayVal)]
+        if not arrays or any(not a.known() for a in arrays):
+            return None
+        if any(not (isinstance(v, (ArrayVal, BoolVal, DtypeVal))
+                    or is_sym(v) or v is None) for v in vals):
+            return None
+        dims = self._broadcast_dims([a.dims for a in arrays])
+        if dims is None:
+            return None
+        if dtype is None:
+            dtypes = {a.dtype for a in arrays}
+            dtype = dtypes.pop() if len(dtypes) == 1 else None
+        site = arrays[0].site
+        return ArrayVal(dims, dtype, site)
+
+    # -- calls -------------------------------------------------------------
+
+    def eval_call(self, node, env, args, keywords):
+        name = dotted_name(node.func)
+        last = name.rsplit(".", 1)[-1]
+
+        # method-style calls: evaluate the receiver ourselves (the base
+        # engine does not evaluate node.func)
+        if isinstance(node.func, ast.Attribute):
+            base = self.eval_expr(node.func.value, env)
+            attr = node.func.attr
+            if isinstance(base, AtVal) and attr in _AT_METHODS:
+                return base.array
+            if isinstance(base, StructVal) and attr == "_replace":
+                updates = {
+                    kw.arg: keywords.get(kw.arg)
+                    for kw in node.keywords if kw.arg is not None
+                }
+                return base.replace(updates)
+            if isinstance(base, ArrayVal):
+                return self._array_method(node, base, attr, args,
+                                          keywords, env)
+            if isinstance(base, FnRef):
+                return self._call_fn(base.fn, node, args, keywords)
+            if isinstance(base, ClassRef):
+                fn = self.eval_attr(node.func, base, env)
+                if isinstance(fn, FnRef):
+                    return self._call_fn(fn.fn, node, args, keywords)
+                return None
+
+        # local lambda / class constructor / resolvable function
+        if isinstance(node.func, ast.Name):
+            fv = env.get(node.func.id)
+            if isinstance(fv, LambdaVal):
+                return self._call_lambda(fv, args, keywords)
+            if isinstance(fv, ClassRef):
+                return self._construct(fv.info, node, args, keywords)
+            if node.func.id in self.ctx.classes and (
+                    self.fn is None
+                    or node.func.id not in self.fn.local_names()):
+                return self._construct(self.ctx.classes[node.func.id],
+                                       node, args, keywords)
+
+        # builtins
+        if name == "getattr" and len(node.args) >= 2:
+            if isinstance(args[0], ConfigVal) and isinstance(args[1], str):
+                if args[0].has(args[1]):
+                    return args[0].attr(args[1])
+                return args[2] if len(args) > 2 else None
+            return None
+        if name in ("max", "min") and len(args) >= 2:
+            if all(is_sym(a) or isinstance(a, int) for a in args):
+                return SymOp(name, args)
+            return None
+        if name == "int" and args:
+            return args[0] if is_sym(args[0]) else None
+        if name == "len":
+            if isinstance(args[0], TupleVal):
+                return Poly.const(len(args[0].elements))
+            return None
+
+        # jnp surface
+        out = self._jnp_call(node, name, last, args, keywords, env)
+        if out is not None:
+            return out
+
+        # registered helper shapes (ops/dense, ops/select, transport)
+        helper = _HELPER_SHAPES.get(last)
+        if helper is not None:
+            return self._helper_call(node, helper, args)
+
+        # resolvable project call (budget mode: constructors + helpers)
+        if self.ctx.interprocedural and self.fn is not None:
+            fn = self.ctx.project.resolve_call(node, self.fn)
+            if fn is not None:
+                return self._call_fn(fn, node, args, keywords)
+        return None
+
+    def _array_method(self, node, base: ArrayVal, attr, args, keywords,
+                      env):
+        if attr == "astype":
+            dt = self._as_dtype(
+                args[0] if args else keywords.get("dtype"),
+                node.args[0] if node.args else None)
+            return ArrayVal(base.dims, dt, base.site)
+        if attr == "reshape":
+            shape = (args[0] if len(args) == 1 else TupleVal(args))
+            dims = self._as_dims(shape)
+            if dims is None:
+                return None
+            out = ArrayVal(dims, base.dtype, base.site)
+            self._check_dense(node, out, [base])
+            return out
+        if attr in _REDUCTION_FNS:
+            return self._reduce(base, node, args, keywords)
+        if attr in ("copy", "block_until_ready"):
+            return base
+        return None
+
+    def _reduce(self, base: ArrayVal, node, args, keywords):
+        axis_node = next((kw.value for kw in node.keywords
+                          if kw.arg == "axis"), None)
+        if axis_node is None and len(node.args) >= 2:
+            axis_node = node.args[1]
+        if axis_node is None:
+            return ArrayVal((), base.dtype, base.site)
+        if isinstance(axis_node, ast.Constant) and isinstance(
+                axis_node.value, int):
+            ax = axis_node.value
+            if -len(base.dims) <= ax < len(base.dims):
+                dims = list(base.dims)
+                del dims[ax]
+                return ArrayVal(tuple(dims), base.dtype, base.site)
+        return None
+
+    def _as_dtype(self, val, node) -> Optional[str]:
+        if isinstance(val, DtypeVal):
+            return val.name
+        if isinstance(val, str):
+            return val if val in _DTYPE_SIZES else None
+        if node is not None:
+            leaf = dotted_name(node).rsplit(".", 1)[-1]
+            leaf = "bool" if leaf == "bool_" else leaf
+            if leaf in _DTYPE_SIZES:
+                return leaf
+        return None
+
+    def _as_dims(self, shape_val) -> Optional[Tuple]:
+        if is_sym(shape_val):
+            return (shape_val,)
+        if isinstance(shape_val, TupleVal):
+            dims = []
+            for e in shape_val.elements:
+                if not is_sym(e):
+                    return None
+                dims.append(e)
+            return tuple(dims)
+        return None
+
+    def _jnp_call(self, node, name, last, args, keywords, env):
+        site = (self.path, node.lineno)
+        kw_nodes = {kw.arg: kw.value for kw in node.keywords
+                    if kw.arg is not None}
+
+        def dtype_at(pos: int) -> Optional[str]:
+            if "dtype" in keywords or "dtype" in kw_nodes:
+                return self._as_dtype(keywords.get("dtype"),
+                                      kw_nodes.get("dtype"))
+            if len(args) > pos:
+                return self._as_dtype(
+                    args[pos],
+                    node.args[pos] if len(node.args) > pos else None)
+            return None
+
+        if last in _CREATION_FNS:
+            dims = self._as_dims(args[0]) if args else None
+            if dims is None:
+                return None
+            pos = 2 if last == "full" else 1
+            dt = dtype_at(pos)
+            if dt is None and last != "full":
+                dt = "float32"  # jnp default
+            out = ArrayVal(dims, dt, site)
+            self._check_dense(node, out, [])
+            return out
+        if last in _LIKE_FNS and args and isinstance(args[0], ArrayVal):
+            dt = dtype_at(99) or args[0].dtype
+            return ArrayVal(args[0].dims, dt, site)
+        if last == "arange":
+            dt = dtype_at(99)
+            if len(node.args) == 1 and is_sym(args[0]):
+                return ArrayVal((args[0],), dt or "int32", site)
+            if len(node.args) >= 2 and is_sym(args[0]) and is_sym(args[1]):
+                return ArrayVal((sym_binop("sub", args[1], args[0]),),
+                                dt or "int32", site)
+            return None
+        if last == "eye" and args and is_sym(args[0]):
+            out = ArrayVal((args[0], args[0]), dtype_at(99) or "float32",
+                           site)
+            self._check_dense(node, out, [])
+            return out
+        if last == "broadcast_to" and len(args) >= 2:
+            dims = self._as_dims(args[1])
+            if dims is None:
+                return None
+            out = ArrayVal(
+                dims,
+                args[0].dtype if isinstance(args[0], ArrayVal) else None,
+                site)
+            self._check_dense(
+                node, out,
+                [args[0]] if isinstance(args[0], ArrayVal) else [])
+            return out
+        if last == "reshape" and len(args) >= 2 and isinstance(
+                args[0], ArrayVal):
+            dims = self._as_dims(args[1])
+            if dims is None:
+                return None
+            return ArrayVal(dims, args[0].dtype, site)
+        if last == "concatenate" and node.args:
+            return self._concat(node, args, keywords, env, stack=False)
+        if last == "stack" and node.args:
+            return self._concat(node, args, keywords, env, stack=True)
+        if last in _ELEMENTWISE_FNS:
+            arrays = [a for a in args if isinstance(a, ArrayVal)]
+            if not arrays:
+                return None
+            out = self._broadcast(args, None, node)
+            self._check_dense(node, out, args)
+            return out
+        if last in _PASS_FIRST_FNS and args and isinstance(
+                args[0], ArrayVal):
+            return args[0]
+        if last in _REDUCTION_FNS and args and isinstance(
+                args[0], ArrayVal):
+            return self._reduce(args[0], node, args, keywords)
+        if last in ("randint", "uniform", "normal", "bernoulli") and (
+                len(node.args) >= 2):
+            dims = self._as_dims(args[1])
+            if dims is None:
+                return None
+            dt = dtype_at(99) or (
+                "float32" if last in ("uniform", "normal") else None)
+            out = ArrayVal(dims, dt, site)
+            self._check_dense(node, out, [])
+            return out
+        # jnp.int32(x)-style scalar casts
+        canon = "bool" if last == "bool_" else last
+        if canon in _DTYPE_SIZES and "." in name and (
+                name.rsplit(".", 1)[0] in _DTYPE_BASES):
+            if args and isinstance(args[0], ArrayVal):
+                return ArrayVal(args[0].dims, canon, site)
+            return ArrayVal((), canon, site)
+        return None
+
+    def _concat(self, node, args, keywords, env, stack: bool):
+        if not isinstance(node.args[0], (ast.List, ast.Tuple)):
+            return None
+        parts = [self.eval_expr(e, env) for e in node.args[0].elts]
+        if not parts or any(not isinstance(p, ArrayVal) or not p.known()
+                            for p in parts):
+            return None
+        axis = 0
+        ax_node = next((kw.value for kw in node.keywords
+                        if kw.arg == "axis"), None)
+        if ax_node is not None:
+            if not (isinstance(ax_node, ast.Constant)
+                    and isinstance(ax_node.value, int)):
+                return None
+            axis = ax_node.value
+        dtypes = {p.dtype for p in parts}
+        dt = dtypes.pop() if len(dtypes) == 1 else None
+        site = parts[0].site
+        if stack:
+            dims = list(parts[0].dims)
+            if any(p.dims != parts[0].dims for p in parts):
+                return None
+            if not -len(dims) - 1 <= axis <= len(dims):
+                return None
+            if axis < 0:
+                axis += len(dims) + 1
+            dims.insert(axis, Poly.const(len(parts)))
+            return ArrayVal(tuple(dims), dt, site)
+        rank = len(parts[0].dims)
+        if any(len(p.dims) != rank for p in parts) or not (
+                -rank <= axis < rank):
+            return None
+        axis %= rank
+        total = parts[0].dims[axis]
+        for p in parts[1:]:
+            total = sym_binop("add", total, p.dims[axis])
+        dims = list(parts[0].dims)
+        dims[axis] = total
+        return ArrayVal(tuple(dims), dt, site)
+
+    def _helper_call(self, node, kind: str, args):
+        def arr(i):
+            return args[i] if (len(args) > i
+                               and isinstance(args[i], ArrayVal)
+                               and args[i].known()) else None
+
+        if kind == "gather":
+            table, idx = arr(0), arr(1)
+            if table is None or idx is None:
+                return None
+            return ArrayVal(idx.dims, table.dtype, idx.site)
+        if kind == "dest":
+            return arr(0)
+        if kind in ("sample_k", "sample_k_biased"):
+            mask = arr(0)
+            k = args[2] if kind == "sample_k_biased" else (
+                args[1] if len(args) > 1 else None)
+            if mask is None or not is_sym(k) or not mask.dims:
+                return None
+            lead = mask.dims[0]
+            return TupleVal((ArrayVal((lead, k), "int32", mask.site),
+                             ArrayVal((lead, k), "bool", mask.site)))
+        if kind == "sample_one":
+            mask = arr(0)
+            if mask is None or not mask.dims:
+                return None
+            lead = mask.dims[0]
+            return TupleVal((ArrayVal((lead,), "int32", mask.site),
+                             ArrayVal((lead,), "bool", mask.site)))
+        if kind == "card_at":
+            card, idx = arr(0), arr(1)
+            if card is None or idx is None or len(card.dims) < 2:
+                return None
+            return ArrayVal(idx.dims + card.dims[1:], card.dtype,
+                            idx.site)
+        if kind == "pack_int32":
+            out = self._broadcast(args, "int32", node)
+            self._check_dense(node, out, args)
+            return out
+        return None
+
+    # -- interprocedural ---------------------------------------------------
+
+    def _call_lambda(self, lv: LambdaVal, args, keywords):
+        a = lv.node.args
+        env = dict(lv.env)
+        params = [p.arg for p in a.posonlyargs + a.args]
+        for pname, val in zip(params, args):
+            env[pname] = val
+        defaults = a.defaults
+        for pname, d in zip(params[len(params) - len(defaults):],
+                            defaults):
+            env.setdefault(pname, self.eval_expr(d, dict(lv.env)))
+        if a.vararg is not None:
+            env[a.vararg.arg] = TupleVal(args[len(params):])
+        env.update(keywords)
+        # a lambda body is textually inside the caller, so the densify
+        # patrol follows the call in — `z = lambda *s: jnp.zeros(s, ..)`
+        # building an [N, N] must flag exactly like the direct form
+        sub = ShapeAnalysis(self.ctx, self.fn, self.path, self.findings,
+                            densify=self.densify, depth=self.depth + 1)
+        return sub.eval_expr(lv.node.body, env)
+
+    def _call_fn(self, fn: FunctionInfo, node, args, keywords):
+        if self.depth >= 12 or fn.qualname in self.ctx.stack:
+            return None
+        a = fn.node.args
+        params = [p.arg for p in a.posonlyargs + a.args]
+        env: Env = {}
+        for pname, val in zip(params, args):
+            env[pname] = val
+        defaults = a.defaults
+        for pname, d in zip(params[len(params) - len(defaults):],
+                            defaults):
+            if pname not in env:
+                sub0 = ShapeAnalysis(self.ctx, fn, fn.path, self.findings,
+                                     depth=self.depth + 1)
+                env[pname] = sub0.eval_expr(d, {})
+        for kw in a.kwonlyargs:
+            env.setdefault(kw.arg, None)
+        for pname, val in keywords.items():
+            if pname in params or any(k.arg == pname
+                                      for k in a.kwonlyargs):
+                env[pname] = val
+        self.ctx.stack.append(fn.qualname)
+        try:
+            sub = ShapeAnalysis(self.ctx, fn, fn.path, self.findings,
+                                densify=False, depth=self.depth + 1)
+            sub.run(list(fn.node.body), env)
+            return sub.return_value
+        finally:
+            self.ctx.stack.pop()
+
+    def _construct(self, info: ClassInfo, node, args, keywords):
+        fields: Dict[str, Any] = {}
+        for fname, val in zip(info.fields, args):
+            fields[fname] = val
+        for kw in node.keywords:
+            if kw.arg is not None and kw.arg in info.fields:
+                fields[kw.arg] = keywords.get(kw.arg)
+        return StructVal(info.name, info.fields, fields)
+
+    # -- concrete statements -----------------------------------------------
+
+    def _stmt(self, stmt, env):
+        # config-extent guards decide concretely: `if cfg.tx_max_cells
+        # > 1:` runs ONE branch, matching the real constructor (a join
+        # of both would lose the partial-buffer shapes)
+        if isinstance(stmt, ast.If):
+            test = self.eval_expr(stmt.test, env)
+            if isinstance(test, BoolVal):
+                return self.run(stmt.body if test.value else stmt.orelse,
+                                env)
+        return super()._stmt(stmt, env)
+
+    # -- densify -----------------------------------------------------------
+
+    def _n_degree(self, arr: ArrayVal) -> Optional[int]:
+        if not arr.known():
+            return None
+        return sum(d.degree("N") for d in arr.dims)
+
+    def _check_dense(self, node, out, inputs) -> None:
+        """Flag a provably-superlinear intermediate: the output's
+        N-degree is >= 2 and exceeds every input array's. Config
+        extents (M, Q, ...) are bounded constants — only N scales with
+        the cluster, so only N-degree growth densifies."""
+        if not self.densify or not isinstance(out, ArrayVal):
+            return
+        out_deg = self._n_degree(out)
+        if out_deg is None or out_deg < 2:
+            return
+        in_degs = []
+        for v in inputs:
+            if isinstance(v, ArrayVal):
+                d = self._n_degree(v)
+                if d is None:
+                    return  # unknown operand: cannot prove growth
+                in_degs.append(d)
+            elif not (is_sym(v) or isinstance(v, (BoolVal, DtypeVal))
+                      or v is None):
+                return
+        if in_degs and max(in_degs) >= out_deg:
+            return
+        shape = "[" + ", ".join(sym_render(d) for d in out.dims) + "]"
+        self.findings.append(Finding(
+            path=self.path, line=node.lineno, rule=DENSIFY_RULE,
+            message=f"trace-time intermediate of shape {shape} is "
+                    f"O(N^{out_deg}) but every input is "
+                    f"O(N^{max(in_degs, default=0)}) — fits at 100k, "
+                    "OOMs at the 1M point (docs/memory-budget.md)",
+            hint="restructure as gathers/scatters over [N, const] "
+                 "tables, or suppress with a reason if the dense form "
+                 "is deliberate",
+        ))
+
+
+# --- inventory ------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class LeafShape:
+    name: str
+    dims: Optional[Tuple]  # symbolic dims, None = unresolved
+    dtype: Optional[str]
+    path: str = ""
+    line: int = 0
+
+    def shape_str(self) -> str:
+        if self.dims is None:
+            return "?"
+        return "[" + ", ".join(sym_render(d) for d in self.dims) + "]"
+
+    def nbytes(self, bindings: Dict[str, int]) -> Optional[int]:
+        if self.dims is None or self.dtype not in _DTYPE_SIZES:
+            return None
+        total = _DTYPE_SIZES[self.dtype]
+        for d in self.dims:
+            ev = sym_eval(d, bindings)
+            if ev is None:
+                return None
+            total *= ev
+        return total
+
+    def shape_at(self, bindings: Dict[str, int]) -> Optional[Tuple[int,
+                                                                   ...]]:
+        if self.dims is None:
+            return None
+        out = []
+        for d in self.dims:
+            ev = sym_eval(d, bindings)
+            if ev is None:
+                return None
+            out.append(int(ev))
+        return tuple(out)
+
+
+@dataclasses.dataclass
+class Inventory:
+    root: str
+    leaves: Dict[str, LeafShape]
+    bindings: Dict[str, int]
+    flags: Dict[str, bool]
+
+    def report(self, overrides: Optional[Dict[str, int]] = None) -> dict:
+        """Static projection in the runtime audit's schema
+        (``obs.memory.memory_report``): evaluate every symbolic leaf at
+        the (possibly overridden) bindings and classify with the SHARED
+        ``classify_leaf``. ``overrides`` rebinds symbols (``{"N":
+        1_000_000}``) — the other extents keep their config values."""
+        bindings = dict(self.bindings)
+        bindings.update(overrides or {})
+        n_nodes = bindings.get("N")
+        tables: Dict[str, dict] = {}
+        by_class: Dict[str, int] = {}
+        total = 0
+        unresolved = []
+        for name, leaf in self.leaves.items():
+            shape = leaf.shape_at(bindings)
+            nbytes = leaf.nbytes(bindings)
+            if shape is None or nbytes is None:
+                unresolved.append(name)
+                continue
+            cls = classify_leaf(shape, n_nodes)
+            entry = {
+                "shape": list(shape),
+                "dtype": leaf.dtype,
+                "nbytes": nbytes,
+                "class": cls,
+                "symbolic": leaf.shape_str(),
+            }
+            if cls != "O(1)" and n_nodes:
+                entry["per_node_bytes"] = nbytes // n_nodes
+            tables[name] = entry
+            by_class[cls] = by_class.get(cls, 0) + nbytes
+            total += nbytes
+        return {
+            "total_bytes": total,
+            "n_nodes": n_nodes,
+            "tables": tables,
+            "by_class": by_class,
+            "unresolved": unresolved,
+            "source": "static",
+            "root": self.root,
+        }
+
+
+def _flatten(val, prefix: str, out: Dict[str, LeafShape]) -> None:
+    if isinstance(val, StructVal):
+        for f in val.field_order:
+            _flatten(val.fields.get(f), f"{prefix}.{f}" if prefix else f,
+                     out)
+        return
+    if isinstance(val, TupleVal):
+        for i, v in enumerate(val.elements):
+            _flatten(v, f"{prefix}[{i}]", out)
+        return
+    name = prefix or "<leaf>"
+    if isinstance(val, ArrayVal) and val.known():
+        path, line = val.site or ("", 0)
+        out[name] = LeafShape(name, val.dims, val.dtype, path, line)
+    else:
+        out[name] = LeafShape(name, None, None)
+
+
+def build_inventory(project: Project, root: str,
+                    config: Optional[ConfigVal] = None) -> Optional[
+                        Inventory]:
+    """Interpret ``<root>.create(cfg)`` symbolically over the project's
+    own ASTs. Returns None when the root class (or its ``create``) is
+    not in the walked set."""
+    config = config or ConfigVal.default()
+    ctx = ShapeContext(project, config)
+    info = ctx.classes.get(root)
+    if info is None:
+        return None
+    creates = [c for c in project.methods.get((root, "create"), [])
+               if c.module is info.module]
+    if not creates:
+        return None
+    fn = creates[0]
+    driver = ShapeAnalysis(ctx, fn, fn.path)
+    result = driver._call_fn(fn, fn.node, [config], {})
+    leaves: Dict[str, LeafShape] = {}
+    _flatten(result, "", leaves)
+    if not isinstance(result, StructVal):
+        leaves = {"<root>": LeafShape("<root>", None, None)}
+    return Inventory(root, leaves, dict(config.bindings),
+                     dict(config.flags))
+
+
+# --- the repo-facing entry points ----------------------------------------
+
+#: the sim/ops files whose ASTs define the state schema — the obs/CLI
+#: projection path parses exactly these (the lint gate instead uses the
+#: walked set, so a PR's modified source is what gets priced)
+STATE_FILES = (
+    "sim/scale.py", "sim/scale_step.py", "sim/broadcast.py",
+    "sim/step.py", "sim/swim.py", "sim/transport.py",
+    "ops/versions.py", "ops/partials.py",
+)
+
+#: mode -> state root class (mirrors ``obs.memory.mem_report_cli``)
+ROOTS = {"scale": "ScaleSimState", "full": "SimState"}
+
+
+def state_project() -> Project:
+    """Parse the installed package's state-schema files into a Project
+    (no jax import, no bytecode execution)."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    modules = []
+    for rel in STATE_FILES:
+        path = os.path.join(pkg, rel)
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+        modules.append(ModuleInfo(
+            path=path, name=module_name_for(path), tree=ast.parse(source),
+            source=source, suppressions={}, bad_suppressions=[],
+        ))
+    return Project(modules)
+
+
+def static_inventory(cfg=None, mode: str = "scale") -> Inventory:
+    """The static inventory for a live config instance (or the flagship
+    defaults): the ``obs/memory.py`` projection hook and the
+    ``mem-report --project`` backend."""
+    config = ConfigVal.from_config(cfg) if cfg is not None else (
+        ConfigVal.default())
+    inv = build_inventory(state_project(), ROOTS[mode], config)
+    if inv is None:
+        raise RuntimeError(
+            f"state root {ROOTS[mode]!r} not found in {STATE_FILES}")
+    return inv
+
+
+# --- the two rules --------------------------------------------------------
+
+
+def check_budget(project: Project) -> List[Finding]:
+    """``mem-budget``: price the walked tree's own state constructors at
+    the declared 1M point and fail when a complexity class exceeds its
+    budget (or when a leaf's static shape cannot be resolved — an
+    unpriceable table is a gate hole, not a pass)."""
+    findings: List[Finding] = []
+    root = HBM_BUDGET["root"]
+    ctx_classes = index_classes(project)
+    info = ctx_classes.get(root)
+    if info is None:
+        return findings  # walked subset does not define the state
+    inv = build_inventory(project, root, ConfigVal.default())
+    if inv is None:
+        return findings
+    overrides = dict(HBM_BUDGET["point"])
+    report = inv.report(overrides)
+    for name in report["unresolved"]:
+        findings.append(Finding(
+            path=info.module.path, line=info.node.lineno,
+            rule=BUDGET_RULE,
+            message=f"state leaf `{name}` of {root} has no statically "
+                    "resolvable shape — the 1M budget cannot price it",
+            hint="keep constructor shapes as config-extent expressions "
+                 "the interpreter covers (analysis/shapes.py)",
+        ))
+    budgets = HBM_BUDGET["per_class_bytes"]
+    for cls, budget in budgets.items():
+        used = report["by_class"].get(cls, 0)
+        if used <= budget:
+            continue
+        offenders = sorted(
+            ((n, e) for n, e in report["tables"].items()
+             if e["class"] == cls),
+            key=lambda kv: -kv[1]["nbytes"])
+        worst_name, worst = offenders[0]
+        leaf = inv.leaves[worst_name]
+        path = leaf.path or info.module.path
+        line = leaf.line or info.node.lineno
+        top = ", ".join(
+            f"{n}={e['nbytes'] / 1e6:.0f}MB" for n, e in offenders[:3])
+        findings.append(Finding(
+            path=path, line=line, rule=BUDGET_RULE,
+            message=f"{cls} state footprint at N="
+                    f"{overrides['N']:,} is {used / 1e9:.3f} GB, over "
+                    f"the declared {budget / 1e9:.3f} GB budget "
+                    f"(worst: {top})",
+            hint="shrink a table (docs/memory-budget.md) or re-price "
+                 "HBM_BUDGET with the PR that justifies the growth",
+        ))
+    unknown = set(report["by_class"]) - set(budgets)
+    for cls in sorted(unknown):
+        findings.append(Finding(
+            path=info.module.path, line=info.node.lineno,
+            rule=BUDGET_RULE,
+            message=f"complexity class {cls} has no declared budget "
+                    f"(used {report['by_class'][cls] / 1e9:.3f} GB at "
+                    "the 1M point)",
+            hint="add the class to HBM_BUDGET per_class_bytes",
+        ))
+    return findings
+
+
+#: full-view modules where O(N^2) planes are the DESIGN (sim/swim.py's
+#: [N, N] view; sim/step.py drives it) — densify only patrols the
+#: scale-capable surfaces
+_DENSIFY_EXCLUDE = ("/sim/step.py", "/sim/swim.py")
+
+
+def densify_in_scope(path: str) -> bool:
+    p = os.path.abspath(path)
+    if not os.path.exists(p):
+        return True  # fixture / bare source blob
+    norm = p.replace("\\", "/")
+    if any(norm.endswith(x) for x in _DENSIFY_EXCLUDE):
+        return False
+    return "/sim/" in norm or "/ops/" in norm
+
+
+#: annotation name -> treat the parameter as a config
+_CONFIG_ANNOTATIONS = ("Config",)
+
+
+def _seed_param(ctx: ShapeContext, name: str, annotation: Optional[str],
+                findings: List[Finding]):
+    """Abstract value for a function parameter in densify mode: configs
+    become :class:`ConfigVal`, annotated state types get their create-
+    derived StructVal, extent-named ints their symbol."""
+    if name == "cfg" or (annotation or "").endswith(_CONFIG_ANNOTATIONS):
+        return ctx.config
+    if annotation and annotation in ctx.classes:
+        cached = ctx.struct_cache.get(annotation)
+        if annotation not in ctx.struct_cache:
+            cached = _class_struct(ctx, annotation, findings)
+            ctx.struct_cache[annotation] = cached
+        return cached
+    if name in SYMBOLS:
+        return Poly.var(SYMBOLS[name])
+    return None
+
+
+def _class_struct(ctx: ShapeContext, cls_name: str,
+                  findings: List[Finding]):
+    info = ctx.classes.get(cls_name)
+    creates = [c for c in ctx.project.methods.get((cls_name, "create"), [])
+               if info is not None and c.module is info.module]
+    if not creates:
+        return None
+    fn = creates[0]
+    a = fn.node.args
+    params = [p.arg for p in a.posonlyargs + a.args]
+    args = []
+    for pname in params:
+        if pname == "cfg":
+            args.append(ctx.config)
+        elif pname in SYMBOLS:
+            args.append(Poly.var(SYMBOLS[pname]))
+        else:
+            args.append(None)
+    driver = ShapeAnalysis(ctx, fn, fn.path, findings)
+    return driver._call_fn(fn, fn.node, args, {})
+
+
+def check_densify(project: Project) -> List[Finding]:
+    """``densify``: walk every scale-path function with shape-seeded
+    parameters and flag provably-superlinear intermediates."""
+    findings: List[Finding] = []
+    ctx = ShapeContext(project, ConfigVal.default(),
+                       interprocedural=False)
+    for fn in project.iter_functions():
+        if not densify_in_scope(fn.path):
+            continue
+        a = fn.node.args
+        env: Env = {}
+        for p in a.posonlyargs + a.args + a.kwonlyargs:
+            ann = ""
+            if p.annotation is not None:
+                ann = dotted_name(p.annotation).rsplit(".", 1)[-1] or (
+                    p.annotation.value
+                    if isinstance(p.annotation, ast.Constant)
+                    and isinstance(p.annotation.value, str) else "")
+            env[p.arg] = _seed_param(ctx, p.arg, ann or None, [])
+        analysis = ShapeAnalysis(ctx, fn, fn.path, findings,
+                                 densify=True)
+        analysis.run(list(fn.node.body), env)
+    return findings

@@ -62,16 +62,29 @@ class CheckpointIntegrityError(ValueError):
 #: written before the key existed keep restoring.
 EXECUTION_ONLY_CONFIG_KEYS = ("fused",)
 
+#: semantic config keys added AFTER checkpoints already existed in the
+#: wild, with the default the older code behaved as: a manifest written
+#: before the key existed normalizes to this value, so pre-key
+#: checkpoints keep restoring under the (identical-avals) default while
+#: a NON-default setting still refuses them loudly. ``narrow_int8``
+#: (ISSUE 12) changes the ``mem_tx`` aval when on, so unlike ``fused``
+#: it cannot be execution-only.
+COMPAT_DEFAULT_CONFIG_KEYS = {"narrow_int8": False}
+
 
 def config_identity(cfg_or_dict) -> dict:
     """The portion of a sim config that checkpoint compatibility is
     judged on: the ``dataclasses.asdict`` dict minus
-    :data:`EXECUTION_ONLY_CONFIG_KEYS`. Accepts a config dataclass or
-    an already-serialized manifest ``sim_config`` dict."""
+    :data:`EXECUTION_ONLY_CONFIG_KEYS`, with absent late-added keys
+    normalized per :data:`COMPAT_DEFAULT_CONFIG_KEYS`. Accepts a config
+    dataclass or an already-serialized manifest ``sim_config`` dict."""
     d = (cfg_or_dict if isinstance(cfg_or_dict, dict)
          else dataclasses.asdict(cfg_or_dict))
-    return {k: v for k, v in d.items()
-            if k not in EXECUTION_ONLY_CONFIG_KEYS}
+    out = {k: v for k, v in d.items()
+           if k not in EXECUTION_ONLY_CONFIG_KEYS}
+    for k, default in COMPAT_DEFAULT_CONFIG_KEYS.items():
+        out.setdefault(k, default)
+    return out
 
 
 def _leaves(state) -> list:

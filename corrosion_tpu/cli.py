@@ -422,10 +422,26 @@ def cmd_consul(args) -> int:
     return consul_sync_cli(args)
 
 
+def _project_point(text: str) -> str:
+    """argparse type for ``--project N[,M]``: validate here so a typo
+    is a usage error, not a traceback; the string passes through to
+    ``mem_report_cli``, which owns the one N/M parse."""
+    try:
+        parts = [int(p) for p in text.split(",")]
+    except ValueError:
+        parts = []
+    if len(parts) not in (1, 2) or any(p <= 0 for p in parts):
+        raise argparse.ArgumentTypeError(
+            f"expected N or N,M (positive integers), got {text!r}")
+    return text
+
+
 def cmd_mem_report(args) -> int:
     """Per-table nbytes audit of the configured simulator state — the
     CLI face of ``obs/memory.py`` (which table is O(N·M) vs O(N), and
-    what the HBM budget at [sim] n_nodes actually is)."""
+    what the HBM budget at [sim] n_nodes actually is). With
+    ``--project N[,M]`` the audit is corrobudget's static projection
+    instead (no state built — prices N=1M from the constructor ASTs)."""
     from corrosion_tpu.obs.memory import mem_report_cli
 
     return mem_report_cli(args)
@@ -708,9 +724,11 @@ def build_parser() -> argparse.ArgumentParser:
     asr.set_defaults(fn=cmd_assertions)
 
     lint = sub.add_parser(
-        "lint", help="corrolint static analysis (v1 lexical checkers "
-                     "plus the v2 interprocedural sharding-contract, "
-                     "dtype-flow, lock-order, donation-flow passes)")
+        "lint", help="corrolint static analysis (v1 lexical checkers, "
+                     "the v2 interprocedural sharding-contract, "
+                     "dtype-flow, lock-order, donation-flow passes, "
+                     "and the v3 corrobudget mem-budget/densify "
+                     "symbolic-shape gate)")
     lint.add_argument("paths", nargs="*", default=None,
                       help="files/dirs (default: corrosion_tpu)")
     lint.add_argument("--format", choices=("text", "json"), default="text")
@@ -743,6 +761,12 @@ def build_parser() -> argparse.ArgumentParser:
     mr.add_argument("-c", "--config", default=None)
     mr.add_argument("--n-nodes", type=int, default=0,
                     help="override [sim] n_nodes for the audit")
+    mr.add_argument("--project", metavar="N[,M]", default=None,
+                    type=_project_point,
+                    help="print corrobudget's STATIC projection at "
+                         "(N[, M]) instead of building a state — "
+                         "symbolic inventory, zero arrays, any N "
+                         "(docs/memory-budget.md)")
     mr.set_defaults(fn=cmd_mem_report)
 
     d = sub.add_parser("default-config", help="print an example config file")

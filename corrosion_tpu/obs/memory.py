@@ -15,6 +15,15 @@ and the ``hbm_bytes`` field every bench record now carries. The
 invariant the obs smoke pins: the per-table audit SUMS to the measured
 total state size — a table the walk misses would silently undercount
 the 1M budget.
+
+Since ISSUE 12 the audit has a STATIC twin: corrobudget
+(``analysis/shapes.py``) derives the same inventory from the state
+constructors' ASTs — symbolic shapes, no arrays built — and projects it
+to arbitrary (N, M) (``mem-report --project``, the bench
+``hbm_bytes_projected_1m`` field, and the lint-time ``mem-budget``
+gate). Both planes classify leaves through the ONE
+:func:`classify_leaf` below, and ``tests/test_membudget.py`` pins them
+leaf-for-leaf against each other and ``jax.eval_shape``.
 """
 
 from __future__ import annotations
@@ -42,13 +51,34 @@ def _walk_leaves(obj, prefix: str, out: dict) -> None:
         out[prefix or "<leaf>"] = obj
 
 
-def _classify(shape, n_nodes: Optional[int]) -> str:
+def classify_leaf(shape, n_nodes: Optional[int]) -> str:
     """Scaling class against the cluster size: the leading axis of every
     per-node table is N, so ``[N]`` is O(N), ``[N, ...]`` is O(N·M)
-    (M = the trailing extent), anything else is O(1) bookkeeping."""
+    (M = the trailing extent), anything else is O(1) bookkeeping.
+
+    THE classification — the runtime audit below and corrobudget's
+    static inventory (``analysis/shapes.py``) both call it, so the two
+    planes can never disagree about what a table costs."""
     if not n_nodes or not shape or shape[0] != n_nodes:
         return "O(1)"
     return "O(N)" if math.prod(shape[1:]) == 1 else "O(N*M)"
+
+
+#: backward-compat alias (pre-ISSUE-12 internal name)
+_classify = classify_leaf
+
+
+def _fallback_nbytes(leaf) -> int:
+    """nbytes for metadata-only leaves that don't carry the attribute
+    (``jax.eval_shape`` returns ``ShapeDtypeStruct`` on some versions
+    without it) — shape × itemsize, same arithmetic as a real array."""
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    import numpy as np
+
+    return int(math.prod(shape) * np.dtype(dtype).itemsize)
 
 
 def state_bytes(state) -> int:
@@ -78,8 +108,10 @@ def memory_report(state, n_nodes: Optional[int] = None) -> dict:
     total = 0
     for name, leaf in leaves.items():
         shape = tuple(int(s) for s in getattr(leaf, "shape", ()))
-        nbytes = int(getattr(leaf, "nbytes", 0))
-        cls = _classify(shape, n_nodes)
+        nbytes = getattr(leaf, "nbytes", None)
+        nbytes = int(nbytes) if nbytes is not None else (
+            _fallback_nbytes(leaf))
+        cls = classify_leaf(shape, n_nodes)
         entry = {
             "shape": list(shape),
             "dtype": str(getattr(leaf, "dtype", "?")),
@@ -112,11 +144,54 @@ def publish_memory_gauges(report: dict, registry) -> None:
                        labels={"class": cls})
 
 
+def static_report(cfg, mode: str = "scale",
+                  n_nodes: Optional[int] = None,
+                  m_slots: Optional[int] = None) -> dict:
+    """STATIC projection of the state audit: corrobudget's symbolic
+    inventory (``analysis/shapes.py``) evaluated at the config's
+    extents, optionally rebinding N (and M). Same schema as
+    :func:`memory_report` plus per-leaf ``symbolic`` shapes — and it
+    never builds an array, so it prices N=1M on a laptop (past the
+    current ``validate()`` 2^19 runtime wall, docs/memory-budget.md)."""
+    from corrosion_tpu.analysis import shapes
+
+    inv = shapes.static_inventory(cfg, mode=mode)
+    overrides = {}
+    if n_nodes:
+        overrides["N"] = int(n_nodes)
+    if m_slots:
+        overrides["M"] = int(m_slots)
+    report = inv.report(overrides)
+    report["mode"] = mode
+    return report
+
+
+def projected_bytes(cfg, n_nodes: int, mode: str = "scale") -> int:
+    """Total projected HBM bytes of one state replica at ``n_nodes`` —
+    the bench's ``hbm_bytes_projected_1m`` field (static projection of
+    the SAME config the run used, so the recorded number prices the
+    run's actual table set). A leaf the interpreter can't price is a
+    loud error here, never a silent undercount — the single-number
+    callers (bench JSON) have no ``unresolved`` field to look at."""
+    report = static_report(cfg, mode=mode, n_nodes=n_nodes)
+    if report.get("unresolved"):
+        raise ValueError(
+            "static projection has unpriceable leaves "
+            f"{report['unresolved']}; the total would silently "
+            "undercount (see docs/memory-budget.md)"
+        )
+    return int(report["total_bytes"])
+
+
 def mem_report_cli(args) -> int:
     """``corrosion-tpu mem-report``: build the configured sim state and
     print the audit as JSON — the first step of the 1M memory-budget
     audit, runnable against any config without touching a device-sized
-    cluster (state CREATION at the configured N is the only cost)."""
+    cluster (state CREATION at the configured N is the only cost).
+
+    ``--project N[,M]`` skips state creation entirely and prints the
+    STATIC projection at that point instead (corrobudget's symbolic
+    inventory — zero arrays, any N)."""
     import json
 
     from corrosion_tpu.config import Config, load_config
@@ -125,12 +200,21 @@ def mem_report_cli(args) -> int:
     if args.n_nodes:
         cfg_file.sim.n_nodes = args.n_nodes
     cfg = cfg_file.sim_config()
-    if cfg_file.sim.mode == "scale":
+    mode = cfg_file.sim.mode
+    if getattr(args, "project", None):
+        parts = [int(p) for p in str(args.project).split(",") if p]
+        n_proj = parts[0]
+        m_proj = parts[1] if len(parts) > 1 else None
+        report = static_report(cfg, mode=mode, n_nodes=n_proj,
+                               m_slots=m_proj)
+        print(json.dumps(report, indent=2))
+        return 0
+    if mode == "scale":
         from corrosion_tpu.sim.scale_step import ScaleSimState as StCls
     else:
         from corrosion_tpu.sim.step import SimState as StCls
     state = StCls.create(cfg)
     report = memory_report(state, cfg.n_nodes)
-    report["mode"] = cfg_file.sim.mode
+    report["mode"] = mode
     print(json.dumps(report, indent=2))
     return 0

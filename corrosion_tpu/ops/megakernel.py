@@ -139,16 +139,20 @@ def _warn_degrade(stage: str, detail: str = "") -> None:
 
 
 def _swim_probe_args(n: int, m: int, key, pig_k: int = 0,
-                     narrow: bool = False):
+                     narrow: bool = False, tx8: bool = False):
     """Operand tuple for a ``swim_tables_*`` probe call (21 positional
     args after ``consts``) — shared by the tiny differential probes and
     the block-width probes so they cannot drift from the signature.
     ``pig_k > 0`` shapes the channel planes as packed entry lists
     ([n, pig_k]) like the bounded-piggyback mode; ``narrow`` carries the
-    timer/budget planes as int16 like ``narrow_dtypes`` configs."""
+    timer/budget planes as int16 like ``narrow_dtypes`` configs; ``tx8``
+    carries ``mem_tx`` as int8 like ``narrow_int8`` configs (ISSUE 12 —
+    the probed dtype set must match the caller's or a DIFFERENT,
+    unprobed kernel would lower at dispatch)."""
     import jax.random as jr
 
     tdt = jnp.int16 if narrow else jnp.int32
+    txdt = jnp.int8 if tx8 else tdt
     iarr = jnp.arange(n, dtype=jnp.int32)
     mem_id = jr.randint(key, (n, m), -1, n, dtype=jnp.int32)
     mem_view = jr.randint(jr.fold_in(key, 1), (n, m), -1, 64,
@@ -164,7 +168,7 @@ def _swim_probe_args(n: int, m: int, key, pig_k: int = 0,
         ch_send = jnp.ones((n, m), bool)
     return (
         mem_id, mem_view, mem_id, mem_view,
-        jnp.zeros((n, m), tdt), jnp.ones((n, m), tdt),
+        jnp.zeros((n, m), tdt), jnp.ones((n, m), txdt),
         jnp.ones(n, bool), jnp.zeros(n, jnp.int32), iarr, iarr % m,
         jnp.full(n, -1, jnp.int32), jnp.ones(n, jnp.int32),
         iarr % m, jnp.ones(n, jnp.int32), jnp.zeros(n, bool),
@@ -312,14 +316,15 @@ def _width_ok_ingest(cfg, msgs: int, emit: bool = False) -> bool:
 
 
 def _width_ok_swim(n_nodes: int, m_slots: int, pig_k: int = 0,
-                   narrow: bool = False) -> bool:
+                   narrow: bool = False, tx8: bool = False) -> bool:
     """Same as :func:`_width_ok_ingest` for the swim kernel (both the
     aligned-row and bounded-piggyback channel forms). ``narrow`` probes
     with int16 timer/budget planes so the probed kernel matches a
-    ``narrow_dtypes`` caller's lowering."""
+    ``narrow_dtypes`` caller's lowering; ``tx8`` keys the ``narrow_int8``
+    (int8 mem_tx) dtype set separately for the same reason."""
     backend = _backend()
     blk = _block_size(n_nodes)
-    key = (backend, "swim", blk, m_slots, pig_k, narrow)
+    key = (backend, "swim", blk, m_slots, pig_k, narrow, tx8)
     if key not in _width_ok_cache:
         nb = _probe_n(blk)
         if nb == 0 or nb >= n_nodes:
@@ -329,7 +334,7 @@ def _width_ok_swim(n_nodes: int, m_slots: int, pig_k: int = 0,
             import jax.random as jr
 
             args = _swim_probe_args(nb, m_slots, jr.key(1), pig_k=pig_k,
-                                    narrow=narrow)
+                                    narrow=narrow, tx8=tx8)
             outs = swim_tables_fused(
                 (m_slots, 6, 48, 10, pig_k), *args, interpret=False
             )
@@ -375,7 +380,8 @@ def use_fused_ingest(cfg, msgs: int = 16, emit: bool = False) -> bool:
 
 
 def use_fused_swim(n_nodes: int, m_slots: int, pig_k: int = 0,
-                   narrow: bool = False, mode: str = "auto") -> bool:
+                   narrow: bool = False, mode: str = "auto",
+                   tx8: bool = False) -> bool:
     """Shape-aware answer for the swim kernel at the caller's widths;
     ``mode`` is the caller's ``fused_mode(cfg)`` (the swim tables carry
     no config object of their own)."""
@@ -385,7 +391,8 @@ def use_fused_swim(n_nodes: int, m_slots: int, pig_k: int = 0,
         )
     if mode != "auto":
         return mode in ("on", "interpret")
-    return use_fused() and _width_ok_swim(n_nodes, m_slots, pig_k, narrow)
+    return use_fused() and _width_ok_swim(n_nodes, m_slots, pig_k, narrow,
+                                          tx8)
 
 
 def prime_fused(cfg) -> dict:
@@ -438,6 +445,7 @@ def prime_fused(cfg) -> dict:
             cfg.n_nodes, cfg.m_slots,
             int(getattr(cfg, "pig_members", 0)),
             narrow=bool(getattr(cfg, "narrow_dtypes", False)),
+            tx8=bool(getattr(cfg, "narrow_int8", False)),
             mode=mode,
         )
     # interpret is a statement about the kernels that RUN: False when

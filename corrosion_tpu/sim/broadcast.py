@@ -131,6 +131,11 @@ class CrdtState(NamedTuple):
 
     @staticmethod
     def create(cfg: SimConfig) -> "CrdtState":
+        # budget-bearing boundary (corrobudget, docs/memory-budget.md):
+        # every plane built here is priced symbolically by
+        # analysis/shapes.py and gated at N=1M by the mem-budget rule —
+        # the store and queue planes below are the two largest O(N·M)
+        # line items of the flagship budget
         n, q, c = cfg.n_nodes, cfg.bcast_queue, cfg.n_cells
         z = lambda *s: jnp.zeros(s, jnp.int32)  # noqa: E731
         # narrowed planes (PERF.md cut #4): small-range bookkeeping lives

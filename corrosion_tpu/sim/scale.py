@@ -102,6 +102,16 @@ class ScaleConfig:
     # round-step narrows once on carry-out, halving those planes' HBM
     # read+write traffic
     narrow_dtypes: bool = False
+    # int8 tier (ISSUE 12, the corrobudget-identified shrink): the
+    # piggyback budget plane ``mem_tx`` is the one [N, M] table whose
+    # value range the analyzer can PROVE < 2^7 under flagship defaults
+    # (max_transmissions = log2(N)+4 ≈ 24 at 1M; mem_timer is refused —
+    # down_purge_rounds = 8·log2(N) = 160 overflows int8). Requires
+    # narrow_dtypes (it is a deeper tier of the same knob); halves
+    # mem_tx's HBM footprint again (docs/memory-budget.md). Default OFF
+    # until a real-TPU width probe validates the int8 lowering — the
+    # same staging int16 went through in rounds 3→4.
+    narrow_int8: bool = False
     # fused megakernel path: auto/on/off/interpret (see docs/fused.md
     # and ScaleSimConfig.fused — execution knob, never changes results)
     fused: str = "auto"
@@ -132,6 +142,15 @@ class ScaleConfig:
                 "narrow_dtypes stores timers/budgets as int16; a "
                 "timer/budget bound exceeds int16 range"
             )
+        if self.narrow_int8 and not self.narrow_dtypes:
+            raise ValueError(
+                "narrow_int8 is a tier of narrow_dtypes; enable both"
+            )
+        if self.narrow_int8 and self.max_transmissions >= (1 << 7):
+            raise ValueError(
+                "narrow_int8 stores mem_tx as int8; max_transmissions "
+                f"{self.max_transmissions} exceeds int8 range"
+            )
         from corrosion_tpu.sim.config import FUSED_MODES
 
         if self.fused not in FUSED_MODES:
@@ -144,6 +163,13 @@ class ScaleConfig:
     @property
     def timer_dtype(self):
         return jnp.int16 if self.narrow_dtypes else jnp.int32
+
+    @property
+    def tx_dtype(self):
+        """HBM dtype of the ``mem_tx`` budget plane (the ISSUE-12 int8
+        shrink; mirrored by ``analysis/shapes.py::ConfigVal.tx_dtype``
+        so the static inventory prices the same plane set)."""
+        return jnp.int8 if self.narrow_int8 else self.timer_dtype
 
 
 def scale_config(n_nodes: int, **overrides) -> ScaleConfig:
@@ -181,6 +207,11 @@ class ScaleSwimState(NamedTuple):
         # self entry (always wins its hash class)
         mem_id = mem_id.at[iarr, iarr % m].set(iarr)
         mem_view = mem_view.at[iarr, iarr % m].set(alive_key)
+        # budget-bearing boundary (corrobudget, docs/memory-budget.md):
+        # every plane built here is priced by the static inventory
+        # (analysis/shapes.py) and gated at N=1M by the mem-budget
+        # rule — a new [N, M] table or a widened dtype fails lint
+        # until HBM_BUDGET is re-priced with it
         return ScaleSwimState(
             alive=jnp.ones(n, bool),
             inc=jnp.zeros(n, jnp.int32),
@@ -188,7 +219,7 @@ class ScaleSwimState(NamedTuple):
             mem_view=mem_view,
             mem_timer=jnp.zeros((n, m), cfg.timer_dtype),
             mem_tx=jnp.full((n, m), cfg.max_transmissions,
-                            cfg.timer_dtype),
+                            cfg.tx_dtype),
         )
 
 
@@ -631,6 +662,7 @@ def scale_swim_step(
     if megakernel.use_fused_swim(
             cfg.n_nodes, cfg.m_slots, pig_k,
             narrow=bool(getattr(cfg, "narrow_dtypes", False)),
+            tx8=bool(getattr(cfg, "narrow_int8", False)),
             mode=megakernel.fused_mode(cfg)):
         mem_id, mem_view, timer, mem_tx, inc, refute = (
             megakernel.swim_tables_fused(

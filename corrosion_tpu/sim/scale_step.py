@@ -149,6 +149,11 @@ class ScaleSimConfig:
     # favors it slightly, and the TPU traffic model halves those
     # planes' HBM bytes; BENCH_NARROW=0 measures the wide arm
     narrow_dtypes: bool = True
+    # int8 tier for the mem_tx budget plane (ISSUE 12 — the shrink
+    # corrobudget's dtype-bound analysis proves safe; see
+    # ScaleConfig.narrow_int8 and docs/memory-budget.md). Default OFF
+    # pending a real-TPU width probe; BENCH_NARROW8=1 measures it
+    narrow_int8: bool = False
     # --- fused megakernel path (ops/megakernel.py, docs/fused.md) --------
     # the production execution knob, fed from ``config.perf.fused``:
     #   "auto"      — pallas kernels on non-CPU backends when the eager
@@ -208,6 +213,15 @@ class ScaleSimConfig:
                     "narrow_dtypes stores these planes as int16; a "
                     "plane bound exceeds int16 range"
                 )
+        if self.narrow_int8 and not self.narrow_dtypes:
+            raise ValueError(
+                "narrow_int8 is a tier of narrow_dtypes; enable both"
+            )
+        if self.narrow_int8 and self.max_transmissions >= (1 << 7):
+            raise ValueError(
+                "narrow_int8 stores mem_tx as int8; max_transmissions "
+                f"{self.max_transmissions} exceeds int8 range"
+            )
         from corrosion_tpu.sim.config import FUSED_MODES
 
         if self.fused not in FUSED_MODES:
@@ -221,6 +235,11 @@ class ScaleSimConfig:
     def timer_dtype(self):
         """Dtype of the narrowed planes (see ``ScaleConfig.timer_dtype``)."""
         return jnp.int16 if self.narrow_dtypes else jnp.int32
+
+    @property
+    def tx_dtype(self):
+        """HBM dtype of ``mem_tx`` (see ``ScaleConfig.tx_dtype``)."""
+        return jnp.int8 if self.narrow_int8 else self.timer_dtype
 
 
 def scale_sim_config(n_nodes: int, **overrides) -> ScaleSimConfig:
@@ -639,7 +658,8 @@ def _narrow_carry(cfg: ScaleSimConfig, st: ScaleSimState) -> ScaleSimState:
     dt = cfg.timer_dtype
     swim = st.swim._replace(
         mem_timer=st.swim.mem_timer.astype(dt),
-        mem_tx=st.swim.mem_tx.astype(dt),
+        # mem_tx has its own (possibly int8) HBM tier — ISSUE 12 shrink
+        mem_tx=st.swim.mem_tx.astype(cfg.tx_dtype),
     )
     crdt = st.crdt._replace(
         q_cell=st.crdt.q_cell.astype(dt),

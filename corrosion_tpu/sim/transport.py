@@ -72,6 +72,7 @@ def ring_of(net: NetModel, src, dst):
 
 def same_region(net: NetModel):
     """[N, N] ring-0 adjacency (full-view sims only)."""
+    # corrolint: disable=densify -- full-view broadcast fanout only (sim/step.py); the scale path pairs via cards and never calls this
     return net.region[:, None] == net.region[None, :]
 
 

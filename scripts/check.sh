@@ -60,6 +60,29 @@ print(f"corrolint scope: fused-path files covered "
 PY
 echo "corrolint: clean (report: artifacts/lint_r06.json)"
 
+echo "== corrobudget: 1M HBM budget gate =="
+# the ISSUE 12 memory-budget audit (docs/memory-budget.md): static
+# inventory + projections at N in {100k, 300k, 1M}, the static==runtime
+# cross-check at a real small-N point, the declared per-class budget at
+# the 1M point, and the mem-budget/densify rule counts — published as
+# artifacts/membudget_r12.json (written even on failure)
+env JAX_PLATFORMS=cpu python scripts/membudget_probe.py \
+    --output artifacts/membudget_r12.json
+python - <<'PY'
+import json
+rec = json.load(open("artifacts/membudget_r12.json"))
+if not rec.get("ok"):
+    raise SystemExit(f"membudget gate failed: {rec.get('problems')}")
+if not rec.get("budget_ok") or not rec.get("cross_check_ok"):
+    raise SystemExit(f"membudget gate inconsistent: {rec}")
+proj = rec["projections"]["1000000"]
+print("membudget: 1M projection",
+      f"{proj['total_bytes'] / 1e9:.3f} GB",
+      f"({len(rec['inventory'])} leaves,",
+      f"int8 arm saves {rec['projection_1m_narrow_int8']['saved_bytes_vs_default'] / 1e6:.0f} MB)")
+PY
+echo "corrobudget: under budget (report: artifacts/membudget_r12.json)"
+
 if [[ "${1:-}" == "--lint" ]]; then
     exit 0
 fi

@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""corrobudget gate probe -> artifacts/membudget_r12.json (ISSUE 12).
+
+The CI face of the 1M memory-budget audit (docs/memory-budget.md):
+
+- **static inventory**: every ``ScaleSimState`` leaf with its symbolic
+  shape, dtype, and complexity class, from the constructor ASTs
+  (``analysis/shapes.py`` — no arrays built);
+- **projections** at N ∈ {100k, 300k, 1M} under the flagship extents,
+  plus the int8 (``narrow_int8``) arm at 1M;
+- **cross-check**: the static inventory must match the LIVE
+  ``obs/memory.py`` audit leaf-for-leaf (names, shapes, dtypes,
+  nbytes) at a small real (N, M) point — the same both-directions
+  pin tier-1 runs in ``tests/test_membudget.py``;
+- **budget gate**: the declared per-class budget (``HBM_BUDGET``) must
+  hold at the 1M point, and the ``mem-budget``/``densify`` rules must
+  be clean over the repo walk (rule counts recorded).
+
+Exit 0 with ``"ok": true`` when every claim holds; exit 1 otherwise
+(the artifact is written either way).
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# must be set before jax initializes (the runtime cross-check builds a
+# real small-N state); conftest does the same for tests
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+def main() -> int:
+    problems = []
+
+    from corrosion_tpu.analysis import shapes
+    from corrosion_tpu.analysis.runner import lint_report
+    from corrosion_tpu.sim.scale_step import scale_sim_config
+
+    # --- static inventory + projections ---------------------------------
+    template = scale_sim_config(100_000)
+    inv = shapes.static_inventory(template, mode="scale")
+    projections = {}
+    for n in (100_000, 300_000, 1_000_000):
+        rep = inv.report({"N": n})
+        if rep["unresolved"]:
+            problems.append(f"unresolved leaves at N={n}: "
+                            f"{rep['unresolved']}")
+        projections[str(n)] = {
+            "total_bytes": rep["total_bytes"],
+            "by_class": rep["by_class"],
+        }
+    report_1m = inv.report(dict(shapes.HBM_BUDGET["point"]))
+
+    # the int8 arm (the applied ISSUE-12 shrink) at the same point
+    import dataclasses
+
+    i8_cfg = dataclasses.replace(template, narrow_int8=True).validate()
+    i8_rep = shapes.static_inventory(i8_cfg, mode="scale").report(
+        dict(shapes.HBM_BUDGET["point"]))
+    saved = report_1m["total_bytes"] - i8_rep["total_bytes"]
+    if saved <= 0:
+        problems.append(
+            f"narrow_int8 projection saved nothing ({saved} bytes)")
+
+    # --- budget gate ----------------------------------------------------
+    budget_ok = True
+    for cls, budget in shapes.HBM_BUDGET["per_class_bytes"].items():
+        used = report_1m["by_class"].get(cls, 0)
+        if used > budget:
+            budget_ok = False
+            problems.append(
+                f"{cls} over budget at 1M: {used} > {budget}")
+
+    # --- static == runtime cross-check at a real point ------------------
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from corrosion_tpu.obs.memory import memory_report
+    from corrosion_tpu.sim.scale_step import ScaleSimState
+
+    small = scale_sim_config(4096, m_slots=32)
+    st = ScaleSimState.create(small)
+    live = memory_report(st, small.n_nodes)
+    static = shapes.static_inventory(small, mode="scale").report()
+    cross_ok = set(live["tables"]) == set(static["tables"])
+    for name in live["tables"]:
+        a = live["tables"][name]
+        b = static["tables"].get(name)
+        if b is None or any(a[k] != b[k] for k in
+                            ("shape", "dtype", "nbytes", "class")):
+            cross_ok = False
+            problems.append(f"static/runtime drift at {name}: {a} vs {b}")
+            break
+    if live["total_bytes"] != static["total_bytes"]:
+        cross_ok = False
+        problems.append("static/runtime total_bytes drift")
+
+    # --- rule counts over the repo walk ---------------------------------
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = [os.path.join(root, "corrosion_tpu"),
+             os.path.join(root, "bench.py"),
+             os.path.join(root, "scripts")]
+    findings, n_files = lint_report(
+        paths, checkers=["mem-budget", "densify"])
+    rule_counts = {"mem-budget": 0, "densify": 0}
+    for f in findings:
+        rule_counts[f.rule] = rule_counts.get(f.rule, 0) + 1
+        problems.append(f.render())
+
+    # --- ranked offenders (the audit deliverable) -----------------------
+    offenders = sorted(
+        ((name, e) for name, e in report_1m["tables"].items()
+         if e["class"] != "O(1)"),
+        key=lambda kv: -kv[1]["nbytes"])
+
+    record = {
+        "probe": "membudget_r12",
+        "ok": not problems,
+        "budget_ok": budget_ok,
+        "cross_check_ok": cross_ok,
+        "budget": shapes.HBM_BUDGET,
+        "extents": dict(inv.bindings),
+        "flags": dict(inv.flags),
+        "inventory": {
+            name: {
+                "symbolic": leaf.shape_str(),
+                "dtype": leaf.dtype,
+            }
+            for name, leaf in inv.leaves.items()
+        },
+        "projections": projections,
+        "projection_1m_narrow_int8": {
+            "total_bytes": i8_rep["total_bytes"],
+            "by_class": i8_rep["by_class"],
+            "saved_bytes_vs_default": saved,
+        },
+        "worst_offenders_1m": [
+            {"table": name, "nbytes": e["nbytes"], "class": e["class"],
+             "symbolic": e["symbolic"], "dtype": e["dtype"]}
+            for name, e in offenders[:10]
+        ],
+        "rule_counts": rule_counts,
+        "files_checked": n_files,
+    }
+    if problems:
+        record["problems"] = problems
+    out = sys.argv[sys.argv.index("--output") + 1] if (
+        "--output" in sys.argv) else "artifacts/membudget_r12.json"
+    os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+    with open(out, "w") as f:
+        json.dump(record, f, indent=2)
+        f.write("\n")
+    print(json.dumps({k: record[k] for k in
+                      ("probe", "ok", "budget_ok", "cross_check_ok",
+                       "rule_counts")}))
+    return 0 if not problems else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

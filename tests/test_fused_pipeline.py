@@ -249,6 +249,11 @@ def test_sharded_mesh_parity_interpret(mesh_kind):
     assert _trees_equal(st_ref, st_f)
 
 
+# slow (ISSUE 12 tier-1 rebalance): ~33s; crash-injected resume stays
+# tier-1 unfused (test_resilience) and cross-mode fused resume stays
+# via test_fused_checkpoint_resumes_across_modes — check.sh's fused
+# interpret smoke still replays the full segmented fused pipeline
+@pytest.mark.slow
 def test_fused_segmented_soak_crash_injected_resume(tmp_path, monkeypatch):
     """The acceptance scenario in one: a fused(interpret) segmented
     soak with per-segment checkpoints, a crash injected mid-save, a

@@ -38,6 +38,11 @@ def _random_batch(key, n, m, cfg):
     return live, origin, dbv, cell, ver, val, site, clp, ts
 
 
+# slow (ISSUE 12 tier-1 rebalance): ~29s of interpret-mode pallas for
+# ingest-level parity that the round-level gates keep in tier-1
+# (test_fused_scale_round_matches_unfused + kernel-features[0] drive
+# the same ingest inside the full round)
+@pytest.mark.slow
 @pytest.mark.parametrize("rounds", [3])
 def test_fused_ingest_matches_unfused(rounds):
     n, m = 64, 12
@@ -195,7 +200,12 @@ def test_fused_swim_matches_unfused_bounded_piggyback():
         assert np.array_equal(np.asarray(a), np.asarray(b))
 
 
-@pytest.mark.parametrize("pig_members", [0, 8])
+# pig_members=8 is slow-marked (ISSUE 12 tier-1 rebalance): ~23s; the
+# piggyback kernel's fused parity stays tier-1 via
+# test_fused_swim_matches_unfused_bounded_piggyback and the
+# scale_step flagship-combination (narrow+pig+fused) test
+@pytest.mark.parametrize(
+    "pig_members", [0, pytest.param(8, marks=pytest.mark.slow)])
 def test_fused_round_matches_unfused_with_kernel_features(pig_members):
     """The round-3 kernel features — in-kernel payload emission (always
     on the fused path) and bounded packed-entry piggyback (pig_members >

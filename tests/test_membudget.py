@@ -1,0 +1,516 @@
+"""corrobudget (ISSUE 12): symbolic shape interpreter + HBM budget gate.
+
+Three test tiers:
+
+- **rule fixtures**: the ``mem-budget``/``densify`` rules fire on
+  seeded bad code and honor reasoned suppressions;
+- **symbolic regressions**: the interpreter covers the constructor
+  idioms the real state classes use (tuple packing, branch joins,
+  ``_replace`` threading, local-lambda factories, ``.shape``
+  unpacking);
+- **both-directions meta-tests**: the static inventory equals the
+  runtime ``obs/memory.py`` audit AND ``jax.eval_shape`` ground truth
+  leaf-for-leaf (names, shapes, dtypes, nbytes) at two real (N, M)
+  points, the declared extents match the real flagship config, and the
+  repo passes the N=1M budget gate.
+"""
+
+import dataclasses
+import json
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from corrosion_tpu.analysis import shapes
+from corrosion_tpu.analysis.runner import check_source
+from corrosion_tpu.obs.memory import classify_leaf, memory_report
+from corrosion_tpu.sim.scale_step import ScaleSimState, scale_sim_config
+
+
+def _budget(src, path="fixture_budget.py"):
+    return check_source(src, path, {"mem-budget": shapes.check_budget})
+
+
+def _densify(src, path="fixture_densify.py"):
+    return check_source(src, path, {"densify": shapes.check_densify})
+
+
+# --- rule fixtures --------------------------------------------------------
+
+OVER_BUDGET = '''
+from typing import NamedTuple
+import jax
+import jax.numpy as jnp
+
+
+class ScaleSimState(NamedTuple):
+    big: jax.Array
+    ok: jax.Array
+
+    @staticmethod
+    def create(cfg):
+        n, m = cfg.n_nodes, cfg.m_slots
+        big = jnp.zeros((n, 64 * m), jnp.int32)  # 16 KB/node
+        return ScaleSimState(big=big, ok=jnp.zeros(n, jnp.int32))
+'''
+
+
+def test_mem_budget_fires_on_over_budget_state():
+    findings = _budget(OVER_BUDGET)
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "mem-budget"
+    # the finding lands on the offending leaf's creation line and
+    # prices it at the declared 1M point
+    assert "1,000,000" in f.message and "O(N*M)" in f.message
+    assert "big" in f.message
+    assert f.line == 14
+
+
+def test_mem_budget_fires_on_unpriceable_leaf():
+    src = OVER_BUDGET.replace("jnp.zeros((n, 64 * m), jnp.int32)",
+                              "mystery_table(cfg)")
+    findings = _budget(src)
+    assert any("no statically resolvable shape" in f.message
+               and "`big`" in f.message for f in findings)
+
+
+def test_mem_budget_silent_without_state_root():
+    # a walked subset that does not define the state grows no facts
+    assert _budget("def f():\n    return 1\n") == []
+
+
+NXN = '''
+import jax.numpy as jnp
+
+
+def pairwise(cfg, key):
+    iarr = jnp.arange(cfg.n_nodes, dtype=jnp.int32)
+    adj = iarr[:, None] == iarr[None, :]
+    return jnp.sum(adj)
+'''
+
+
+def test_densify_fires_on_nxn_broadcast():
+    findings = _densify(NXN)
+    assert len(findings) == 1
+    assert findings[0].rule == "densify"
+    assert "O(N^2)" in findings[0].message
+    assert findings[0].line == 7
+
+
+def test_densify_reasoned_suppression():
+    src = NXN.replace(
+        "iarr[:, None] == iarr[None, :]",
+        "iarr[:, None] == iarr[None, :]  "
+        "# corrolint: disable=densify -- deliberate dense fixture")
+    assert _densify(src) == []
+    # a reasonless suppression is itself a finding
+    bad = NXN.replace(
+        "iarr[:, None] == iarr[None, :]",
+        "iarr[:, None] == iarr[None, :]  # corrolint: disable=densify")
+    assert any(f.rule == "suppression-missing-reason"
+               for f in _densify(bad))
+
+
+def test_densify_unknown_operand_never_flags():
+    # precision over recall: an unproven input shape grows no finding
+    src = '''
+import jax.numpy as jnp
+
+
+def f(cfg, mystery):
+    iarr = jnp.arange(cfg.n_nodes, dtype=jnp.int32)
+    return iarr[:, None] * mystery
+'''
+    assert _densify(src) == []
+
+
+def test_densify_creation_and_eye_flag():
+    src = '''
+import jax.numpy as jnp
+
+
+def f(cfg):
+    n = cfg.n_nodes
+    a = jnp.zeros((n, n), jnp.int32)
+    b = jnp.eye(n, dtype=jnp.int32)
+    return a, b
+'''
+    findings = _densify(src)
+    assert len(findings) == 2
+
+
+def test_densify_follows_local_lambda_factory():
+    # the sim/broadcast.py idiom `z = lambda *s: jnp.zeros(s, ...)`
+    # must not be a densify escape hatch: the [N, N] built INSIDE the
+    # lambda flags exactly like the direct form (review fix, ISSUE 12)
+    src = '''
+import jax.numpy as jnp
+
+
+def f(cfg):
+    n = cfg.n_nodes
+    z = lambda *s: jnp.zeros(s, jnp.int32)
+    adj = z(n, n)
+    return adj * 2
+'''
+    findings = _densify(src)
+    assert len(findings) == 1 and findings[0].rule == "densify"
+
+
+def test_budget_ignores_create_less_name_collision():
+    # a create-less annotated class named ScaleSimState in an earlier
+    # module must not shadow the real one: the tie-break inspects each
+    # class BODY for create, not the project-wide method table (which
+    # can't tell two same-named classes apart) — a collision used to
+    # turn the whole gate silently dark (review fix, ISSUE 12)
+    import ast
+
+    from corrosion_tpu.analysis.callgraph import ModuleInfo, Project
+
+    decoy = '''
+class ScaleSimState:
+    rows: int
+    cols: int
+'''
+    mods = []
+    for name, src in (("decoy", decoy), ("real", OVER_BUDGET)):
+        mods.append(ModuleInfo(path=f"{name}.py", name=name,
+                               tree=ast.parse(src), source=src,
+                               suppressions={}, bad_suppressions=[]))
+    project = Project(mods)
+    info = shapes.index_classes(project)["ScaleSimState"]
+    assert info.module.name == "real"
+    findings = shapes.check_budget(project)
+    assert any(f.rule == "mem-budget" for f in findings)
+
+
+def test_densify_gather_of_table_is_linear():
+    # old_view[src] ([N] rows of an [N, M] table) stays O(N·M): the
+    # input already carries N-degree 1 and M is a bounded extent
+    src = '''
+import jax.numpy as jnp
+
+
+def f(cfg, key):
+    n, m = cfg.n_nodes, cfg.m_slots
+    table = jnp.zeros((n, m), jnp.int32)
+    src_ids = jnp.arange(n, dtype=jnp.int32)
+    got = table[src_ids]
+    return got * 2
+'''
+    assert _densify(src) == []
+
+
+# --- symbolic regressions -------------------------------------------------
+
+def _leaf_shapes(src, root="ScaleSimState"):
+    from corrosion_tpu.analysis.callgraph import ModuleInfo, Project
+    import ast
+
+    mod = ModuleInfo(path="fixture.py", name="fixture",
+                     tree=ast.parse(src), source=src, suppressions={},
+                     bad_suppressions=[])
+    inv = shapes.build_inventory(Project([mod]), root)
+    assert inv is not None
+    return {n: leaf.shape_str() for n, leaf in inv.leaves.items()}
+
+
+def test_symbolic_tuple_packing_and_shape_unpack():
+    src = '''
+from typing import NamedTuple
+import jax
+import jax.numpy as jnp
+
+
+class Inner(NamedTuple):
+    a: jax.Array
+
+    @staticmethod
+    def create(cfg):
+        return Inner(a=jnp.zeros((cfg.n_nodes, cfg.m_slots), jnp.int32))
+
+
+class ScaleSimState(NamedTuple):
+    pair: tuple
+    b: jax.Array
+
+    @staticmethod
+    def create(cfg):
+        inner = Inner.create(cfg)
+        n, m = inner.a.shape          # .shape tuple unpack
+        x, y = jnp.zeros(n, jnp.int16), jnp.zeros((n, m), jnp.int8)
+        pair = (x, y)                 # tuple packing into a field
+        return ScaleSimState(pair=pair, b=inner.a)
+'''
+    got = _leaf_shapes(src)
+    assert got == {"pair[0]": "[N]", "pair[1]": "[N, M]",
+                   "b": "[N, M]"}
+
+
+def test_symbolic_branch_joins():
+    src = '''
+from typing import NamedTuple
+import jax
+import jax.numpy as jnp
+
+
+class ScaleSimState(NamedTuple):
+    a: jax.Array
+    b: jax.Array
+
+    @staticmethod
+    def create(cfg):
+        n = cfg.n_nodes
+        if cfg.tx_max_cells > 1:      # concrete config guard: one arm
+            a = jnp.zeros((n, cfg.partial_slots), jnp.int32)
+        else:
+            a = jnp.zeros((n, 1), jnp.int32)
+        if unknowable():              # join: same shape both arms
+            b = jnp.zeros(n, jnp.int32)
+        else:
+            b = jnp.zeros(n, jnp.int32)
+        return ScaleSimState(a=a, b=b)
+'''
+    got = _leaf_shapes(src)
+    # flagship K=1 picks the else arm concretely; the unknowable test
+    # joins to the common shape
+    assert got == {"a": "[N, 1]", "b": "[N]"}
+
+
+def test_symbolic_replace_threading():
+    src = '''
+from typing import NamedTuple
+import jax
+import jax.numpy as jnp
+
+
+class ScaleSimState(NamedTuple):
+    a: jax.Array
+    b: jax.Array
+
+    @staticmethod
+    def create(cfg):
+        n = cfg.n_nodes
+        st = ScaleSimState(a=jnp.zeros(n, jnp.int32),
+                           b=jnp.zeros(n, jnp.int32))
+        st = st._replace(b=jnp.zeros((n, cfg.m_slots), jnp.int16))
+        st = st._replace(a=st.a.astype(jnp.int8))
+        return st
+'''
+    inv_shapes = _leaf_shapes(src)
+    assert inv_shapes == {"a": "[N]", "b": "[N, M]"}
+
+
+def test_symbolic_lambda_factory():
+    src = '''
+from typing import NamedTuple
+import jax
+import jax.numpy as jnp
+
+
+class ScaleSimState(NamedTuple):
+    a: jax.Array
+    b: jax.Array
+
+    @staticmethod
+    def create(cfg):
+        n, q = cfg.n_nodes, cfg.bcast_queue
+        z = lambda *s: jnp.zeros(s, jnp.int32)
+        z2 = lambda: jnp.ones((n, q), jnp.uint32)
+        return ScaleSimState(a=z(n, q), b=z2())
+'''
+    got = _leaf_shapes(src)
+    assert got == {"a": "[N, Q]", "b": "[N, Q]"}
+
+
+# --- both-directions meta-tests ------------------------------------------
+
+TWO_POINTS = [
+    dict(n_nodes=64, m_slots=8, n_origins=8, n_rows=4, n_cols=2,
+         buf_slots=8, sync_interval=4),
+    # exercises the partial-buffer branch (K>1), multi-word seen
+    # windows, and the wide-dtype arm
+    dict(n_nodes=96, m_slots=12, n_origins=6, n_rows=4, n_cols=4,
+         buf_slots=40, tx_max_cells=4, partial_slots=4,
+         narrow_dtypes=False),
+]
+
+
+def _eval_shape_report(cfg):
+    spec = jax.eval_shape(lambda: ScaleSimState.create(cfg))
+    return memory_report(spec, cfg.n_nodes)
+
+
+@pytest.mark.parametrize("overrides", TWO_POINTS)
+def test_static_matches_runtime_and_eval_shape(overrides):
+    """The acceptance pin: static inventory == runtime audit ==
+    jax.eval_shape, leaf for leaf (names, shapes, dtypes, nbytes,
+    classes), both directions (set equality, not subset)."""
+    cfg = scale_sim_config(**overrides)
+    static = shapes.static_inventory(cfg, mode="scale").report()
+    assert static["unresolved"] == []
+    runtime = memory_report(ScaleSimState.create(cfg), cfg.n_nodes)
+    evaled = _eval_shape_report(cfg)
+
+    for other, label in ((runtime, "runtime"), (evaled, "eval_shape")):
+        assert set(static["tables"]) == set(other["tables"]), label
+        for name, b in other["tables"].items():
+            a = static["tables"][name]
+            for k in ("shape", "dtype", "nbytes", "class"):
+                assert a[k] == b[k], (label, name, k, a, b)
+        assert static["total_bytes"] == other["total_bytes"], label
+        assert static["by_class"] == other["by_class"], label
+
+
+def test_static_matches_runtime_full_sim():
+    from corrosion_tpu.sim.config import wan_config
+    from corrosion_tpu.sim.step import SimState
+
+    cfg = wan_config(24)
+    static = shapes.static_inventory(cfg, mode="full").report()
+    assert static["unresolved"] == []
+    runtime = memory_report(SimState.create(cfg), cfg.n_nodes)
+    assert set(static["tables"]) == set(runtime["tables"])
+    for name, b in runtime["tables"].items():
+        a = static["tables"][name]
+        assert (a["shape"], a["dtype"], a["nbytes"], a["class"]) == (
+            b["shape"], b["dtype"], b["nbytes"], b["class"]), name
+    # the full-view [N, N] plane is priced (the honest reason the
+    # flagship budget is declared over the SCALE state)
+    assert static["tables"]["swim.view"]["symbolic"] == "[N, N]"
+
+
+def test_default_extents_match_flagship_config():
+    """Registry-sync: the lint gate's declared extents/flags are the
+    real ``scale_sim_config(100_000)`` — a drifted default would price
+    a config nobody ships."""
+    cfg = scale_sim_config(100_000)
+    sym_of = dict(shapes.SYMBOLS)
+    for attr, symbol in sym_of.items():
+        assert shapes.DEFAULT_EXTENTS[symbol] == getattr(cfg, attr), attr
+    assert shapes.DEFAULT_EXTENTS["C"] == cfg.n_cells
+    for flag, val in shapes.DEFAULT_FLAGS.items():
+        assert getattr(cfg, flag) == val, flag
+    # the abstract config's dtype properties mirror the real ones
+    cv = shapes.ConfigVal.from_config(cfg)
+    assert cv.attr("timer_dtype").name == str(
+        jnp.dtype(cfg.timer_dtype).name)
+    assert cv.attr("tx_dtype").name == str(jnp.dtype(cfg.tx_dtype).name)
+    i8 = dataclasses.replace(cfg, narrow_int8=True).validate()
+    assert shapes.ConfigVal.from_config(i8).attr("tx_dtype").name == "int8"
+
+
+def test_repo_passes_declared_budget():
+    """The gate of record at the declared point: under budget in every
+    class, with real headroom numbers recorded in the failure message
+    if this ever trips."""
+    inv = shapes.static_inventory(mode="scale")
+    report = inv.report(dict(shapes.HBM_BUDGET["point"]))
+    assert report["unresolved"] == []
+    for cls, budget in shapes.HBM_BUDGET["per_class_bytes"].items():
+        used = report["by_class"].get(cls, 0)
+        assert used <= budget, (cls, used, budget)
+    # and no class exists outside the declared budget set
+    assert set(report["by_class"]) <= set(
+        shapes.HBM_BUDGET["per_class_bytes"])
+    # the int8 arm shrinks the projection (the applied ISSUE-12 shrink)
+    i8 = dataclasses.replace(scale_sim_config(100_000),
+                             narrow_int8=True).validate()
+    i8_total = shapes.static_inventory(i8, mode="scale").report(
+        dict(shapes.HBM_BUDGET["point"]))["total_bytes"]
+    assert i8_total < report["total_bytes"]
+    # mem_tx halves: 2 B/node/slot -> 1 B/node/slot at M=64
+    assert report["total_bytes"] - i8_total == 64 * 1_000_000
+
+
+def test_projection_rebinds_n_and_m():
+    cfg = scale_sim_config(64, m_slots=8)
+    inv = shapes.static_inventory(cfg, mode="scale")
+    base = inv.report()
+    grown = inv.report({"N": 128})
+    # O(N)/O(N·M) tables scale linearly in N; O(1) does not
+    assert grown["tables"]["swim.mem_id"]["nbytes"] == (
+        2 * base["tables"]["swim.mem_id"]["nbytes"])
+    assert grown["tables"]["crdt.now"]["nbytes"] == (
+        base["tables"]["crdt.now"]["nbytes"])
+    wider = inv.report({"N": 128, "M": 16})
+    assert wider["tables"]["swim.mem_id"]["nbytes"] == (
+        4 * base["tables"]["swim.mem_id"]["nbytes"])
+    # last_sync tracks member slots at scale: rebinding M follows it
+    assert wider["tables"]["crdt.last_sync"]["shape"][1] == 16
+
+
+def test_classification_shared_with_runtime():
+    """Satellite 2: one classification source. The static report calls
+    the SAME ``classify_leaf`` the runtime audit uses."""
+    assert classify_leaf((100, 7), 100) == "O(N*M)"
+    assert classify_leaf((100, 1, 1), 100) == "O(N)"
+    assert classify_leaf((), 100) == "O(1)"
+    from corrosion_tpu.obs import memory as obs_memory
+
+    assert obs_memory._classify is classify_leaf
+    cfg = scale_sim_config(64, m_slots=8)
+    static = shapes.static_inventory(cfg, mode="scale").report()
+    for name, e in static["tables"].items():
+        assert e["class"] == classify_leaf(tuple(e["shape"]),
+                                           cfg.n_nodes), name
+
+
+def test_mem_report_project_cli(capsys):
+    """``corrosion-tpu mem-report --project N,M`` prints the static
+    projection without building a state (prices 1M past the runtime
+    validate() wall)."""
+    from corrosion_tpu.cli import main
+
+    rc = main(["mem-report", "--project", "1000000,64"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["source"] == "static"
+    assert out["n_nodes"] == 1_000_000
+    assert out["tables"]["swim.mem_id"]["shape"] == [1_000_000, 64]
+    assert out["total_bytes"] > 3_000_000_000
+
+
+def test_projected_bytes_hook():
+    from corrosion_tpu.obs.memory import projected_bytes
+
+    cfg = scale_sim_config(64, m_slots=8)
+    runtime_total = memory_report(ScaleSimState.create(cfg),
+                                  cfg.n_nodes)["total_bytes"]
+    # projecting at the config's own N reproduces the live audit
+    assert projected_bytes(cfg, cfg.n_nodes) == runtime_total
+
+
+def test_pre_int8_manifests_keep_their_identity():
+    """Checkpoint compat for the new field: a manifest written BEFORE
+    ``narrow_int8`` existed must equal a default (off) config's
+    identity — and must still refuse a config that turns the shrink on
+    (the mem_tx aval differs)."""
+    from corrosion_tpu.checkpoint import config_identity
+
+    cfg = scale_sim_config(48, m_slots=16)
+    old_manifest = config_identity(cfg)
+    del old_manifest["narrow_int8"]  # what a pre-ISSUE-12 save recorded
+    assert config_identity(old_manifest) == config_identity(cfg)
+    i8 = dataclasses.replace(cfg, narrow_int8=True).validate()
+    assert config_identity(old_manifest) != config_identity(i8)
+
+
+def test_densify_clean_on_scale_modules():
+    """The real scale path carries no provable superlinear
+    intermediate (the one deliberate [N, N] — ``same_region`` — is
+    reason-suppressed for the full-view sim)."""
+    import os
+
+    import corrosion_tpu
+    from corrosion_tpu.analysis.runner import lint_report
+
+    pkg = os.path.dirname(os.path.abspath(corrosion_tpu.__file__))
+    findings, n_files = lint_report(
+        [os.path.join(pkg, "sim"), os.path.join(pkg, "ops")],
+        checkers=["densify"])
+    assert findings == [], [f.render() for f in findings]
+    assert n_files > 10

@@ -566,3 +566,119 @@ def test_flagship_combination_narrow_pig_anywriter_fused():
     st_u, _ = run(cfg, st_u, net, jr.key(12), quiet_inputs(cfg, 300))
     m = scale_crdt_metrics(cfg, st_u)
     assert bool(m["converged"]), f"diverged: {int(m['n_diverged'])}"
+
+
+# --- ISSUE 12: the corrobudget-identified int8 shrink (mem_tx) ----------
+
+def _int8_write_rig(n_nodes=48, rounds=40):
+    import dataclasses
+
+    base = scale_sim_config(
+        n_nodes, m_slots=16, n_origins=4, n_rows=4, n_cols=2,
+        sync_interval=4, pig_members=4, narrow_dtypes=True,
+    )
+    i8 = dataclasses.replace(base, narrow_int8=True).validate()
+    net = NetModel.create(base.n_nodes, drop_prob=0.02)
+    inp = quiet_inputs(base, rounds)
+    n = base.n_nodes
+    k1, k2, k3 = jr.split(jr.key(40), 3)
+    w = jr.uniform(k1, (rounds, n)) < 0.3
+    inp = inp._replace(
+        write_mask=w,
+        write_cell=jr.randint(k2, (rounds, n), 0, base.n_cells,
+                              dtype=jnp.int32),
+        write_val=jr.randint(k3, (rounds, n), 1, 1 << 15, dtype=jnp.int32),
+        kill=jnp.zeros((rounds, n), bool).at[8, 3].set(True),
+        revive=jnp.zeros((rounds, n), bool).at[25, 3].set(True),
+    )
+    return base, i8, net, inp
+
+
+def test_narrow_int8_matches_int16_exactly():
+    """The ISSUE-12 shrink must be a pure layout change: the int8
+    ``mem_tx`` arm equals the int16 arm bit-for-bit (state widened for
+    comparison) on a churny written trace, and the dtype actually
+    narrowed — corrobudget's projection maths is only honest if the
+    narrowed plane is semantics-free."""
+    base, i8, net, inp = _int8_write_rig()
+    assert i8.tx_dtype == jnp.int8 and base.tx_dtype == jnp.int16
+
+    st16, info16 = run(base, ScaleSimState.create(base), net, jr.key(41),
+                       inp)
+    st8, info8 = run(i8, ScaleSimState.create(i8), net, jr.key(41), inp)
+    assert st8.swim.mem_tx.dtype == jnp.int8
+    assert st8.swim.mem_timer.dtype == jnp.int16  # timer stays 16
+    for a, b in zip(jax.tree.leaves(st16), jax.tree.leaves(st8)):
+        wa = a if a.dtype == bool else jnp.asarray(a, jnp.int32)
+        wb = b if b.dtype == bool else jnp.asarray(b, jnp.int32)
+        assert jnp.array_equal(wa, wb), "int8 state diverged from int16"
+    for k in info16:
+        assert jnp.array_equal(info16[k], info8[k]), f"info {k} diverged"
+
+
+def test_narrow_int8_validation():
+    import dataclasses
+
+    base = scale_sim_config(32, m_slots=8)
+    with pytest.raises(ValueError, match="tier of narrow_dtypes"):
+        dataclasses.replace(base, narrow_dtypes=False,
+                            narrow_int8=True).validate()
+    with pytest.raises(ValueError, match="int8 range"):
+        dataclasses.replace(base, narrow_dtypes=True, narrow_int8=True,
+                            max_transmissions=200).validate()
+    # the dtype-flow registry guards the shrunk leaf at 8 bits
+    from corrosion_tpu.analysis.dtypes import NARROW_LEAVES, NARROW_REFS
+
+    assert NARROW_LEAVES["mem_tx"] == 8 and NARROW_REFS["o_tx"] == 8
+
+
+def test_narrow_int8_fused_matches_unfused():
+    """The pallas swim kernel under the int8 budget plane (widen on
+    load, cast back at the out-ref store) — the probe cache keys the
+    int8 dtype set separately (``tx8``), so the probed kernel is the
+    dispatched kernel."""
+    import dataclasses
+
+    _, i8, net, inp = _int8_write_rig(n_nodes=32, rounds=24)
+    fused = dataclasses.replace(i8, fused="interpret").validate()
+    unfused = dataclasses.replace(i8, fused="off").validate()
+    st_f, info_f = run(fused, ScaleSimState.create(fused), net,
+                       jr.key(42), inp)
+    st_u, info_u = run(unfused, ScaleSimState.create(unfused), net,
+                       jr.key(42), inp)
+    assert st_f.swim.mem_tx.dtype == jnp.int8
+    for a, b in zip(jax.tree.leaves(st_f), jax.tree.leaves(st_u)):
+        assert jnp.array_equal(a, b), "fused int8 state diverged"
+    for k in info_f:
+        assert jnp.array_equal(info_f[k], info_u[k]), f"info {k} diverged"
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+def test_narrow_int8_sharded_matches_single_device():
+    """The int8 plane through the REAL donated mesh entry point
+    (``sharded_scale_run``): bitwise == single device, carry donated,
+    mem_tx still int8 on the way out."""
+    from corrosion_tpu.parallel.mesh import make_mesh, shard_state, sharded_scale_run
+
+    _, i8, net, inp = _int8_write_rig(n_nodes=48, rounds=16)
+    st = ScaleSimState.create(i8)
+    key = jr.key(43)
+    ref, ref_infos = jax.jit(
+        lambda s, k, i: scale_run_rounds(i8, s, net, k, i)
+    )(st, key, inp)
+    jax.block_until_ready(ref)
+
+    mesh = make_mesh(jax.devices()[:8])
+    st_s = shard_state(mesh, i8.n_nodes, st)
+    net_s = shard_state(mesh, i8.n_nodes, net)
+    in_s = shard_state(mesh, i8.n_nodes, inp)
+    probe = st_s
+    out, infos = sharded_scale_run(i8, mesh, st_s, net_s, key, in_s)
+    jax.block_until_ready(out)
+
+    assert any(leaf.is_deleted() for leaf in jax.tree.leaves(probe))
+    assert out.swim.mem_tx.dtype == jnp.int8
+    for a, b in zip(jax.tree.leaves(ref), jax.tree.leaves(out)):
+        assert jnp.array_equal(a, b), "sharded int8 state diverged"
+    for k in ref_infos:
+        assert jnp.array_equal(ref_infos[k], infos[k]), k
