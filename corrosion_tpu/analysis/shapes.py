@@ -41,9 +41,10 @@ classification is shared with the runtime audit through
 
 Like the rest of the analysis package this module never imports jax:
 the interpreter runs on ASTs and arithmetic only, which is also why it
-can project past runtime walls (``ScaleConfig.validate`` refuses
-N > 2^19 until the sender-election packing is widened — the budget
-gate prices N=1M anyway).
+can project past runtime walls (the sender-election packing is now
+adaptive-width, so ``ScaleConfig.validate`` admits the 1M flagship
+point and only refuses N > 2^30; the budget gate prices N=1M either
+way).
 """
 
 from __future__ import annotations

@@ -132,6 +132,17 @@ def _write_bytes(path: str, data: bytes) -> None:
         f.write(data)
 
 
+def _publish_manifest(tmp: str, final: str) -> None:
+    """The commit point: a checkpoint exists iff this rename lands.
+
+    A module seam (like ``_write_bytes``) so crash injection — the
+    resilience tests and the corrochaos engine
+    (``resilience/chaos.py``) — can kill a save exactly between the
+    state-file writes and the manifest publish, the mid-segment
+    preemption window the crash-consistent ordering exists for."""
+    os.replace(tmp, final)
+
+
 def _shard_filename(ordinal: int) -> str:
     return f"shard-{ordinal:05d}.npz"
 
@@ -297,7 +308,7 @@ def save_checkpoint(agent, db=None, path: str = "./checkpoint",
     tmp = manifest_path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(manifest, f)
-    os.replace(tmp, manifest_path)
+    _publish_manifest(tmp, manifest_path)
     return path
 
 

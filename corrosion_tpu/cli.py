@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -545,6 +546,80 @@ def cmd_lint(args) -> int:
     return lint_main(argv)
 
 
+def cmd_chaos(args) -> int:
+    """corrochaos: run seeded fault scenarios through the segmented
+    soak pipeline and oracle-check them (docs/chaos.md). Any scenario
+    is reproducible from ``(name, seed)`` alone — the verdict carries
+    the trace digest that pins it. Under ``CORROSAN=1`` the whole run
+    rides inside a sanitized window (races/leaks in the pipeline's
+    threads fail the command)."""
+    from corrosion_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+    from corrosion_tpu.resilience.chaos import (
+        SCENARIOS,
+        TIER1_SCENARIOS,
+        run_sweep,
+    )
+
+    if args.list:
+        for name, script in sorted(SCENARIOS.items()):
+            tier = " [tier1]" if name in TIER1_SCENARIOS else ""
+            print(f"{name}{tier}: {len(script.phases)} phases, "
+                  f"{script.total_rounds} rounds, "
+                  f"{len(script.injections)} injection(s)")
+        return 0
+    if args.scenario:
+        names = list(args.scenario)
+    elif args.tier1:
+        names = list(TIER1_SCENARIOS)
+    else:
+        names = sorted(SCENARIOS)
+    corrosan = os.environ.get("CORROSAN") == "1"
+    if corrosan:
+        from corrosion_tpu.analysis.sanitizer import sanitized
+
+        with sanitized() as san:
+            out = run_sweep(names, seed=args.seed)
+        findings = san.gate()
+        if findings:
+            out["ok"] = False
+            out.setdefault("problems", []).extend(
+                f"corrosan: {f.kind} {f.subject}" for f in findings
+            )
+    else:
+        out = run_sweep(names, seed=args.seed)
+    out["corrosan"] = corrosan
+    if args.output_json:
+        os.makedirs(os.path.dirname(os.path.abspath(args.output_json)),
+                    exist_ok=True)
+        with open(args.output_json, "w") as f:
+            json.dump(out, f, indent=2)
+    if args.convergence_json:
+        # the rounds-to-convergence lineage artifact (supersedes the
+        # seed-era single-scenario CONVERGENCE records): one entry per
+        # scripted scenario, through the chaos engine's oracle-1 path
+        conv = [
+            {
+                "scenario": r["name"],
+                "seed": r["seed"],
+                "n": r["n_nodes"],
+                "faults": True,
+                "rounds_to_convergence": r.get("rounds_to_convergence", -1),
+                "converged": bool(r.get("converged")),
+                "platform": out["platform"],
+            }
+            for r in out["scenarios"] if not r.get("skipped")
+        ]
+        os.makedirs(
+            os.path.dirname(os.path.abspath(args.convergence_json)),
+            exist_ok=True)
+        with open(args.convergence_json, "w") as f:
+            json.dump(conv, f, indent=1)
+    print(json.dumps(out, indent=2))
+    return 0 if out["ok"] else 1
+
+
 def cmd_san(args) -> int:
     """corrosan fixture replay (same engine as
     ``python -m corrosion_tpu.analysis.sanitizer``): seeded
@@ -752,6 +827,30 @@ def build_parser() -> argparse.ArgumentParser:
                      help="write the fixtures section of the corrosan "
                           "report artifact")
     san.set_defaults(fn=cmd_san)
+
+    ch = sub.add_parser(
+        "chaos",
+        help="corrochaos: run deterministic seeded fault scenarios "
+             "through the segmented soak pipeline, double-oracle-"
+             "checked (docs/chaos.md)")
+    ch.add_argument("scenario", nargs="*", default=None,
+                    help="scenario name(s) to run (default: the full "
+                         "sweep; see --list)")
+    ch.add_argument("--seed", type=int, default=0,
+                    help="scenario seed — (name, seed) fully determines "
+                         "the trace and the verdict")
+    ch.add_argument("--tier1", action="store_true",
+                    help="run only the tier-1 smoke subset")
+    ch.add_argument("--list", action="store_true",
+                    help="list the shipped scenarios and exit")
+    ch.add_argument("--output-json", metavar="PATH", default=None,
+                    help="write the sweep record (per-scenario verdicts, "
+                         "rounds-to-convergence, checkpoints validated, "
+                         "faults injected)")
+    ch.add_argument("--convergence-json", metavar="PATH", default=None,
+                    help="also write the CONVERGENCE_* lineage artifact "
+                         "derived from the sweep")
+    ch.set_defaults(fn=cmd_chaos)
 
     mr = sub.add_parser(
         "mem-report",

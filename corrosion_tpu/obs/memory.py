@@ -151,8 +151,10 @@ def static_report(cfg, mode: str = "scale",
     inventory (``analysis/shapes.py``) evaluated at the config's
     extents, optionally rebinding N (and M). Same schema as
     :func:`memory_report` plus per-leaf ``symbolic`` shapes — and it
-    never builds an array, so it prices N=1M on a laptop (past the
-    current ``validate()`` 2^19 runtime wall, docs/memory-budget.md)."""
+    never builds an array, so it prices N=1M on a laptop without
+    paying for one (the old 2^19 ``validate()`` wall is gone — the
+    sender election packs adaptive-width priorities now; the remaining
+    ceiling is 2^30, docs/memory-budget.md)."""
     from corrosion_tpu.analysis import shapes
 
     inv = shapes.static_inventory(cfg, mode=mode)

@@ -19,7 +19,15 @@ preemption lost the whole run. This package closes that gap:
 - :mod:`retention` — keep-last-K pruning plus an atomic ``LATEST``
   pointer naming the newest committed checkpoint;
 - :mod:`supervisor` — deadline-and-retry watchdog around device
-  dispatch, built on :class:`corrosion_tpu.utils.backoff.Backoff`.
+  dispatch, built on :class:`corrosion_tpu.utils.backoff.Backoff`;
+- :mod:`chaos` — corrochaos: deterministic seeded fault scenarios
+  (partitions, clock skew, rejoin refutation, mid-commit crashes,
+  checkpoint corruption, mesh changes, fused flips) driven through the
+  real segmented pipeline and double-oracle-checked (docs/chaos.md).
+
+``chaos`` is imported lazily (not re-exported here): it pulls the whole
+sim plane in, and the package's other consumers (agent boot, HTTP
+health) must stay import-light.
 """
 
 from corrosion_tpu.resilience.async_ckpt import (  # noqa: F401
@@ -34,6 +42,7 @@ from corrosion_tpu.resilience.retention import (  # noqa: F401
 )
 from corrosion_tpu.resilience.segments import (  # noqa: F401
     SoakResult,
+    restore_soak_carry,
     resume_segmented,
     run_segmented,
 )

@@ -1,0 +1,799 @@
+"""corrochaos: deterministic seeded fault scenarios over the segmented
+soak pipeline (docs/chaos.md).
+
+The reference survives production because Fly.io hammers Corrosion with
+Antithesis-style fault workloads (PAPER.md: SWIM refutation,
+anti-entropy after partitions, ``configurable_stress_test``). This
+module is that discipline for the repro: **composable fault scenarios
+expressed as data**, compiled into traced fault inputs for the sim
+plane (``sim/scenario.compile_scale_phase``) and scripted host-plane
+injections for the pipeline plane, driven through the REAL segmented
+soak runner + Supervisor + AsyncCheckpointWriter, and oracle-checked.
+
+Every scenario is a pure function of ``(name, seed)``: same seed, same
+compiled trace, same injection schedule, same verdict — the
+``trace_digest`` in the verdict pins it. Two oracles gate every run:
+
+1. **convergence** — after the scripted fault phases the cluster must
+   reach the converged fixpoint (``scale_crdt_metrics``: no needs,
+   equal heads, equal stores over alive nodes) within the script's
+   settle budget; and the chaos leg's post-script state must be
+   BITWISE identical to an uninterrupted straight-scan reference of
+   the same trace (preemptions, corrupt-checkpoint fallbacks, mesh
+   changes and fused flips are execution noise, never semantics).
+2. **checkpoint lineage** — every manifest the scenario left behind
+   must either refuse to load loudly (a fault the scenario itself
+   injected) or restore to a state that, replaying the remaining
+   scripted rounds, lands bitwise on the SAME fixpoint as the
+   uninterrupted run: no checkpoint ever restores diverged state.
+
+Host-plane injections (``Injection.kind``):
+
+- ``crash_slice`` / ``crash_manifest`` — kill a save mid-write /
+  between state-file write and manifest publish (the
+  ``checkpoint._write_bytes`` / ``checkpoint._publish_manifest``
+  seams); the soak crashes and must resume from the previous committed
+  segment.
+- ``preempt`` — drop the live carry at a phase boundary and resume
+  from the newest valid checkpoint.
+- ``corrupt_checkpoint`` — flip bytes in the newest checkpoint's first
+  state file; the hash gate must refuse it and recovery must fall back
+  to the previous segment.
+- ``remesh`` — resume the checkpoint onto a DIFFERENT device mesh
+  (e.g. 8 -> 4 chips, the PR-8 elastic-restore surface) mid-scenario.
+- ``fused_flip`` — resume under a different ``config.perf.fused``
+  execution mode (the PR-9 cross-mode surface).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import shutil
+import tempfile
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+import numpy as np
+
+from corrosion_tpu.checkpoint import (
+    CheckpointIntegrityError,
+    load_checkpoint,
+)
+from corrosion_tpu.resilience.retention import latest_valid_checkpoint
+from corrosion_tpu.resilience.segments import (
+    _key_from_json,
+    _n_rounds,
+    _slice_inputs,
+    restore_soak_carry,
+    run_segmented,
+)
+from corrosion_tpu.resilience.supervisor import Supervisor
+from corrosion_tpu.sim.scenario import FaultPhase, compile_scale_phase
+from corrosion_tpu.utils.tracing import logger
+
+INJECTION_KINDS = (
+    "preempt",
+    "crash_slice",
+    "crash_manifest",
+    "corrupt_checkpoint",
+    "remesh",
+    "fused_flip",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Injection:
+    """One host-plane fault. ``crash_*`` kinds arm a seam DURING phase
+    ``phase`` (the checkpoint at that phase's final segment dies
+    mid-commit); the other kinds apply at the boundary AFTER phase
+    ``phase`` completes."""
+
+    kind: str
+    phase: int
+    mesh_devices: int = 0  # remesh target (0 = single device)
+    fused: str = ""  # fused_flip target execution mode
+
+    def validate(self) -> "Injection":
+        if self.kind not in INJECTION_KINDS:
+            raise ValueError(
+                f"injection kind {self.kind!r} not in {INJECTION_KINDS}"
+            )
+        if self.phase < 0:
+            raise ValueError(f"injection phase {self.phase} < 0")
+        if self.kind == "fused_flip" and not self.fused:
+            raise ValueError("fused_flip needs a target fused mode")
+        return self
+
+
+@dataclasses.dataclass(frozen=True)
+class ScenarioScript:
+    """A whole scenario: device-plane fault phases + host-plane
+    injections + the oracle budgets. Everything here is data — the
+    verdict is a pure function of ``(script, seed)``."""
+
+    name: str
+    phases: Tuple[FaultPhase, ...]
+    injections: Tuple[Injection, ...] = ()
+    n_nodes: int = 24
+    segment_rounds: int = 4
+    settle_budget: int = 256  # quiet rounds allowed to reach the fixpoint
+    keep_last: int = 64  # retention wide enough for the lineage oracle
+    mesh_devices: int = 0  # initial mesh (0 = single device)
+    fused: str = "auto"  # initial execution mode
+    # minimum per-info-key sums the chaos leg must report (e.g. the
+    # clock-skew script must actually trip the drift gate)
+    expect_info: Tuple[Tuple[str, int], ...] = ()
+
+    def validate(self) -> "ScenarioScript":
+        if not self.phases:
+            raise ValueError(f"scenario {self.name!r} has no phases")
+        for ph in self.phases:
+            ph.validate()
+        for inj in self.injections:
+            inj.validate()
+            if inj.phase >= len(self.phases):
+                raise ValueError(
+                    f"injection {inj.kind!r} targets phase {inj.phase} "
+                    f"but the script has {len(self.phases)}"
+                )
+        if self.segment_rounds <= 0 or self.settle_budget <= 0:
+            raise ValueError("segment_rounds/settle_budget must be positive")
+        return self
+
+    @property
+    def total_rounds(self) -> int:
+        return sum(ph.rounds for ph in self.phases)
+
+
+def scenario_config(script: ScenarioScript):
+    """The scenario's sim config: the SAME small-N shapes as
+    ``tests/test_resilience.scale_cfg`` (24 nodes, 8 slots, 4x2 grid,
+    sync every 4) so chaos programs share the persistent compile cache
+    with the resilience suite, plus the script's execution mode."""
+    from corrosion_tpu.sim.scale_step import scale_sim_config
+
+    return scale_sim_config(
+        script.n_nodes, m_slots=8, n_origins=4, n_rows=4, n_cols=2,
+        sync_interval=4, fused=script.fused,
+    )
+
+
+class PhaseTrace(NamedTuple):
+    """One compiled phase: absolute round window + traced inputs."""
+
+    start: int  # absolute first round of the phase
+    rounds: int
+    inputs: object  # stacked ScaleRoundInput, host-resident
+    net: object  # the phase's constant NetModel
+    skew: np.ndarray  # int32 [N] HLC units added at phase entry
+
+
+def compile_scenario(script: ScenarioScript, seed: int):
+    """-> (cfg, [PhaseTrace], trace_digest). Deterministic in
+    ``(script, seed)``: the digest hashes the script declaration plus
+    every compiled input/net/skew array byte-for-byte."""
+    script.validate()
+    cfg = scenario_config(script)
+    root_key = jr.key(seed)
+    h = hashlib.sha256(f"{script.name}:{seed}".encode())
+    h.update(json.dumps(dataclasses.asdict(script), sort_keys=True).encode())
+    traces, dead, start = [], None, 0
+    for i, ph in enumerate(script.phases):
+        inputs, net, skew, dead = compile_scale_phase(
+            cfg, ph, jr.fold_in(root_key, i), dead
+        )
+        for leaf in jax.tree.leaves(inputs) + jax.tree.leaves(net):
+            h.update(np.asarray(leaf).tobytes())
+        h.update(skew.tobytes())
+        traces.append(PhaseTrace(start, ph.rounds, inputs, net, skew))
+        start += ph.rounds
+    return cfg, traces, h.hexdigest()
+
+
+class _StraightRunner:
+    """Jitted straight-scan dispatch with the net as a traced argument:
+    ONE compile per segment length serves every phase, every lineage
+    replay and the settle loop, whatever the round's network shape."""
+
+    def __init__(self, cfg):
+        from corrosion_tpu.sim.scale_step import scale_run_rounds_carry
+
+        self._cfg = cfg
+        self._run = scale_run_rounds_carry
+        self._fns: dict = {}
+
+    def __call__(self, st, key, net, inputs):
+        n = _n_rounds(inputs)
+        if n not in self._fns:
+            cfg, run = self._cfg, self._run
+            self._fns[n] = jax.jit(
+                lambda s, k, nt, i: run(cfg, s, nt, k, i)
+            )
+        (st, key), infos = self._fns[n](st, key, net, inputs)
+        return st, key, infos
+
+
+def _apply_skew(st, skew: np.ndarray, mesh, n_nodes: int):
+    """Host-inject clock skew: bump the skewed nodes' HLCs by the
+    pre-shifted amount (the scenario's analog of a wall clock running
+    ahead; ``hlc_fold``'s max-drift gate is what it sweeps against)."""
+    if not skew.any():
+        return st
+    bump = jnp.asarray(skew)
+    if mesh is not None:
+        from corrosion_tpu.parallel.mesh import shard_state
+
+        bump = shard_state(mesh, n_nodes, bump)
+    return st._replace(crdt=st.crdt._replace(hlc=st.crdt.hlc + bump))
+
+
+def _phase_at(traces, pos: int) -> int:
+    """Index of the phase whose round window contains ``pos``."""
+    for i, tr in enumerate(traces):
+        if tr.start <= pos < tr.start + tr.rounds:
+            return i
+    raise ValueError(f"round {pos} outside the scripted trace")
+
+
+class _CrashSeam:
+    """Arm one of the checkpoint crash seams against a specific segment
+    directory; ``restore()`` always puts the real function back (the
+    async writer is joined before run_segmented returns, so no write
+    can race the restore)."""
+
+    def __init__(self, kind: str, target_round: int):
+        import corrosion_tpu.checkpoint as ckpt_mod
+
+        self._mod = ckpt_mod
+        target = f"seg-{target_round:08d}"
+        if kind == "crash_manifest":
+            self._attr, real = "_publish_manifest", ckpt_mod._publish_manifest
+
+            def patched(tmp, final, _real=real):
+                if target in final:
+                    raise OSError(
+                        f"corrochaos: killed between state write and "
+                        f"manifest publish of {target}"
+                    )
+                return _real(tmp, final)
+        else:  # crash_slice
+            self._attr, real = "_write_bytes", ckpt_mod._write_bytes
+
+            def patched(path, data, _real=real):
+                if target in path and "shard-00000" in path:
+                    raise OSError(
+                        f"corrochaos: killed writing a state slice of "
+                        f"{target}"
+                    )
+                return _real(path, data)
+
+        self._real = real
+        setattr(ckpt_mod, self._attr, patched)
+
+    def restore(self) -> None:
+        setattr(self._mod, self._attr, self._real)
+
+
+def corrupt_checkpoint(path: str) -> str:
+    """Flip a byte mid-way through the first state file the manifest
+    records (the engine twin of ``tests/test_resilience.state_file``):
+    the SHA-256 gate must refuse the directory on load."""
+    with open(os.path.join(path, "manifest.json")) as f:
+        files = sorted(json.load(f)["files"])
+    if not files:
+        raise ValueError(f"checkpoint {path} records no state files")
+    fp = os.path.join(path, files[0])
+    with open(fp, "rb") as f:
+        data = bytearray(f.read())
+    data[len(data) // 2] ^= 0xFF
+    with open(fp, "wb") as f:
+        f.write(bytes(data))
+    return fp
+
+
+def _make_mesh_or_skip(devices: int):
+    """-> (mesh, skip_reason). A scenario that needs more devices than
+    the process has is SKIPPED (reported, not failed) — check.sh and
+    the test harness both force 8 virtual devices, so the remesh
+    scripts always run there."""
+    if devices <= 0:
+        return None, None
+    have = jax.devices()
+    if len(have) < devices:
+        return None, (
+            f"needs {devices} devices, only {len(have)} available"
+        )
+    from corrosion_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(have[:devices]), None
+
+
+def _place(mesh, n_nodes, *trees):
+    if mesh is None:
+        return trees if len(trees) > 1 else trees[0]
+    from corrosion_tpu.parallel.mesh import shard_state
+
+    placed = tuple(shard_state(mesh, n_nodes, t) for t in trees)
+    return placed if len(placed) > 1 else placed[0]
+
+
+def _resume_point(cfg, root: str, mesh):
+    """The engine's restore path: the SAME gates a production resume
+    runs (:func:`segments.restore_soak_carry` — newest VALID
+    checkpoint, mode + config-identity drift refused, soak carry
+    required), so the scenarios validate the restore path real soaks
+    use, not a private re-implementation of it.
+    -> (state, key, completed_rounds, path)."""
+    return restore_soak_carry(cfg, root, mode="scale", mesh=mesh)
+
+
+def _injected_crash(exc) -> bool:
+    """True iff the exception chain carries a seam-injected kill (the
+    ``corrochaos:`` marker the :class:`_CrashSeam` patches raise with).
+    A genuine pipeline failure during an armed phase — e.g. a real
+    disk-full ``OSError`` surfacing through the async writer's
+    ``RuntimeError`` wrapper — must NOT be attributed to the scripted
+    fault and silently recovered from."""
+    seen: set = set()
+    while exc is not None and id(exc) not in seen:
+        seen.add(id(exc))
+        if "corrochaos:" in str(exc):
+            return True
+        exc = exc.__cause__ or exc.__context__
+    return False
+
+
+def _host_state(st):
+    """Owned host copies of a (possibly sharded) small-N scenario state
+    — the oracle comparisons and the settle loop run single-device.
+    Deliberate whole-state drain: chaos scenarios are 24-node rigs."""
+    leaves, treedef = jax.tree.flatten(st)
+    return treedef, [np.asarray(x) for x in leaves]
+
+
+def _run_chaos_leg(cfg, script, traces, key0, root, rec, problems):
+    """Drive the scripted trace through the REAL segmented pipeline,
+    applying the host-plane injections. Returns (state, key) after the
+    final scripted round (possibly mesh-placed / under a flipped
+    execution config)."""
+    from corrosion_tpu.ops import megakernel
+    from corrosion_tpu.sim.scale_step import ScaleSimState
+
+    mesh, skip = _make_mesh_or_skip(script.mesh_devices)
+    if skip:
+        return None, None, skip
+    crash_by_phase = {
+        inj.phase: inj for inj in script.injections
+        if inj.kind in ("crash_slice", "crash_manifest")
+    }
+    boundary: dict = {}
+    for inj in script.injections:
+        if inj.kind not in ("crash_slice", "crash_manifest"):
+            boundary.setdefault(inj.phase, []).append(inj)
+    applied: set = set()
+
+    run_cfg = cfg
+    st = _place(mesh, cfg.n_nodes, ScaleSimState.create(cfg))
+    key = key0
+    total = script.total_rounds
+    pos = 0
+    info_sums: dict = {}
+    while pos < total:
+        phase_idx = _phase_at(traces, pos)
+        tr = traces[phase_idx]
+        if pos == tr.start:
+            st = _apply_skew(st, tr.skew, mesh, cfg.n_nodes)
+        inputs = _slice_inputs(tr.inputs, pos - tr.start, tr.rounds)
+        net, inputs = _place(mesh, cfg.n_nodes, tr.net, inputs)
+        crash = crash_by_phase.get(phase_idx)
+        seam = None
+        if crash is not None and id(crash) not in applied:
+            seam = _CrashSeam(crash.kind, tr.start + tr.rounds)
+        try:
+            res = run_segmented(
+                run_cfg, st, net, key, inputs, script.segment_rounds,
+                mode="scale", checkpoint_root=root,
+                keep_last=script.keep_last, supervisor=Supervisor(),
+                start_round=pos,
+            )
+        except RuntimeError as e:
+            if seam is None or not _injected_crash(e):
+                raise
+            # the injected mid-commit kill: the run died with the
+            # target segment's checkpoint uncommitted — recover the
+            # way a preempted soak does
+            applied.add(id(crash))
+            rec["faults_injected"] += 1
+            seam.restore()
+            seam = None
+            st, key, pos, path = _resume_point(run_cfg, root, mesh)
+            rec["resumes"] += 1
+            logger.info("chaos %s: crashed save recovered from %s",
+                        script.name, path)
+            continue
+        finally:
+            if seam is not None:
+                seam.restore()
+        if seam is not None and id(crash) not in applied:
+            problems.append(
+                f"{crash.kind} armed for phase {phase_idx} never fired"
+            )
+        st, key = res.state, res.key
+        pos = res.completed_rounds
+        if res.aborted:
+            problems.append(f"soak aborted at round {pos}")
+            break
+        for k, v in res.infos.items():
+            info_sums[k] = info_sums.get(k, 0) + int(np.asarray(v).sum())
+        if pos != tr.start + tr.rounds:
+            continue
+        for inj in boundary.get(phase_idx, []):
+            if id(inj) in applied:
+                continue
+            applied.add(id(inj))
+            rec["faults_injected"] += 1
+            if inj.kind == "corrupt_checkpoint":
+                newest = latest_valid_checkpoint(root)
+                corrupt_checkpoint(newest)
+                rec["corrupted"].append(os.path.basename(newest))
+                try:
+                    load_checkpoint(newest, verify=True)
+                    problems.append(
+                        f"corruption of {newest} was NOT detected"
+                    )
+                except CheckpointIntegrityError:
+                    rec["corruptions_detected"] += 1
+                st, key, pos, path = _resume_point(run_cfg, root, mesh)
+                rec["resumes"] += 1
+                if path == newest:
+                    problems.append(
+                        "recovery resumed from the corrupted checkpoint"
+                    )
+            elif inj.kind == "preempt":
+                st, key, pos, _ = _resume_point(run_cfg, root, mesh)
+                rec["resumes"] += 1
+            elif inj.kind == "remesh":
+                mesh, skip = _make_mesh_or_skip(inj.mesh_devices)
+                if skip:
+                    return None, None, skip
+                st, key, pos, _ = _resume_point(run_cfg, root, mesh)
+                rec["resumes"] += 1
+                rec["remeshes"] += 1
+            elif inj.kind == "fused_flip":
+                run_cfg = dataclasses.replace(
+                    cfg, fused=inj.fused).validate()
+                megakernel.prime_fused(run_cfg)
+                st, key, pos, _ = _resume_point(run_cfg, root, mesh)
+                rec["resumes"] += 1
+                rec["fused_flips"].append(inj.fused)
+    rec["info_sums"] = {k: info_sums[k] for k in sorted(info_sums)}
+    for inj in script.injections:
+        if id(inj) not in applied:
+            problems.append(
+                f"injection {inj.kind!r} at phase {inj.phase} never applied"
+            )
+    return st, key, None
+
+
+def _settle(cfg, st, key, runner, budget: int, chunk: int = 8):
+    """Quiet, healed rounds until the convergence predicate holds.
+    -> (rounds_taken or -1, converged)."""
+    from corrosion_tpu.sim.scale_step import (
+        ScaleRoundInput,
+        scale_crdt_metrics,
+    )
+    from corrosion_tpu.sim.transport import NetModel
+
+    net = NetModel.create(cfg.n_nodes)
+    quiet = jax.tree.map(
+        lambda a: jnp.broadcast_to(a, (chunk,) + a.shape),
+        ScaleRoundInput.quiet(cfg),
+    )
+    converged_now = jax.jit(
+        lambda s: scale_crdt_metrics(cfg, s)["converged"]
+    )
+    taken = 0
+    if bool(converged_now(st)):
+        return 0, True
+    while taken < budget:
+        st, key, _ = runner(st, key, net, quiet)
+        taken += chunk
+        if bool(converged_now(st)):
+            return taken, True
+    return -1, False
+
+
+def _validate_lineage(cfg, script, traces, root, ref_leaves, runner, rec,
+                      problems):
+    """Oracle 2: every manifest left behind restores + replays to the
+    uninterrupted fixpoint, or refuses loudly."""
+    total = script.total_rounds
+    for name in sorted(os.listdir(root)):
+        if not name.startswith("seg-"):
+            continue
+        path = os.path.join(root, name)
+        try:
+            manifest, state = load_checkpoint(path, verify=True)
+        except (CheckpointIntegrityError, ValueError) as e:
+            rec["checkpoints_refused"] += 1
+            if name not in rec["corrupted"]:
+                problems.append(
+                    f"lineage: {name} refused outside an injected "
+                    f"corruption: {e}"
+                )
+            continue
+        soak = (manifest.get("extra") or {}).get("soak") or {}
+        if "completed_rounds" not in soak:
+            problems.append(f"lineage: {name} has no soak carry")
+            continue
+        pos = int(soak["completed_rounds"])
+        key = _key_from_json(soak["key"])
+        st = state
+        while pos < total:
+            tr = traces[_phase_at(traces, pos)]
+            if pos == tr.start:
+                st = _apply_skew(st, tr.skew, None, cfg.n_nodes)
+            inputs = _slice_inputs(tr.inputs, pos - tr.start, tr.rounds)
+            st, key, _ = runner(st, key, tr.net, inputs)
+            pos = tr.start + tr.rounds
+        for i, (got, want) in enumerate(
+                zip(jax.tree.leaves(st), ref_leaves)):
+            if not np.array_equal(np.asarray(got), want):
+                problems.append(
+                    f"lineage: {name} replays to a DIVERGED state "
+                    f"(leaf {i})"
+                )
+                break
+        else:
+            rec["checkpoints_validated"] += 1
+    if rec["checkpoints_validated"] == 0:
+        problems.append("lineage: no checkpoint survived to validate")
+
+
+def run_scenario(script: ScenarioScript, seed: int = 0,
+                 workdir: Optional[str] = None,
+                 keep_workdir: bool = False) -> dict:
+    """Run one scenario end to end; -> the verdict record
+    (deterministic in ``(script, seed)``; see module docstring for the
+    oracle definitions)."""
+    from corrosion_tpu.ops import megakernel
+
+    cfg, traces, digest = compile_scenario(script, seed)
+    root_dir = workdir or tempfile.mkdtemp(prefix=f"chaos-{script.name}-")
+    root = os.path.join(root_dir, "ckpt")
+    rec = {
+        "name": script.name,
+        "seed": int(seed),
+        "n_nodes": cfg.n_nodes,
+        "trace_digest": digest,
+        "rounds_scripted": script.total_rounds,
+        "phases": len(script.phases),
+        "faults_injected": 0,
+        "resumes": 0,
+        "remeshes": 0,
+        "fused_flips": [],
+        "corrupted": [],
+        "corruptions_detected": 0,
+        "checkpoints_validated": 0,
+        "checkpoints_refused": 0,
+    }
+    problems: list = []
+    try:
+        megakernel.prime_fused(cfg)
+        runner = _StraightRunner(cfg)
+        key0 = jr.key(seed + 1)
+
+        # uninterrupted reference: the same compiled trace, straight
+        # through — the fixpoint both oracles are judged against
+        from corrosion_tpu.sim.scale_step import ScaleSimState
+
+        ref_st, ref_key = ScaleSimState.create(cfg), key0
+        for tr in traces:
+            ref_st = _apply_skew(ref_st, tr.skew, None, cfg.n_nodes)
+            ref_st, ref_key, _ = runner(ref_st, ref_key, tr.net, tr.inputs)
+        _, ref_leaves = _host_state(ref_st)
+
+        # chaos leg: same trace through the segmented pipeline + faults
+        st, key, skip = _run_chaos_leg(
+            cfg, script, traces, key0, root, rec, problems)
+        if skip:
+            rec["skipped"] = skip
+            rec["ok"] = True
+            return rec
+
+        treedef, chaos_leaves = _host_state(st)
+        mismatch = [
+            i for i, (a, b) in enumerate(zip(chaos_leaves, ref_leaves))
+            if not np.array_equal(a, b)
+        ]
+        rec["bitwise_match"] = not mismatch
+        if mismatch:
+            problems.append(
+                f"chaos leg diverged from the uninterrupted reference "
+                f"at leaves {mismatch[:4]}"
+            )
+
+        for k, want in script.expect_info:
+            got = rec.get("info_sums", {}).get(k, 0)
+            rec[f"observed_{k}"] = got
+            if got < want:
+                problems.append(
+                    f"expected info {k} >= {want}, observed {got}"
+                )
+
+        # oracle 1: settle the chaos state to the converged fixpoint
+        st_host = jax.tree.unflatten(
+            treedef, [jnp.asarray(x) for x in chaos_leaves])
+        settle_rounds, converged = _settle(
+            cfg, st_host, key, runner, script.settle_budget)
+        rec["converged"] = converged
+        rec["rounds_to_convergence"] = (
+            script.total_rounds + settle_rounds if converged else -1
+        )
+        if not converged:
+            problems.append(
+                f"did not converge within {script.settle_budget} settle "
+                f"rounds"
+            )
+
+        # oracle 2: the checkpoint lineage
+        _validate_lineage(cfg, script, traces, root, ref_leaves, runner,
+                          rec, problems)
+    except Exception as e:
+        # a broken scenario (e.g. a user script whose injected crash
+        # kills the FIRST ever save, leaving nothing to resume from)
+        # fails ITS verdict — it must never take the rest of a sweep
+        # down with it
+        logger.exception("chaos %s: engine error", script.name)
+        problems.append(f"engine error: {e!r}")
+    finally:
+        if workdir is None and not keep_workdir:
+            shutil.rmtree(root_dir, ignore_errors=True)
+    rec["ok"] = not problems
+    if problems:
+        rec["problems"] = problems
+    return rec
+
+
+# --- the shipped scenario registry ---------------------------------------
+# Names are load-bearing: docs/chaos.md documents every entry (pinned by
+# the tests/test_chaos.py meta-test) and `corrosion-tpu chaos` runs them
+# by (name, seed).
+
+SCENARIOS = {
+    s.name: s.validate()
+    for s in (
+        # asymmetric partition that heals mid-sync: both islands keep
+        # writing under loss, then the heal phase lets anti-entropy
+        # repair the divergence
+        ScenarioScript(
+            name="partition-heal",
+            phases=(
+                FaultPhase(rounds=8, write_frac=0.3, partition_groups=2,
+                           drop_prob=0.02),
+                FaultPhase(rounds=8, write_frac=0.2),
+                FaultPhase(rounds=8),
+            ),
+            expect_info=(("syncs", 1),),
+        ),
+        # clock skew swept against the HLC max-drift gate: first under
+        # it (folds cleanly), then far past it (receivers must REJECT
+        # the stamps — and anti-entropy still converges the data)
+        ScenarioScript(
+            name="clock-skew",
+            phases=(
+                FaultPhase(rounds=8, write_frac=0.3, clock_skew_rounds=1,
+                           clock_skew_frac=0.3),
+                FaultPhase(rounds=8, write_frac=0.3, clock_skew_rounds=12,
+                           clock_skew_frac=0.3),
+                FaultPhase(rounds=8),
+            ),
+            expect_info=(("clock_drift_rejects", 1),),
+        ),
+        # node state-loss-and-rejoin: a quarter of the non-seed nodes
+        # die (suspicion -> Down), then rejoin with bumped incarnations
+        # under heavy datagram loss — the refutation machinery must
+        # overturn the stale Down beliefs
+        ScenarioScript(
+            name="rejoin-refutation",
+            phases=(
+                FaultPhase(rounds=8, write_frac=0.3, kill_frac=0.25,
+                           drop_prob=0.15),
+                FaultPhase(rounds=8, write_frac=0.2, revive_killed=True,
+                           drop_prob=0.15),
+                FaultPhase(rounds=8),
+            ),
+            expect_info=(("refutes", 1), ("failed_probes", 1)),
+        ),
+        # mid-segment preemption, both crash windows: a state-slice
+        # write dies mid-file, and a later save is killed BETWEEN the
+        # state write and the manifest publish — each time the soak
+        # must resume from the previous committed segment
+        ScenarioScript(
+            name="preempt-mid-segment",
+            phases=(
+                FaultPhase(rounds=8, write_frac=0.3),
+                FaultPhase(rounds=8, write_frac=0.2),
+                FaultPhase(rounds=4),
+            ),
+            injections=(
+                Injection(kind="crash_slice", phase=0),
+                Injection(kind="crash_manifest", phase=1),
+            ),
+        ),
+        # checkpoint corruption on restore: flip bytes in the newest
+        # committed checkpoint, preempt, and recovery must refuse it
+        # (hash gate) and fall back to the previous segment
+        ScenarioScript(
+            name="ckpt-corrupt",
+            phases=(
+                FaultPhase(rounds=8, write_frac=0.3),
+                FaultPhase(rounds=8, write_frac=0.1),
+            ),
+            injections=(
+                Injection(kind="corrupt_checkpoint", phase=0),
+            ),
+        ),
+        # elastic restore onto a DIFFERENT mesh mid-scenario (the PR-8
+        # surface): start sharded over 8 devices, preempt, resume the
+        # same checkpoint lineage on 4
+        ScenarioScript(
+            name="elastic-remesh",
+            phases=(
+                FaultPhase(rounds=8, write_frac=0.3),
+                FaultPhase(rounds=8, write_frac=0.1),
+            ),
+            injections=(
+                Injection(kind="remesh", phase=0, mesh_devices=4),
+            ),
+            mesh_devices=8,
+        ),
+        # fused<->unfused execution-mode flip across a resume (the PR-9
+        # surface): the pallas interpret path writes the checkpoints,
+        # the XLA path resumes them — bitwise, per config_identity
+        ScenarioScript(
+            name="fused-flip",
+            phases=(
+                FaultPhase(rounds=8, write_frac=0.3),
+                FaultPhase(rounds=8, write_frac=0.1),
+            ),
+            injections=(
+                Injection(kind="fused_flip", phase=0, fused="off"),
+            ),
+            fused="interpret",
+        ),
+    )
+}
+
+#: the small-N subset the tier-1 suite replays (and check.sh runs
+#: under CORROSAN=1 — the rest ride the slow sweep + the check.sh
+#: chaos stage). Two scripts, chosen to cover both oracle-stressing
+#: host-plane families (crash windows; corruption fallback) — the
+#: injection-free scripts exercise nothing the engine machinery these
+#: two already drive, so tier-1 buys no coverage by adding them
+TIER1_SCENARIOS = ("preempt-mid-segment", "ckpt-corrupt")
+
+
+def run_sweep(names=None, seed: int = 0) -> dict:
+    """Run a set of scenarios (default: all) and fold the verdicts into
+    one artifact-shaped record."""
+    names = list(names) if names else sorted(SCENARIOS)
+    records = []
+    for name in names:
+        if name not in SCENARIOS:
+            raise ValueError(
+                f"unknown scenario {name!r}; have {sorted(SCENARIOS)}"
+            )
+        records.append(run_scenario(SCENARIOS[name], seed=seed))
+    return {
+        "metric": "chaos_sweep",
+        "seed": int(seed),
+        "platform": jax.devices()[0].platform,
+        "scenarios": records,
+        "ok": all(r["ok"] for r in records),
+    }

@@ -475,6 +475,51 @@ def run_segmented(
     )
 
 
+def restore_soak_carry(cfg, checkpoint_root: str, *,
+                       mode: Optional[str] = None, mesh=None):
+    """Restore the newest valid soak checkpoint under
+    ``checkpoint_root`` without running anything: the restore gate of
+    :func:`resume_segmented`, shared with the corrochaos engine's
+    recovery path (``resilience/chaos.py``) so fault scenarios exercise
+    the SAME gates a production resume runs.
+
+    -> ``(state, key, completed_rounds, path)``. Raises
+    ``FileNotFoundError`` when no restorable checkpoint exists and
+    ``ValueError`` on mode/config drift or a missing soak carry."""
+    mode = mode or _infer_mode(cfg)
+    path = latest_valid_checkpoint(checkpoint_root)
+    if path is None:
+        raise FileNotFoundError(
+            f"no restorable checkpoint under {checkpoint_root!r}"
+        )
+    # latest_valid_checkpoint just ran the full hash pass on this path —
+    # skip re-hashing the state it already proved clean
+    manifest, state = load_checkpoint(path, verify=False, mesh=mesh)
+    if manifest["mode"] != mode:
+        raise ValueError(
+            f"checkpoint mode {manifest['mode']!r} != run mode {mode!r}"
+        )
+    from corrosion_tpu.checkpoint import config_identity
+
+    # identity minus execution-only keys: a soak checkpointed on the
+    # fused path resumes on the XLA path (or interpret mode) bit for
+    # bit — fused parity is pinned — while any SEMANTIC drift still
+    # refuses loudly
+    if config_identity(manifest["sim_config"]) != config_identity(cfg):
+        raise ValueError(
+            "checkpoint sim config differs from the resuming run's — "
+            "resuming would not reproduce the original scan"
+        )
+    soak = (manifest.get("extra") or {}).get("soak")
+    if not soak:
+        raise ValueError(
+            f"checkpoint {path} was not written by the segmented runner "
+            f"(no soak carry in its manifest)"
+        )
+    return (state, _key_from_json(soak["key"]),
+            int(soak["completed_rounds"]), path)
+
+
 def resume_segmented(
     cfg,
     net,
@@ -513,37 +558,8 @@ def resume_segmented(
     and ``ValueError`` on config drift (the checkpoint was written by a
     run with a different sim config)."""
     mode = mode or _infer_mode(cfg)
-    path = latest_valid_checkpoint(checkpoint_root)
-    if path is None:
-        raise FileNotFoundError(
-            f"no restorable checkpoint under {checkpoint_root!r}"
-        )
-    # latest_valid_checkpoint just ran the full hash pass on this path —
-    # skip re-hashing the state it already proved clean
-    manifest, state = load_checkpoint(path, verify=False, mesh=mesh)
-    if manifest["mode"] != mode:
-        raise ValueError(
-            f"checkpoint mode {manifest['mode']!r} != run mode {mode!r}"
-        )
-    from corrosion_tpu.checkpoint import config_identity
-
-    # identity minus execution-only keys: a soak checkpointed on the
-    # fused path resumes on the XLA path (or interpret mode) bit for
-    # bit — fused parity is pinned — while any SEMANTIC drift still
-    # refuses loudly
-    if config_identity(manifest["sim_config"]) != config_identity(cfg):
-        raise ValueError(
-            "checkpoint sim config differs from the resuming run's — "
-            "resuming would not reproduce the original scan"
-        )
-    soak = (manifest.get("extra") or {}).get("soak")
-    if not soak:
-        raise ValueError(
-            f"checkpoint {path} was not written by the segmented runner "
-            f"(no soak carry in its manifest)"
-        )
-    completed = int(soak["completed_rounds"])
-    key = _key_from_json(soak["key"])
+    state, key, completed, path = restore_soak_carry(
+        cfg, checkpoint_root, mode=mode, mesh=mesh)
     rounds = _n_rounds(inputs)
     logger.info("resuming soak from %s at round %d/%d", path, completed,
                 rounds)

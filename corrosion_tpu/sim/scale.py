@@ -123,12 +123,14 @@ class ScaleConfig:
                 f"need m_slots > 0 and n_seeds >= 1, got "
                 f"{self.m_slots}/{self.n_seeds}"
             )
-        # sender-election packs a 12-bit priority above the node id in one
-        # int32 (_one_sender_per_receiver); larger clusters would overflow
-        if self.n_nodes > 1 << 19:
+        # sender-election packs an adaptive-width random priority above
+        # the node id in one int32 (_one_sender_per_receiver /
+        # _election_pri_bits — 12 bits through 2^19 ids, 11 at the 1M
+        # flagship point); past 2^30 ids no priority bit is left
+        if self.n_nodes > 1 << 30:
             raise ValueError(
-                f"n_nodes {self.n_nodes} > 2^19: sender-election packs "
-                f"the node id in one int32 word"
+                f"n_nodes {self.n_nodes} > 2^30: sender-election packs "
+                f"priority + node id in one int32 word"
             )
         if not 0 <= self.pig_members <= self.m_slots:
             raise ValueError(
@@ -259,6 +261,23 @@ def bootstrap_members(st: ScaleSwimState, member_ids,
     return st._replace(mem_id=mem_id, mem_view=mem_view)
 
 
+def _election_pri_bits(n: int) -> int:
+    """Random-priority width of the sender election: 12 bits while the
+    id width leaves room (every n <= 2^19 — bit-for-bit identical to
+    the historical fixed-12-bit packing), narrowing as the id grows so
+    priority + id always fit one non-negative int32. The flagship 1M
+    point (20 id bits) gets 11 priority bits; the packing runs out of
+    room past 2^30 ids (the validate() wall)."""
+    bits = max(1, n - 1).bit_length()
+    pri_bits = min(12, 31 - bits)
+    if pri_bits < 1:
+        raise ValueError(
+            f"sender election has no priority bit left above {bits} id "
+            f"bits (n_nodes {n} > 2^30)"
+        )
+    return pri_bits
+
+
 def _one_sender_per_receiver(n, src_valid, tgt, key):
     """Pick one sender per receiver from competing (sender -> tgt) edges.
 
@@ -266,7 +285,8 @@ def _one_sender_per_receiver(n, src_valid, tgt, key):
     resolves contention; surplus senders' packets drop (the datagram
     channel is lossy anyway). Returns (sender_of [N], has_sender [N])."""
     bits = max(1, n - 1).bit_length()
-    pri = jr.randint(key, (n,), 0, 1 << 12, dtype=jnp.int32)
+    pri = jr.randint(key, (n,), 0, 1 << _election_pri_bits(n),
+                     dtype=jnp.int32)
     packed = jnp.where(
         src_valid, (pri << bits) | jnp.arange(n, dtype=jnp.int32), -1
     )

@@ -192,10 +192,10 @@ class ScaleSimConfig:
                 f"(seq bitmask lives in an int32)"
             )
         # shares the sender-election int32 packing (see ScaleConfig.validate)
-        if self.n_nodes > 1 << 19:
+        if self.n_nodes > 1 << 30:
             raise ValueError(
-                f"n_nodes {self.n_nodes} > 2^19: sender-election packs "
-                f"the node id in one int32 word"
+                f"n_nodes {self.n_nodes} > 2^30: sender-election packs "
+                f"priority + node id in one int32 word"
             )
         if not 0 <= self.pig_members <= self.m_slots:
             raise ValueError(

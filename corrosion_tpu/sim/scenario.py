@@ -1,20 +1,34 @@
 """Workload scenarios — the BASELINE.json benchmark configs as input
-streams.
+streams, plus the corrochaos scale-sim fault compiler.
 
-Each scenario builds a stacked ``RoundInput`` (leading axis = rounds)
-plus a ``NetModel``, mirroring the reference's test drivers: single-writer
-inserts (config 1/3), membership churn (config 2), conflict-heavy
-multi-writer LWW (config 4), and the full mix with partitions (config 5)
-— the same shapes as ``configurable_stress_test``
+Each full-sim scenario builds a stacked ``RoundInput`` (leading axis =
+rounds) plus a ``NetModel``, mirroring the reference's test drivers:
+single-writer inserts (config 1/3), membership churn (config 2),
+conflict-heavy multi-writer LWW (config 4), and the full mix with
+partitions (config 5) — the same shapes as ``configurable_stress_test``
 (``crates/corro-agent/src/agent/tests.rs:286-600``) and the Antithesis
 workload scripts.
+
+The **fault compiler** at the bottom is the scale-sim half of the
+corrochaos engine (``resilience/chaos.py``, docs/chaos.md): a
+:class:`FaultPhase` is a declarative window of the scenario — a
+constant network shape plus seeded workload/churn/clock-skew knobs —
+and :func:`compile_scale_phase` lowers it into the traced fault inputs
+the segmented soak pipeline actually consumes (a stacked
+``ScaleRoundInput``, the phase's ``NetModel``, and a host-injected HLC
+skew vector). Compilation is a pure function of ``(cfg, phase, key,
+dead)``: same seed, same trace — the whole determinism contract of the
+chaos engine rides on it.
 """
 
 from __future__ import annotations
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import jax.random as jr
+import numpy as np
 
 from corrosion_tpu.sim.config import SimConfig
 from corrosion_tpu.sim.step import RoundInput
@@ -89,3 +103,120 @@ def partitioned_net(cfg: SimConfig, groups: int = 2, drop_prob: float = 0.0) -> 
     return NetModel.create(cfg.n_nodes, drop_prob=drop_prob)._replace(
         partition=(jnp.arange(cfg.n_nodes) % groups).astype(jnp.int32),
     )
+
+
+# --- corrochaos: the scale-sim fault compiler (docs/chaos.md) ------------
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPhase:
+    """One declarative window of a chaos scenario (scenario-as-data).
+
+    Device-plane faults (kills, revives, writes) land in the compiled
+    ``ScaleRoundInput`` stack; network faults (partition, loss) shape
+    the phase's constant ``NetModel``; ``clock_skew_*`` compiles to a
+    host-injected HLC bump the engine applies at phase entry — the
+    knob the HLC max-drift gate (``broadcast.hlc_fold``,
+    ``HLC_MAX_DRIFT_ROUNDS``) is swept against. Kills and revives both
+    fire on the phase's FIRST round and are disjoint by construction:
+    revives cover only nodes dead at entry, kills draw from alive
+    non-seed nodes (seeds anchor bootstrap, like the reference's
+    Antithesis driver sparing its bootstrap set)."""
+
+    rounds: int
+    write_frac: float = 0.0  # conflict-heavy writer fraction per round
+    kill_frac: float = 0.0  # one-shot kill draw at phase entry
+    revive_killed: bool = False  # revive every dead node at phase entry
+    partition_groups: int = 1  # >1: net split into id%groups islands
+    drop_prob: float = 0.0  # datagram loss for the phase
+    clock_skew_rounds: int = 0  # HLC skew injected at phase entry...
+    clock_skew_frac: float = 0.0  # ...on this fraction of nodes
+
+    def validate(self) -> "FaultPhase":
+        if self.rounds <= 0:
+            raise ValueError(f"phase rounds must be positive, got {self.rounds}")
+        for name in ("write_frac", "kill_frac", "clock_skew_frac"):
+            v = getattr(self, name)
+            if not 0.0 <= v <= 1.0:
+                raise ValueError(f"{name} {v} not in [0, 1]")
+        if self.partition_groups < 1:
+            raise ValueError(
+                f"partition_groups must be >= 1, got {self.partition_groups}"
+            )
+        if self.clock_skew_rounds < 0 or not 0.0 <= self.drop_prob <= 1.0:
+            raise ValueError(
+                f"bad clock_skew_rounds/drop_prob "
+                f"{self.clock_skew_rounds}/{self.drop_prob}"
+            )
+        return self
+
+
+def compile_scale_phase(cfg, phase: FaultPhase, key, dead=None):
+    """Lower one :class:`FaultPhase` into traced fault inputs.
+
+    -> ``(inputs, net, skew, dead_out)`` where ``inputs`` is a stacked
+    ``ScaleRoundInput`` (leading axis = ``phase.rounds``), ``net`` the
+    phase's constant ``NetModel``, ``skew`` an int32 numpy [N] of
+    pre-shifted HLC units (``rounds << HLC_ROUND_BITS``; all-zero when
+    the phase skews no clocks) the engine adds to ``crdt.hlc`` at phase
+    entry, and ``dead_out`` the bool numpy [N] dead-set after this
+    phase's entry events (thread it into the next phase so revives stay
+    exact inverses of prior kills).
+
+    Pure in ``(cfg, phase, key, dead)`` — the chaos determinism
+    contract. Writes are masked to nodes alive after the entry events,
+    so a scripted workload never writes from a corpse."""
+    from corrosion_tpu.sim.broadcast import HLC_ROUND_BITS
+    from corrosion_tpu.sim.scale_step import (
+        ScaleRoundInput,
+        make_write_inputs,
+    )
+
+    phase.validate()
+    n, rounds = cfg.n_nodes, phase.rounds
+    k_kill, k_write, k_mask, k_skew = jr.split(key, 4)
+    dead = (np.zeros(n, bool) if dead is None
+            else np.array(dead, dtype=bool, copy=True))
+    if dead.shape != (n,):
+        raise ValueError(f"dead mask shape {dead.shape} != ({n},)")
+
+    revive_mask = dead if phase.revive_killed else np.zeros(n, bool)
+    killable = ~dead & (np.arange(n) >= cfg.n_seeds)
+    kill_mask = (
+        (np.asarray(jr.uniform(k_kill, (n,))) < phase.kill_frac) & killable
+        if phase.kill_frac > 0.0 else np.zeros(n, bool)
+    )
+    dead_out = (dead & ~revive_mask) | kill_mask
+
+    if phase.write_frac > 0.0:
+        wm = (np.asarray(jr.uniform(k_mask, (rounds, n))) < phase.write_frac)
+        wm &= ~dead_out[None, :]
+        inputs = make_write_inputs(cfg, k_write, rounds, jnp.asarray(wm))
+    else:
+        inputs = jax.tree.map(
+            lambda a: jnp.broadcast_to(a, (rounds,) + a.shape),
+            ScaleRoundInput.quiet(cfg),
+        )
+    z = np.zeros((rounds, n), bool)
+    inputs = inputs._replace(
+        kill=jnp.asarray(np.where(np.arange(rounds)[:, None] == 0,
+                                  kill_mask[None, :], z)),
+        revive=jnp.asarray(np.where(np.arange(rounds)[:, None] == 0,
+                                    revive_mask[None, :], z)),
+    )
+
+    net = NetModel.create(n, drop_prob=phase.drop_prob)
+    if phase.partition_groups > 1:
+        net = net._replace(
+            partition=(jnp.arange(n) % phase.partition_groups).astype(
+                jnp.int32
+            )
+        )
+
+    skew = np.zeros(n, np.int32)
+    if phase.clock_skew_rounds > 0 and phase.clock_skew_frac > 0.0:
+        sel = np.asarray(jr.uniform(k_skew, (n,))) < phase.clock_skew_frac
+        skew = np.where(
+            sel, np.int32(phase.clock_skew_rounds << HLC_ROUND_BITS), 0
+        ).astype(np.int32)
+    return inputs, net, skew, dead_out

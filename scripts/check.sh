@@ -37,25 +37,34 @@ cd "$(dirname "$0")/.."
 echo "== corrolint =="
 python -m corrosion_tpu.analysis corrosion_tpu bench.py scripts \
     --output-json artifacts/lint_r06.json
-# the fused path's files must be IN lint scope (ISSUE 10): lint them
+# the fused path's files must be IN lint scope (ISSUE 10), and since
+# ISSUE 13 so must the corrochaos engine + fault compiler: lint them
 # explicitly (missing paths exit 2) and require the focused report to
-# have actually walked all four — an accidental walk/scope regression
-# would otherwise silently stop checking the kernel boundaries the
-# dtype-flow/donation rules exist for
+# have actually walked all of them — an accidental walk/scope
+# regression would otherwise silently stop checking the kernel
+# boundaries the dtype-flow/donation rules exist for (or the chaos
+# engine's lock/assert discipline). The walk must also close over the
+# state-constructor files (scale/broadcast/versions/partials): the
+# PR-11 mem-budget checker prices the WALKED tree, and a scoped walk
+# that cannot see the constructors reports the budget dark (this gate
+# was silently red between PR 11 and ISSUE 13 for exactly that reason)
 python -m corrosion_tpu.analysis \
     corrosion_tpu/ops/megakernel.py corrosion_tpu/sim/scale_step.py \
     corrosion_tpu/parallel/mesh.py corrosion_tpu/resilience/segments.py \
+    corrosion_tpu/resilience/chaos.py corrosion_tpu/sim/scenario.py \
+    corrosion_tpu/sim/scale.py corrosion_tpu/sim/broadcast.py \
+    corrosion_tpu/ops/versions.py corrosion_tpu/ops/partials.py \
     --output-json /tmp/lint_fused_scope.json
 python - <<'PY'
 import json
 scoped = json.load(open("/tmp/lint_fused_scope.json"))
-if scoped["files_checked"] != 4 or not scoped["clean"]:
-    raise SystemExit(f"fused-path lint scope regressed: {scoped}")
+if scoped["files_checked"] != 10 or not scoped["clean"]:
+    raise SystemExit(f"fused/chaos-path lint scope regressed: {scoped}")
 full = json.load(open("artifacts/lint_r06.json"))
 assert "rule_counts" in full, "lint report lost rule_counts"
 if full["files_checked"] < scoped["files_checked"]:
-    raise SystemExit("repo lint walk smaller than the fused file set")
-print(f"corrolint scope: fused-path files covered "
+    raise SystemExit("repo lint walk smaller than the fused/chaos file set")
+print(f"corrolint scope: fused + chaos files covered "
       f"({full['files_checked']} files in the repo walk)")
 PY
 echo "corrolint: clean (report: artifacts/lint_r06.json)"
@@ -159,6 +168,39 @@ print("obs smoke:", rec["flight"]["segments"], "segment(s) replayed,",
       rec["hbm_bytes"], "hbm bytes")
 PY
 echo "obs smoke: ok (report: artifacts/obs_r11.json)"
+
+echo "== corrochaos fault-scenario sweep =="
+# the ISSUE 13 robustness gate (docs/chaos.md): every shipped seeded
+# fault scenario — partition-heal, clock-skew past the HLC drift gate,
+# rejoin refutation, mid-segment preemption (both crash windows),
+# checkpoint corruption, elastic 8->4 remesh, fused<->unfused flip —
+# through the REAL segmented pipeline under CORROSAN=1, double-oracle-
+# checked (convergence + no checkpoint restores diverged state).
+# Publishes per-scenario verdicts to artifacts/chaos_r13.json and the
+# rounds-to-convergence lineage record to CONVERGENCE_r13_cpu.json
+# (superseding the seed-era one-scenario artifact).
+env CORROSAN=1 JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m corrosion_tpu chaos \
+    --output-json artifacts/chaos_r13.json \
+    --convergence-json artifacts/CONVERGENCE_r13_cpu.json > /dev/null
+python - <<'PY'
+import json
+rec = json.load(open("artifacts/chaos_r13.json"))
+if not rec.get("ok"):
+    bad = [r for r in rec["scenarios"] if not r.get("ok")]
+    raise SystemExit(f"chaos sweep failed: {bad or rec.get('problems')}")
+if not rec.get("corrosan"):
+    raise SystemExit("chaos sweep did not run under the sanitizer")
+scen = rec["scenarios"]
+if len(scen) < 6 or any(r.get("skipped") for r in scen):
+    raise SystemExit(f"chaos sweep incomplete: {scen}")
+validated = sum(r["checkpoints_validated"] for r in scen)
+faults = sum(r["faults_injected"] for r in scen)
+print(f"chaos sweep: {len(scen)} scenarios ok, {validated} checkpoints "
+      f"validated, {faults} host-plane faults injected")
+PY
+echo "chaos sweep: ok (report: artifacts/chaos_r13.json)"
 
 echo "== sharded checkpoint probe =="
 # per-shard drain + elastic 8->4 resharded restore, published next to

@@ -1,0 +1,215 @@
+"""corrochaos: the deterministic seeded fault-scenario engine
+(docs/chaos.md, ``resilience/chaos.py``).
+
+Tier-1 replays the small tier-1 scripts end to end against BOTH
+oracles (convergence within budget; every surviving manifest replays
+to the uninterrupted fixpoint bitwise), pins verdict determinism in
+``(name, seed)``, and meta-tests the registry against the doc. The
+full sweep — every shipped scenario, including the 8->4 remesh and the
+fused flip — is slow-marked here and rides ``scripts/check.sh`` under
+``CORROSAN=1`` (publishing ``artifacts/chaos_r13.json``).
+"""
+
+import dataclasses
+import os
+
+import pytest
+
+from corrosion_tpu.checkpoint import CheckpointIntegrityError, load_checkpoint
+from corrosion_tpu.resilience.chaos import (
+    INJECTION_KINDS,
+    SCENARIOS,
+    TIER1_SCENARIOS,
+    Injection,
+    ScenarioScript,
+    compile_scenario,
+    corrupt_checkpoint,
+    run_scenario,
+    run_sweep,
+    scenario_config,
+)
+from corrosion_tpu.sim.broadcast import HLC_MAX_DRIFT_ROUNDS
+from corrosion_tpu.sim.scenario import FaultPhase
+
+DOC = os.path.join(os.path.dirname(__file__), "..", "docs", "chaos.md")
+
+
+# --- tier-1 smoke: the small scripts, both oracles ------------------------
+
+
+@pytest.mark.parametrize("name", TIER1_SCENARIOS)
+def test_tier1_scenario_passes_both_oracles(name, tmp_path):
+    rec = run_scenario(SCENARIOS[name], seed=0, workdir=str(tmp_path))
+    assert rec["ok"], rec.get("problems")
+    # oracle 1: the chaos leg matches the uninterrupted run bitwise and
+    # settles to the converged fixpoint within the script's budget
+    assert rec["bitwise_match"] and rec["converged"]
+    assert rec["rounds_to_convergence"] >= rec["rounds_scripted"]
+    # oracle 2: the checkpoint lineage validated (no diverged restores)
+    assert rec["checkpoints_validated"] >= 1
+    # every scripted host-plane fault actually fired
+    assert rec["faults_injected"] == len(SCENARIOS[name].injections)
+
+
+def test_verdict_deterministic_in_name_and_seed(tmp_path):
+    """Same (name, seed) -> the SAME verdict record, field for field
+    (trace digest included); a different seed -> a different trace."""
+    script = ScenarioScript(
+        name="determinism-probe",
+        phases=(FaultPhase(rounds=4, write_frac=0.3),
+                FaultPhase(rounds=4)),
+        injections=(Injection(kind="preempt", phase=0),),
+        settle_budget=128,
+    )
+    a = run_scenario(script, seed=3, workdir=str(tmp_path / "a"))
+    b = run_scenario(script, seed=3, workdir=str(tmp_path / "b"))
+    assert a == b
+    assert a["ok"], a.get("problems")
+    _cfg, _traces, other = compile_scenario(script, seed=4)
+    assert other != a["trace_digest"]
+
+
+# --- the injected-fault primitives ---------------------------------------
+
+
+def test_injected_crash_marker_gates_seam_attribution():
+    """Only an exception chain carrying the seam's ``corrochaos:``
+    marker counts as the scripted fault — a genuine pipeline failure
+    during an armed phase must surface, not be silently recovered."""
+    from corrosion_tpu.resilience.chaos import _injected_crash
+
+    inner = OSError("corrochaos: killed writing a state slice of seg-x")
+    wrapped = RuntimeError(
+        "async checkpoint write failed; the previous segment has no "
+        "committed recovery point"
+    )
+    wrapped.__cause__ = inner
+    assert _injected_crash(wrapped)
+    assert _injected_crash(inner)
+    assert not _injected_crash(RuntimeError("disk full"))
+    genuine = RuntimeError("async checkpoint write failed")
+    genuine.__cause__ = OSError(28, "No space left on device")
+    assert not _injected_crash(genuine)
+
+
+def test_crash_before_first_commit_fails_the_verdict_not_the_sweep(tmp_path):
+    """A script whose injected crash kills the FIRST ever save leaves
+    nothing to resume from: the scenario must record a failed verdict
+    (engine error in ``problems``) instead of raising out of the
+    engine and killing the rest of a sweep."""
+    script = ScenarioScript(
+        name="first-save-crash",
+        phases=(FaultPhase(rounds=4, write_frac=0.2),),
+        injections=(Injection(kind="crash_slice", phase=0),),
+        settle_budget=64,
+    )
+    rec = run_scenario(script, seed=0, workdir=str(tmp_path))
+    assert not rec["ok"]
+    assert any("engine error" in p for p in rec["problems"])
+
+
+def test_corrupt_checkpoint_is_refused_on_load(tmp_path):
+    from corrosion_tpu.resilience.async_ckpt import write_segment_checkpoint
+    from corrosion_tpu.resilience.segments import _key_to_json
+    from corrosion_tpu.sim.scale_step import ScaleSimState
+
+    script = SCENARIOS["ckpt-corrupt"]
+    cfg = scenario_config(script)
+    import jax.random as jr
+
+    path = write_segment_checkpoint(
+        cfg, "scale", ScaleSimState.create(cfg),
+        _key_to_json(jr.key(0)), 4, str(tmp_path), keep_last=8,
+    )
+    load_checkpoint(path, verify=True)  # clean before the flip
+    corrupt_checkpoint(path)
+    with pytest.raises(CheckpointIntegrityError):
+        load_checkpoint(path, verify=True)
+
+
+def test_script_validation_refuses_malformed_scenarios():
+    with pytest.raises(ValueError):
+        ScenarioScript(name="empty", phases=()).validate()
+    with pytest.raises(ValueError):
+        FaultPhase(rounds=0).validate()
+    with pytest.raises(ValueError):
+        FaultPhase(rounds=4, kill_frac=1.5).validate()
+    with pytest.raises(ValueError):
+        Injection(kind="meteor-strike", phase=0).validate()
+    with pytest.raises(ValueError):
+        Injection(kind="fused_flip", phase=0).validate()  # no target mode
+    with pytest.raises(ValueError):
+        ScenarioScript(
+            name="oob",
+            phases=(FaultPhase(rounds=4),),
+            injections=(Injection(kind="preempt", phase=7),),
+        ).validate()
+
+
+# --- registry / doc meta-tests -------------------------------------------
+
+
+def test_registry_covers_the_required_fault_axes():
+    """The ISSUE-13 acceptance axes all have a shipped scenario."""
+    assert len(SCENARIOS) >= 6
+    phases = [ph for s in SCENARIOS.values() for ph in s.phases]
+    kinds = {i.kind for s in SCENARIOS.values() for i in s.injections}
+    assert any(ph.partition_groups > 1 for ph in phases)  # partition-heal
+    assert any(
+        ph.clock_skew_rounds > HLC_MAX_DRIFT_ROUNDS for ph in phases
+    )  # skew past the drift gate
+    assert any(ph.kill_frac > 0 for ph in phases)
+    assert any(ph.revive_killed for ph in phases)  # rejoin-refutation
+    assert {"crash_slice", "crash_manifest", "corrupt_checkpoint",
+            "remesh", "fused_flip"} <= kinds
+    # tier-1 subset is real and shipped
+    assert set(TIER1_SCENARIOS) <= set(SCENARIOS)
+    assert 2 <= len(TIER1_SCENARIOS) <= 3
+
+
+def test_every_shipped_scenario_is_documented():
+    """docs/chaos.md names every scenario, every injection kind, and
+    every FaultPhase field (the corrosan-KINDS meta-test pattern)."""
+    with open(DOC) as f:
+        doc = f.read()
+    missing = [name for name in SCENARIOS if name not in doc]
+    assert not missing, f"scenarios missing from docs/chaos.md: {missing}"
+    missing = [k for k in INJECTION_KINDS if k not in doc]
+    assert not missing, f"injection kinds missing from docs/chaos.md: {missing}"
+    missing = [
+        f.name for f in dataclasses.fields(FaultPhase) if f.name not in doc
+    ]
+    assert not missing, f"FaultPhase fields missing from docs/chaos.md: {missing}"
+
+
+def test_artifact_lineage_superseded():
+    """The scripted sweep's convergence artifact exists (satellite 6:
+    CONVERGENCE_r13 supersedes the seed-era one-scenario record) and
+    carries one converged entry per non-skipped shipped scenario."""
+    import json
+
+    path = os.path.join(os.path.dirname(__file__), "..", "artifacts",
+                        "CONVERGENCE_r13_cpu.json")
+    assert os.path.exists(path), "run scripts/check.sh to record it"
+    with open(path) as f:
+        conv = json.load(f)
+    names = {r["scenario"] for r in conv}
+    assert names <= set(SCENARIOS)
+    assert len(names) >= 6
+    assert all(r["converged"] and r["rounds_to_convergence"] > 0
+               for r in conv)
+
+
+# --- the full sweep (slow; also rides check.sh under CORROSAN=1) ---------
+
+
+@pytest.mark.slow
+def test_full_sweep_every_scenario_both_oracles():
+    out = run_sweep(seed=0)
+    bad = [r for r in out["scenarios"] if not r["ok"]]
+    assert out["ok"], bad
+    assert {r["name"] for r in out["scenarios"]} == set(SCENARIOS)
+    # the 8-virtual-device conftest rig means nothing may skip here
+    assert not any(r.get("skipped") for r in out["scenarios"])
+    assert all(r["converged"] and r["bitwise_match"]
+               for r in out["scenarios"])

@@ -110,3 +110,123 @@ def test_backup_and_graft(tmp_path, rig):
     # (round-1 advisor finding: previously only the site plane was
     # rewritten). Heads are monotone, so this holds under live rounds.
     assert snap["head"][target, target] >= src_head_origin0
+
+
+# --- format compatibility: v1 checkpoints still load ----------------------
+# (``checkpoint.py`` has claimed this since format 2 landed; the v2 path
+# has a hand-written restore test in test_sharded_checkpoint.py — this
+# is the missing v1 twin.)
+
+
+def write_v1_checkpoint(path, cfg, state, round_no):
+    """The exact v1 layout the seed era wrote: one ``state.npz`` of
+    whole leaves and a manifest with NO ``files`` hashes, NO ``extra``
+    and NO late-added config keys (``narrow_int8``/``fused`` postdate
+    v1 — restoring must normalize them to the compat defaults)."""
+    import dataclasses
+    import json
+    import os
+
+    import jax
+
+    os.makedirs(path, exist_ok=True)
+    leaves = [np.asarray(x) for x in jax.tree.leaves(state)]
+    np.savez_compressed(
+        os.path.join(path, "state.npz"),
+        **{f"leaf_{i}": a for i, a in enumerate(leaves)},
+    )
+    sim_config = dataclasses.asdict(cfg)
+    for late_key in ("narrow_int8", "fused"):
+        del sim_config[late_key]
+    manifest = {
+        "format": 1,
+        "mode": "scale",
+        "round": round_no,
+        "sim_config": sim_config,
+        "n_leaves": len(leaves),
+        "db": None,
+    }
+    with open(os.path.join(path, "manifest.json"), "w") as f:
+        json.dump(manifest, f)
+    return path
+
+
+@pytest.fixture(scope="module")
+def v1_rig(tmp_path_factory):
+    import jax.random as jr
+
+    from corrosion_tpu.sim.scale_step import (
+        ScaleSimState,
+        scale_run_rounds,
+        scale_sim_config,
+    )
+    from corrosion_tpu.sim.transport import NetModel
+
+    cfg = scale_sim_config(
+        24, m_slots=8, n_origins=4, n_rows=4, n_cols=2, sync_interval=4)
+    net = NetModel.create(cfg.n_nodes, drop_prob=0.0)
+    from corrosion_tpu.resilience.segments import make_soak_inputs
+
+    inputs = make_soak_inputs(cfg, jr.key(5), 4, write_frac=0.25,
+                              mode="scale")
+    import jax
+
+    st, _ = jax.jit(
+        lambda s, k, i: scale_run_rounds(cfg, s, net, k, i)
+    )(ScaleSimState.create(cfg), jr.key(3), inputs)
+    path = write_v1_checkpoint(
+        str(tmp_path_factory.mktemp("v1") / "ckpt"), cfg, st, 4)
+    return cfg, st, path
+
+
+def test_v1_checkpoint_still_restores(v1_rig):
+    from corrosion_tpu.checkpoint import verify_checkpoint
+
+    cfg, st, path = v1_rig
+    manifest, state = load_checkpoint(path)
+    assert manifest["format"] == 1
+    # the late-added config keys normalized to their compat defaults
+    assert manifest["sim_config"].get("narrow_int8") is None
+    import jax
+
+    for got, want in zip(jax.tree.leaves(state), jax.tree.leaves(st)):
+        assert np.array_equal(np.asarray(got), np.asarray(want))
+    # verify_checkpoint summarizes it (nothing to hash in v1 — the
+    # documented "can't be integrity-checked" limitation)
+    out = verify_checkpoint(path)
+    assert out["format"] == 1 and out["shards"] == 1
+    assert out["hashed_files"] == []
+
+
+def test_v1_checkpoint_restores_elastically_onto_a_mesh(v1_rig):
+    import jax
+
+    from corrosion_tpu.parallel.mesh import make_mesh
+
+    cfg, st, path = v1_rig
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    mesh = make_mesh(jax.devices()[:4])
+    _manifest, state = load_checkpoint(path, mesh=mesh)
+    for got, want in zip(jax.tree.leaves(state), jax.tree.leaves(st)):
+        assert np.array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_v1_checkpoint_leaf_count_gate_still_fires(v1_rig, tmp_path):
+    """A v1 manifest whose saved schema predates a state-schema change
+    is refused loudly at the leaf-count gate, exactly like v2/v3."""
+    import json
+    import os
+    import shutil
+
+    cfg, st, path = v1_rig
+    broken = str(tmp_path / "v1broken")
+    shutil.copytree(path, broken)
+    mpath = os.path.join(broken, "manifest.json")
+    with open(mpath) as f:
+        man = json.load(f)
+    man["n_leaves"] -= 1
+    with open(mpath, "w") as f:
+        json.dump(man, f)
+    with pytest.raises((ValueError, KeyError)):
+        load_checkpoint(broken)

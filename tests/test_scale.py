@@ -126,3 +126,101 @@ def test_churn_recovery(cfg):
     st, _ = run_rounds(cfg, st, net, jr.key(12), 120)
     m = scale_swim_metrics(st)
     assert float(m["accuracy"]) > 0.9
+
+
+# --- sender-election int32 packing (the widened 1M-capable form) ----------
+
+
+def _numpy_election(n, src_valid, tgt, pri):
+    """Independent numpy re-election: per receiver, the valid sender
+    with the highest (priority, id) pair wins — the semantics the
+    packed scatter-max must reproduce."""
+    import numpy as np
+
+    src_valid = np.asarray(src_valid)
+    tgt = np.asarray(tgt)
+    pri = np.asarray(pri)
+    best_key = np.full(n, -1, np.int64)
+    best_src = np.full(n, -1, np.int64)
+    for s in np.nonzero(src_valid)[0]:
+        key = (int(pri[s]) << 32) | int(s)  # id breaks priority ties
+        t = int(tgt[s])
+        if key > best_key[t]:
+            best_key[t], best_src[t] = key, s
+    return best_src, best_key >= 0
+
+
+def test_sender_election_parity_at_old_boundary():
+    """n = 2^19 — the last size the historical fixed-12-bit packing
+    served: the adaptive width must still use 12 priority bits (same
+    randint draw, same packing), reproducing the old election bit for
+    bit."""
+    import numpy as np
+
+    from corrosion_tpu.sim.scale import (
+        _election_pri_bits,
+        _one_sender_per_receiver,
+    )
+
+    n = 1 << 19
+    assert _election_pri_bits(n) == 12
+    k_valid, k_tgt, k_pri = jr.split(jr.key(21), 3)
+    src_valid = jr.uniform(k_valid, (n,)) < 0.5
+    tgt = jr.randint(k_tgt, (n,), 0, n, dtype=jnp.int32)
+    sender, has = _one_sender_per_receiver(n, src_valid, tgt, k_pri)
+    # the historical packing, inlined verbatim
+    bits = (n - 1).bit_length()
+    pri = jr.randint(k_pri, (n,), 0, 1 << 12, dtype=jnp.int32)
+    packed = jnp.where(
+        src_valid, (pri << bits) | jnp.arange(n, dtype=jnp.int32), -1
+    )
+    best = jnp.full(n, -1, jnp.int32).at[tgt].max(packed, mode="drop")
+    assert np.array_equal(np.asarray(sender),
+                          np.asarray(best & ((1 << bits) - 1)))
+    assert np.array_equal(np.asarray(has), np.asarray(best >= 0))
+
+
+def test_sender_election_past_old_wall_matches_numpy():
+    """n past 2^19 (the old overflow wall): 20 id bits + 11 priority
+    bits still fit int32, and the election equals an independent numpy
+    re-election on the same draws."""
+    import numpy as np
+
+    from corrosion_tpu.sim.scale import (
+        _election_pri_bits,
+        _one_sender_per_receiver,
+    )
+
+    n = (1 << 19) + 37
+    pb = _election_pri_bits(n)
+    assert pb == 11
+    k_valid, k_tgt, k_pri = jr.split(jr.key(22), 3)
+    src_valid = jr.uniform(k_valid, (n,)) < 0.3
+    tgt = jr.randint(k_tgt, (n,), 0, n, dtype=jnp.int32)
+    sender, has = _one_sender_per_receiver(n, src_valid, tgt, k_pri)
+    pri = jr.randint(k_pri, (n,), 0, 1 << pb, dtype=jnp.int32)
+    want_src, want_has = _numpy_election(n, src_valid, tgt, pri)
+    got_src = np.where(np.asarray(has), np.asarray(sender), -1)
+    assert np.array_equal(got_src, want_src)
+    assert np.array_equal(np.asarray(has), want_has)
+
+
+def test_validate_admits_flagship_sizes_and_keeps_a_wall():
+    """The 2^19 validate() wall is gone (ROADMAP's recorded 1M runtime
+    blocker): the flagship 1M point validates on both configs; the new
+    wall sits where the int32 packing genuinely runs out (2^30)."""
+    from corrosion_tpu.sim.scale import _election_pri_bits
+    from corrosion_tpu.sim.scale_step import scale_sim_config
+
+    cfg = scale_config(1 << 20)
+    assert cfg.n_nodes == 1 << 20
+    sim = scale_sim_config(1 << 20)
+    assert sim.n_nodes == 1 << 20
+    assert _election_pri_bits(1 << 20) == 11
+    assert _election_pri_bits(1 << 30) == 1
+    with pytest.raises(ValueError):
+        scale_config((1 << 30) + 1)
+    with pytest.raises(ValueError):
+        scale_sim_config((1 << 30) + 1)
+    with pytest.raises(ValueError):
+        _election_pri_bits((1 << 30) + 1)

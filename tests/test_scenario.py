@@ -1,0 +1,183 @@
+"""Contracts of the workload generators in ``sim/scenario.py`` — the
+layer the corrochaos fault compiler builds on (previously shipped
+untested): shape/dtype contracts, seed determinism, kill/revive
+disjointness — plus the scale-sim fault compiler itself
+(``compile_scale_phase``, docs/chaos.md)."""
+
+import jax
+import jax.random as jr
+import numpy as np
+import pytest
+
+from corrosion_tpu.sim import scenario
+from corrosion_tpu.sim.broadcast import HLC_ROUND_BITS
+from corrosion_tpu.sim.config import SimConfig
+from corrosion_tpu.sim.scenario import FaultPhase, compile_scale_phase
+from corrosion_tpu.sim.step import RoundInput
+
+ROUNDS = 12
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return SimConfig(n_nodes=12, n_origins=4, n_rows=4, n_cols=2)
+
+
+def leaves_match_quiet(cfg, inp, rounds):
+    """Every generator returns a stacked RoundInput whose per-round
+    slices have exactly the quiet template's shapes and dtypes."""
+    quiet = RoundInput.quiet(cfg)
+    got, want = jax.tree.leaves(inp), jax.tree.leaves(quiet)
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        assert g.shape == (rounds,) + w.shape
+        assert g.dtype == w.dtype
+
+
+def trees_equal(a, b):
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+
+
+# --- shape/dtype contracts ------------------------------------------------
+
+
+def test_generator_shape_dtype_contracts(cfg):
+    leaves_match_quiet(cfg, scenario.quiet(cfg, ROUNDS), ROUNDS)
+    leaves_match_quiet(
+        cfg, scenario.churn(cfg, ROUNDS, jr.key(1), rate=0.2), ROUNDS)
+    leaves_match_quiet(
+        cfg, scenario.single_writer(cfg, ROUNDS, jr.key(2)), ROUNDS)
+    leaves_match_quiet(
+        cfg, scenario.conflict_heavy(cfg, ROUNDS, jr.key(3)), ROUNDS)
+    leaves_match_quiet(
+        cfg, scenario.full_mix(cfg, ROUNDS, jr.key(4)), ROUNDS)
+
+
+def test_single_writer_only_node_zero_writes(cfg):
+    inp = scenario.single_writer(cfg, ROUNDS, jr.key(5))
+    wm = np.asarray(inp.write_mask)
+    assert wm[:, 0].all() and not wm[:, 1:].any()
+    cells = np.asarray(inp.write_cell)[:, 0]
+    assert ((cells >= 0) & (cells < cfg.n_cells)).all()
+
+
+def test_conflict_heavy_respects_origin_pool_and_hot_cells(cfg):
+    inp = scenario.conflict_heavy(
+        cfg, ROUNDS, jr.key(6), write_prob=1.0, hot_cells=2)
+    wm = np.asarray(inp.write_mask)
+    assert not wm[:, cfg.n_origins:].any()
+    assert wm[:, :cfg.n_origins].all()  # write_prob=1.0
+    cells = np.asarray(inp.write_cell)[wm]
+    assert ((cells >= 0) & (cells < 2)).all()
+
+
+def test_partitioned_net_groups(cfg):
+    net = scenario.partitioned_net(cfg, groups=3, drop_prob=0.1)
+    part = np.asarray(net.partition)
+    assert part.shape == (cfg.n_nodes,)
+    assert set(part.tolist()) == {0, 1, 2}
+    assert float(net.drop_prob) == pytest.approx(0.1)
+
+
+# --- seed determinism -----------------------------------------------------
+
+
+@pytest.mark.parametrize("gen", ["churn", "single_writer", "conflict_heavy",
+                                 "full_mix"])
+def test_generators_are_seed_deterministic(cfg, gen):
+    fn = getattr(scenario, gen)
+    assert trees_equal(fn(cfg, ROUNDS, jr.key(7)), fn(cfg, ROUNDS, jr.key(7)))
+    assert not trees_equal(
+        fn(cfg, ROUNDS, jr.key(7)), fn(cfg, ROUNDS, jr.key(8)))
+
+
+# --- kill/revive disjointness ---------------------------------------------
+
+
+@pytest.mark.parametrize("gen", ["churn", "full_mix"])
+def test_kill_revive_disjoint(cfg, gen):
+    fn = getattr(scenario, gen)
+    # high churn rate so overlap would actually be drawn without the
+    # explicit & ~kill exclusion
+    kwargs = ({"rate": 0.6} if gen == "churn" else {"churn_rate": 0.6})
+    inp = fn(cfg, 64, jr.key(9), **kwargs)
+    kill, revive = np.asarray(inp.kill), np.asarray(inp.revive)
+    assert kill.any() and revive.any()
+    assert not (kill & revive).any()
+
+
+# --- the corrochaos scale-sim fault compiler ------------------------------
+
+
+@pytest.fixture(scope="module")
+def scfg():
+    from corrosion_tpu.sim.scale_step import scale_sim_config
+
+    return scale_sim_config(
+        24, m_slots=8, n_origins=4, n_rows=4, n_cols=2, sync_interval=4)
+
+
+def test_compile_phase_shapes_and_determinism(scfg):
+    from corrosion_tpu.sim.scale_step import ScaleRoundInput
+
+    ph = FaultPhase(rounds=6, write_frac=0.4, kill_frac=0.3,
+                    partition_groups=2, drop_prob=0.05,
+                    clock_skew_rounds=3, clock_skew_frac=0.5)
+    a = compile_scale_phase(scfg, ph, jr.key(11))
+    b = compile_scale_phase(scfg, ph, jr.key(11))
+    quiet = ScaleRoundInput.quiet(scfg)
+    for g, w in zip(jax.tree.leaves(a[0]), jax.tree.leaves(quiet)):
+        assert g.shape == (6,) + w.shape and g.dtype == w.dtype
+    assert trees_equal(a[0], b[0]) and trees_equal(a[1], b[1])
+    assert np.array_equal(a[2], b[2]) and np.array_equal(a[3], b[3])
+    c = compile_scale_phase(scfg, ph, jr.key(12))
+    assert not trees_equal(a[0], c[0])
+    # skew is pre-shifted HLC units on a seeded node subset
+    skew = a[2]
+    assert skew.dtype == np.int32 and skew.shape == (scfg.n_nodes,)
+    assert set(np.unique(skew)) <= {0, 3 << HLC_ROUND_BITS}
+    assert skew.any()
+    # partition shape
+    assert set(np.asarray(a[1].partition).tolist()) == {0, 1}
+
+
+def test_compile_phase_kill_revive_contract(scfg):
+    n = scfg.n_nodes
+    ph_kill = FaultPhase(rounds=4, kill_frac=1.0)
+    inputs, _net, _skew, dead = compile_scale_phase(scfg, ph_kill, jr.key(13))
+    kill = np.asarray(inputs.kill)
+    # kills land on round 0 only, never touch the seed set, and the
+    # dead-set bookkeeping mirrors them exactly
+    assert kill[0, scfg.n_seeds:].all() and not kill[0, :scfg.n_seeds].any()
+    assert not kill[1:].any()
+    assert np.array_equal(dead, kill[0])
+    # revive_killed revives exactly the dead set, disjoint from kills
+    ph_rev = FaultPhase(rounds=4, kill_frac=0.5, revive_killed=True)
+    inputs2, _n2, _s2, dead2 = compile_scale_phase(
+        scfg, ph_rev, jr.key(14), dead)
+    kill2, revive2 = np.asarray(inputs2.kill), np.asarray(inputs2.revive)
+    assert np.array_equal(revive2[0], dead)
+    assert not (kill2[0] & revive2[0]).any()
+    assert not (kill2[1:].any() or revive2[1:].any())
+    assert not (dead2 & dead).any()  # everyone revived; new kills elsewhere
+
+
+def test_compile_phase_never_writes_from_a_corpse(scfg):
+    ph = FaultPhase(rounds=8, write_frac=1.0, kill_frac=1.0)
+    inputs, _net, _skew, dead = compile_scale_phase(scfg, ph, jr.key(15))
+    wm = np.asarray(inputs.write_mask)
+    assert wm.any()
+    assert not wm[:, dead].any()
+    assert wm[:, ~dead].all()  # write_frac=1.0 on the survivors
+
+
+def test_compile_phase_validates(scfg):
+    with pytest.raises(ValueError):
+        compile_scale_phase(scfg, FaultPhase(rounds=0), jr.key(0))
+    with pytest.raises(ValueError):
+        compile_scale_phase(
+            scfg, FaultPhase(rounds=4), jr.key(0),
+            dead=np.zeros(3, bool))
