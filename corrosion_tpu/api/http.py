@@ -36,6 +36,7 @@ from __future__ import annotations
 import json
 import queue
 import threading
+import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, List, Optional, Tuple
@@ -43,7 +44,32 @@ from typing import Any, List, Optional, Tuple
 from corrosion_tpu.db.database import SqlError
 from corrosion_tpu.db.schema import SchemaError
 from corrosion_tpu.pubsub import SubsManager, UpdatesManager
-from corrosion_tpu.utils.tracing import logger
+from corrosion_tpu.utils.lifecycle import DrainingConnMixin
+from corrosion_tpu.utils.tracing import inject_traceparent, logger, span
+
+
+class _DrainingHTTPServer(DrainingConnMixin, ThreadingHTTPServer):
+    _conn_name = "corro-http-conn"
+
+# fixed route templates (ISSUE 16): request metrics label by TEMPLATE,
+# never by raw path — subscription ids and table names in the path (or
+# arbitrary 404 probes) would otherwise mint unbounded label cardinality
+_FIXED_ROUTES = frozenset({
+    "/v1/transactions", "/v1/queries", "/v1/migrations",
+    "/v1/subscriptions", "/v1/health", "/v1/ready", "/v1/table_stats",
+    "/v1/members", "/v1/sync", "/v1/obs/memory", "/metrics",
+})
+
+
+def route_label(path: str) -> str:
+    """Collapse a request path onto its route template."""
+    if path in _FIXED_ROUTES:
+        return path
+    if path.startswith("/v1/subscriptions/"):
+        return "/v1/subscriptions/{id}"
+    if path.startswith("/v1/updates/"):
+        return "/v1/updates/{table}"
+    return "unmatched"
 
 
 def _encode_value(v: Any) -> Any:
@@ -96,10 +122,13 @@ class ApiServer:
         self.subs = subs or SubsManager(db)
         self.updates = updates or UpdatesManager(db)
         handler = _make_handler(self)
-        self.httpd = ThreadingHTTPServer((addr, port), handler)
-        self.httpd.daemon_threads = True
+        self.httpd = _DrainingHTTPServer((addr, port), handler)
         self.addr, self.port = self.httpd.server_address[:2]
         self._thread: Optional[threading.Thread] = None
+        # stop() raises this so streaming handlers (which otherwise
+        # poll their queue forever on a quiet subscription) exit within
+        # one poll period and the connection drain stays graceful
+        self._stopping = threading.Event()
 
     def start(self) -> "ApiServer":
         from corrosion_tpu.utils.lifecycle import spawn_counted
@@ -113,7 +142,9 @@ class ApiServer:
         return self
 
     def stop(self) -> None:
+        self._stopping.set()
         self.httpd.shutdown()
+        self.httpd.drain_connections()
         self.httpd.server_close()
         if self._thread:
             self._thread.join(timeout=10)
@@ -130,8 +161,17 @@ def _make_handler(server: ApiServer):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
+        # per-request accounting (reset by _serve; a keep-alive
+        # connection reuses one Handler instance across requests)
+        _code = 0
+        _resp_bytes = 0
+
         def log_message(self, fmt, *args):  # route to our logger
             logger.debug("http: " + fmt, *args)
+
+        def send_response(self, code, message=None):
+            self._code = code
+            super().send_response(code, message)
 
         # --- helpers -----------------------------------------------------
         def _json_body(self) -> Any:
@@ -149,6 +189,7 @@ def _make_handler(server: ApiServer):
                 self.send_header(k, v)
             self.end_headers()
             self.wfile.write(data)
+            self._resp_bytes += len(data)
 
         def _reply_error(self, code: int, msg: str) -> None:
             self._reply_json(code, {"error": msg})
@@ -165,6 +206,7 @@ def _make_handler(server: ApiServer):
             data = json.dumps(obj).encode() + b"\n"
             self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
             self.wfile.flush()
+            self._resp_bytes += len(data)
 
         def _end_chunks(self) -> None:
             self.wfile.write(b"0\r\n\r\n")
@@ -179,20 +221,37 @@ def _make_handler(server: ApiServer):
         def _node(self, q: dict) -> int:
             return int(q.get("node", server.default_node))
 
-        # --- POST --------------------------------------------------------
+        # --- instrumented dispatch (ISSUE 16) ----------------------------
         def do_POST(self):
+            self._serve("POST")
+
+        def do_GET(self):
+            self._serve("GET")
+
+        def _serve(self, method: str) -> None:
+            """Every route rides one instrumented envelope: a joined
+            per-request span (the ``sync.serve`` traceparent pattern
+            extended to the whole surface — a client write traces
+            through commit into fanout), the per-{route, method, code}
+            latency histogram, the in-flight gauge, and request/response
+            byte counters. Streaming routes observe their full stream
+            lifetime — that IS the request latency for an NDJSON feed."""
             path, q = self._route()
+            route = route_label(path)
+            metrics = server.agent.metrics
+            self._code = 0
+            self._resp_bytes = 0
+            req_bytes = int(self.headers.get("Content-Length") or 0)
+            metrics.gauge_add("corro.http.inflight", 1)
+            t0 = time.perf_counter()
             try:
-                if path == "/v1/transactions":
-                    self._transactions(q)
-                elif path == "/v1/queries":
-                    self._queries(q)
-                elif path == "/v1/migrations":
-                    self._migrations()
-                elif path == "/v1/subscriptions":
-                    self._subscribe_new(q)
-                else:
-                    self._reply_error(404, f"no such route: POST {path}")
+                with span(f"http.{method.lower()}.{route}",
+                          traceparent=self.headers.get("traceparent"),
+                          route=route, method=method):
+                    if method == "POST":
+                        self._dispatch_post(path, q)
+                    else:
+                        self._dispatch_get(path, q)
             except (SqlError, SchemaError, ValueError, KeyError) as e:
                 self._reply_error(400, str(e))
             except BrokenPipeError:
@@ -203,62 +262,73 @@ def _make_handler(server: ApiServer):
                     self._reply_error(500, str(e))
                 except Exception:  # noqa: BLE001 — headers may be gone
                     pass
+            finally:
+                dt = time.perf_counter() - t0
+                metrics.gauge_add("corro.http.inflight", -1)
+                metrics.histogram(
+                    "corro.http.request.seconds", dt,
+                    {"route": route, "method": method,
+                     "code": str(self._code or 0)})
+                if req_bytes:
+                    metrics.counter(
+                        "corro.http.request.bytes", float(req_bytes),
+                        {"route": route, "method": method})
+                if self._resp_bytes:
+                    metrics.counter(
+                        "corro.http.response.bytes", float(self._resp_bytes),
+                        {"route": route, "method": method})
 
-        def do_GET(self):
-            path, q = self._route()
-            try:
-                if path in ("/v1/health", "/v1/ready"):
-                    self._health()
-                elif path == "/v1/table_stats":
-                    self._reply_json(
-                        200, server.db.table_stats(self._node(q)))
-                elif path == "/v1/members":
-                    self._reply_json(200, server.agent.members())
-                elif path == "/v1/sync":
-                    node = self._node(q)
-                    # serve_sync extracts the client's traceparent and
-                    # answers inside a joined span (sync.rs:33-67 +
-                    # peer/mod.rs:1414-1416); the server span id is
-                    # returned so the caller can link both sides
-                    from corrosion_tpu.utils.tracing import (
-                        inject_traceparent,
-                        span,
-                    )
+        def _dispatch_post(self, path: str, q: dict) -> None:
+            if path == "/v1/transactions":
+                self._transactions(q)
+            elif path == "/v1/queries":
+                self._queries(q)
+            elif path == "/v1/migrations":
+                self._migrations()
+            elif path == "/v1/subscriptions":
+                self._subscribe_new(q)
+            else:
+                self._reply_error(404, f"no such route: POST {path}")
 
-                    with span("sync.serve",
-                              traceparent=self.headers.get("traceparent")):
-                        state = server.agent.sync_state(node)
-                        state["traceparent"] = inject_traceparent()
-                    self._reply_json(200, state)
-                elif path == "/v1/obs/memory":
-                    # per-table HBM audit of the live state (ISSUE 11):
-                    # array metadata only, never a device transfer —
-                    # cheap enough to poll while a 1M-node soak runs
-                    self._reply_json(200, server.agent.memory_report())
-                elif path == "/metrics":
-                    data = server.agent.metrics.render().encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type",
-                                     "text/plain; version=0.0.4")
-                    self.send_header("Content-Length", str(len(data)))
-                    self.end_headers()
-                    self.wfile.write(data)
-                elif path.startswith("/v1/subscriptions/"):
-                    self._subscribe_existing(path.rsplit("/", 1)[1], q)
-                elif path.startswith("/v1/updates/"):
-                    self._updates_feed(path.rsplit("/", 1)[1])
-                else:
-                    self._reply_error(404, f"no such route: GET {path}")
-            except (SqlError, SchemaError, ValueError, KeyError) as e:
-                self._reply_error(400, str(e))
-            except BrokenPipeError:
-                pass
-            except Exception as e:  # noqa: BLE001
-                logger.exception("http handler error")
-                try:
-                    self._reply_error(500, str(e))
-                except Exception:  # noqa: BLE001
-                    pass
+        def _dispatch_get(self, path: str, q: dict) -> None:
+            if path in ("/v1/health", "/v1/ready"):
+                self._health()
+            elif path == "/v1/table_stats":
+                self._reply_json(
+                    200, server.db.table_stats(self._node(q)))
+            elif path == "/v1/members":
+                self._reply_json(200, server.agent.members())
+            elif path == "/v1/sync":
+                node = self._node(q)
+                # serve_sync answers inside its own joined span
+                # (sync.rs:33-67 + peer/mod.rs:1414-1416), nested under
+                # the request span; the server span id is returned so
+                # the caller can link both sides
+                with span("sync.serve",
+                          traceparent=self.headers.get("traceparent")):
+                    state = server.agent.sync_state(node)
+                    state["traceparent"] = inject_traceparent()
+                self._reply_json(200, state)
+            elif path == "/v1/obs/memory":
+                # per-table HBM audit of the live state (ISSUE 11):
+                # array metadata only, never a device transfer —
+                # cheap enough to poll while a 1M-node soak runs
+                self._reply_json(200, server.agent.memory_report())
+            elif path == "/metrics":
+                data = server.agent.metrics.render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+                self._resp_bytes += len(data)
+            elif path.startswith("/v1/subscriptions/"):
+                self._subscribe_existing(path.rsplit("/", 1)[1], q)
+            elif path.startswith("/v1/updates/"):
+                self._updates_feed(path.rsplit("/", 1)[1])
+            else:
+                self._reply_error(404, f"no such route: GET {path}")
 
         # --- route bodies ------------------------------------------------
         def _health(self) -> None:
@@ -279,6 +349,17 @@ def _make_handler(server: ApiServer):
             headers = {}
             if not ok and h["status"] != "down":
                 headers["Retry-After"] = str(h.get("retry_after", 1))
+            if not ok:
+                # readiness shedding is measurable (ISSUE 16): the
+                # future admission-control PR needs a baseline of how
+                # often — and for how long — the plane turned clients
+                # away while restoring / backing off
+                metrics = server.agent.metrics
+                metrics.counter("corro.http.unready_total", 1.0,
+                                {"status": h["status"]})
+                if "Retry-After" in headers:
+                    metrics.histogram("corro.http.retry_after.seconds",
+                                      float(headers["Retry-After"]))
             self._reply_json(200 if ok else 503, h, headers=headers)
 
         def _transactions(self, q: dict) -> None:
@@ -329,7 +410,8 @@ def _make_handler(server: ApiServer):
             sub_q = matcher.attach(from_change_id=from_id)
             self._start_ndjson({"corro-query-id": matcher.id})
             try:
-                while not server.agent.tripwire.tripped:
+                while not (server.agent.tripwire.tripped
+                           or server._stopping.is_set()):
                     try:
                         kind, payload = sub_q.get(timeout=1.0)
                     except queue.Empty:
@@ -355,16 +437,33 @@ def _make_handler(server: ApiServer):
                             else [_encode_value(v) for v in row],
                             cid,
                         ]})
+                        self._observe_delivery(matcher, key)
             except (BrokenPipeError, ConnectionResetError):
                 pass
             finally:
                 matcher.detach(sub_q)
 
+        def _observe_delivery(self, matcher, key) -> None:
+            """Write-commit -> NDJSON delivery latency: the change event
+            just went out on the wire; diff against the commit stamp the
+            Database recorded for its (table, pk). Composite JOIN keys
+            observe the first component carrying a stamp (the write that
+            triggered the event)."""
+            keys = key if isinstance(key, tuple) else (key,)
+            now = time.perf_counter()
+            for table, pk in zip(matcher.delivery_tables, keys):
+                t = server.db.write_stamp(table, pk)
+                if t is not None:
+                    server.agent.metrics.histogram(
+                        "corro.subs.delivery.seconds", max(0.0, now - t))
+                    return
+
         def _updates_feed(self, table: str) -> None:
             feed_q = server.updates.attach(table)
             self._start_ndjson()
             try:
-                while not server.agent.tripwire.tripped:
+                while not (server.agent.tripwire.tripped
+                           or server._stopping.is_set()):
                     try:
                         kind, payload = feed_q.get(timeout=1.0)
                     except queue.Empty:

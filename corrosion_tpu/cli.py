@@ -620,6 +620,49 @@ def cmd_chaos(args) -> int:
     return 0 if out["ok"] else 1
 
 
+def cmd_load(args) -> int:
+    """corroload: the seeded concurrent-client load harness
+    (docs/observability.md, "Serving plane"). Drives an in-process
+    devcluster's HTTP API, NDJSON subscriptions and PG-wire server with
+    N writers + M subscribers + K readers whose op streams are a pure
+    function of ``--seed``, and emits the ``BENCH_SERVE`` record —
+    client-side p50/p95/p99 per op class, delivery lag, and the
+    server-vs-client request-count agreement gate. Under ``CORROSAN=1``
+    the whole run rides inside a sanitized window."""
+    from corrosion_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+    from corrosion_tpu.obs.load import run_load
+
+    kwargs = dict(
+        writers=args.writers, subscribers=args.subscribers,
+        pg_readers=args.pg_readers, write_ops=args.write_ops,
+        pg_ops=args.pg_ops, keys=args.keys, seed=args.seed,
+    )
+    corrosan = os.environ.get("CORROSAN") == "1"
+    if corrosan:
+        from corrosion_tpu.analysis.sanitizer import sanitized
+
+        with sanitized() as san:
+            out = run_load(**kwargs)
+        findings = san.gate()
+        if findings:
+            out["ok"] = False
+            out.setdefault("problems", []).extend(
+                f"corrosan: {f.kind} {f.subject}" for f in findings
+            )
+    else:
+        out = run_load(**kwargs)
+    out["corrosan"] = corrosan
+    if args.output_json:
+        os.makedirs(os.path.dirname(os.path.abspath(args.output_json)),
+                    exist_ok=True)
+        with open(args.output_json, "w") as f:
+            json.dump(out, f, indent=2)
+    print(json.dumps(out, indent=2))
+    return 0 if out["ok"] else 1
+
+
 def cmd_san(args) -> int:
     """corrosan fixture replay (same engine as
     ``python -m corrosion_tpu.analysis.sanitizer``): seeded
@@ -851,6 +894,32 @@ def build_parser() -> argparse.ArgumentParser:
                     help="also write the CONVERGENCE_* lineage artifact "
                          "derived from the sweep")
     ch.set_defaults(fn=cmd_chaos)
+
+    ld = sub.add_parser(
+        "load",
+        help="corroload: seeded concurrent-client load harness over "
+             "the serving plane (HTTP + subscriptions + PG-wire) — "
+             "emits the BENCH_SERVE record with client p50/p95/p99 "
+             "and the server-vs-client agreement gate")
+    ld.add_argument("--writers", type=int, default=4,
+                    help="open-loop HTTP transaction writers")
+    ld.add_argument("--subscribers", type=int, default=2,
+                    help="NDJSON subscription streams measuring "
+                         "write-commit -> delivery lag")
+    ld.add_argument("--pg-readers", type=int, default=2,
+                    help="PG-wire simple-query readers")
+    ld.add_argument("--write-ops", type=int, default=32,
+                    help="transactions per writer")
+    ld.add_argument("--pg-ops", type=int, default=32,
+                    help="queries per reader")
+    ld.add_argument("--keys", type=int, default=12,
+                    help="keyspace size (rows in load_kv)")
+    ld.add_argument("--seed", type=int, default=0,
+                    help="op-plan seed — the record carries the plan "
+                         "digest it determines")
+    ld.add_argument("--output-json", metavar="PATH", default=None,
+                    help="write the BENCH_SERVE record")
+    ld.set_defaults(fn=cmd_load)
 
     mr = sub.add_parser(
         "mem-report",

@@ -149,12 +149,19 @@ class CorrosionApiClient:
     def _request_stream(self, method: str, path: str, body: Any = None,
                         stream_timeout=_UNSET):
         payload = None if body is None else json.dumps(body)
+        headers = {"Content-Type": "application/json"}
+        # streams join the same trace as one-shot requests: the server
+        # wraps every route in a joined per-request span (ISSUE 16)
+        from corrosion_tpu.utils.tracing import inject_traceparent
+
+        tp = inject_traceparent()
+        if tp:
+            headers["traceparent"] = tp
 
         def attempt():
             conn = self._connect(timeout=stream_timeout)
             try:
-                conn.request(method, path, body=payload,
-                             headers={"Content-Type": "application/json"})
+                conn.request(method, path, body=payload, headers=headers)
                 resp = conn.getresponse()
             except BaseException:
                 conn.close()
@@ -215,14 +222,20 @@ class CorrosionApiClient:
         return _NdjsonStream(conn, resp)
 
     def subscribe(self, sql: str, params: Any = None, node: int = 0,
-                  from_change_id: Optional[int] = None) -> SubscriptionStream:
-        """``POST /v1/subscriptions`` — an endless NDJSON event stream."""
+                  from_change_id: Optional[int] = None,
+                  stream_timeout: Optional[float] = None
+                  ) -> SubscriptionStream:
+        """``POST /v1/subscriptions`` — an endless NDJSON event stream.
+
+        ``stream_timeout`` bounds each socket read (None = wait
+        forever): harness/test subscribers use it so a stalled stream
+        surfaces as ``TimeoutError`` instead of a hung thread."""
         body = [sql, _encode_params(params)] if params is not None else sql
         path = f"/v1/subscriptions?node={node}"
         if from_change_id is not None:
             path += f"&from={from_change_id}"
         conn, resp = self._request_stream("POST", path, body,
-                                          stream_timeout=None)
+                                          stream_timeout=stream_timeout)
         sub_id = resp.headers.get("corro-query-id", "")
         return SubscriptionStream(conn, resp, sub_id, from_change_id)
 

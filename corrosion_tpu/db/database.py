@@ -574,6 +574,15 @@ class Database:
         self.n_cols = agent.cfg.n_cols
         self._mu = threading.Lock()
         self._write_hooks: List = []  # pubsub/updates change hooks
+        # commit-instant stamps per (table, pk), bounded LRU (ISSUE 16):
+        # the write path stamps each committed notification and the
+        # NDJSON subscription streams observe write-commit -> delivery
+        # latency against them (corro.subs.delivery.seconds)
+        from collections import OrderedDict
+
+        self._write_stamps: "OrderedDict[Tuple[str, Any], float]" = (
+            OrderedDict()
+        )
         # open StagedTxs (weak: an abandoned tx drops out on GC) — their
         # planned value ids are pinned against heap compaction
         import weakref
@@ -618,6 +627,30 @@ class Database:
         (``util.rs:1034-1037``)."""
         with self._mu:
             self._write_hooks.append(hook)
+
+    _STAMP_CAP = 8192  # bounded: stamps for keys nobody subscribes to age out
+
+    def _stamp_writes(self, notes: Sequence[tuple]) -> None:
+        """Record the commit instant for each write notification. Called
+        on the write path right after ``write_many`` returns (the write
+        has entered the round loop — the reference's committed point);
+        delivery observation looks the stamp up per (table, pk)."""
+        if not notes:
+            return
+        now = time.perf_counter()
+        with self._mu:
+            stamps = self._write_stamps
+            for table, pk, _values, _deleted in notes:
+                stamps[(table, pk)] = now
+                stamps.move_to_end((table, pk))
+            while len(stamps) > self._STAMP_CAP:
+                stamps.popitem(last=False)
+
+    def write_stamp(self, table: str, pk: Any) -> Optional[float]:
+        """Latest commit instant (``time.perf_counter`` domain) for
+        (table, pk), or None if never written / aged out."""
+        with self._mu:
+            return self._write_stamps.get((table, pk))
 
     # --- cell helpers ----------------------------------------------------
     def _cell(self, row: int, col: int) -> int:
@@ -690,6 +723,7 @@ class Database:
         cells = self._order_tx_cells(merged)
         if cells:
             self.agent.write_many(node, cells, wait=wait, timeout=timeout)
+        self._stamp_writes(notifications)
         with self._mu:
             hooks = list(self._write_hooks)
         for note in notifications:
@@ -2049,6 +2083,7 @@ class StagedTx:
         if cells:
             self.db.agent.write_many(self.node, cells, wait=wait,
                                      timeout=timeout)
+        self.db._stamp_writes(self._notes)
         with self.db._mu:
             hooks = list(self.db._write_hooks)
         for note in self._notes:

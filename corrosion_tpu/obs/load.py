@@ -1,0 +1,434 @@
+"""corroload: the seeded concurrent-client load harness (ISSUE 16).
+
+The reference serves whole fleets over its HTTP API, subscriptions and
+PG-wire server; this repo's serving plane had only ever seen single
+test clients. ``run_load`` drives it the way a fleet would — N open-loop
+writers (``POST /v1/transactions``), M NDJSON subscribers measuring
+write-commit -> delivery lag client-side, and K PG-wire readers speaking
+the v3 simple-query protocol — against an in-process devcluster rig
+(Agent + Database + ApiServer + PgServer), and reports client-side
+p50/p95/p99 per op class, sustained QPS, and error/503 counts as a
+``BENCH_SERVE`` record.
+
+Determinism: the op streams come from :func:`plan_ops`, a pure function
+of the seed — the record carries the plan digest that pins them. Wall
+times obviously vary run to run; WHAT was issued does not.
+
+The record's ``agreement`` section is the harness's own oracle: the
+server-side ``corro.http.request.seconds`` / ``corro.pg.query.seconds``
+histograms (scraped off ``/metrics`` and parsed back through
+``utils.metrics.parse_exposition``) must count exactly the requests the
+clients tallied. A lost or double-counted request fails the record.
+
+CLI: ``corrosion-tpu load`` (``--output-json`` -> the check.sh serve
+stage artifact). Under ``CORROSAN=1`` the CLI wraps the whole run in a
+sanitized window — every fanout/metrics path race- and leak-gated.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import random
+import socket
+import struct
+import time
+from typing import Any, Dict, List, Optional
+
+BENCH_SERVE_SCHEMA = 1
+
+LOAD_SCHEMA = (
+    "CREATE TABLE load_kv (k TEXT PRIMARY KEY, v INTEGER, who TEXT);"
+)
+_STOP_KEY = "__stop__"
+
+
+# --- seeded op planning (pure) -------------------------------------------
+def plan_ops(seed: int, writers: int, write_ops: int, pg_readers: int,
+             pg_ops: int, keys: int) -> dict:
+    """The deterministic op plan: per-writer and per-reader key-index
+    streams, derived only from ``seed`` (``random.Random`` — a stable
+    algorithm across CPython versions). Returns
+    ``{"writers": [[idx,...],...], "pg": [[idx,...],...], "digest"}``."""
+    plan: Dict[str, Any] = {
+        "writers": [
+            [random.Random(seed * 7919 + w).randrange(keys)
+             for _ in range(write_ops)]
+            for w in range(writers)
+        ],
+        "pg": [
+            [random.Random(seed * 104729 + 31 * r).randrange(keys)
+             for _ in range(pg_ops)]
+            for r in range(pg_readers)
+        ],
+    }
+    digest = hashlib.sha256(
+        json.dumps(plan, sort_keys=True).encode()
+    ).hexdigest()[:16]
+    plan["digest"] = digest
+    return plan
+
+
+def percentiles(samples: List[float],
+                qs=(0.5, 0.95, 0.99)) -> Dict[str, float]:
+    """Exact client-side percentiles (sorted-sample interpolation) —
+    the client half of the client-vs-server latency story; the server
+    half comes from bucketed ``quantiles_from_histogram``."""
+    out: Dict[str, float] = {}
+    if not samples:
+        return {f"p{int(round(q * 100))}": 0.0 for q in qs}
+    s = sorted(samples)
+    n = len(s)
+    for q in qs:
+        pos = q * (n - 1)
+        lo = int(pos)
+        hi = min(lo + 1, n - 1)
+        out[f"p{int(round(q * 100))}"] = s[lo] + (s[hi] - s[lo]) * (pos - lo)
+    return out
+
+
+# --- minimal PG v3 frontend (simple query only) --------------------------
+class _PgClient:
+    """Just enough of the PG wire protocol for the reader legs: startup,
+    simple query, ReadyForQuery drain. (The image ships no PG client
+    library; tests/test_pg.py speaks the same dialect.)"""
+
+    def __init__(self, addr: str, port: int, database: str = "corrosion",
+                 timeout: float = 30.0):
+        self.sock = socket.create_connection((addr, port), timeout=timeout)
+        payload = struct.pack("!I", 196608)
+        for k, v in (("user", "corroload"), ("database", database)):
+            payload += k.encode() + b"\x00" + v.encode() + b"\x00"
+        payload += b"\x00"
+        self.sock.sendall(struct.pack("!I", len(payload) + 4) + payload)
+        self._drain()
+
+    def close(self) -> None:
+        try:
+            self.sock.sendall(b"X" + struct.pack("!I", 4))
+        finally:
+            self.sock.close()
+
+    def _read_exact(self, n: int) -> bytes:
+        data = b""
+        while len(data) < n:
+            chunk = self.sock.recv(n - len(data))
+            if not chunk:
+                raise ConnectionResetError
+            data += chunk
+        return data
+
+    def _drain(self) -> List[tuple]:
+        msgs = []
+        while True:
+            kind = self._read_exact(1)
+            (length,) = struct.unpack("!I", self._read_exact(4))
+            payload = self._read_exact(length - 4)
+            msgs.append((kind, payload))
+            if kind == b"Z":
+                return msgs
+
+    def query(self, sql: str) -> List[List[Optional[str]]]:
+        """Simple query; returns decoded text rows. Raises on an
+        ErrorResponse (the reader legs only issue valid SELECTs)."""
+        q = sql.encode() + b"\x00"
+        self.sock.sendall(b"Q" + struct.pack("!I", len(q) + 4) + q)
+        rows: List[List[Optional[str]]] = []
+        for kind, payload in self._drain():
+            if kind == b"D":
+                (n,) = struct.unpack("!H", payload[:2])
+                off = 2
+                row: List[Optional[str]] = []
+                for _ in range(n):
+                    (ln,) = struct.unpack("!i", payload[off:off + 4])
+                    off += 4
+                    if ln == -1:
+                        row.append(None)
+                    else:
+                        row.append(payload[off:off + ln].decode())
+                        off += ln
+                rows.append(row)
+            elif kind == b"E":
+                raise RuntimeError(f"pg error for {sql!r}: {payload!r}")
+        return rows
+
+
+# --- the harness ---------------------------------------------------------
+def run_load(writers: int = 4, subscribers: int = 2, pg_readers: int = 2,
+             write_ops: int = 32, pg_ops: int = 32, keys: int = 12,
+             seed: int = 0, n_nodes: int = 16, warm_rounds: int = 8,
+             deadline_s: float = 120.0) -> dict:
+    """Boot a devcluster rig, run the seeded concurrent-client load, and
+    return the ``BENCH_SERVE`` record (see docs/observability.md)."""
+    from corrosion_tpu.agent import Agent
+    from corrosion_tpu.api.http import ApiServer
+    from corrosion_tpu.client import ApiError, CorrosionApiClient
+    from corrosion_tpu.db import Database
+    from corrosion_tpu.pg import PgServer
+    from corrosion_tpu.testing import cluster_config
+    from corrosion_tpu.utils.lifecycle import spawn_counted
+    from corrosion_tpu.utils.metrics import (
+        parse_exposition,
+        quantiles_from_histogram,
+    )
+
+    plan = plan_ops(seed, writers, write_ops, pg_readers, pg_ops, keys)
+    problems: List[str] = []
+
+    # keyspace + stop marker + headroom must fit the row budget
+    cfg = cluster_config(n_nodes=n_nodes, n_rows=keys + 4)
+
+    # per-leg results: one pre-allocated slot per thread, read only
+    # after join (no shared mutation)
+    w_out: List[Optional[dict]] = [None] * writers
+    s_out: List[Optional[dict]] = [None] * subscribers
+    p_out: List[Optional[dict]] = [None] * pg_readers
+
+    with Agent(cfg) as agent:
+        agent.wait_rounds(warm_rounds, timeout=deadline_s)
+        db = Database(agent)
+        with ApiServer(db, port=0) as api, PgServer(db, port=0) as pgs:
+            setup = CorrosionApiClient(api.addr, api.port)
+            setup.schema([LOAD_SCHEMA])
+            # pre-populate the keyspace so writers are pure UPDATEs
+            # (fixed row budget; INSERT-vs-UPDATE split stays seeded)
+            setup.execute([
+                ("INSERT INTO load_kv (k, v, who) VALUES (?, ?, ?)",
+                 [f"k{i}", 0, "seed"])
+                for i in range(keys)
+            ])
+            setup_tx_posts = 1
+            agent.wait_rounds(2, timeout=deadline_s)
+
+            def subscriber(i: int) -> None:
+                out = {"lags": [], "changes": 0, "errors": 0,
+                       "ready": False}
+                s_out[i] = out
+                c = CorrosionApiClient(api.addr, api.port)
+                try:
+                    stream = c.subscribe("SELECT k, v, who FROM load_kv",
+                                         stream_timeout=deadline_s)
+                    for ev in stream:
+                        if "eoq" in ev:
+                            out["ready"] = True
+                        ch = ev.get("change")
+                        if ch is None:
+                            continue
+                        _kind, key, row, _cid = ch
+                        if key == _STOP_KEY:
+                            break
+                        out["changes"] += 1
+                        if row and isinstance(row[1], int) and row[1] > 0:
+                            out["lags"].append(
+                                max(0.0, (time.time_ns() - row[1]) / 1e9))
+                except (TimeoutError, OSError, ApiError):
+                    out["errors"] += 1
+
+            def writer(i: int) -> None:
+                out = {"lat": [], "errors": 0, "http_503": 0, "posts": 0}
+                w_out[i] = out
+                c = CorrosionApiClient(api.addr, api.port)
+                for key_idx in plan["writers"][i]:
+                    t0 = time.perf_counter()
+                    try:
+                        out["posts"] += 1
+                        c.execute([(
+                            "UPDATE load_kv SET v = ?, who = ? WHERE k = ?",
+                            [time.time_ns(), f"w{i}", f"k{key_idx}"],
+                        )])
+                        out["lat"].append(time.perf_counter() - t0)
+                    except ApiError as e:
+                        if e.status == 503:
+                            out["http_503"] += 1
+                        else:
+                            out["errors"] += 1
+                    except OSError:
+                        out["errors"] += 1
+
+            def pg_reader(i: int) -> None:
+                out = {"lat": [], "errors": 0, "queries": 0}
+                p_out[i] = out
+                try:
+                    client = _PgClient(pgs.addr, pgs.port)
+                except OSError:
+                    out["errors"] += 1
+                    return
+                try:
+                    for key_idx in plan["pg"][i]:
+                        t0 = time.perf_counter()
+                        try:
+                            out["queries"] += 1
+                            rows = client.query(
+                                "SELECT k, v, who FROM load_kv "
+                                f"WHERE k = 'k{key_idx}'")
+                            out["lat"].append(time.perf_counter() - t0)
+                            if len(rows) != 1 or rows[0][0] != f"k{key_idx}":
+                                out["errors"] += 1
+                        except (RuntimeError, OSError):
+                            out["errors"] += 1
+                finally:
+                    try:
+                        client.close()
+                    except OSError:
+                        pass
+
+            t_start = time.perf_counter()
+            threads = [
+                spawn_counted(lambda i=i: subscriber(i),
+                              name=f"corro-load-sub-{i}")
+                for i in range(subscribers)
+            ]
+            # subscribers must be attached (initial snapshot drained)
+            # before the first write or early deliveries are invisible
+            deadline = time.monotonic() + deadline_s
+            while not all(s and s["ready"] for s in s_out):
+                if time.monotonic() > deadline:
+                    problems.append("subscribers never reached eoq")
+                    break
+                time.sleep(0.01)
+            threads += [
+                spawn_counted(lambda i=i: writer(i),
+                              name=f"corro-load-writer-{i}")
+                for i in range(writers)
+            ]
+            threads += [
+                spawn_counted(lambda i=i: pg_reader(i),
+                              name=f"corro-load-pg-{i}")
+                for i in range(pg_readers)
+            ]
+            for t in threads[subscribers:]:
+                t.join(timeout=deadline_s)
+            # stop marker: subscribers exit when its change delivers
+            try:
+                setup.execute([(
+                    "INSERT INTO load_kv (k, v, who) VALUES (?, ?, ?)",
+                    [_STOP_KEY, 0, "stop"],
+                )])
+                setup_tx_posts += 1
+            except ApiError:
+                problems.append("stop-marker write failed")
+            agent.wait_rounds(3, timeout=deadline_s)
+            for t in threads[:subscribers]:
+                t.join(timeout=deadline_s)
+            duration = time.perf_counter() - t_start
+            if any(t.is_alive() for t in threads):
+                problems.append("load legs did not finish before deadline")
+
+            # --- server-side scrape + agreement -----------------------
+            scrape = parse_exposition(setup.metrics())
+            hist = scrape["histograms"]
+
+            def server_count(name: str, **want: str) -> int:
+                total = 0
+                for (pname, labels), h in hist.items():
+                    if pname != name:
+                        continue
+                    lab = dict(labels)
+                    if all(lab.get(k) == v for k, v in want.items()):
+                        total += h["count"]
+                return total
+
+            def server_hist(name: str, **want: str) -> dict:
+                agg = {"buckets": (), "counts": [], "sum": 0.0, "count": 0}
+                for (pname, labels), h in hist.items():
+                    if pname != name:
+                        continue
+                    lab = dict(labels)
+                    if not all(lab.get(k) == v for k, v in want.items()):
+                        continue
+                    if not agg["counts"]:
+                        agg["buckets"] = h["buckets"]
+                        agg["counts"] = list(h["counts"])
+                    else:
+                        agg["counts"] = [
+                            a + b
+                            for a, b in zip(agg["counts"], h["counts"])
+                        ]
+                    agg["sum"] += h["sum"]
+                    agg["count"] += h["count"]
+                return agg
+
+            client_tx = (sum(w["posts"] for w in w_out if w)
+                         + setup_tx_posts)
+            server_tx = server_count("corro_http_request_seconds",
+                                     route="/v1/transactions", method="POST")
+            client_pg = sum(p["queries"] for p in p_out if p)
+            server_pg = server_count("corro_pg_query_seconds", kind="select")
+            agreement = {
+                "transactions": {"client": client_tx, "server": server_tx,
+                                 "ok": client_tx == server_tx},
+                "pg_select": {"client": client_pg, "server": server_pg,
+                              "ok": client_pg == server_pg},
+            }
+            agreement["ok"] = (agreement["transactions"]["ok"]
+                               and agreement["pg_select"]["ok"])
+            if not agreement["ok"]:
+                problems.append(f"server/client count disagreement: "
+                                f"{agreement}")
+
+            w_lat = [x for w in w_out if w for x in w["lat"]]
+            p_lat = [x for p in p_out if p for x in p["lat"]]
+            s_lag = [x for s in s_out if s for x in s["lags"]]
+            w_errors = sum(w["errors"] for w in w_out if w)
+            p_errors = sum(p["errors"] for p in p_out if p)
+            s_errors = sum(s["errors"] for s in s_out if s)
+            if w_errors or p_errors or s_errors:
+                problems.append(
+                    f"client errors: write={w_errors} pg={p_errors} "
+                    f"sub={s_errors}")
+            if not s_lag and subscribers:
+                problems.append("subscribers observed no deliveries")
+
+            delivery_h = server_hist("corro_subs_delivery_seconds")
+            record = {
+                "schema": BENCH_SERVE_SCHEMA,
+                "kind": "bench_serve",
+                "seed": seed,
+                "plan_digest": plan["digest"],
+                "n_nodes": n_nodes,
+                "writers": writers,
+                "subscribers": subscribers,
+                "pg_readers": pg_readers,
+                "write_ops_per_writer": write_ops,
+                "pg_ops_per_reader": pg_ops,
+                "keys": keys,
+                "duration_s": duration,
+                "qps": ((len(w_lat) + len(p_lat)) / duration
+                        if duration > 0 else 0.0),
+                "ops": {
+                    "write": dict(
+                        percentiles(w_lat),
+                        count=len(w_lat), errors=w_errors,
+                        http_503=sum(w["http_503"] for w in w_out if w),
+                        qps=(len(w_lat) / duration if duration else 0.0),
+                    ),
+                    "pg_query": dict(
+                        percentiles(p_lat),
+                        count=len(p_lat), errors=p_errors,
+                        qps=(len(p_lat) / duration if duration else 0.0),
+                    ),
+                    "subscribe_delivery": dict(
+                        percentiles(s_lag),
+                        count=len(s_lag), errors=s_errors,
+                        changes=sum(s["changes"] for s in s_out if s),
+                    ),
+                },
+                "server": {
+                    "tx_requests": server_tx,
+                    "pg_selects": server_pg,
+                    "deliveries": delivery_h["count"],
+                    "delivery_quantiles_s":
+                        quantiles_from_histogram(delivery_h)
+                        if delivery_h["count"] else None,
+                    "unready_total": sum(
+                        v for (n, _l), v in scrape["counters"].items()
+                        if n == "corro_http_unready_total"),
+                    "shed_total": sum(
+                        v for (n, _l), v in scrape["counters"].items()
+                        if n == "corro_subs_shed_total"),
+                },
+                "agreement": agreement,
+                "problems": problems,
+                "ok": not problems,
+            }
+            return record

@@ -36,10 +36,12 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from corrosion_tpu.db.database import SqlError
 from corrosion_tpu.db.schema import SchemaError
+from corrosion_tpu.utils.lifecycle import DrainingConnMixin
 from corrosion_tpu.utils.tracing import logger
 
 PROTO_V3 = 196608
@@ -417,6 +419,30 @@ def _translate_sql(sql: str) -> str:
     ).strip()
 
 
+def _sql_kind(sql: str) -> str:
+    """Bounded-cardinality statement class for the per-kind query
+    latency histogram (ISSUE 16): ``select`` / ``write`` / ``tx`` /
+    ``catalog`` / ``meta`` / ``empty``. Classification mirrors the
+    ``_run_sql`` dispatch order so every wire statement lands in exactly
+    the class whose code path served it."""
+    s = _translate_sql(sql)
+    upper = s.upper().rstrip(";").strip()
+    if not upper:
+        return "empty"
+    verb = upper.split()[0]
+    if (verb in ("BEGIN", "COMMIT", "END", "ROLLBACK", "SAVEPOINT",
+                 "RELEASE")
+            or upper.startswith("START TRANSACTION")):
+        return "tx"
+    if verb in ("SET", "RESET", "DISCARD", "SHOW"):
+        return "meta"
+    if _CATALOG_FROM_RE.search(upper):
+        return "catalog"
+    if verb == "SELECT":
+        return "select"
+    return "write"
+
+
 def _substitute_placeholders(sql: str) -> "Tuple[str, List[int]]":
     """Rewrite ``$N`` -> ``?`` *outside single-quoted literals* (a dollar
     sign inside a string like ``'costs $5'`` is data, not a parameter).
@@ -497,11 +523,15 @@ class PgServer:
         self.db = db
         self.default_node = default_node
         handler = _make_handler(self)
-        self.server = socketserver.ThreadingTCPServer(
+
+        class _DrainingTCPServer(DrainingConnMixin,
+                                 socketserver.ThreadingTCPServer):
+            _conn_name = "corro-pg-conn"
+
+        self.server = _DrainingTCPServer(
             (addr, port), handler, bind_and_activate=False
         )
         self.server.allow_reuse_address = True
-        self.server.daemon_threads = True
         self.server.server_bind()
         self.server.server_activate()
         self.addr, self.port = self.server.server_address[:2]
@@ -517,6 +547,9 @@ class PgServer:
 
     def stop(self) -> None:
         self.server.shutdown()
+        # grace=0: a PG handler parked in recv only exits when its
+        # socket dies — a well-behaved client already sent Terminate
+        self.server.drain_connections(grace=0.0)
         self.server.server_close()
         if self._thread:
             self._thread.join(timeout=10)
@@ -650,6 +683,21 @@ def _make_handler(server: PgServer):
         def _run_sql(self, sql: str, params: Any = None,
                      send_desc: bool = True,
                      fmts: Optional[List[int]] = None) -> None:
+            """Timed envelope around :meth:`_dispatch_sql` — both wire
+            entry points (simple query and extended Execute) land here,
+            so the per-kind latency histogram counts every issued
+            statement exactly once, errors included."""
+            t0 = time.perf_counter()
+            try:
+                self._dispatch_sql(sql, params, send_desc, fmts)
+            finally:
+                server.db.agent.metrics.histogram(
+                    "corro.pg.query.seconds",
+                    time.perf_counter() - t0, {"kind": _sql_kind(sql)})
+
+        def _dispatch_sql(self, sql: str, params: Any = None,
+                          send_desc: bool = True,
+                          fmts: Optional[List[int]] = None) -> None:
             """``send_desc``: simple query includes RowDescription;
             extended Execute must NOT (the client learned the shape from
             Describe — a second 'T' is a protocol violation). ``fmts``:

@@ -203,6 +203,10 @@ class Matcher:
             or any(j[0] == "left" for j in ast.get("joins", ()))
         )
         self.n_queries = 0  # full + filtered executions (tests/metrics)
+        # serving-plane telemetry (ISSUE 16): fanout depth/shed series
+        # land on the owning agent's registry; bound once at build time
+        # (read-only after publication)
+        self._registry = db.agent.metrics
         # a restored matcher's state predates any delta baseline (the
         # persisted manifest may be a whole downtime old): its first
         # poll MUST be a full re-diff or down-window changes are lost
@@ -385,7 +389,17 @@ class Matcher:
                 if not q.offer(("change", rec)):
                     lagged.append(q)
                     break
+        if out and subs:
+            # deepest subscriber queue after this fanout: the early-
+            # warning signal admission control will act on — a depth
+            # climbing toward SubQueue maxsize means a consumer is
+            # about to be shed
+            self._registry.gauge(
+                "corro.subs.queue.depth",
+                max(q.qsize() for q in subs), {"sub": self.id})
         for q in lagged:
+            self._registry.counter("corro.subs.shed_total", 1.0,
+                                   {"sub": self.id})
             logger.warning("matcher %s: disconnecting lagged subscriber",
                            self.id)
             self.detach(q)
@@ -424,6 +438,13 @@ class Matcher:
     @property
     def n_subscribers(self) -> int:
         return len(self._subs)
+
+    @property
+    def delivery_tables(self) -> List[str]:
+        """Table name per pk-key component, in key order — the HTTP
+        streaming loop resolves commit stamps (delivery latency) through
+        this without reaching into the parse internals."""
+        return [tname for _alias, tname, _pk in self._aliases]
 
     # --- persistence (pubsub.rs stores matcher SQL + state on disk) ------
     def manifest(self) -> dict:
@@ -744,7 +765,15 @@ class UpdatesManager:
                     if not q.offer(("notify", ev)):
                         lagged.append(q)
                         break
+            if events and subs:
+                self.db.agent.metrics.gauge(
+                    "corro.subs.queue.depth",
+                    max(q.qsize() for q in subs),
+                    {"sub": f"updates:{table}"})
             for q in lagged:
+                self.db.agent.metrics.counter(
+                    "corro.subs.shed_total", 1.0,
+                    {"sub": f"updates:{table}"})
                 logger.warning("updates feed %s: disconnecting lagged "
                                "subscriber", table)
                 self.detach(table, q)

@@ -13,7 +13,10 @@ from __future__ import annotations
 
 import random
 import signal
+import socket
 import threading
+import time
+import weakref
 from typing import Iterator, Optional
 
 
@@ -75,6 +78,77 @@ def wait_for_all_pending(timeout: Optional[float] = None) -> bool:
     """Block until every counted spawn finished (shutdown barrier)."""
     with _pending_mu:
         return _pending_zero.wait_for(lambda: _pending == 0, timeout)
+
+
+class DrainingConnMixin:
+    """``socketserver.ThreadingMixIn`` companion for the serving-plane
+    listeners: per-connection threads are corro- named, counted, and
+    drained by the owning listener's ``stop()``.
+
+    stdlib ``ThreadingMixIn`` with ``daemon_threads`` never tracks its
+    handler threads, so ``server_close()`` joins nothing and a handler
+    parked on a quiet socket (an NDJSON stream whose client went away,
+    a PG connection that never sent Terminate) outlives the listener —
+    exactly the leak the corrosan gate flags. Here the threads stay
+    daemonic (a stuck peer cannot wedge interpreter exit) but
+    ``drain_connections()`` makes shutdown deterministic: a grace join
+    for handlers that exit on their own, then a socket shutdown to
+    unblock any still parked in ``recv``, then a final join.
+    """
+
+    _conn_name = "corro-conn"
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._conns_mu = threading.Lock()
+        self._conn_threads: "weakref.WeakSet[threading.Thread]" = (
+            weakref.WeakSet())
+        self._conn_socks: "weakref.WeakSet[socket.socket]" = (
+            weakref.WeakSet())
+
+    def process_request(self, request, client_address):
+        global _pending
+        with _pending_mu:
+            _pending += 1
+
+        def run():
+            global _pending
+            try:
+                self.process_request_thread(request, client_address)
+            finally:
+                with _pending_mu:
+                    _pending -= 1
+                    if _pending == 0:
+                        _pending_zero.notify_all()
+
+        t = threading.Thread(target=run, daemon=True, name=self._conn_name)
+        with self._conns_mu:
+            self._conn_threads.add(t)
+            self._conn_socks.add(request)
+        t.start()
+
+    def drain_connections(self, grace: float = 2.0,
+                          timeout: float = 10.0) -> bool:
+        """Join handler threads; force-close sockets of any that
+        outlive ``grace``. True iff everything exited in time."""
+        deadline = time.monotonic() + timeout
+        grace_end = time.monotonic() + grace
+        with self._conns_mu:
+            threads = list(self._conn_threads)
+        for t in threads:
+            t.join(timeout=max(0.0, grace_end - time.monotonic()))
+        if any(t.is_alive() for t in threads):
+            with self._conns_mu:
+                socks = list(self._conn_socks)
+            for s in socks:
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass  # already closed by its handler
+            for t in threads:
+                if t.is_alive():
+                    t.join(timeout=max(0.1, deadline - time.monotonic()))
+        return not any(t.is_alive() for t in threads)
 
 
 def backoff(

@@ -16,13 +16,23 @@ the reference's loops (gossip ``broadcast/mod.rs:296-312``, changes-queue
 from __future__ import annotations
 
 import bisect
+import re
 import threading
 import time
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
+# Latency-appropriate default ladder (ISSUE 16): log-spaced 1/2.5/5 per
+# decade from 100 µs to 10 s. The serving plane observes sub-millisecond
+# host operations (a PG catalog probe, a cached read) next to multi-
+# second streams — the old 1 ms floor folded everything fast into one
+# bucket and made the quantile estimator blind below it.
 _DEFAULT_BUCKETS = (
-    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0
 )
+# public alias for callers that override per histogram and want the
+# standard ladder as a base
+LATENCY_BUCKETS = _DEFAULT_BUCKETS
 
 
 def _key(name: str, labels: Optional[dict]) -> Tuple[str, Tuple[Tuple[str, str], ...]]:
@@ -60,6 +70,11 @@ class Registry:
         self._counters: Dict = {}
         self._gauges: Dict = {}
         self._histograms: Dict = {}
+        # bucket ladder per histogram NAME (not per label set): every
+        # label combination of one metric family must share one `le`
+        # ladder or the exposition is unqueryable (and strict parsers
+        # reject the family) — see histogram() below
+        self._hist_buckets: Dict[str, Tuple[float, ...]] = {}
 
     def counter(self, name: str, value: float = 1.0, labels: Optional[dict] = None):
         k = _key(name, labels)
@@ -70,6 +85,13 @@ class Registry:
         with self._lock:
             self._gauges[_key(name, labels)] = float(value)
 
+    def gauge_add(self, name: str, delta: float, labels: Optional[dict] = None):
+        """Additive gauge update (in-flight request counts and other
+        up/down levels; Prometheus gauges support both set and add)."""
+        k = _key(name, labels)
+        with self._lock:
+            self._gauges[k] = self._gauges.get(k, 0.0) + float(delta)
+
     def histogram(
         self,
         name: str,
@@ -77,11 +99,19 @@ class Registry:
         labels: Optional[dict] = None,
         buckets: Tuple[float, ...] = _DEFAULT_BUCKETS,
     ):
+        """Observe ``value``. ``buckets`` overrides the default ladder —
+        but the FIRST observation of a name fixes the ladder for every
+        label set of that family: per-{route,method,code} histograms
+        (ISSUE 16) create label sets lazily, and mixing ladders within
+        one family would render inconsistent ``le`` label sets for the
+        same metric (the latent exposition gap the render-roundtrip test
+        pins)."""
         k = _key(name, labels)
         with self._lock:
+            eff = self._hist_buckets.setdefault(name, tuple(buckets))
             h = self._histograms.get(k)
             if h is None:
-                h = {"buckets": buckets, "counts": [0] * (len(buckets) + 1),
+                h = {"buckets": eff, "counts": [0] * (len(eff) + 1),
                      "sum": 0.0, "count": 0}
                 self._histograms[k] = h
             h["counts"][bisect.bisect_left(h["buckets"], value)] += 1
@@ -154,6 +184,128 @@ class Registry:
 
 
 REGISTRY = Registry()
+
+
+# --- snapshot-side quantile estimation (ISSUE 16) ------------------------
+def histogram_quantile(h: dict, q: float) -> float:
+    """Estimate the ``q``-quantile (0 < q <= 1) of one snapshot
+    histogram dict (``{"buckets", "counts", "count", ...}``) by linear
+    interpolation inside the owning bucket — the same model PromQL's
+    ``histogram_quantile`` applies server-side. Values in the overflow
+    bucket clamp to the top bound (the ladder cannot see past it).
+    Returns 0.0 for an empty histogram."""
+    count = h.get("count", 0)
+    if count <= 0:
+        return 0.0
+    target = q * count
+    acc = 0.0
+    lo = 0.0
+    for b, c in zip(h["buckets"], h["counts"]):
+        if c and acc + c >= target:
+            return lo + (float(b) - lo) * (target - acc) / c
+        acc += c
+        lo = float(b)
+    # remaining mass sits in the overflow bucket: clamp to the top bound
+    return float(h["buckets"][-1]) if h["buckets"] else lo
+
+
+def quantiles_from_histogram(
+    h: dict, qs: Sequence[float] = (0.5, 0.95, 0.99)
+) -> Dict[str, float]:
+    """``{"p50": ..., "p95": ..., "p99": ...}`` from one snapshot
+    histogram — the server-side half of the load-harness report."""
+    out = {}
+    for q in qs:
+        out[f"p{int(round(q * 100))}"] = histogram_quantile(h, q)
+    return out
+
+
+# --- Prometheus text-format parsing --------------------------------------
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?\s+(?P<value>\S+)$"
+)
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _unescape_label_value(v: str) -> str:
+    return (
+        v.replace("\\n", "\n").replace('\\"', '"').replace("\\\\", "\\")
+    )
+
+
+def parse_exposition(text: str) -> dict:
+    """Parse Prometheus text format v0.0.4 (as :meth:`Registry.render`
+    emits it) back into a snapshot-shaped dict.
+
+    Names come back in exposition form (dots already folded to
+    underscores — the fold is lossy, so the original dotted name is not
+    recoverable); histogram cumulative ``_bucket`` samples are
+    de-accumulated back into per-bucket counts. The load harness scrapes
+    ``/metrics`` through this to compare server-side request counts with
+    its own client-side tallies, and the render-roundtrip test pins
+    ``parse_exposition(reg.render())`` == ``reg.snapshot()`` (modulo the
+    name fold)."""
+    kinds: Dict[str, str] = {}
+    counters: Dict = {}
+    gauges: Dict = {}
+    hist_raw: Dict = {}  # (name, labels) -> {"le": [...], "sum":, "count":}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                kinds[parts[2]] = parts[3]
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(f"unparseable exposition line: {line!r}")
+        name = m.group("name")
+        raw_labels = m.group("labels") or ""
+        labels = [
+            (k, _unescape_label_value(v))
+            for k, v in _LABEL_RE.findall(raw_labels)
+        ]
+        value = float(m.group("value"))
+        base = None
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and kinds.get(
+                    name[: -len(suffix)]) == "histogram":
+                base = name[: -len(suffix)]
+                break
+        if base is not None:
+            plain = tuple(kv for kv in labels if kv[0] != "le")
+            h = hist_raw.setdefault(
+                (base, plain), {"le": [], "sum": 0.0, "count": 0})
+            if name.endswith("_bucket"):
+                le = dict(labels).get("le", "+Inf")
+                bound = float("inf") if le == "+Inf" else float(le)
+                h["le"].append((bound, value))
+            elif name.endswith("_sum"):
+                h["sum"] = value
+            else:
+                h["count"] = int(value)
+        elif kinds.get(name) == "gauge":
+            gauges[(name, tuple(labels))] = value
+        else:
+            counters[(name, tuple(labels))] = value
+    histograms: Dict = {}
+    for key, h in hist_raw.items():
+        les = sorted(h["le"])
+        buckets = tuple(b for b, _ in les if b != float("inf"))
+        counts: List[int] = []
+        prev = 0.0
+        for _, cum in les:
+            counts.append(int(cum - prev))
+            prev = cum
+        if len(counts) == len(buckets):  # no +Inf sample seen
+            counts.append(int(h["count"] - prev))
+        histograms[key] = {"buckets": buckets, "counts": counts,
+                           "sum": h["sum"], "count": h["count"]}
+    return {"counters": counters, "gauges": gauges,
+            "histograms": histograms}
 
 # round-info key -> corro.* series (reference names where one exists).
 # MUST cover every key ``sim_step``/``scale_sim_step`` emit — an

@@ -19,6 +19,11 @@
 # the quiet-trace activity oracle, and the memory-audit closure —
 # under CORROSAN=1.
 #
+# The corroserve load harness (ISSUE 16) publishes
+# artifacts/serve_r16.json: seeded concurrent HTTP/subscription/PG-wire
+# clients vs the server's own request accounting (the agreement gate),
+# under CORROSAN=1.
+#
 # corrosan (ISSUE 8) publishes artifacts/san_r08.json with two
 # sections: "fixtures" (seeded-race replay verdicts via
 # `corrosion-tpu san`) and "pytest" (the threaded test modules re-run
@@ -168,6 +173,42 @@ print("obs smoke:", rec["flight"]["segments"], "segment(s) replayed,",
       rec["hbm_bytes"], "hbm bytes")
 PY
 echo "obs smoke: ok (report: artifacts/obs_r11.json)"
+
+echo "== corroserve load harness =="
+# the ISSUE 16 serving-plane gate (docs/observability.md, "Serving
+# plane"): seeded concurrent clients — HTTP writers + NDJSON
+# subscribers + PG-wire readers — against an in-process devcluster,
+# under CORROSAN=1. The record's agreement section is the oracle:
+# server-side request histograms must count EXACTLY the requests the
+# clients tallied. Published as artifacts/serve_r16.json
+# (BENCH_SERVE_r16.json at the repo root is the committed lineage
+# record from the same harness).
+env CORROSAN=1 JAX_PLATFORMS=cpu \
+    python -m corrosion_tpu load \
+    --writers 3 --subscribers 2 --pg-readers 2 \
+    --write-ops 8 --pg-ops 8 --keys 8 --seed 16 \
+    --output-json artifacts/serve_r16.json > /dev/null
+python - <<'PY'
+import json
+rec = json.load(open("artifacts/serve_r16.json"))
+if not rec.get("ok"):
+    raise SystemExit(f"serve harness not ok: {rec.get('problems')}")
+if not rec.get("corrosan"):
+    raise SystemExit("serve harness did not run under the sanitizer")
+agr = rec["agreement"]
+if not (agr["ok"] and agr["transactions"]["ok"] and agr["pg_select"]["ok"]):
+    raise SystemExit(f"server/client request counts disagree: {agr}")
+for op in ("write", "pg_query", "subscribe_delivery"):
+    stats = rec["ops"][op]
+    if stats["count"] <= 0 or not (0.0 <= stats["p50"] <= stats["p99"]):
+        raise SystemExit(f"serve harness op {op} malformed: {stats}")
+print(f"serve harness: {agr['transactions']['server']} tx, "
+      f"{agr['pg_select']['server']} pg selects, "
+      f"{rec['server']['deliveries']} deliveries agree "
+      f"(write p99 {rec['ops']['write']['p99'] * 1e3:.1f} ms, "
+      f"delivery p99 {rec['ops']['subscribe_delivery']['p99'] * 1e3:.1f} ms)")
+PY
+echo "serve harness: ok (report: artifacts/serve_r16.json)"
 
 echo "== corrochaos fault-scenario sweep =="
 # the ISSUE 13 robustness gate (docs/chaos.md): every shipped seeded

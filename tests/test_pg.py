@@ -470,3 +470,82 @@ def test_sqlstate_mapper_units():
     ]
     for msg, want in cases:
         assert _sqlstate_for(Exception(msg)) == want, msg
+
+
+# --- ISSUE 16: concurrent clients + per-kind latency accounting ----------
+def test_concurrent_connections_isolated(pg):
+    """Eight simultaneous PG-wire connections run interleaved statement
+    mixes: every connection gets exactly its own results back (no
+    cross-connection bleed of rows, prepared state, or transaction
+    status), and the server's corro.pg.query.seconds{kind="select"}
+    histogram advances by exactly the number of selects the clients
+    issued — the same agreement gate the load harness enforces."""
+    import threading
+
+    agent, _, server, main = pg
+    metrics = agent.metrics
+
+    def select_count():
+        return sum(h["count"] for (n, lab), h in
+                   metrics.snapshot()["histograms"].items()
+                   if n == "corro.pg.query.seconds"
+                   and dict(lab).get("kind") == "select")
+
+    _, _, tag, err = main.query(
+        "INSERT INTO users (id, name, score) VALUES (55, 'conc', 99)")
+    assert err is None and tag == "INSERT 0 1"
+    base = select_count()
+
+    N_CONNS, N_OPS = 8, 5
+    results = [None] * N_CONNS
+    barrier = threading.Barrier(N_CONNS, timeout=60)
+
+    def worker(i):
+        out = {"errors": [], "selects": 0}
+        results[i] = out
+        c = MiniPg(server.addr, server.port)
+        try:
+            barrier.wait()  # all 8 connections live before any queries
+            for j in range(N_OPS):
+                want = 100000 + i * 1000 + j
+                _, rows, _, err = c.query(f"SELECT {want}")
+                out["selects"] += 1
+                if err is not None or rows != [[str(want)]]:
+                    out["errors"].append(("const", j, rows, err))
+                # extended protocol: portals/statements are per-conn
+                _, rows, _, err = c.extended(
+                    "SELECT name FROM users WHERE id = $1", params=(55,))
+                out["selects"] += 1
+                if err is not None or rows != [["conc"]]:
+                    out["errors"].append(("ext", j, rows, err))
+            # transaction status is connection-local: an open block on
+            # this conn must never leak into the others' ReadyForQuery
+            _, _, _, err = c.query("BEGIN")
+            if err is not None or c.last_status != "T":
+                out["errors"].append(("begin", c.last_status, err))
+            _, rows, _, err = c.query(
+                "SELECT score FROM users WHERE id = 55")
+            out["selects"] += 1
+            if err is not None or rows != [["99"]]:
+                out["errors"].append(("tx-select", rows, err))
+            _, _, _, err = c.query("ROLLBACK")
+            if err is not None or c.last_status != "I":
+                out["errors"].append(("rollback", c.last_status, err))
+        finally:
+            c.close()
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True,
+                                name=f"corro-test-pgconn-{i}")
+               for i in range(N_CONNS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not any(t.is_alive() for t in threads)
+    assert all(r is not None for r in results)
+    for i, r in enumerate(results):
+        assert not r["errors"], f"conn {i}: {r['errors'][:3]}"
+    issued = sum(r["selects"] for r in results)
+    assert issued == N_CONNS * (2 * N_OPS + 1)
+    # server-side accounting agrees exactly with the client tallies
+    assert select_count() - base == issued

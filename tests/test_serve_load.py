@@ -1,0 +1,77 @@
+"""corroload harness (ISSUE 16): seeded op plans, client-side
+percentiles, one end-to-end load run, and the BENCH_SERVE schema
+docs-sync gate."""
+
+import os
+
+from corrosion_tpu.obs.load import percentiles, plan_ops
+
+
+def test_plan_ops_deterministic():
+    """(seed, shape) fully determines the op streams and the digest the
+    BENCH_SERVE record carries — reruns are byte-identical plans."""
+    a = plan_ops(7, writers=3, write_ops=16, pg_readers=2, pg_ops=8,
+                 keys=10)
+    b = plan_ops(7, writers=3, write_ops=16, pg_readers=2, pg_ops=8,
+                 keys=10)
+    assert a == b
+    assert len(a["writers"]) == 3 and len(a["writers"][0]) == 16
+    assert len(a["pg"]) == 2 and len(a["pg"][0]) == 8
+    assert all(0 <= k < 10 for ops in a["writers"] + a["pg"] for k in ops)
+    # per-leg streams are independent (not one stream copied around)
+    assert a["writers"][0] != a["writers"][1]
+    c = plan_ops(8, writers=3, write_ops=16, pg_readers=2, pg_ops=8,
+                 keys=10)
+    assert c["digest"] != a["digest"]
+
+
+def test_percentiles_exact():
+    """Client-side percentiles are exact order statistics with linear
+    interpolation — checked against a known distribution."""
+    samples = [i / 100.0 for i in range(1, 101)]  # 0.01 .. 1.00
+    p = percentiles(samples)
+    assert abs(p["p50"] - 0.505) < 1e-9
+    assert abs(p["p95"] - 0.9505) < 1e-9
+    assert abs(p["p99"] - 0.9901) < 1e-9
+    assert percentiles([]) == {"p50": 0.0, "p95": 0.0, "p99": 0.0}
+    assert percentiles([0.25])["p99"] == 0.25
+
+
+def test_run_load_end_to_end():
+    """One small load run against a real in-process rig: the record is
+    well-formed, every op class saw traffic, and the server-vs-client
+    agreement gates hold."""
+    from corrosion_tpu.obs.load import run_load
+
+    rec = run_load(writers=2, subscribers=1, pg_readers=1, write_ops=3,
+                   pg_ops=3, keys=4, seed=3, warm_rounds=6)
+    assert rec["ok"], rec["problems"]
+    assert rec["kind"] == "bench_serve" and rec["schema"] == 1
+    assert rec["plan_digest"] == plan_ops(
+        3, writers=2, write_ops=3, pg_readers=1, pg_ops=3, keys=4
+    )["digest"]
+    assert rec["ops"]["write"]["count"] == 6
+    assert rec["ops"]["pg_query"]["count"] == 3
+    assert rec["ops"]["subscribe_delivery"]["count"] > 0
+    assert rec["ops"]["write"]["p99"] >= rec["ops"]["write"]["p50"] > 0
+    assert rec["qps"] > 0 and rec["duration_s"] > 0
+    assert rec["agreement"]["ok"]
+    assert rec["agreement"]["transactions"]["server"] == \
+        rec["agreement"]["transactions"]["client"]
+    assert rec["server"]["deliveries"] >= rec[
+        "ops"]["subscribe_delivery"]["count"]
+    assert rec["server"]["delivery_quantiles_s"]["p50"] >= 0.0
+
+
+def test_bench_serve_schema_documented():
+    """Every field the harness writes into the BENCH_SERVE record
+    appears in the schema section of docs/observability.md (the flight-
+    record doc-gate pattern)."""
+    doc = open(os.path.join(os.path.dirname(__file__), "..", "docs",
+                            "observability.md")).read()
+    for field in ("plan_digest", "duration_s", "qps", "write",
+                  "pg_query", "subscribe_delivery", "http_503",
+                  "tx_requests", "pg_selects", "deliveries",
+                  "delivery_quantiles_s", "unready_total", "shed_total",
+                  "agreement", "corrosan"):
+        assert f"`{field}`" in doc, f"BENCH_SERVE field {field} undocumented"

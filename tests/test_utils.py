@@ -83,6 +83,106 @@ def test_prometheus_le_formatting():
     assert 'le="1234567"' in t2 and 'le="1234568"' in t2
 
 
+def test_latency_buckets_default_ladder():
+    """The default histogram ladder must resolve serving-plane
+    latencies: sub-ms (PG point reads) through 10s (slow-path sync),
+    log-spaced so quantile interpolation error stays proportional."""
+    from corrosion_tpu.utils.metrics import LATENCY_BUCKETS
+
+    assert LATENCY_BUCKETS[0] <= 0.0005  # sub-ms resolution
+    assert LATENCY_BUCKETS[-1] >= 10.0
+    assert list(LATENCY_BUCKETS) == sorted(LATENCY_BUCKETS)
+    # log-spaced: no adjacent pair more than ~4x apart (a decade gap
+    # would make every quantile in it a wild guess)
+    for lo, hi in zip(LATENCY_BUCKETS, LATENCY_BUCKETS[1:]):
+        assert hi / lo <= 4.0 + 1e-9
+    r = Registry()
+    r.histogram("corro.test.lat", 0.003)
+    h = r.snapshot()["histograms"][("corro.test.lat", ())]
+    assert tuple(h["buckets"]) == tuple(LATENCY_BUCKETS)
+
+
+def test_histogram_bucket_ladder_pinned_per_name():
+    """First observation of a NAME fixes its bucket ladder for every
+    label set: mixed `le` ladders within one family are malformed
+    exposition (PromQL histogram_quantile silently mis-aggregates)."""
+    r = Registry()
+    r.histogram("corro.test.pin", 0.8, buckets=(0.5, 1.0, 2.0),
+                labels={"a": "1"})
+    # later caller asks for a different ladder — it must NOT fork the family
+    r.histogram("corro.test.pin", 1.5, buckets=(0.1, 10.0),
+                labels={"a": "2"})
+    hists = r.snapshot()["histograms"]
+    ladders = {tuple(h["buckets"]) for (n, _l), h in hists.items()
+               if n == "corro.test.pin"}
+    assert ladders == {(0.5, 1.0, 2.0)}
+    text = r.render()
+    assert 'le="10"' not in text
+
+
+def test_histogram_quantiles_known_distribution():
+    """The snapshot-side quantile estimator against distributions with
+    known percentiles (linear interpolation within a bucket)."""
+    from corrosion_tpu.utils.metrics import (
+        histogram_quantile,
+        quantiles_from_histogram,
+    )
+
+    r = Registry()
+    # uniform on (0, 1): 1000 samples, fine ladder -> p50 ~ 0.5 etc.
+    for i in range(1000):
+        r.histogram("corro.test.uni", (i + 0.5) / 1000.0,
+                    buckets=tuple(j / 20.0 for j in range(1, 21)))
+    h = r.snapshot()["histograms"][("corro.test.uni", ())]
+    qs = quantiles_from_histogram(h)
+    assert abs(qs["p50"] - 0.5) < 0.06
+    assert abs(qs["p95"] - 0.95) < 0.06
+    assert abs(qs["p99"] - 0.99) < 0.06
+    # two-point distribution: 90 fast + 10 slow -> p50 in the fast
+    # bucket, p99 in the slow one
+    r2 = Registry()
+    for _ in range(90):
+        r2.histogram("corro.test.bi", 0.004, buckets=(0.005, 0.05, 0.5))
+    for _ in range(10):
+        r2.histogram("corro.test.bi", 0.4, buckets=(0.005, 0.05, 0.5))
+    h2 = r2.snapshot()["histograms"][("corro.test.bi", ())]
+    assert histogram_quantile(h2, 0.5) <= 0.005
+    assert 0.05 < histogram_quantile(h2, 0.99) <= 0.5
+    # degenerate inputs stay finite
+    assert histogram_quantile({"count": 0, "buckets": (), "counts": (),
+                               "sum": 0.0}, 0.5) == 0.0
+
+
+def test_exposition_render_parse_roundtrip():
+    """`parse_exposition(render())` reconstructs the snapshot — the
+    guarantee the load harness's server-vs-client agreement gate (and
+    any external scraper) stands on. Covers escaped label values and
+    histograms, where the exposition is cumulative but the snapshot
+    is per-bucket."""
+    from corrosion_tpu.utils.metrics import parse_exposition
+
+    r = Registry()
+    r.counter("corro.test.reqs", 7, labels={"route": "/v1/x", "m": "GET"})
+    r.counter("corro.test.reqs", 3, labels={"route": "/v1/y", "m": "POST"})
+    r.gauge("corro.test.depth", 42, labels={"q": 'say "hi"\n\\done'})
+    for v in (0.003, 0.02, 0.02, 4.0):
+        r.histogram("corro.test.lat", v, buckets=(0.01, 0.1, 1.0))
+    parsed = parse_exposition(r.render())
+    assert parsed["counters"][
+        ("corro_test_reqs", (("m", "GET"), ("route", "/v1/x")))] == 7.0
+    assert parsed["counters"][
+        ("corro_test_reqs", (("m", "POST"), ("route", "/v1/y")))] == 3.0
+    # escaped label value survives the round trip byte-for-byte
+    assert parsed["gauges"][
+        ("corro_test_depth", (("q", 'say "hi"\n\\done'),))] == 42.0
+    h = parsed["histograms"][("corro_test_lat", ())]
+    assert h["count"] == 4
+    assert abs(h["sum"] - 4.043) < 1e-9
+    # de-accumulated per-bucket counts, not the cumulative wire form
+    assert h["counts"] == [1, 2, 0, 1]
+    assert [float(b) for b in h["buckets"]] == [0.01, 0.1, 1.0]
+
+
 def test_prometheus_listener_ephemeral_port_and_join():
     """port=0 binds an ephemeral port exposed as `bound_port`, and
     shutdown() joins the counted corro-prometheus thread (the leak gate
