@@ -24,7 +24,11 @@ Scope rules (precision over recall):
 - a method named ``*_locked`` is treated as called with the lock held
   (the ``_flush_locked`` convention);
 - a nested ``def`` resets the held-lock context: a closure defined
-  under ``with`` runs later, when the lock is long released.
+  under ``with`` runs later, when the lock is long released;
+- ``self._cv = threading.Condition(self.<lock>)`` makes ``self._cv``
+  an ALIAS of the lock (a Condition shares the mutex it wraps), so
+  ``with self._cv:`` counts as holding it — the admission-controller
+  idiom. A Condition wrapping anything else stays out of scope.
 """
 
 from __future__ import annotations
@@ -38,6 +42,7 @@ RULE_MUTATION = "unlocked-mutation"
 RULE_BLOCKING = "blocking-under-lock"
 
 _LOCK_CTORS = {"threading.Lock", "threading.RLock", "Lock", "RLock"}
+_CONDITION_CTORS = {"threading.Condition", "Condition"}
 
 #: container methods that mutate their receiver
 _MUTATORS = {
@@ -91,16 +96,36 @@ def _lock_attrs(cls: ast.ClassDef) -> Set[str]:
     return attrs
 
 
-def _is_lock_with(item: ast.withitem, lock_attr: str) -> bool:
-    return _self_attr(item.context_expr) == lock_attr
+def _cv_aliases(cls: ast.ClassDef, lock_attr: str) -> Set[str]:
+    """Attrs bound to ``threading.Condition(self.<lock>)``: the
+    Condition shares the class's own mutex, so entering it IS entering
+    the lock."""
+    aliases: Set[str] = set()
+    for node in _walk_own_class(cls):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            call = node.value
+            if (dotted_name(call.func) in _CONDITION_CTORS
+                    and len(call.args) == 1
+                    and _self_attr(call.args[0]) == lock_attr):
+                for tgt in node.targets:
+                    name = _self_attr(tgt)
+                    if name:
+                        aliases.add(name)
+    return aliases
+
+
+def _is_lock_with(item: ast.withitem, lock_names: Set[str]) -> bool:
+    return _self_attr(item.context_expr) in lock_names
 
 
 class _MethodScan:
     def __init__(self, cls_name: str, method: ast.FunctionDef,
-                 lock_attr: str, path: str, findings: List[Finding]):
+                 lock_attr: str, lock_names: Set[str], path: str,
+                 findings: List[Finding]):
         self.cls_name = cls_name
         self.method = method
         self.lock_attr = lock_attr
+        self.lock_names = lock_names  # the lock + its Condition aliases
         self.path = path
         self.findings = findings
 
@@ -117,7 +142,7 @@ class _MethodScan:
         name = _self_attr(target)
         if name is None and isinstance(target, ast.Subscript):
             name = _self_attr(target.value)
-        if name and name.startswith("_") and name != self.lock_attr:
+        if name and name.startswith("_") and name not in self.lock_names:
             return [name]
         return []
 
@@ -154,7 +179,8 @@ class _MethodScan:
         if (isinstance(node.func, ast.Attribute)
                 and node.func.attr in _MUTATORS):
             name = _self_attr(node.func.value)
-            if name and name.startswith("_") and name != self.lock_attr:
+            if (name and name.startswith("_")
+                    and name not in self.lock_names):
                 return name
         return None
 
@@ -178,7 +204,7 @@ class _MethodScan:
             return
         if isinstance(stmt, ast.With):
             inner_held = held or any(
-                _is_lock_with(it, self.lock_attr) for it in stmt.items
+                _is_lock_with(it, self.lock_names) for it in stmt.items
             )
             for it in stmt.items:
                 self._scan_expr(it.context_expr, held)
@@ -222,10 +248,12 @@ def check(tree: ast.AST, source: str, path: str) -> List[Finding]:
         if len(locks) != 1:
             continue  # no lock, or multi-lock: ownership is not inferable
         lock_attr = locks.pop()
+        lock_names = {lock_attr} | _cv_aliases(cls, lock_attr)
         for method in cls.body:
             if not isinstance(method, ast.FunctionDef):
                 continue
             if method.name == "__init__":
                 continue  # the object is not shared during construction
-            _MethodScan(cls.name, method, lock_attr, path, findings).run()
+            _MethodScan(cls.name, method, lock_attr, lock_names, path,
+                        findings).run()
     return findings

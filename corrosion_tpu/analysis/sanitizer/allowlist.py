@@ -152,6 +152,14 @@ ALLOWED_LOCK_EDGES: Dict[Tuple[str, str], str] = {
         "same snapshot path as TrackedLock._lock above; the registry "
         "lock is a leaf"
     ),
+    ("corrosion_tpu.api.admission.AdmissionController._mu",
+     "corrosion_tpu.utils.metrics.Registry._lock"): (
+        "admit()/release() publish the corro.admission.* counters and "
+        "level gauges while the admission mutex is held so the levels "
+        "are snapshot-consistent with the decision; Registry._lock is "
+        "a leaf (pure dict updates, no outcalls), so no path can "
+        "acquire an admission lock under it and close a cycle"
+    ),
 }
 
 #: thread-name prefixes the leak gate exempts, with reasons.

@@ -35,12 +35,14 @@ from __future__ import annotations
 
 import json
 import queue
+import socket
 import threading
 import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, List, Optional, Tuple
 
+from corrosion_tpu.api.admission import AdmissionController, route_class
 from corrosion_tpu.db.database import SqlError
 from corrosion_tpu.db.schema import SchemaError
 from corrosion_tpu.pubsub import SubsManager, UpdatesManager
@@ -115,12 +117,20 @@ class ApiServer:
 
     def __init__(self, db, addr: str = "127.0.0.1", port: int = 0,
                  default_node: int = 0, subs: Optional[SubsManager] = None,
-                 updates: Optional[UpdatesManager] = None):
+                 updates: Optional[UpdatesManager] = None, serve=None,
+                 admission: Optional[AdmissionController] = None):
         self.db = db
         self.agent = db.agent
         self.default_node = default_node
-        self.subs = subs or SubsManager(db)
-        self.updates = updates or UpdatesManager(db)
+        # corroguard (docs/overload.md): ``serve`` is the [serve] config
+        # section (queue bounds + admission limits); ``admission`` lets a
+        # PgServer share ONE controller so both listeners shed against
+        # the same per-class budgets
+        self.serve = serve
+        self.admission = admission or AdmissionController(
+            serve, registry=db.agent.metrics)
+        self.subs = subs or SubsManager(db, serve=serve)
+        self.updates = updates or UpdatesManager(db, serve=serve)
         handler = _make_handler(self)
         self.httpd = _DrainingHTTPServer((addr, port), handler)
         self.addr, self.port = self.httpd.server_address[:2]
@@ -203,7 +213,13 @@ def _make_handler(server: ApiServer):
             self.end_headers()
 
         def _ndjson_line(self, obj: Any) -> None:
-            data = json.dumps(obj).encode() + b"\n"
+            self._write_frame(json.dumps(obj).encode() + b"\n")
+
+        def _write_frame(self, data: bytes) -> None:
+            """One NDJSON line, pre-encoded: the chunked framing around
+            the multicast bytes the batched fanout cached (the hot path
+            writes frames verbatim instead of re-encoding per
+            subscriber — corroguard, docs/overload.md)."""
             self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
             self.wfile.flush()
             self._resp_bytes += len(data)
@@ -244,14 +260,24 @@ def _make_handler(server: ApiServer):
             req_bytes = int(self.headers.get("Content-Length") or 0)
             metrics.gauge_add("corro.http.inflight", 1)
             t0 = time.perf_counter()
+            # corroguard admission (docs/overload.md): every route
+            # except the control plane takes a per-class slot before
+            # dispatch; a shed request still rides the full metrics
+            # envelope below (the 503 is a served request — the
+            # server-vs-client agreement gates count it)
+            cls = route_class(route, method)
+            admitted = cls is None or server.admission.admit(cls)
             try:
-                with span(f"http.{method.lower()}.{route}",
-                          traceparent=self.headers.get("traceparent"),
-                          route=route, method=method):
-                    if method == "POST":
-                        self._dispatch_post(path, q)
-                    else:
-                        self._dispatch_get(path, q)
+                if not admitted:
+                    self._reject_overloaded(cls, route)
+                else:
+                    with span(f"http.{method.lower()}.{route}",
+                              traceparent=self.headers.get("traceparent"),
+                              route=route, method=method):
+                        if method == "POST":
+                            self._dispatch_post(path, q)
+                        else:
+                            self._dispatch_get(path, q)
             except (SqlError, SchemaError, ValueError, KeyError) as e:
                 self._reply_error(400, str(e))
             except BrokenPipeError:
@@ -263,6 +289,8 @@ def _make_handler(server: ApiServer):
                 except Exception:  # noqa: BLE001 — headers may be gone
                     pass
             finally:
+                if admitted and cls is not None:
+                    server.admission.release(cls)
                 dt = time.perf_counter() - t0
                 metrics.gauge_add("corro.http.inflight", -1)
                 metrics.histogram(
@@ -331,6 +359,22 @@ def _make_handler(server: ApiServer):
                 self._reply_error(404, f"no such route: GET {path}")
 
         # --- route bodies ------------------------------------------------
+        def _reject_overloaded(self, cls: str, route: str) -> None:
+            """corroguard shed: 503 + a Retry-After derived from the
+            LIVE latency histograms (p95 × requests ahead, clamped),
+            riding the same unready accounting the ``/v1/ready``
+            machinery established (docs/overload.md)."""
+            ra = server.admission.retry_after(cls)
+            metrics = server.agent.metrics
+            metrics.counter("corro.http.unready_total", 1.0,
+                            {"status": "overloaded"})
+            metrics.histogram("corro.http.retry_after.seconds", float(ra))
+            self._reply_json(
+                503,
+                {"error": "overloaded", "class": cls, "route": route,
+                 "retry_after": ra},
+                headers={"Retry-After": str(ra)})
+
         def _health(self) -> None:
             """``/v1/health`` and ``/v1/ready`` (both route here — the
             two names exist for orchestrator convention; this agent has
@@ -406,8 +450,22 @@ def _make_handler(server: ApiServer):
             from_id = int(q["from"]) if "from" in q else None
             self._stream_matcher(matcher, from_id)
 
+        def _clamp_stream_socket(self) -> None:
+            """Bound the kernel half of the delivery pipeline: the
+            per-sub queue only bounds a slow consumer's lag if the
+            socket send buffer behind it can't silently absorb the
+            backlog (docs/overload.md)."""
+            sndbuf = getattr(server.serve, "stream_sndbuf", 0) or 0
+            if sndbuf > 0:
+                try:
+                    self.connection.setsockopt(
+                        socket.SOL_SOCKET, socket.SO_SNDBUF, sndbuf)
+                except OSError:
+                    pass
+
         def _stream_matcher(self, matcher, from_id: Optional[int]) -> None:
             sub_q = matcher.attach(from_change_id=from_id)
+            self._clamp_stream_socket()
             self._start_ndjson({"corro-query-id": matcher.id})
             try:
                 while not (server.agent.tripwire.tripped
@@ -416,9 +474,22 @@ def _make_handler(server: ApiServer):
                         kind, payload = sub_q.get(timeout=1.0)
                     except queue.Empty:
                         if sub_q.lagged:
-                            # slow consumer was disconnected by the matcher
+                            # slow consumer disconnected by the fanout:
+                            # the stream's last line is an explicit
+                            # resync marker — the client must re-snapshot
+                            # (docs/overload.md resync contract)
+                            self._resync_marker(
+                                sub_q.take_resync(), matcher,
+                                "slow-consumer")
                             break
                         continue
+                    # shed-oldest drops leave a gap in the change-id
+                    # sequence: announce it BEFORE the next event so the
+                    # client knows the stream skipped ahead
+                    dropped = sub_q.take_resync()
+                    if dropped:
+                        self._resync_marker(dropped, matcher,
+                                            "shed-oldest")
                     if kind == "columns":
                         self._ndjson_line({"columns": payload})
                     elif kind == "row":
@@ -431,17 +502,36 @@ def _make_handler(server: ApiServer):
                         self._ndjson_line({"eoq": {"change_id": payload}})
                     elif kind == "change":
                         cid, ckind, key, row = payload
-                        self._ndjson_line({"change": [
-                            ckind, _encode_value(key),
-                            None if row is None
-                            else [_encode_value(v) for v in row],
-                            cid,
-                        ]})
+                        # batched fanout: multicast the frame the matcher
+                        # encoded once for ALL subscribers; encode only
+                        # when the cache already trimmed past this id
+                        frame = matcher.wire_frame(cid)
+                        if frame is None:
+                            frame = json.dumps({"change": [
+                                ckind, _encode_value(key),
+                                None if row is None
+                                else [_encode_value(v) for v in row],
+                                cid,
+                            ]}).encode() + b"\n"
+                        self._write_frame(frame)
                         self._observe_delivery(matcher, key)
             except (BrokenPipeError, ConnectionResetError):
                 pass
             finally:
                 matcher.detach(sub_q)
+
+        def _resync_marker(self, dropped: int, matcher, reason: str
+                           ) -> None:
+            """The catch-up resync marker (docs/overload.md): the
+            stream shed frames (or is disconnecting a slow consumer) —
+            the client must re-snapshot, or re-attach with
+            ``?from=<last delivered id>`` to replay the gap from the
+            retained change log."""
+            self._ndjson_line({"resync": {
+                "dropped": int(dropped),
+                "change_id": matcher.last_change_id,
+                "reason": reason,
+            }})
 
         def _observe_delivery(self, matcher, key) -> None:
             """Write-commit -> NDJSON delivery latency: the change event
@@ -460,6 +550,7 @@ def _make_handler(server: ApiServer):
 
         def _updates_feed(self, table: str) -> None:
             feed_q = server.updates.attach(table)
+            self._clamp_stream_socket()
             self._start_ndjson()
             try:
                 while not (server.agent.tripwire.tripped
@@ -468,8 +559,16 @@ def _make_handler(server: ApiServer):
                         kind, payload = feed_q.get(timeout=1.0)
                     except queue.Empty:
                         if feed_q.lagged:
+                            # same resync contract as subscriptions
+                            self._ndjson_line({"resync": {
+                                "dropped": feed_q.take_resync(),
+                                "reason": "slow-consumer"}})
                             break
                         continue
+                    dropped = feed_q.take_resync()
+                    if dropped:
+                        self._ndjson_line({"resync": {
+                            "dropped": dropped, "reason": "shed-oldest"}})
                     ckind, pk = payload
                     self._ndjson_line({"notify": [ckind, _encode_value(pk)]})
             except (BrokenPipeError, ConnectionResetError):

@@ -559,6 +559,7 @@ def cmd_chaos(args) -> int:
     from corrosion_tpu.resilience.chaos import (
         SCENARIOS,
         TIER1_SCENARIOS,
+        _host_scenarios,
         run_sweep,
     )
 
@@ -568,6 +569,9 @@ def cmd_chaos(args) -> int:
             print(f"{name}{tier}: {len(script.phases)} phases, "
                   f"{script.total_rounds} rounds, "
                   f"{len(script.injections)} injection(s)")
+        for name in sorted(_host_scenarios()):
+            print(f"{name} [host-plane]: serving-plane scenario, "
+                  f"run by name (not part of the default sweep)")
         return 0
     if args.scenario:
         names = list(args.scenario)
@@ -575,12 +579,21 @@ def cmd_chaos(args) -> int:
         names = list(TIER1_SCENARIOS)
     else:
         names = sorted(SCENARIOS)
+    seed_range = None
+    if args.seed_range:
+        try:
+            lo, _, hi = args.seed_range.partition(":")
+            seed_range = (int(lo), int(hi))
+        except ValueError:
+            print(f"error: --seed-range wants A:B, got "
+                  f"{args.seed_range!r}", file=sys.stderr)
+            return 2
     corrosan = os.environ.get("CORROSAN") == "1"
     if corrosan:
         from corrosion_tpu.analysis.sanitizer import sanitized
 
         with sanitized() as san:
-            out = run_sweep(names, seed=args.seed)
+            out = run_sweep(names, seed=args.seed, seed_range=seed_range)
         findings = san.gate()
         if findings:
             out["ok"] = False
@@ -588,7 +601,7 @@ def cmd_chaos(args) -> int:
                 f"corrosan: {f.kind} {f.subject}" for f in findings
             )
     else:
-        out = run_sweep(names, seed=args.seed)
+        out = run_sweep(names, seed=args.seed, seed_range=seed_range)
     out["corrosan"] = corrosan
     if args.output_json:
         os.makedirs(os.path.dirname(os.path.abspath(args.output_json)),
@@ -609,7 +622,8 @@ def cmd_chaos(args) -> int:
                 "converged": bool(r.get("converged")),
                 "platform": out["platform"],
             }
-            for r in out["scenarios"] if not r.get("skipped")
+            for r in out["scenarios"]
+            if not r.get("skipped") and not r.get("host_plane")
         ]
         os.makedirs(
             os.path.dirname(os.path.abspath(args.convergence_json)),
@@ -632,19 +646,33 @@ def cmd_load(args) -> int:
     from corrosion_tpu.utils.compile_cache import enable_compile_cache
 
     enable_compile_cache()
-    from corrosion_tpu.obs.load import run_load
+    from corrosion_tpu.obs.load import run_load, run_overload_bench
 
-    kwargs = dict(
-        writers=args.writers, subscribers=args.subscribers,
-        pg_readers=args.pg_readers, write_ops=args.write_ops,
-        pg_ops=args.pg_ops, keys=args.keys, seed=args.seed,
-    )
+    if args.overload:
+        # overload mode: corroguard's degradation-contract bench —
+        # guarded arm (admission + bounded queues) and unguarded arm,
+        # gated on "guard holds AND no-guard demonstrably violates".
+        # The harness's own defaults (writers/subscribers/keys tuned to
+        # saturate the guard) govern everything but the flags below.
+        runner = run_overload_bench
+        kwargs = dict(
+            stages=tuple(int(x) for x in args.stages.split(",")),
+            slow_subs=args.slow_subs, slow_ms=args.slow_ms,
+            lag_bound_s=args.lag_bound, seed=args.seed,
+        )
+    else:
+        runner = run_load
+        kwargs = dict(
+            writers=args.writers, subscribers=args.subscribers,
+            pg_readers=args.pg_readers, write_ops=args.write_ops,
+            pg_ops=args.pg_ops, keys=args.keys, seed=args.seed,
+        )
     corrosan = os.environ.get("CORROSAN") == "1"
     if corrosan:
         from corrosion_tpu.analysis.sanitizer import sanitized
 
         with sanitized() as san:
-            out = run_load(**kwargs)
+            out = runner(**kwargs)
         findings = san.gate()
         if findings:
             out["ok"] = False
@@ -652,7 +680,7 @@ def cmd_load(args) -> int:
                 f"corrosan: {f.kind} {f.subject}" for f in findings
             )
     else:
-        out = run_load(**kwargs)
+        out = runner(**kwargs)
     out["corrosan"] = corrosan
     if args.output_json:
         os.makedirs(os.path.dirname(os.path.abspath(args.output_json)),
@@ -882,6 +910,10 @@ def build_parser() -> argparse.ArgumentParser:
     ch.add_argument("--seed", type=int, default=0,
                     help="scenario seed — (name, seed) fully determines "
                          "the trace and the verdict")
+    ch.add_argument("--seed-range", metavar="A:B", default=None,
+                    help="sweep every scenario across seeds A..B "
+                         "(inclusive); the record gains a per_seed map "
+                         "of seed -> rounds-to-convergence")
     ch.add_argument("--tier1", action="store_true",
                     help="run only the tier-1 smoke subset")
     ch.add_argument("--list", action="store_true",
@@ -917,6 +949,22 @@ def build_parser() -> argparse.ArgumentParser:
     ld.add_argument("--seed", type=int, default=0,
                     help="op-plan seed — the record carries the plan "
                          "digest it determines")
+    ld.add_argument("--overload", action="store_true",
+                    help="run corroguard's overload bench instead: a "
+                         "guarded arm (admission control + bounded "
+                         "queues) and an unguarded arm, gated on the "
+                         "degradation contract (docs/overload.md)")
+    ld.add_argument("--stages", default="2,4,8",
+                    help="[overload] comma-separated open-loop writer "
+                         "counts per ramp stage")
+    ld.add_argument("--slow-subs", type=int, default=2,
+                    help="[overload] deliberately slow subscribers")
+    ld.add_argument("--slow-ms", type=float, default=25.0,
+                    help="[overload] per-event stall of a slow "
+                         "subscriber, milliseconds")
+    ld.add_argument("--lag-bound", type=float, default=2.5,
+                    help="[overload] p99 delivery-lag bound (seconds) "
+                         "the guarded arm must hold")
     ld.add_argument("--output-json", metavar="PATH", default=None,
                     help="write the BENCH_SERVE record")
     ld.set_defaults(fn=cmd_load)

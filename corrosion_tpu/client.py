@@ -17,9 +17,37 @@ from corrosion_tpu.utils.backoff import Backoff, retry_call
 
 
 class ApiError(RuntimeError):
-    def __init__(self, status: int, message: str):
+    def __init__(self, status: int, message: str,
+                 retry_after: Optional[float] = None):
         super().__init__(f"HTTP {status}: {message}")
         self.status = status
+        # the server's Retry-After hint (seconds, parsed off a 503) —
+        # ``retry_call`` honors it over its own jittered schedule,
+        # capped at the policy's max_wait (corroguard, docs/overload.md)
+        self.retry_after = retry_after
+
+
+class ApiUnavailable(ApiError):
+    """503 from the serving plane: the agent is restoring/backing off
+    (``/v1/ready`` machinery) or corroguard admission shed the request.
+    Carries the Retry-After hint; a client built with ``retry_503 > 0``
+    retries these through the shared ``retry_call`` policy."""
+
+
+def _parse_retry_after(resp) -> Optional[float]:
+    raw = resp.headers.get("Retry-After")
+    if raw is None:
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        return None
+
+
+def _raise_for_status(resp, status: int, msg: str) -> None:
+    if status == 503:
+        raise ApiUnavailable(status, msg, _parse_retry_after(resp))
+    raise ApiError(status, msg)
 
 
 def _decode_value(v: Any) -> Any:
@@ -72,6 +100,10 @@ class SubscriptionStream(_NdjsonStream):
         super().__init__(conn, resp)
         self.id = sub_id
         self.last_change_id = last_change_id
+        # resync markers seen (corroguard shed — the stream has gaps
+        # and the consumer should re-snapshot, docs/overload.md)
+        self.resyncs = 0
+        self.dropped = 0
 
     def __iter__(self) -> Iterator[dict]:
         for event in super().__iter__():
@@ -81,6 +113,9 @@ class SubscriptionStream(_NdjsonStream):
                 cid = event["eoq"].get("change_id")
                 if cid is not None:
                     self.last_change_id = cid
+            elif "resync" in event:
+                self.resyncs += 1
+                self.dropped += int(event["resync"].get("dropped", 0))
             yield event
 
 
@@ -88,7 +123,8 @@ class CorrosionApiClient:
     """Client for one agent's HTTP API."""
 
     def __init__(self, addr: str = "127.0.0.1", port: int = 8787,
-                 timeout: float = 30.0, connect_retries: int = 2):
+                 timeout: float = 30.0, connect_retries: int = 2,
+                 retry_503: int = 0, retry_503_max_wait: float = 2.0):
         self.addr = addr
         self.port = port
         self.timeout = timeout
@@ -98,13 +134,27 @@ class CorrosionApiClient:
         # failing the one-shot command. Refused means nothing was sent,
         # so retrying is safe for writes too.
         self.connect_retries = connect_retries
+        # corroguard closed-loop mode (docs/overload.md): retry_503 > 0
+        # also retries 503s, sleeping the server's Retry-After hint
+        # (capped at retry_503_max_wait) instead of the jittered
+        # schedule. A 503 was a complete (rejected) exchange — nothing
+        # committed — so retrying writes is safe too.
+        self.retry_503 = retry_503
+        self.retry_503_max_wait = retry_503_max_wait
 
     def _retry_connect(self, attempt):
+        retry_on: tuple = (ConnectionRefusedError,)
+        max_wait = 0.5
+        retries = self.connect_retries
+        if self.retry_503 > 0:
+            retry_on = (ConnectionRefusedError, ApiUnavailable)
+            max_wait = self.retry_503_max_wait
+            retries = max(self.connect_retries, self.retry_503)
         return retry_call(
             attempt,
-            backoff=Backoff(min_wait=0.05, max_wait=0.5,
-                            max_retries=self.connect_retries),
-            retry_on=(ConnectionRefusedError,),
+            backoff=Backoff(min_wait=0.05, max_wait=max_wait,
+                            max_retries=retries),
+            retry_on=retry_on,
         )
 
     # --- plumbing --------------------------------------------------------
@@ -139,7 +189,7 @@ class CorrosionApiClient:
                 if resp.status >= 400:
                     msg = obj.get("error", data.decode()) if isinstance(
                         obj, dict) else data.decode()
-                    raise ApiError(resp.status, msg)
+                    _raise_for_status(resp, resp.status, msg)
                 return obj
             finally:
                 conn.close()
@@ -173,7 +223,7 @@ class CorrosionApiClient:
                     msg = json.loads(data).get("error", data.decode())
                 except Exception:  # noqa: BLE001
                     msg = data.decode()
-                raise ApiError(resp.status, msg)
+                _raise_for_status(resp, resp.status, msg)
             return conn, resp
 
         return self._retry_connect(attempt)

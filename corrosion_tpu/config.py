@@ -118,6 +118,36 @@ class PgConfig:
 
 
 @dataclasses.dataclass
+class ServeConfig:
+    """corroguard overload policy for the serving plane
+    (``api/admission.py``, docs/overload.md).
+
+    ``max_inflight`` <= 0 disables admission control entirely (the
+    unguarded plane); with it on, each route class (write / read /
+    stream / pg) admits at most ``max_inflight`` concurrent requests,
+    queues up to ``max_queue`` more for ``queue_wait`` seconds, and
+    sheds the rest with 503 + Retry-After derived from the live
+    latency histograms."""
+
+    max_inflight: int = 0  # per-route-class concurrency cap; <=0 = off
+    max_queue: int = 0  # per-class waiters beyond the cap before shedding
+    # stream/pg tickets are held for the WHOLE stream / wire connection,
+    # so long-lived classes get their own capacity instead of starving
+    # one-shot requests out of max_inflight; <=0 inherits max_inflight
+    max_streams: int = 0
+    queue_wait: float = 0.25  # seconds a queued request waits for a slot
+    retry_after_cap: float = 30.0  # ceiling on derived Retry-After hints
+    # bounded per-subscription NDJSON delivery queues (pubsub.py):
+    shed_policy: str = "shed-oldest"  # or "drop-newest" (legacy)
+    sub_queue: int = 65536  # per-sub queue bound (frames)
+    sub_shed_threshold: int = 256  # cumulative sheds before disconnect
+    # SO_SNDBUF clamp for NDJSON stream sockets (> 0 to enable): the
+    # per-sub queue only bounds delivery lag if the kernel's socket
+    # pipeline can't silently absorb the backlog behind it
+    stream_sndbuf: int = 0
+
+
+@dataclasses.dataclass
 class AdminConfig:
     """UDS admin socket (``config.rs`` ``admin.uds_path``)."""
 
@@ -177,6 +207,7 @@ class Config:
     perf: PerfConfig = dataclasses.field(default_factory=PerfConfig)
     sim: SimConfigSection = dataclasses.field(default_factory=SimConfigSection)
     pg: PgConfig = dataclasses.field(default_factory=PgConfig)
+    serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
     admin: AdminConfig = dataclasses.field(default_factory=AdminConfig)
     telemetry: TelemetryConfig = dataclasses.field(default_factory=TelemetryConfig)
     obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)

@@ -69,6 +69,7 @@ SQLSTATE_FEATURE_UNSUPPORTED = "0A000"
 SQLSTATE_PROGRAM_LIMIT = "54000"
 SQLSTATE_INTERNAL = "XX000"
 SQLSTATE_IN_FAILED_TX = "25P02"
+SQLSTATE_TOO_MANY_CONNECTIONS = "53300"  # corroguard admission shed
 
 
 def _sqlstate_for(exc: Exception) -> str:
@@ -519,9 +520,17 @@ class PgServer:
     """PG v3 listener bound to one Database."""
 
     def __init__(self, db, addr: str = "127.0.0.1", port: int = 0,
-                 default_node: int = 0):
+                 default_node: int = 0, admission=None):
+        from corrosion_tpu.api.admission import AdmissionController
+
         self.db = db
         self.default_node = default_node
+        # corroguard (docs/overload.md): pass the ApiServer's controller
+        # to shed PG connections against the same per-class budgets as
+        # the HTTP plane; the default standalone controller is disabled
+        # (ServeConfig.max_inflight == 0)
+        self.admission = admission or AdmissionController(
+            None, registry=db.agent.metrics)
         handler = _make_handler(self)
 
         class _DrainingTCPServer(DrainingConnMixin,
@@ -829,6 +838,7 @@ def _make_handler(server: PgServer):
 
         # --- protocol phases ---------------------------------------------
         def handle(self):
+            admitted = False
             try:
                 params = self._read_startup()
                 if params is None:
@@ -840,6 +850,18 @@ def _make_handler(server: PgServer):
                             params["database"].replace("node", ""))
                     except ValueError:
                         pass
+                # corroguard admission on the accept path (docs/
+                # overload.md): a connection slot is a "pg"-class ticket
+                # held for the whole wire session; a shed connection gets
+                # the canonical 53300 before the auth handshake
+                if not server.admission.admit("pg"):
+                    ra = server.admission.retry_after("pg")
+                    self._send_error(
+                        f"server overloaded; retry after {ra}s",
+                        SQLSTATE_TOO_MANY_CONNECTIONS)
+                    self.out.flush()
+                    return
+                admitted = True
                 self.out.add(b"R", struct.pack("!I", 0))  # AuthenticationOk
                 for k, v in (("server_version", "14.0"),
                              ("server_encoding", "UTF8"),
@@ -853,6 +875,9 @@ def _make_handler(server: PgServer):
                 pass
             except Exception:  # noqa: BLE001
                 logger.exception("pg connection failed")
+            finally:
+                if admitted:
+                    server.admission.release("pg")
 
         def _loop(self):
             while True:

@@ -59,6 +59,33 @@ def _enc_key(k: Any) -> Any:
     return _enc(k)
 
 
+def _enc_wire(v: Any) -> Any:
+    """HTTP wire encoding for change-event values (the ``{"blob"}``
+    convention of ``api/http.py``, applied recursively to composite pk
+    tuples)."""
+    if isinstance(v, bytes):
+        return {"blob": v.hex()}
+    if isinstance(v, tuple):
+        return [_enc_wire(x) for x in v]
+    return v
+
+
+def encode_change_frame(rec) -> bytes:
+    """The NDJSON wire line for one change record, exactly as the HTTP
+    layer frames it (``{"change": [kind, key, row, id]}`` + newline).
+    Batched fanout (corroguard, docs/overload.md): the matcher encodes
+    each per-round delta record ONCE through this and caches the bytes
+    by change id — every subscriber stream multicasts the same frame
+    instead of re-encoding per subscriber."""
+    cid, kind, key, row = rec
+    return json.dumps({"change": [
+        kind,
+        _enc_wire(key),
+        None if row is None else [_enc_wire(v) for v in row],
+        cid,
+    ]}).encode() + b"\n"
+
+
 def _dec_key(k: Any) -> Any:
     if isinstance(k, dict) and "__key__" in k:
         return tuple(_dec(x) for x in k["__key__"])
@@ -66,22 +93,82 @@ def _dec_key(k: Any) -> Any:
 
 
 class SubQueue(queue.Queue):
-    """Per-subscriber event queue with lag semantics: the producer (the
-    round thread) never blocks — an overflowing subscriber is marked
-    lagged and disconnected, exactly the tokio broadcast
-    ``RecvError::Lagged`` behavior the reference relies on."""
+    """Per-subscriber event queue with bounded backpressure
+    (corroguard, docs/overload.md). The producer (the round thread)
+    never blocks. Two shed policies:
 
-    def __init__(self, maxsize: int = 65536):
+    - ``shed-oldest`` (default): on overflow the OLDEST queued frame is
+      dropped to admit the new one — the consumer keeps receiving fresh
+      events with a bounded lag and learns about the gap through the
+      stream's resync marker (:meth:`take_resync`). Crossing
+      ``shed_threshold`` cumulative drops marks the consumer lagged:
+      the slow-consumer disconnect policy.
+    - ``drop-newest`` (legacy): overflow refuses the new frame and
+      marks the consumer lagged immediately — the tokio broadcast
+      ``RecvError::Lagged`` behavior the reference relies on.
+    """
+
+    def __init__(self, maxsize: int = 65536,
+                 shed_policy: str = "shed-oldest",
+                 shed_threshold: int = 256):
         super().__init__(maxsize=maxsize)
+        self.shed_policy = shed_policy
+        self.shed_threshold = max(1, int(shed_threshold))
         self.lagged = False
+        self._shed_mu = threading.Lock()
+        self._shed = 0  # lifetime frames dropped (shed-oldest)
+        self._resync = 0  # drops since the consumer last took a marker
+        self._reported = 0  # drops already drained into shed_total
 
     def offer(self, item) -> bool:
-        try:
-            self.put_nowait(item)
-            return True
-        except queue.Full:
-            self.lagged = True
+        """Producer-side non-blocking enqueue. False = refused: the
+        consumer is lagged and the fanout will disconnect it."""
+        if self.lagged:
             return False
+        while True:
+            try:
+                self.put_nowait(item)
+                return True
+            except queue.Full:
+                if self.shed_policy != "shed-oldest":
+                    self.lagged = True
+                    return False
+                try:
+                    self.get_nowait()  # drop the oldest frame
+                except queue.Empty:
+                    continue  # the consumer drained it first; retry
+                with self._shed_mu:
+                    self._shed += 1
+                    self._resync += 1
+                    if self._shed >= self.shed_threshold:
+                        self.lagged = True
+
+    def preload(self, item) -> None:
+        """Attach-time enqueue that bypasses ``maxsize``: the initial
+        snapshot / catch-up backlog must arrive whole even when it is
+        larger than the live bound (the consumer has not even started
+        reading yet, so it cannot be 'slow'). Live ``offer`` traffic
+        sheds against the bound as usual once the stream is running."""
+        with self.mutex:
+            self.queue.append(item)
+            self.unfinished_tasks += 1
+            self.not_empty.notify()
+
+    def drain_shed(self) -> int:
+        """Producer side: drops not yet folded into
+        ``corro.subs.shed_total`` (the fanout drains after each round)."""
+        with self._shed_mu:
+            n = self._shed - self._reported
+            self._reported = self._shed
+            return n
+
+    def take_resync(self) -> int:
+        """Consumer side: drops since the last call — non-zero means
+        the stream has a gap and the HTTP loop owes the client a resync
+        marker before the next event (docs/overload.md)."""
+        with self._shed_mu:
+            n, self._resync = self._resync, 0
+            return n
 
 
 class DeltaTracker:
@@ -143,7 +230,7 @@ class Matcher:
 
     def __init__(self, db, node: int, sql: str, params: Any = None,
                  sub_id: Optional[str] = None, max_log: int = 4096,
-                 restore: Optional[dict] = None):
+                 restore: Optional[dict] = None, serve=None):
         self.id = sub_id or uuid.uuid4().hex
         self.db = db
         self.node = node
@@ -211,11 +298,24 @@ class Matcher:
         # persisted manifest may be a whole downtime old): its first
         # poll MUST be a full re-diff or down-window changes are lost
         self._force_full = restore is not None
+        # corroguard queue policy for the subscriber queues this matcher
+        # hands out (duck-typed [serve] section — pubsub stays free of a
+        # config import; any object with these attrs works)
+        self.sub_queue = getattr(serve, "sub_queue", 65536) if serve else 65536
+        self.shed_policy = (getattr(serve, "shed_policy", "shed-oldest")
+                            if serve else "shed-oldest")
+        self.shed_threshold = (getattr(serve, "sub_shed_threshold", 256)
+                               if serve else 256)
         self._state: Dict[Any, Tuple] = {}
         self._log: List[Tuple[int, str, Any, Optional[List[Any]]]] = []
         self._log_base = 1  # change id of _log[0]
         self.last_change_id = 0
         self._subs: List[SubQueue] = []
+        # batched fanout: pre-encoded NDJSON frame per retained change id
+        # (trimmed alongside _log); n_encodes counts encode operations so
+        # tests can pin encode-once-per-event (not per-subscriber)
+        self._wire: Dict[int, bytes] = {}
+        self.n_encodes = 0
         self._mu = threading.Lock()
         if restore is not None:
             # resume the change-id sequence where the persisted manifest
@@ -382,28 +482,62 @@ class Matcher:
 
     def _fanout(self, out, subs) -> int:
         """Deliver records to subscriber queues OUTSIDE the lock
-        (detach of a lagged subscriber re-acquires it)."""
+        (detach of a lagged subscriber re-acquires it).
+
+        Batched fanout (corroguard): the per-round delta is walked once
+        — each record's NDJSON wire line is encoded a single time and
+        cached by change id, so every subscriber's HTTP loop multicasts
+        the same bytes instead of re-encoding per subscriber. Shed
+        accounting: shed-oldest drops drain into
+        ``corro.subs.shed_total`` here (frame-accurate — the series
+        agrees with the gaps clients observe), and consumers past their
+        shed threshold are disconnected."""
+        if out and subs:
+            frames = {rec[0]: encode_change_frame(rec) for rec in out}
+            self.n_encodes += len(frames)
+            with self._mu:
+                self._wire.update(frames)
+                if len(self._wire) > self.max_log:
+                    for cid in [c for c in self._wire
+                                if c < self._log_base]:
+                        del self._wire[cid]
         lagged = []
         for q in subs:
+            refused = False
             for rec in out:
                 if not q.offer(("change", rec)):
-                    lagged.append(q)
+                    refused = True
                     break
+            shed = q.drain_shed()
+            if shed:
+                self._registry.counter("corro.subs.shed_total",
+                                       float(shed), {"sub": self.id})
+            if refused or q.lagged:
+                lagged.append(q)
         if out and subs:
             # deepest subscriber queue after this fanout: the early-
-            # warning signal admission control will act on — a depth
+            # warning signal admission control acts on — a depth
             # climbing toward SubQueue maxsize means a consumer is
-            # about to be shed
+            # about to shed
             self._registry.gauge(
                 "corro.subs.queue.depth",
                 max(q.qsize() for q in subs), {"sub": self.id})
         for q in lagged:
-            self._registry.counter("corro.subs.shed_total", 1.0,
-                                   {"sub": self.id})
+            if q.shed_policy != "shed-oldest":
+                # legacy drop-newest: the disconnect IS the shed event
+                self._registry.counter("corro.subs.shed_total", 1.0,
+                                       {"sub": self.id})
             logger.warning("matcher %s: disconnecting lagged subscriber",
                            self.id)
             self.detach(q)
         return len(out)
+
+    def wire_frame(self, change_id: int) -> Optional[bytes]:
+        """The cached pre-encoded NDJSON line for a retained change id
+        (None once trimmed past ``max_log`` — streaming loops fall back
+        to encoding the record themselves)."""
+        with self._mu:
+            return self._wire.get(change_id)
 
     # --- subscriber attach/detach ---------------------------------------
     def attach(self, from_change_id: Optional[int] = None) -> "SubQueue":
@@ -411,22 +545,26 @@ class Matcher:
         backlog from ``from_change_id`` (exclusive). If the backlog has
         been GC'd past that id, the subscriber gets a full re-dump
         (columns + rows), like the reference's query restart."""
-        q = SubQueue()
+        q = SubQueue(maxsize=self.sub_queue, shed_policy=self.shed_policy,
+                     shed_threshold=self.shed_threshold)
         with self._mu:
-            q.offer(("columns", self.columns))
+            # preload (not offer): the catch-up dump bypasses the live
+            # bound — a subscriber must never be shed before it has had
+            # a chance to read its first frame
+            q.preload(("columns", self.columns))
             if from_change_id is None:
                 for key, row in self._state.items():
-                    q.offer(("row", (key, list(row))))
-                q.offer(("eoq", self.last_change_id))
+                    q.preload(("row", (key, list(row))))
+                q.preload(("eoq", self.last_change_id))
             elif (from_change_id + 1 >= self._log_base
                   and from_change_id <= self.last_change_id):
                 for rec in self._log[from_change_id + 1 - self._log_base:]:
-                    q.offer(("change", rec))
+                    q.preload(("change", rec))
             else:
                 # backlog GC'd: full resync
                 for key, row in self._state.items():
-                    q.offer(("row", (key, list(row))))
-                q.offer(("eoq", self.last_change_id))
+                    q.preload(("row", (key, list(row))))
+                q.preload(("eoq", self.last_change_id))
             self._subs.append(q)
         return q
 
@@ -463,9 +601,10 @@ class Matcher:
 class SubsManager:
     """All matchers of one agent; re-polls them after every round."""
 
-    def __init__(self, db, persist_dir: Optional[str] = None):
+    def __init__(self, db, persist_dir: Optional[str] = None, serve=None):
         self.db = db
         self.persist_dir = persist_dir
+        self.serve = serve  # corroguard [serve] queue policy (or None)
         self._tracker = db.delta_tracker()  # shared, per-round cached
         self._matchers: Dict[str, Matcher] = {}
         self._by_query: Dict[Tuple, str] = {}
@@ -569,7 +708,7 @@ class SubsManager:
             mid = self._by_query.get(key)
             if mid is not None:
                 return self._matchers[mid], False
-            m = Matcher(self.db, node, sql, params)
+            m = Matcher(self.db, node, sql, params, serve=self.serve)
             self._matchers[m.id] = m
             self._by_query[key] = m.id
             self._persist(m)
@@ -630,7 +769,7 @@ class SubsManager:
                 with open(os.path.join(self.persist_dir, name)) as f:
                     man = json.load(f)
                 m = Matcher(self.db, man["node"], man["sql"], man["params"],
-                            sub_id=man["id"], restore=man)
+                            sub_id=man["id"], restore=man, serve=self.serve)
                 with self._mu:
                     self._matchers[m.id] = m
                     key = (m.node, m.sql,
@@ -647,9 +786,10 @@ class UpdatesManager:
     diffs pk liveness + row content every round and emits
     ``NotifyEvent {kind, pk}``."""
 
-    def __init__(self, db, node: int = 0):
+    def __init__(self, db, node: int = 0, serve=None):
         self.db = db
         self.node = node
+        self.serve = serve  # corroguard [serve] queue policy (or None)
         self._tracker = db.delta_tracker()  # shared, per-round cached
         self._feeds: Dict[str, List[queue.Queue]] = {}
         self._state: Dict[str, Dict[Any, Tuple]] = {}
@@ -662,7 +802,14 @@ class UpdatesManager:
 
     def attach(self, table: str) -> SubQueue:
         self.db.schema.table(table)  # raises on unknown table
-        q = SubQueue()
+        s = self.serve
+        q = SubQueue(
+            maxsize=getattr(s, "sub_queue", 65536) if s else 65536,
+            shed_policy=(getattr(s, "shed_policy", "shed-oldest")
+                         if s else "shed-oldest"),
+            shed_threshold=(getattr(s, "sub_shed_threshold", 256)
+                            if s else 256),
+        )
         with self._mu:
             if table not in self._feeds:
                 self._state[table] = self._snapshot_table(table)
@@ -760,20 +907,30 @@ class UpdatesManager:
                     self._state[table] = fresh
                 subs = list(self._feeds.get(table, ()))
             lagged = []
+            label = {"sub": f"updates:{table}"}
             for q in subs:
+                refused = False
                 for ev in events:
                     if not q.offer(("notify", ev)):
-                        lagged.append(q)
+                        refused = True
                         break
+                shed = q.drain_shed()
+                if shed:
+                    # shed-oldest drops (frame-accurate, like the
+                    # matcher fanout)
+                    self.db.agent.metrics.counter(
+                        "corro.subs.shed_total", float(shed), label)
+                if refused or q.lagged:
+                    lagged.append(q)
             if events and subs:
                 self.db.agent.metrics.gauge(
                     "corro.subs.queue.depth",
-                    max(q.qsize() for q in subs),
-                    {"sub": f"updates:{table}"})
+                    max(q.qsize() for q in subs), label)
             for q in lagged:
-                self.db.agent.metrics.counter(
-                    "corro.subs.shed_total", 1.0,
-                    {"sub": f"updates:{table}"})
+                if q.shed_policy != "shed-oldest":
+                    # legacy drop-newest: the disconnect IS the shed
+                    self.db.agent.metrics.counter(
+                        "corro.subs.shed_total", 1.0, label)
                 logger.warning("updates feed %s: disconnecting lagged "
                                "subscriber", table)
                 self.detach(table, q)

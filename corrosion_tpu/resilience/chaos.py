@@ -779,21 +779,65 @@ SCENARIOS = {
 TIER1_SCENARIOS = ("preempt-mid-segment", "ckpt-corrupt")
 
 
-def run_sweep(names=None, seed: int = 0) -> dict:
-    """Run a set of scenarios (default: all) and fold the verdicts into
-    one artifact-shaped record."""
+def _host_scenarios() -> dict:
+    """Host-plane scenarios: serving-plane rigs judged by serving-plane
+    oracles (no compiled fault traces, no device-state bitwise oracle).
+    They are NOT in the default sweep — ``SCENARIOS`` stays the
+    device-plane registry the sweep artifact schema is pinned to — and
+    run only when named explicitly."""
+    from corrosion_tpu.resilience.serve_overload import run_serve_overload
+
+    return {"serve-overload": run_serve_overload}
+
+
+def run_sweep(names=None, seed: int = 0, seed_range=None) -> dict:
+    """Run a set of scenarios and fold the verdicts into one
+    artifact-shaped record. Default: every device-plane scenario in
+    ``SCENARIOS``; host-plane scenarios (``serve-overload``) join only
+    when named explicitly.
+
+    ``seed_range=(a, b)`` sweeps seeds ``a..b`` inclusive — every
+    scenario runs once per seed and the record gains ``seed_range``
+    plus a ``per_seed`` map of rounds-to-convergence per scenario, the
+    determinism evidence the chaos artifact exists to carry."""
+    hosts = _host_scenarios()
     names = list(names) if names else sorted(SCENARIOS)
-    records = []
     for name in names:
-        if name not in SCENARIOS:
+        if name not in SCENARIOS and name not in hosts:
             raise ValueError(
-                f"unknown scenario {name!r}; have {sorted(SCENARIOS)}"
+                f"unknown scenario {name!r}; have "
+                f"{sorted(SCENARIOS) + sorted(hosts)}"
             )
-        records.append(run_scenario(SCENARIOS[name], seed=seed))
-    return {
+    if seed_range is not None:
+        a, b = int(seed_range[0]), int(seed_range[1])
+        if b < a:
+            raise ValueError(f"bad seed range {a}:{b}")
+        seeds = list(range(a, b + 1))
+    else:
+        seeds = [int(seed)]
+    records = []
+    for s in seeds:
+        for name in names:
+            if name in SCENARIOS:
+                records.append(run_scenario(SCENARIOS[name], seed=s))
+            else:
+                records.append(hosts[name](seed=s))
+    out = {
         "metric": "chaos_sweep",
-        "seed": int(seed),
+        "seed": int(seeds[0]),
         "platform": jax.devices()[0].platform,
         "scenarios": records,
         "ok": all(r["ok"] for r in records),
     }
+    if seed_range is not None:
+        out["seed_range"] = [seeds[0], seeds[-1]]
+        per_seed: dict = {}
+        for r in records:
+            entry = per_seed.setdefault(str(r["seed"]), {})
+            entry[r["name"]] = (
+                r.get("rounds_to_convergence", -1)
+                if not r.get("skipped") and not r.get("host_plane")
+                else None
+            )
+        out["per_seed"] = per_seed
+    return out

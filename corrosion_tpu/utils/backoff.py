@@ -101,8 +101,16 @@ def retry_call(
       supervisor state).
 
     When the delay iterator is exhausted the last exception propagates —
-    callers keep their natural ``except`` types."""
-    delays = iter(backoff if backoff is not None else Backoff(max_retries=5))
+    callers keep their natural ``except`` types.
+
+    Server hints (corroguard, docs/overload.md): an exception carrying a
+    numeric ``retry_after`` attribute (the parsed ``Retry-After`` of a
+    503) OVERRIDES the jittered delay for that attempt — the server
+    knows how overloaded it is better than the client's schedule does —
+    capped at the policy's ``max_wait`` so a hostile or confused hint
+    cannot park the client."""
+    bo = backoff if backoff is not None else Backoff(max_retries=5)
+    delays = iter(bo)
     attempt = 0
     while True:
         try:
@@ -113,6 +121,9 @@ def retry_call(
             delay = next(delays, None)
             if delay is None:
                 raise
+            hint = getattr(e, "retry_after", None)
+            if hint is not None:
+                delay = min(float(hint), bo.max_wait)
             attempt += 1
             if on_retry is not None:
                 on_retry(e, delay, attempt)

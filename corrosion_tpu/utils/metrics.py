@@ -209,6 +209,29 @@ def histogram_quantile(h: dict, q: float) -> float:
     return float(h["buckets"][-1]) if h["buckets"] else lo
 
 
+def aggregate_histograms(snap: dict, name: str) -> dict:
+    """Fold every label set of one histogram family in a snapshot (or a
+    ``parse_exposition`` result) into a single histogram dict. All label
+    sets of a family share one bucket ladder by construction
+    (:meth:`Registry.histogram` pins the ladder on first observation),
+    so the per-bucket counts sum directly. Returns an empty histogram
+    when the family has no samples — ``histogram_quantile`` of the
+    result is then 0.0. The admission controller derives live
+    Retry-After hints through this (docs/overload.md)."""
+    agg = {"buckets": (), "counts": [], "sum": 0.0, "count": 0}
+    for (n, _lab), h in snap.get("histograms", {}).items():
+        if n != name:
+            continue
+        if not agg["buckets"]:
+            agg["buckets"] = tuple(h["buckets"])
+            agg["counts"] = [0] * len(h["counts"])
+        for i, c in enumerate(h["counts"]):
+            agg["counts"][i] += c
+        agg["sum"] += h["sum"]
+        agg["count"] += h["count"]
+    return agg
+
+
 def quantiles_from_histogram(
     h: dict, qs: Sequence[float] = (0.5, 0.95, 0.99)
 ) -> Dict[str, float]:

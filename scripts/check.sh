@@ -24,6 +24,11 @@
 # clients vs the server's own request accounting (the agreement gate),
 # under CORROSAN=1.
 #
+# The corroguard overload bench (ISSUE 17) publishes
+# artifacts/serve_r17.json: the two-arm degradation-contract record —
+# the guarded plane must hold the lag bound under the ramp AND the
+# unguarded plane must demonstrably violate it — under CORROSAN=1.
+#
 # corrosan (ISSUE 8) publishes artifacts/san_r08.json with two
 # sections: "fixtures" (seeded-race replay verdicts via
 # `corrosion-tpu san`) and "pytest" (the threaded test modules re-run
@@ -209,6 +214,51 @@ print(f"serve harness: {agr['transactions']['server']} tx, "
       f"delivery p99 {rec['ops']['subscribe_delivery']['p99'] * 1e3:.1f} ms)")
 PY
 echo "serve harness: ok (report: artifacts/serve_r16.json)"
+
+echo "== corroguard overload bench =="
+# the ISSUE 17 degradation-contract gate (docs/overload.md): the same
+# serving plane driven past its breaking point, twice — guarded
+# (admission control + bounded shed-oldest fanout) and unguarded —
+# under CORROSAN=1. The two-arm record is the oracle: the guard must
+# HOLD the contract (bounded p99 delivery lag, monotone shed counters,
+# Retry-After-honoring closed-loop client fully absorbed, zero leaked
+# serving threads) while the identical ramp without the guard must
+# VIOLATE the lag bound — a bound loose enough for the naked plane
+# would gate nothing. Published as artifacts/serve_r17.json;
+# BENCH_SERVE_r17.json at the repo root is the committed lineage record
+# from the same bench.
+env CORROSAN=1 JAX_PLATFORMS=cpu \
+    python -m corrosion_tpu load --overload --seed 17 \
+    --output-json artifacts/serve_r17.json > /dev/null
+python - <<'PY'
+import json
+rec = json.load(open("artifacts/serve_r17.json"))
+if not rec.get("ok"):
+    raise SystemExit(f"overload bench not ok: {rec}")
+if not rec.get("corrosan"):
+    raise SystemExit("overload bench did not run under the sanitizer")
+if not rec["contract_holds_guarded"]:
+    raise SystemExit("guard failed its own degradation contract: "
+                     f"{rec['guarded']['contract']}")
+if not rec["contract_violated_unguarded"]:
+    raise SystemExit("unguarded arm met the lag bound — the bench "
+                     f"gates nothing: {rec['unguarded']['contract']}")
+g = rec["guarded"]
+for arm in (g, rec["unguarded"]):
+    if arm["leaked_threads"]:
+        raise SystemExit(f"serving threads leaked: {arm['leaked_threads']}")
+if not g["agreement"]["ok"]:
+    raise SystemExit(f"server/client counts disagree under overload: "
+                     f"{g['agreement']}")
+print(f"overload bench: guard held (p99 lag "
+      f"{g['contract']['delivery_p99_s'] * 1e3:.0f} ms <= "
+      f"{g['contract']['lag_bound_s'] * 1e3:.0f} ms, pressure "
+      f"{g['contract']['pressure_final']:.0f}, closed-loop "
+      f"{g['closed_loop']['done']}/{g['closed_loop']['ops']} absorbed); "
+      f"unguarded violated (p99 "
+      f"{rec['unguarded']['contract']['delivery_p99_s'] * 1e3:.0f} ms)")
+PY
+echo "overload bench: ok (report: artifacts/serve_r17.json)"
 
 echo "== corrochaos fault-scenario sweep =="
 # the ISSUE 13 robustness gate (docs/chaos.md): every shipped seeded

@@ -1,0 +1,388 @@
+"""corroguard admission control (PR 17, docs/overload.md): the
+AdmissionController policy surface unit-tested against a private
+registry, route classification, the derived Retry-After hint, the
+client's hint-honoring retry engine, and the HTTP 503 / PG-wire 53300
+shed paths end-to-end on a real rig."""
+
+import socket
+import struct
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from corrosion_tpu.agent import Agent
+from corrosion_tpu.api.admission import (
+    ROUTE_CLASSES,
+    AdmissionController,
+    route_class,
+)
+from corrosion_tpu.api.http import ApiServer
+from corrosion_tpu.client import ApiUnavailable, CorrosionApiClient
+from corrosion_tpu.config import Config, ServeConfig
+from corrosion_tpu.db import Database
+from corrosion_tpu.pg import PgServer
+from corrosion_tpu.utils.backoff import Backoff, retry_call
+from corrosion_tpu.utils.metrics import Registry
+
+
+def ctl(reg=None, **kw) -> AdmissionController:
+    return AdmissionController(ServeConfig(**kw),
+                               registry=reg or Registry())
+
+
+# --- policy units ---------------------------------------------------------
+
+def test_disabled_guard_admits_everything():
+    """max_inflight <= 0 is the unguarded plane: every admit is free
+    and the admission series are never minted."""
+    reg = Registry()
+    c = ctl(reg)  # default ServeConfig: max_inflight=0
+    assert not c.enabled
+    for cls in ROUTE_CLASSES:
+        for _ in range(64):
+            assert c.admit(cls)
+    assert reg.get_counter("corro.admission.admitted_total",
+                           {"class": "write"}) == 0.0
+
+
+def test_cap_reject_and_release_cycle():
+    """At capacity with an empty waiting room the next admit sheds
+    immediately; release hands the slot back."""
+    reg = Registry()
+    c = ctl(reg, max_inflight=2, max_queue=0, queue_wait=0.01)
+    assert c.admit("write") and c.admit("write")
+    t0 = time.monotonic()
+    assert not c.admit("write")
+    assert time.monotonic() - t0 < 0.5  # no waiting room -> no wait
+    assert reg.get_counter("corro.admission.admitted_total",
+                           {"class": "write"}) == 2.0
+    assert reg.get_counter("corro.admission.rejected_total",
+                           {"class": "write"}) == 1.0
+    assert reg.get_gauge("corro.admission.inflight",
+                         {"class": "write"}) == 2.0
+    c.release("write")
+    assert c.admit("write")
+    assert reg.get_gauge("corro.admission.inflight",
+                         {"class": "write"}) == 2.0
+
+
+def test_classes_have_independent_budgets():
+    c = ctl(max_inflight=1, max_queue=0, queue_wait=0.01)
+    assert c.admit("write")
+    assert c.admit("read")  # a full write class gates nothing else
+    assert not c.admit("write")
+
+
+def test_queued_caller_gets_freed_slot():
+    """A caller parked in the waiting room is admitted when a slot
+    frees within queue_wait (no shed, queued_total counts the park)."""
+    reg = Registry()
+    c = ctl(reg, max_inflight=1, max_queue=1, queue_wait=5.0)
+    assert c.admit("write")
+    out = {}
+
+    def waiter():
+        out["admitted"] = c.admit("write")
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    # wait until the waiter is actually parked before releasing
+    deadline = time.monotonic() + 5.0
+    while (reg.get_counter("corro.admission.queued_total",
+                           {"class": "write"}) < 1.0
+           and time.monotonic() < deadline):
+        time.sleep(0.005)
+    c.release("write")
+    t.join(timeout=5.0)
+    assert out["admitted"] is True
+    assert reg.get_counter("corro.admission.queued_total",
+                           {"class": "write"}) == 1.0
+    assert reg.get_counter("corro.admission.rejected_total",
+                           {"class": "write"}) == 0.0
+    assert reg.get_gauge("corro.admission.queue.depth",
+                         {"class": "write"}) == 0.0
+
+
+def test_queue_wait_timeout_sheds():
+    reg = Registry()
+    c = ctl(reg, max_inflight=1, max_queue=1, queue_wait=0.05)
+    assert c.admit("write")
+    t0 = time.monotonic()
+    assert not c.admit("write")  # parks, times out, sheds
+    assert 0.04 <= time.monotonic() - t0 < 2.0
+    assert reg.get_counter("corro.admission.queued_total",
+                           {"class": "write"}) == 1.0
+    assert reg.get_counter("corro.admission.rejected_total",
+                           {"class": "write"}) == 1.0
+    assert reg.get_gauge("corro.admission.queue.depth",
+                         {"class": "write"}) == 0.0
+
+
+def test_full_waiting_room_sheds_without_waiting():
+    c = ctl(max_inflight=1, max_queue=1, queue_wait=10.0)
+    assert c.admit("write")
+    parked = threading.Thread(target=c.admit, args=("write",))
+    parked.start()
+    deadline = time.monotonic() + 5.0
+    while c._waiting["write"] < 1 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    t0 = time.monotonic()
+    assert not c.admit("write")  # room already holds max_queue waiters
+    assert time.monotonic() - t0 < 1.0  # shed NOW, not after queue_wait
+    c.release("write")
+    parked.join(timeout=5.0)
+    c.release("write")
+
+
+def test_stream_capacity_separate_from_oneshot():
+    """stream/pg draw from max_streams (held-ticket classes must not
+    starve one-shot requests); <=0 falls back to max_inflight."""
+    c = ctl(max_inflight=2, max_queue=0, queue_wait=0.01, max_streams=5)
+    assert c.capacity("write") == 2 and c.capacity("read") == 2
+    assert c.capacity("stream") == 5 and c.capacity("pg") == 5
+    for _ in range(5):
+        assert c.admit("stream")
+    assert not c.admit("stream")
+    assert ctl(max_inflight=3).capacity("stream") == 3
+
+
+def test_route_class_mapping():
+    # the control plane is NEVER gated
+    for route in ("/v1/health", "/v1/ready", "/metrics"):
+        assert route_class(route, "GET") is None
+        assert route_class(route, "POST") is None
+    assert route_class("/v1/transactions", "POST") == "write"
+    assert route_class("/v1/migrations", "POST") == "write"
+    assert route_class("/v1/subscriptions", "POST") == "stream"
+    assert route_class("/v1/subscriptions/{id}", "GET") == "stream"
+    assert route_class("/v1/updates/{table}", "GET") == "stream"
+    assert route_class("/v1/queries", "POST") == "read"
+    assert route_class("unmatched", "GET") == "read"
+
+
+# --- Retry-After derivation -----------------------------------------------
+
+def test_retry_after_cold_plane_quotes_floor():
+    assert ctl(max_inflight=1).retry_after("write") == 1
+
+
+def test_retry_after_scales_and_clamps_to_cap():
+    """p95 x (requests ahead) — a deep slow backlog quotes the cap, and
+    the hint is memoized so rejects stay cheap under overload."""
+    reg = Registry()
+    c = ctl(reg, max_inflight=8, max_queue=0, queue_wait=0.01,
+            retry_after_cap=7.0)
+    for _ in range(50):
+        reg.histogram("corro.http.request.seconds", 4.0,
+                      {"route": "/v1/transactions", "method": "POST",
+                       "code": "200"})
+    for _ in range(5):
+        assert c.admit("write")
+    ra = c.retry_after("write")
+    assert ra == 7  # ~4s p95 * 5 ahead, clamped to the cap
+    # memo: new observations within the 0.25 s window do not re-derive
+    for _ in range(50):
+        reg.histogram("corro.http.request.seconds", 0.001,
+                      {"route": "/v1/transactions", "method": "POST",
+                       "code": "200"})
+    assert c.retry_after("write") == ra
+
+
+def test_retry_after_always_at_least_one_second():
+    reg = Registry()
+    c = ctl(reg, max_inflight=8, retry_after_cap=30.0)
+    reg.histogram("corro.http.request.seconds", 0.0005,
+                  {"route": "/v1/queries", "method": "POST",
+                   "code": "200"})
+    assert c.retry_after("read") >= 1
+
+
+# --- the client retry engine honors the hint ------------------------------
+
+class _Hinted(ConnectionError):
+    def __init__(self, hint):
+        super().__init__("503")
+        self.retry_after = hint
+
+
+def test_retry_call_honors_retry_after_hint():
+    """A retryable exception carrying retry_after overrides the
+    jittered schedule for that attempt."""
+    sleeps = []
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise _Hinted(0.125)
+        return "done"
+
+    out = retry_call(flaky,
+                     backoff=Backoff(min_wait=30.0, max_wait=60.0,
+                                     jitter=0.0, max_retries=5),
+                     sleep=sleeps.append)
+    assert out == "done"
+    assert sleeps == [0.125, 0.125]  # the hint, not the 30 s schedule
+
+
+def test_retry_call_caps_hint_at_max_wait():
+    """A hostile/confused hint cannot park the client past the
+    policy's max_wait."""
+    sleeps = []
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise _Hinted(3600.0)
+        return "ok"
+
+    assert retry_call(flaky,
+                      backoff=Backoff(min_wait=0.01, max_wait=0.25,
+                                      jitter=0.0, max_retries=3),
+                      sleep=sleeps.append) == "ok"
+    assert sleeps == [0.25]
+
+
+# --- end to end on a real rig ---------------------------------------------
+
+SCHEMA = """
+CREATE TABLE adm (
+    k TEXT PRIMARY KEY,
+    v INTEGER
+);
+"""
+
+
+def adm_config():
+    cfg = Config()
+    cfg.sim.mode = "scale"
+    cfg.sim.n_nodes = 16
+    cfg.sim.m_slots = 8
+    cfg.sim.n_origins = 4
+    cfg.sim.n_rows = 16
+    cfg.sim.n_cols = 4
+    cfg.perf.sync_interval = 4
+    cfg.gossip.drop_prob = 0.0
+    return cfg
+
+
+@pytest.fixture(scope="module")
+def rig():
+    serve = ServeConfig(max_inflight=1, max_queue=0, queue_wait=0.05,
+                        max_streams=1, retry_after_cap=7.0)
+    with Agent(adm_config()) as agent:
+        agent.wait_rounds(10, timeout=120)
+        db = Database(agent)
+        admission = AdmissionController(serve, registry=agent.metrics)
+        with ApiServer(db, port=0, serve=serve,
+                       admission=admission) as api, \
+                PgServer(db, port=0, admission=admission) as pgs:
+            client = CorrosionApiClient(api.addr, api.port)
+            client.schema([SCHEMA])
+            yield agent, api, pgs, admission, client
+
+
+def _quiesce(admission, timeout=10.0):
+    """Wait for every slot to be released: a client sees its response a
+    beat before the server handler's finally-release runs, so a test
+    that grabs slots right after a request can race that gap."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        with admission._mu:
+            if all(v == 0 for v in admission._inflight.values()):
+                return
+        time.sleep(0.005)
+    raise AssertionError(f"slots still held: {admission._inflight}")
+
+
+def test_http_write_shed_503_with_derived_retry_after(rig):
+    agent, api, _, admission, client = rig
+    _quiesce(admission)
+    before = agent.metrics.get_counter("corro.http.unready_total",
+                                       {"status": "overloaded"})
+    assert admission.admit("write")  # saturate the single write slot
+    try:
+        with pytest.raises(ApiUnavailable) as e:
+            client.execute([("INSERT INTO adm (k, v) VALUES (?, ?)",
+                             ["shed", 1])])
+        assert e.value.status == 503
+        assert e.value.retry_after is not None
+        assert 1 <= e.value.retry_after <= 7  # clamped to the rig's cap
+    finally:
+        admission.release("write")
+    assert agent.metrics.get_counter(
+        "corro.http.unready_total", {"status": "overloaded"}) == before + 1
+    assert agent.metrics.get_counter(
+        "corro.admission.rejected_total", {"class": "write"}) >= 1.0
+
+
+def test_control_plane_never_gated(rig):
+    """/v1/health answers 200 even with every admission class
+    saturated — you can always ask a drowning server how it feels."""
+    _, api, _, admission, _ = rig
+    _quiesce(admission)
+    held = [c for c in ROUTE_CLASSES if admission.admit(c)]
+    assert set(held) == set(ROUTE_CLASSES)
+    try:
+        with urllib.request.urlopen(
+                f"http://{api.addr}:{api.port}/v1/health",
+                timeout=30) as resp:
+            assert resp.status == 200
+    finally:
+        for c in held:
+            admission.release(c)
+
+
+def test_client_with_retry_503_rides_out_the_shed(rig):
+    """A retry_503 client sleeps the server's hint and succeeds once
+    the slot frees — the closed-loop contract of the overload bench."""
+    _, api, _, admission, _ = rig
+    _quiesce(admission)
+    polite = CorrosionApiClient(api.addr, api.port, retry_503=6,
+                                retry_503_max_wait=0.1)
+    assert admission.admit("write")
+    freed = threading.Timer(0.3, admission.release, args=("write",))
+    freed.start()
+    try:
+        res = polite.execute([("INSERT INTO adm (k, v) VALUES (?, ?)",
+                               ["polite", 2])])
+        assert res[0]["rows_affected"] == 1
+    finally:
+        freed.join()
+
+
+def test_pg_accept_shed_53300(rig):
+    """A shed PG connection gets the canonical 53300 ErrorResponse
+    before the auth handshake."""
+    _, _, pgs, admission, _ = rig
+    _quiesce(admission)
+    assert admission.admit("pg")  # saturate the single pg ticket
+    try:
+        with socket.create_connection((pgs.addr, pgs.port),
+                                      timeout=30) as s:
+            payload = struct.pack("!I", 196608)
+            for k, v in (("user", "t"), ("database", "corrosion")):
+                payload += k.encode() + b"\x00" + v.encode() + b"\x00"
+            payload += b"\x00"
+            s.sendall(struct.pack("!I", len(payload) + 4) + payload)
+            kind = s.recv(1)
+            assert kind == b"E"
+            (length,) = struct.unpack("!I", _read_exact(s, 4))
+            body = _read_exact(s, length - 4)
+            assert b"53300" in body
+            assert b"retry after" in body
+    finally:
+        admission.release("pg")
+
+
+def _read_exact(s: socket.socket, n: int) -> bytes:
+    data = b""
+    while len(data) < n:
+        chunk = s.recv(n - len(data))
+        if not chunk:
+            raise ConnectionResetError
+        data += chunk
+    return data

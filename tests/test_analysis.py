@@ -244,6 +244,54 @@ def test_nested_class_lock_does_not_shield_outer():
     assert "Outer.push" in findings[0].message
 
 
+def test_condition_wrapping_the_lock_is_an_alias():
+    """``threading.Condition(self._mu)`` shares the mutex it wraps, so
+    ``with self._cv:`` counts as holding the lock (the admission-
+    controller idiom)."""
+    src = """
+        import threading
+
+        class Gate:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self._cv = threading.Condition(self._mu)
+                self._n = 0
+
+            def take(self):
+                with self._cv:
+                    self._n += 1  # the cv IS the lock: clean
+                    self._cv.notify()
+
+            def leak(self):
+                self._n -= 1  # genuinely unlocked: must still flag
+    """
+    findings = lint(src, ["lock-discipline"])
+    assert rules_of(findings) == ["unlocked-mutation"]
+    assert "Gate.leak" in findings[0].message
+
+
+def test_condition_wrapping_another_lock_is_not_an_alias():
+    """A Condition built over anything but the class's own single lock
+    (its own hidden mutex, some other object's lock) must NOT count as
+    holding the lock."""
+    src = """
+        import threading
+
+        class Gate:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self._cv = threading.Condition()
+                self._n = 0
+
+            def take(self):
+                with self._cv:
+                    self._n += 1  # a DIFFERENT mutex: still unlocked
+    """
+    findings = lint(src, ["lock-discipline"])
+    assert rules_of(findings) == ["unlocked-mutation"]
+    assert "Gate.take" in findings[0].message
+
+
 # --- strippable-assert ----------------------------------------------------
 
 

@@ -213,3 +213,81 @@ def test_full_sweep_every_scenario_both_oracles():
     assert not any(r.get("skipped") for r in out["scenarios"])
     assert all(r["converged"] and r["bitwise_match"]
                for r in out["scenarios"])
+
+
+# --- seed-range sweeps + the host-plane scenario (PR 17) ------------------
+
+
+def test_seed_range_sweep_structure(monkeypatch):
+    """--seed-range A:B runs every selected scenario once per seed and
+    folds rounds-to-convergence into the per_seed map."""
+    import corrosion_tpu.resilience.chaos as chaos_mod
+
+    calls = []
+
+    def stub(script, seed=0, workdir=None):
+        calls.append((script.name, seed))
+        return {"name": script.name, "seed": seed, "ok": True,
+                "rounds_to_convergence": 10 + seed}
+
+    monkeypatch.setattr(chaos_mod, "run_scenario", stub)
+    out = chaos_mod.run_sweep(["partition-heal", "clock-skew"],
+                              seed_range=(2, 4))
+    assert calls == [(n, s) for s in (2, 3, 4)
+                     for n in ("partition-heal", "clock-skew")]
+    assert out["ok"] and out["seed"] == 2
+    assert out["seed_range"] == [2, 4]
+    assert set(out["per_seed"]) == {"2", "3", "4"}
+    for s in (2, 3, 4):
+        assert out["per_seed"][str(s)] == {
+            "partition-heal": 10 + s, "clock-skew": 10 + s}
+    with pytest.raises(ValueError):
+        chaos_mod.run_sweep(["partition-heal"], seed_range=(4, 2))
+
+
+def test_host_plane_scenario_registered_outside_default_sweep():
+    """serve-overload is reachable by name but NOT part of SCENARIOS —
+    the sweep artifact schema stays pinned to the device-plane
+    registry (docs/chaos.md, "Host-plane scenarios")."""
+    from corrosion_tpu.resilience.chaos import _host_scenarios
+
+    hosts = _host_scenarios()
+    assert "serve-overload" in hosts
+    assert "serve-overload" not in SCENARIOS
+    with pytest.raises(ValueError):
+        run_sweep(["no-such-scenario"])
+
+
+def test_serve_overload_plan_deterministic():
+    """(seed, shape) fully determines the serve-overload write plan:
+    per-writer single-owner key streams, stamps, and the digest the
+    verdict carries."""
+    from corrosion_tpu.resilience.serve_overload import plan_serve_overload
+
+    a = plan_serve_overload(5, writers=3, ops=8, keys=9)
+    assert a == plan_serve_overload(5, writers=3, ops=8, keys=9)
+    assert a["digest"] != plan_serve_overload(6, writers=3, ops=8,
+                                              keys=9)["digest"]
+    # single-owner partition: writer w owns exactly the keys = w (mod 3)
+    for w, ops in enumerate(a["writers"]):
+        assert ops, "every writer has work"
+        assert all(k % 3 == w and 0 <= k < 9 for k in ops)
+
+
+@pytest.mark.slow
+def test_serve_overload_scenario_end_to_end(tmp_path):
+    """The host-plane scenario through the sweep dispatcher: both
+    serving-plane oracles hold, the ramp actually shed, and the ready
+    flap (mid-run live restore) was applied."""
+    from corrosion_tpu.resilience.serve_overload import plan_serve_overload
+
+    out = run_sweep(["serve-overload"], seed=0)
+    assert out["ok"], [r.get("problems") for r in out["scenarios"]]
+    (rec,) = out["scenarios"]
+    assert rec["host_plane"] and rec["name"] == "serve-overload"
+    assert rec["plan_digest"] == plan_serve_overload(
+        0, writers=4, ops=40, keys=12)["digest"]
+    assert rec["acked_writes"] > 0
+    assert rec["subs_shed_total"] > 0  # the scenario must overload
+    assert rec["resyncs"] >= 1
+    assert rec["ready_flap_applied"]

@@ -4,6 +4,8 @@ docs-sync gate."""
 
 import os
 
+import pytest
+
 from corrosion_tpu.obs.load import percentiles, plan_ops
 
 
@@ -75,3 +77,93 @@ def test_bench_serve_schema_documented():
                   "delivery_quantiles_s", "unready_total", "shed_total",
                   "agreement", "corrosan"):
         assert f"`{field}`" in doc, f"BENCH_SERVE field {field} undocumented"
+
+
+# --- the corroguard overload harness (PR 17, docs/overload.md) ------------
+
+
+def test_plan_overload_deterministic():
+    """(seed, shape) fully determines the overload op plan — ramp-stage
+    writer streams and the closed-loop stream — and its digest."""
+    from corrosion_tpu.obs.load import plan_overload
+
+    a = plan_overload(9, stages=(2, 4), write_ops=6, keys=8,
+                      closed_loop_ops=5)
+    b = plan_overload(9, stages=(2, 4), write_ops=6, keys=8,
+                      closed_loop_ops=5)
+    assert a == b
+    assert len(a["stages"]) == 2
+    assert [len(w) for w in a["stages"][1]] == [6] * 4
+    assert len(a["closed_loop"]) == 5
+    c = plan_overload(10, stages=(2, 4), write_ops=6, keys=8,
+                      closed_loop_ops=5)
+    assert c["digest"] != a["digest"]
+
+
+def test_bench_serve_overload_schema_documented():
+    """Every field of the bench_serve_overload record (and its per-arm
+    serve_overload records) is documented in docs/observability.md."""
+    doc = open(os.path.join(os.path.dirname(__file__), "..", "docs",
+                            "observability.md")).read()
+    for field in ("stage_stats", "delivery_lag_s", "slow_delivery_lag_s",
+                  "resyncs", "frames_dropped", "closed_loop",
+                  "attempts_503", "retry_delays", "pg_probe",
+                  "leaked_threads", "contract", "lag_bound_s",
+                  "delivery_p99_s", "lag_bounded", "shed_monotone",
+                  "pressure_final", "absorbed", "guarded", "unguarded",
+                  "contract_holds_guarded", "contract_violated_unguarded",
+                  "admission_rejected_total", "subs_shed_total",
+                  "unready_overloaded_total"):
+        assert f"`{field}`" in doc, f"overload field {field} undocumented"
+
+
+def test_run_overload_guarded_small_end_to_end():
+    """A small guarded overload run against a deliberately tiny guard:
+    the ramp sheds, the record is well-formed, the server-vs-client
+    agreement holds (503s included), and nothing leaks."""
+    from corrosion_tpu.config import ServeConfig
+    from corrosion_tpu.obs.load import plan_overload, run_overload
+
+    serve = ServeConfig(max_inflight=1, max_queue=0, queue_wait=0.02,
+                        max_streams=8, retry_after_cap=5.0,
+                        sub_queue=2, sub_shed_threshold=1 << 30,
+                        stream_sndbuf=4608)
+    rec = run_overload(stages=(2, 4), write_ops=12, subscribers=2,
+                       slow_subs=1, slow_ms=25.0, keys=16,
+                       closed_loop_ops=6, pg_probes=3, seed=11,
+                       warm_rounds=6, serve=serve)
+    assert rec["kind"] == "serve_overload" and rec["guard"]
+    assert rec["plan_digest"] == plan_overload(
+        11, stages=(2, 4), write_ops=12, keys=16,
+        closed_loop_ops=6)["digest"]
+    assert len(rec["stage_stats"]) == 2
+    # the tiny guard actually shed under the ramp
+    assert rec["contract"]["pressure_final"] > 0
+    assert rec["contract"]["shed_monotone"]
+    # the polite closed-loop client was absorbed whole
+    assert rec["closed_loop"]["done"] == 6
+    assert rec["closed_loop"]["failed"] == 0
+    # agreement: every client attempt (503s included) server-accounted
+    assert rec["agreement"]["ok"], rec["agreement"]
+    assert rec["leaked_threads"] == []
+    assert rec["ok"], rec["problems"]
+
+
+@pytest.mark.slow
+def test_run_overload_bench_degradation_contract():
+    """The full two-arm bench: the guard holds the degradation contract
+    under the default ramp AND the unguarded plane demonstrably
+    violates the same lag bound — the check.sh overload-stage gate."""
+    from corrosion_tpu.obs.load import run_overload_bench
+
+    rec = run_overload_bench(seed=0, n_nodes=8)
+    assert rec["kind"] == "bench_serve_overload"
+    assert rec["contract_holds_guarded"], rec["guarded"]["contract"]
+    assert rec["contract_violated_unguarded"], rec["unguarded"]["contract"]
+    assert rec["ok"]
+    g, u = rec["guarded"], rec["unguarded"]
+    assert g["contract"]["delivery_p99_s"] <= g["contract"]["lag_bound_s"]
+    assert u["contract"]["delivery_p99_s"] > u["contract"]["lag_bound_s"]
+    # Retry-After honored at least once by the closed-loop client in
+    # the guarded arm means the hint plumbing ran end to end
+    assert g["closed_loop"]["failed"] == 0

@@ -1,0 +1,291 @@
+"""corroguard bounded fanout (PR 17, docs/overload.md): shed-oldest
+SubQueue semantics, the attach-time preload bypass, frame-accurate shed
+accounting against a live matcher, batched single-encode fanout, and
+the resync-marker contract a real HTTP subscriber observes."""
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from corrosion_tpu.agent import Agent
+from corrosion_tpu.api.http import ApiServer
+from corrosion_tpu.client import CorrosionApiClient
+from corrosion_tpu.config import Config, ServeConfig
+from corrosion_tpu.db import Database
+from corrosion_tpu.pubsub import (
+    INSERT,
+    SubQueue,
+    SubsManager,
+    encode_change_frame,
+)
+
+# --- SubQueue units -------------------------------------------------------
+
+
+def test_shed_oldest_drops_oldest_first():
+    """Overflow drops from the FRONT: the consumer keeps the freshest
+    frames and the drop count is exact."""
+    q = SubQueue(maxsize=3, shed_policy="shed-oldest",
+                 shed_threshold=1 << 30)
+    for i in range(8):
+        assert q.offer(("change", i))
+    assert [q.get_nowait()[1] for i in range(3)] == [5, 6, 7]
+    assert q.take_resync() == 5
+    assert q.take_resync() == 0  # markers are consumed once
+    assert not q.lagged
+
+
+def test_drain_shed_reports_each_drop_once():
+    q = SubQueue(maxsize=1, shed_policy="shed-oldest",
+                 shed_threshold=1 << 30)
+    for i in range(4):
+        q.offer(("change", i))
+    assert q.drain_shed() == 3
+    assert q.drain_shed() == 0
+    q.offer(("change", 4))
+    assert q.drain_shed() == 1
+
+
+def test_shed_threshold_marks_lagged_then_refuses():
+    """Crossing sub_shed_threshold cumulative drops is the
+    slow-consumer policy: the queue goes lagged and refuses."""
+    q = SubQueue(maxsize=1, shed_policy="shed-oldest", shed_threshold=3)
+    for i in range(4):
+        assert q.offer(("change", i))  # 3 sheds -> lagged
+    assert q.lagged
+    assert not q.offer(("change", 99))
+
+
+def test_drop_newest_legacy_lags_immediately():
+    """The legacy tokio-broadcast behavior: overflow refuses the NEW
+    frame and marks the consumer lagged on the spot."""
+    q = SubQueue(maxsize=1, shed_policy="drop-newest")
+    assert q.offer(("change", 0))
+    assert not q.offer(("change", 1))
+    assert q.lagged
+    assert q.get_nowait()[1] == 0  # the old frame survived
+
+
+def test_preload_bypasses_live_bound():
+    """Attach-time catch-up must arrive whole even past maxsize; only
+    live offers shed against the bound."""
+    q = SubQueue(maxsize=2, shed_policy="shed-oldest",
+                 shed_threshold=1 << 30)
+    for i in range(6):
+        q.preload(("row", i))
+    assert q.qsize() == 6 and q.take_resync() == 0
+    # live traffic converges the queue back to its bound: the offer
+    # sheds the oldest frames until the new one fits
+    assert q.offer(("change", 6))
+    assert q.qsize() == 2 and q.take_resync() == 5
+    assert q.get_nowait() == ("row", 5)
+    assert q.get_nowait() == ("change", 6)
+
+
+def test_encode_change_frame_wire_shape():
+    """The cached frame is byte-identical to the HTTP layer's NDJSON
+    line: {"change": [kind, key, row, id]} + newline, blob-encoded."""
+    frame = encode_change_frame((7, INSERT, b"\x01\x02", ("a", 3)))
+    assert frame.endswith(b"\n")
+    obj = json.loads(frame)
+    assert obj == {"change": [INSERT, {"blob": "0102"}, ["a", 3], 7]}
+
+
+# --- against a live matcher ----------------------------------------------
+
+SCHEMA = """
+CREATE TABLE shed_kv (
+    k TEXT PRIMARY KEY,
+    v TEXT
+);
+"""
+
+N_KEYS = 12
+PAD = "x" * 1024  # frames too large to hide in kernel socket buffers
+
+
+def shed_config():
+    cfg = Config()
+    cfg.sim.mode = "scale"
+    cfg.sim.n_nodes = 16
+    cfg.sim.m_slots = 8
+    cfg.sim.n_origins = 4
+    cfg.sim.n_rows = 64
+    cfg.sim.n_cols = 4
+    cfg.perf.sync_interval = 4
+    cfg.gossip.drop_prob = 0.0
+    return cfg
+
+
+@pytest.fixture(scope="module")
+def rig():
+    with Agent(shed_config()) as agent:
+        agent.wait_rounds(10, timeout=120)
+        db = Database(agent)
+        db.apply_schema_sql(SCHEMA)
+        yield agent, db
+
+
+def _write_keys(db, agent, prefix, n):
+    db.execute(0, [(f"INSERT INTO shed_kv (k, v) VALUES "
+                    f"('{prefix}{i}', '{PAD}')",) for i in range(n)])
+    assert agent.wait_rounds(3, timeout=120)
+
+
+def _poll(fn, timeout=60.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_matcher_fanout_shed_accounting_and_batched_encode(rig):
+    """One stalled consumer sheds oldest-first while a drained consumer
+    sees every change gap-free; corro.subs.shed_total is frame-accurate
+    (== the stalled consumer's gap) and the per-round delta is encoded
+    ONCE for both subscribers."""
+    agent, db = rig
+    serve = ServeConfig(sub_queue=4, sub_shed_threshold=1 << 30)
+    mgr = SubsManager(db, serve=serve)
+    try:
+        m, created = mgr.subscribe(0, "SELECT k, v FROM shed_kv")
+        assert created
+        stalled = m.attach()
+        drained = m.attach()
+        got = []
+        stop = threading.Event()
+
+        def drain():
+            while not stop.is_set():
+                try:
+                    got.append(drained.get(timeout=0.2))
+                except Exception:  # noqa: BLE001 — queue.Empty
+                    pass
+
+        t = threading.Thread(target=drain)
+        t.start()
+        try:
+            _write_keys(db, agent, "a", N_KEYS)
+            metrics = agent.metrics
+            # attach preloaded columns+eoq (2 frames) into the stalled
+            # queue; N_KEYS live changes against maxsize 4 shed all but
+            # the newest 4 frames of the sequence
+            want_shed = float(N_KEYS - 2)
+            assert _poll(lambda: metrics.get_counter(
+                "corro.subs.shed_total", {"sub": m.id}) == want_shed), \
+                metrics.get_counter("corro.subs.shed_total", {"sub": m.id})
+            assert _poll(lambda: sum(
+                1 for k, _ in got if k == "change") == N_KEYS)
+        finally:
+            stop.set()
+            t.join(timeout=10)
+
+        # the drained consumer saw the whole sequence, gap-free
+        assert drained.take_resync() == 0 and not drained.lagged
+        cids = [rec[0] for k, rec in got if k == "change"]
+        assert cids == sorted(cids)
+        # the stalled queue kept exactly the NEWEST 4 frames, in order
+        leftover = [stalled.get_nowait() for _ in range(stalled.qsize())]
+        assert [k for k, _ in leftover] == ["change"] * 4
+        assert [rec[0] for _, rec in leftover] == cids[-4:]
+        assert stalled.take_resync() == N_KEYS - 2
+        # queue-depth gauge: the stalled queue pinned the high-water
+        assert agent.metrics.get_gauge(
+            "corro.subs.queue.depth", {"sub": m.id}) == 4.0
+        # batched fanout: every change encoded once for TWO subscribers,
+        # and the cached frame is the canonical wire line
+        assert m.n_encodes == N_KEYS
+        for kind, rec in got:
+            if kind == "change":
+                assert m.wire_frame(rec[0]) == encode_change_frame(rec)
+    finally:
+        mgr.close()
+
+
+def test_slow_consumer_disconnected_at_threshold(rig):
+    """sub_shed_threshold cumulative drops detaches the consumer from
+    the fanout (the HTTP loop then owes it a slow-consumer resync
+    marker and a disconnect)."""
+    agent, db = rig
+    serve = ServeConfig(sub_queue=2, sub_shed_threshold=3)
+    mgr = SubsManager(db, serve=serve)
+    try:
+        m, created = mgr.subscribe(
+            0, "SELECT k FROM shed_kv WHERE k LIKE 'b%'")
+        assert created
+        q = m.attach()
+        _write_keys(db, agent, "b", N_KEYS)
+        assert _poll(lambda: q.lagged)
+        assert _poll(lambda: q not in m._subs)
+        assert q.take_resync() >= 3
+    finally:
+        mgr.close()
+
+
+# --- the resync contract over a real HTTP stream --------------------------
+
+class _SmallWindowClient(CorrosionApiClient):
+    """Clamps SO_RCVBUF BEFORE the TCP handshake so the receive window
+    is negotiated tiny — a post-connect clamp cannot shrink the ~64 KB
+    the peer was already promised, and the backlog would hide in the
+    kernel pipeline instead of pressuring the fanout queue."""
+
+    def _connect(self, timeout=CorrosionApiClient._UNSET):
+        conn = super()._connect(timeout)
+
+        def create(addr, timeout=None, source_address=None):
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4096)
+            if timeout is not None:
+                s.settimeout(timeout)
+            s.connect(addr)
+            return s
+
+        conn._create_connection = create
+        return conn
+
+
+def test_http_stream_resync_marker_matches_observed_gap(rig):
+    """A stalled NDJSON subscriber: the server sheds oldest frames,
+    announces the gap with a resync marker before the next event, and
+    the marker's dropped count equals BOTH the shed_total series and
+    the gap the client actually observed."""
+    agent, db = rig
+    serve = ServeConfig(sub_queue=2, sub_shed_threshold=1 << 30,
+                        stream_sndbuf=4608)
+    mgr = SubsManager(db, serve=serve)
+    with ApiServer(db, port=0, serve=serve, subs=mgr) as api:
+        client = _SmallWindowClient(api.addr, api.port)
+        stream = client.subscribe("SELECT k, v FROM shed_kv WHERE "
+                                  "k LIKE 'c%'", stream_timeout=30.0)
+        try:
+            for wave in range(3):
+                db.execute(0, [
+                    (f"INSERT INTO shed_kv (k, v) VALUES "
+                     f"('c{wave}_{i}', '{PAD}')",)
+                    for i in range(10)])
+                assert agent.wait_rounds(3, timeout=120)
+            # stall a beat longer, then drain the stream
+            assert agent.wait_rounds(4, timeout=120)
+            changes = 0
+            for event in stream:
+                if "change" in event:
+                    changes += 1
+                if changes + stream.dropped >= 30:
+                    break
+            assert stream.resyncs >= 1
+            assert stream.dropped > 0
+            # frame-accurate, in both directions: series == marker sum
+            # == the gap the client observed
+            assert agent.metrics.get_counter(
+                "corro.subs.shed_total",
+                {"sub": stream.id}) == float(stream.dropped)
+            assert changes + stream.dropped == 30
+        finally:
+            stream.close()
+    mgr.close()
