@@ -933,9 +933,15 @@ class Agent:
         if obs is None:
             from corrosion_tpu.obs.flight import SoakObserver, make_observer
 
+            # serve_registry = the agent's own metrics: the admission
+            # controller and subscription shed counters publish there,
+            # so a production soak's flight record carries its shed
+            # story (docs/observability.md)
             owned_obs = (make_observer(self.config.obs,
-                                       registry=self.metrics)
-                         or SoakObserver(registry=self.metrics))
+                                       registry=self.metrics,
+                                       serve_registry=self.metrics)
+                         or SoakObserver(registry=self.metrics,
+                                         serve_registry=self.metrics))
             obs = owned_obs
         common = dict(
             mode=self.mode, checkpoint_root=checkpoint_root,

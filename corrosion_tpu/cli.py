@@ -573,6 +573,8 @@ def cmd_chaos(args) -> int:
             print(f"{name} [host-plane]: serving-plane scenario, "
                   f"run by name (not part of the default sweep)")
         return 0
+    if args.script:
+        return _chaos_replay_scripts(args)
     if args.scenario:
         names = list(args.scenario)
     elif args.tier1:
@@ -630,6 +632,133 @@ def cmd_chaos(args) -> int:
             exist_ok=True)
         with open(args.convergence_json, "w") as f:
             json.dump(conv, f, indent=1)
+    print(json.dumps(out, indent=2))
+    return 0 if out["ok"] else 1
+
+
+def _chaos_replay_scripts(args) -> int:
+    """``corrosion-tpu chaos --script FILE [...]``: replay serialized
+    scenario scripts — corpus reproducers (the envelope written by
+    ``fuzz.save_reproducer``, which pins its own replay seed) or bare
+    ``script_to_json`` documents (replayed at ``--seed``). The
+    script↔JSON round-trip is a first-class contract: a replay
+    re-derives the same trace digest the original run recorded."""
+    import jax
+
+    from corrosion_tpu.resilience.chaos import run_scenario, script_from_json
+    from corrosion_tpu.resilience.fuzz import load_reproducer
+
+    def replay() -> list:
+        records = []
+        for path in args.script:
+            with open(path) as f:
+                payload = json.load(f)
+            if isinstance(payload, dict) and "script" in payload:
+                script, seed, _meta = load_reproducer(path)
+            else:
+                script, seed = script_from_json(payload), args.seed
+            records.append(run_scenario(script, seed=seed))
+        return records
+
+    corrosan = os.environ.get("CORROSAN") == "1"
+    if corrosan:
+        from corrosion_tpu.analysis.sanitizer import sanitized
+
+        with sanitized() as san:
+            records = replay()
+        findings = san.gate()
+    else:
+        records, findings = replay(), []
+    out = {
+        "metric": "chaos_sweep",
+        "seed": int(args.seed),
+        "platform": jax.devices()[0].platform,
+        "scripts": list(args.script),
+        "scenarios": records,
+        "corrosan": corrosan,
+        "ok": all(r["ok"] for r in records) and not findings,
+    }
+    if findings:
+        out.setdefault("problems", []).extend(
+            f"corrosan: {f.kind} {f.subject}" for f in findings
+        )
+    if args.output_json:
+        os.makedirs(os.path.dirname(os.path.abspath(args.output_json)),
+                    exist_ok=True)
+        with open(args.output_json, "w") as f:
+            json.dump(out, f, indent=2)
+    print(json.dumps(out, indent=2))
+    return 0 if out["ok"] else 1
+
+
+def cmd_fuzz(args) -> int:
+    """corrofuzz: sweep a fixed-seed budget of GENERATED chaos
+    scenarios (docs/chaos.md, "Generative fuzzing") and emit the
+    ``fuzz_r18``-shaped record: per-seed verdict + rounds-to-
+    convergence/quiescence. Deterministic end to end — same seeds,
+    same scripts, same verdicts. ``--shrink-failures`` delta-debugs
+    every failing seed to a 1-minimal reproducer and writes it to the
+    corpus directory for ``chaos --script`` replay. Under
+    ``CORROSAN=1`` the sweep rides a sanitized window like the chaos
+    sweep."""
+    from corrosion_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+    from corrosion_tpu.resilience import fuzz
+
+    try:
+        lo, _, hi = args.seeds.partition(":")
+        seeds = list(range(int(lo), int(hi) + 1))
+    except ValueError:
+        print(f"error: --seeds wants A:B, got {args.seeds!r}",
+              file=sys.stderr)
+        return 2
+    if args.list:
+        for seed in seeds:
+            script = fuzz.gen_script(seed, profile=args.profile)
+            print(f"{script.name}: N={script.n_nodes}, "
+                  f"{len(script.phases)} phases, "
+                  f"{script.total_rounds} rounds, injections="
+                  f"{[i.kind for i in script.injections] or '[]'}")
+        return 0
+    corrosan = os.environ.get("CORROSAN") == "1"
+    if corrosan:
+        from corrosion_tpu.analysis.sanitizer import sanitized
+
+        with sanitized() as san:
+            out = fuzz.run_fuzz(seeds, profile=args.profile,
+                                keep_failures=True)
+        findings = san.gate()
+        if findings:
+            out["ok"] = False
+            out.setdefault("problems", []).extend(
+                f"corrosan: {f.kind} {f.subject}" for f in findings
+            )
+    else:
+        out = fuzz.run_fuzz(seeds, profile=args.profile,
+                            keep_failures=True)
+    out["corrosan"] = corrosan
+    if args.shrink_failures is not None:
+        shrunk = []
+        for case in out["cases"]:
+            if case["ok"] or case.get("skipped"):
+                continue
+            script = fuzz.gen_script(case["seed"], profile=args.profile)
+            minimal, runs = fuzz.shrink(script, case["seed"])
+            path = fuzz.save_reproducer(
+                minimal, case["seed"],
+                note=f"shrunk from {script.name} in {runs} oracle runs; "
+                     f"problems: {case.get('problems')}",
+                path=os.path.join(args.shrink_failures,
+                                  f"{minimal.name}.json"),
+            )
+            shrunk.append(path)
+        out["reproducers"] = shrunk
+    if args.output_json:
+        os.makedirs(os.path.dirname(os.path.abspath(args.output_json)),
+                    exist_ok=True)
+        with open(args.output_json, "w") as f:
+            json.dump(out, f, indent=2)
     print(json.dumps(out, indent=2))
     return 0 if out["ok"] else 1
 
@@ -925,7 +1054,41 @@ def build_parser() -> argparse.ArgumentParser:
     ch.add_argument("--convergence-json", metavar="PATH", default=None,
                     help="also write the CONVERGENCE_* lineage artifact "
                          "derived from the sweep")
+    ch.add_argument("--script", metavar="FILE", action="append",
+                    default=None,
+                    help="replay serialized scenario script(s) instead "
+                         "of registry names: corpus reproducer files "
+                         "(tests/chaos_corpus/*.json, which pin their "
+                         "own seed) or bare script JSON (replayed at "
+                         "--seed); repeatable")
     ch.set_defaults(fn=cmd_chaos)
+
+    fz = sub.add_parser(
+        "fuzz",
+        help="corrofuzz: sweep a fixed-seed budget of generated chaos "
+             "scenarios (seeded grammar draws, three oracles, "
+             "deterministic verdicts) and optionally shrink failures "
+             "to corpus reproducers (docs/chaos.md)")
+    fz.add_argument("--seeds", metavar="A:B", default="0:24",
+                    help="inclusive fuzz-seed range; each seed "
+                         "deterministically generates + judges one "
+                         "scenario (default 0:24)")
+    fz.add_argument("--profile", choices=("fast", "scale"),
+                    default="fast",
+                    help="N-ladder profile: fast = corrobudget-priced "
+                         "fast rungs only; scale = the full 64..4k "
+                         "ladder (slow)")
+    fz.add_argument("--list", action="store_true",
+                    help="print the generated scripts without running "
+                         "them")
+    fz.add_argument("--shrink-failures", metavar="DIR", default=None,
+                    help="delta-debug every failing seed to a 1-minimal "
+                         "reproducer JSON in DIR (replayable via "
+                         "'chaos --script')")
+    fz.add_argument("--output-json", metavar="PATH", default=None,
+                    help="write the fuzz record (per-seed verdict + "
+                         "rounds-to-convergence: artifacts/fuzz_r18.json)")
+    fz.set_defaults(fn=cmd_fuzz)
 
     ld = sub.add_parser(
         "load",

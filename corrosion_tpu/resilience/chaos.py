@@ -12,7 +12,7 @@ soak runner + Supervisor + AsyncCheckpointWriter, and oracle-checked.
 
 Every scenario is a pure function of ``(name, seed)``: same seed, same
 compiled trace, same injection schedule, same verdict — the
-``trace_digest`` in the verdict pins it. Two oracles gate every run:
+``trace_digest`` in the verdict pins it. Three oracles gate every run:
 
 1. **convergence** — after the scripted fault phases the cluster must
    reach the converged fixpoint (``scale_crdt_metrics``: no needs,
@@ -26,6 +26,19 @@ compiled trace, same injection schedule, same verdict — the
    injected) or restore to a state that, replaying the remaining
    scripted rounds, lands bitwise on the SAME fixpoint as the
    uninterrupted run: no checkpoint ever restores diverged state.
+3. **quiescence** — after the healed settle phase the per-node
+   ``activity_masks`` (broadcast queues, partial buffers, sync needs,
+   SWIM timers — the occupancy bits a future active-set round variant
+   would gate on) must drain to all-zero over the alive nodes within
+   the same settle budget: a converged cluster that still owes itself
+   work is a liveness bug the convergence predicate alone cannot see.
+
+Scripts are data and serialize losslessly: :func:`script_to_json` /
+:func:`script_from_json` round-trip a script through plain JSON with
+the ``trace_digest`` preserved (the digest hashes the identical
+``dataclasses.asdict`` view) — the contract the committed
+``tests/chaos_corpus/`` reproducers and ``corrosion-tpu chaos
+--script FILE`` ride on (docs/chaos.md, "Corpus").
 
 Host-plane injections (``Injection.kind``):
 
@@ -148,6 +161,49 @@ class ScenarioScript:
     @property
     def total_rounds(self) -> int:
         return sum(ph.rounds for ph in self.phases)
+
+
+#: corpus/script JSON schema version (bump on incompatible script
+#: field changes; ``script_from_json`` refuses other versions loudly)
+SCRIPT_SCHEMA_VERSION = 1
+
+
+def script_to_json(script: ScenarioScript) -> dict:
+    """The script as plain JSON data — EXACTLY the
+    ``dataclasses.asdict`` view :func:`compile_scenario` digests, plus
+    a schema tag. A script that round-trips equal re-compiles to the
+    same ``trace_digest`` (tests/test_fuzz.py pins it)."""
+    script.validate()
+    return {"schema": SCRIPT_SCHEMA_VERSION, **dataclasses.asdict(script)}
+
+
+def script_from_json(obj: dict) -> ScenarioScript:
+    """Inverse of :func:`script_to_json` (tuples restored, unknown keys
+    refused, the result validated) — the loader behind corpus replay
+    and ``corrosion-tpu chaos --script FILE``."""
+    data = dict(obj)
+    schema = int(data.pop("schema", SCRIPT_SCHEMA_VERSION))
+    if schema != SCRIPT_SCHEMA_VERSION:
+        raise ValueError(
+            f"script schema {schema} != {SCRIPT_SCHEMA_VERSION}"
+        )
+    known = {f.name for f in dataclasses.fields(ScenarioScript)}
+    unknown = sorted(set(data) - known)
+    if unknown:
+        raise ValueError(f"unknown script fields {unknown}")
+    phases = tuple(
+        FaultPhase(**p) for p in data.pop("phases", ())
+    )
+    injections = tuple(
+        Injection(**i) for i in data.pop("injections", ())
+    )
+    expect_info = tuple(
+        (str(k), int(v)) for k, v in data.pop("expect_info", ())
+    )
+    return ScenarioScript(
+        phases=phases, injections=injections, expect_info=expect_info,
+        **data,
+    ).validate()
 
 
 def scenario_config(script: ScenarioScript):
@@ -481,10 +537,14 @@ def _run_chaos_leg(cfg, script, traces, key0, root, rec, problems):
 
 
 def _settle(cfg, st, key, runner, budget: int, chunk: int = 8):
-    """Quiet, healed rounds until the convergence predicate holds.
-    -> (rounds_taken or -1, converged)."""
+    """Quiet, healed rounds until the convergence predicate holds AND
+    the activity masks drain over the alive nodes (oracles 1 + 3 share
+    the one settle budget).
+    -> (rounds_to_converge or -1, converged,
+        rounds_to_quiesce or -1, quiesced)."""
     from corrosion_tpu.sim.scale_step import (
         ScaleRoundInput,
+        activity_masks,
         scale_crdt_metrics,
     )
     from corrosion_tpu.sim.transport import NetModel
@@ -494,18 +554,32 @@ def _settle(cfg, st, key, runner, budget: int, chunk: int = 8):
         lambda a: jnp.broadcast_to(a, (chunk,) + a.shape),
         ScaleRoundInput.quiet(cfg),
     )
-    converged_now = jax.jit(
-        lambda s: scale_crdt_metrics(cfg, s)["converged"]
-    )
+    # quiescence over ALIVE nodes (the oracle-1 convention): a corpse's
+    # frozen tables owe the cluster nothing — alive nodes' timers ABOUT
+    # the corpse still count, and drain once the purge completes
+    probe = jax.jit(lambda s: (
+        scale_crdt_metrics(cfg, s)["converged"],
+        jnp.any(jnp.stack([
+            jnp.any(m & s.swim.alive)
+            for m in activity_masks(cfg, s).values()
+        ])),
+    ))
     taken = 0
-    if bool(converged_now(st)):
-        return 0, True
-    while taken < budget:
+    conv_at = quiet_at = -1
+    conv, active = (bool(x) for x in probe(st))
+    if conv:
+        conv_at = 0
+    if not active:
+        quiet_at = 0
+    while (conv_at < 0 or quiet_at < 0) and taken < budget:
         st, key, _ = runner(st, key, net, quiet)
         taken += chunk
-        if bool(converged_now(st)):
-            return taken, True
-    return -1, False
+        conv, active = (bool(x) for x in probe(st))
+        if conv_at < 0 and conv:
+            conv_at = taken
+        if quiet_at < 0 and not active:
+            quiet_at = taken
+    return conv_at, conv_at >= 0, quiet_at, quiet_at >= 0
 
 
 def _validate_lineage(cfg, script, traces, root, ref_leaves, runner, rec,
@@ -626,19 +700,30 @@ def run_scenario(script: ScenarioScript, seed: int = 0,
                     f"expected info {k} >= {want}, observed {got}"
                 )
 
-        # oracle 1: settle the chaos state to the converged fixpoint
+        # oracle 1: settle the chaos state to the converged fixpoint;
+        # oracle 3: the activity masks must then drain to all-zero
+        # (same quiet rounds, same budget)
         st_host = jax.tree.unflatten(
             treedef, [jnp.asarray(x) for x in chaos_leaves])
-        settle_rounds, converged = _settle(
+        settle_rounds, converged, quiesce_rounds, quiesced = _settle(
             cfg, st_host, key, runner, script.settle_budget)
         rec["converged"] = converged
         rec["rounds_to_convergence"] = (
             script.total_rounds + settle_rounds if converged else -1
         )
+        rec["quiesced"] = quiesced
+        rec["rounds_to_quiescence"] = (
+            script.total_rounds + quiesce_rounds if quiesced else -1
+        )
         if not converged:
             problems.append(
                 f"did not converge within {script.settle_budget} settle "
                 f"rounds"
+            )
+        if not quiesced:
+            problems.append(
+                f"activity masks did not drain within "
+                f"{script.settle_budget} settle rounds (oracle 3)"
             )
 
         # oracle 2: the checkpoint lineage
@@ -766,6 +851,59 @@ SCENARIOS = {
                 Injection(kind="fused_flip", phase=0, fused="off"),
             ),
             fused="interpret",
+        ),
+        # --- composed multi-fault scenarios (ISSUE 18): the ROADMAP's
+        # "multi-fault compositions" rungs, promoted from the fuzzer's
+        # grammar into named regression scripts ------------------------
+        # checkpoint corruption AND an 8->4 remesh in ONE lineage: the
+        # hash-gate fallback must land on a checkpoint that still
+        # restores elastically onto the smaller mesh
+        ScenarioScript(
+            name="corrupt-remesh",
+            phases=(
+                FaultPhase(rounds=8, write_frac=0.3),
+                FaultPhase(rounds=8, write_frac=0.2),
+                FaultPhase(rounds=8),
+            ),
+            injections=(
+                Injection(kind="corrupt_checkpoint", phase=0),
+                Injection(kind="remesh", phase=1, mesh_devices=4),
+            ),
+            mesh_devices=8,
+        ),
+        # HLC drift past the max-drift gate WHILE a 2-island partition
+        # is live: rejected stamps and partitioned anti-entropy in the
+        # same window, then the heal phase must still converge
+        ScenarioScript(
+            name="skew-partition",
+            phases=(
+                FaultPhase(rounds=8, write_frac=0.3, partition_groups=2,
+                           drop_prob=0.05, clock_skew_rounds=12,
+                           clock_skew_frac=0.3),
+                FaultPhase(rounds=8, write_frac=0.2),
+                FaultPhase(rounds=8),
+            ),
+            expect_info=(("clock_drift_rejects", 1), ("syncs", 1)),
+        ),
+        # repeated preemption across BOTH crash windows while a quarter
+        # of the non-seed nodes die and later rejoin: every resume must
+        # land on a committed segment and the refutation machinery must
+        # still overturn the stale Down beliefs
+        ScenarioScript(
+            name="preempt-storm",
+            phases=(
+                FaultPhase(rounds=8, write_frac=0.3, kill_frac=0.25,
+                           drop_prob=0.1),
+                FaultPhase(rounds=8, write_frac=0.2),
+                FaultPhase(rounds=8, write_frac=0.1, revive_killed=True),
+                FaultPhase(rounds=8),
+            ),
+            injections=(
+                Injection(kind="crash_slice", phase=0),
+                Injection(kind="preempt", phase=1),
+                Injection(kind="crash_manifest", phase=2),
+            ),
+            expect_info=(("refutes", 1),),
         ),
     )
 }

@@ -1,0 +1,526 @@
+"""corrofuzz — property-based chaos over the scenario grammar.
+
+The hand-written registry (``resilience/chaos.py``, docs/chaos.md)
+covers each fault axis once; this module searches the *interleaving
+space*: a seeded generator draws a random-but-valid
+:class:`~corrosion_tpu.resilience.chaos.ScenarioScript` composing
+device-plane phases (kill/revive, partition, loss, HLC skew) with
+host-plane injections (both crash seams, checkpoint corruption,
+elastic remesh, fused-flip), and the three chaos oracles judge it —
+a Jepsen-style randomized nemesis schedule, made deterministic.
+
+Determinism contract: ``gen_script(seed, profile)`` is a pure function
+of its arguments (``random.Random(seed)`` drives every draw), and the
+verdict of the generated script is pure in the fuzz seed — the script
+runs under ``run_scenario(script, seed=seed)``, whose own contract is
+purity in ``(script, seed)``. Same seed, same script, same verdict
+(tests/test_fuzz.py pins it with a run-twice test).
+
+**Validity by construction.** Every draw respects the PR-12 grammar
+constraints so a generated failure is a real finding, never a
+malformed script:
+
+- phase ``rounds`` are multiples of ``segment_rounds`` (the crash
+  seams arm whole segments);
+- crash seams and checkpoint corruption only target phases with at
+  least TWO cumulative committed segments, so recovery always has a
+  prior committed segment to land on (killing the first-ever save is
+  the engine's designed *failure* mode, exercised separately by
+  tests/test_chaos.py);
+- kills draw only from non-seed nodes (``compile_scale_phase`` —
+  seeds anchor bootstrap) and every kill-bearing script ends with a
+  revive+heal phase so the settle budget is spent settling, not
+  waiting out ``down_purge_rounds`` for corpses;
+- at most one crash seam per phase (the engine arms one seam per
+  phase window).
+
+**The N ladder** is CPU-priced through corrobudget: each rung is
+priced by the symbolic shape inventory
+(:func:`corrosion_tpu.obs.memory.projected_bytes` — zero arrays, any
+N) and rungs past ``FAST_LADDER_BYTES`` are slow-marked. The fast
+profile (tier-1, check.sh) draws from the fast rungs; the ``scale``
+profile climbs to 4096 nodes and runs only under ``-m slow``.
+
+**The shrinker** delta-debugs any failing script to a 1-minimal
+reproducer: drop phases (re-indexing the surviving injections), drop
+injections, shrink round counts and N, zero fault knobs — greedily
+restarting from every smaller script that still fails, until no
+single reduction reproduces. Reproducers serialize through the
+``script_to_json`` contract into ``tests/chaos_corpus/`` and replay
+via ``corrosion-tpu chaos --script FILE``.
+
+``broken_corruption_oracle`` is the mutation fixture that proves the
+whole find→shrink→replay pipeline is live: it blinds the corruption
+injector, so any script carrying a ``corrupt_checkpoint`` injection
+must fail its verdict — and the shrinker must carve everything else
+away.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import os
+import random
+from typing import Callable, Optional, Tuple
+
+from corrosion_tpu.resilience import chaos
+from corrosion_tpu.resilience.chaos import (
+    Injection,
+    ScenarioScript,
+    scenario_config,
+    script_from_json,
+    script_to_json,
+)
+from corrosion_tpu.sim.scenario import FaultPhase
+from corrosion_tpu.utils.tracing import logger
+
+#: corpus file schema (the envelope AROUND the script JSON; the script
+#: itself carries chaos.SCRIPT_SCHEMA_VERSION)
+CORPUS_SCHEMA_VERSION = 1
+
+#: the N rungs the generator may draw (24 = the registry rig; the rest
+#: per the ROADMAP "scenarios at N=1k-100k" ramp, capped where a CPU
+#: sweep stays tractable)
+LADDER_RUNGS = (24, 64, 256, 1024, 4096)
+
+#: rungs whose corrobudget-priced state exceeds this are slow-marked —
+#: they never enter the fast (tier-1 / check.sh) draw
+FAST_LADDER_BYTES = 1 << 17  # 128 KiB of state: N<=64 at the chaos shapes
+
+
+def fuzz_ladder(rungs=LADDER_RUNGS):
+    """Price every rung through corrobudget's symbolic inventory.
+
+    -> tuple of ``{"n_nodes", "bytes", "slow"}`` — ``bytes`` is the
+    static HBM projection of one state replica at the chaos shapes
+    (:func:`scenario_config`), computed without building a single
+    array, and ``slow`` marks rungs past :data:`FAST_LADDER_BYTES`.
+    An unpriceable rung is a loud error (``projected_bytes`` refuses
+    unresolved leaves), never a silently mis-binned one."""
+    from corrosion_tpu.obs.memory import projected_bytes
+
+    out = []
+    for n in rungs:
+        cfg = scenario_config(probe_script(n_nodes=int(n)))
+        b = projected_bytes(cfg, int(n), mode="scale")
+        out.append({
+            "n_nodes": int(n),
+            "bytes": int(b),
+            "slow": bool(b > FAST_LADDER_BYTES),
+        })
+    return tuple(out)
+
+
+def probe_script(n_nodes: int = 24) -> ScenarioScript:
+    """A minimal valid script at ``n_nodes`` — the config probe the
+    ladder pricer (and nothing else) runs through
+    :func:`scenario_config`."""
+    return ScenarioScript(
+        name=f"probe-{n_nodes}",
+        phases=(FaultPhase(rounds=4),),
+        n_nodes=n_nodes,
+    ).validate()
+
+
+# --- the generator --------------------------------------------------------
+
+#: fused_flip transition: start mode -> flip target. Both legs of the
+#: fused==unfused parity contract, both CPU-runnable (docs/fused.md)
+_FUSED_FLIPS = (("interpret", "off"), ("off", "interpret"))
+
+#: remesh chains: (initial mesh, boundary target) — descending, per
+#: the elastic-restore surface (docs/elastic.md); 8 devices is the
+#: tier-1 host rig (tests/conftest.py forces 8 host devices)
+_REMESH_CHAINS = ((8, 4), (8, 2), (4, 2))
+
+
+def gen_script(seed: int, profile: str = "fast") -> ScenarioScript:
+    """Draw one valid random scenario — pure in ``(seed, profile)``.
+
+    ``profile="fast"``: N from the fast ladder rungs, compact round
+    budgets (tier-1 / check.sh wall-clock). ``profile="scale"``: N may
+    climb the full corrobudget-priced ladder (slow-marked callers
+    only). The returned script always ``validate()``s and always obeys
+    the validity-by-construction rules in the module docstring."""
+    if profile not in ("fast", "scale"):
+        raise ValueError(f"unknown fuzz profile {profile!r}")
+    rng = random.Random(int(seed))
+    ladder = fuzz_ladder()
+    rungs = [r for r in ladder if not r["slow"]] if profile == "fast" else list(ladder)
+    # weight the small rungs heavily: the interleaving space is the
+    # search target, N is just the stage it plays on
+    weights = [1.0 / (i + 1) ** 2 for i in range(len(rungs))]
+    n_nodes = rng.choices([r["n_nodes"] for r in rungs], weights)[0]
+
+    segment_rounds = 4
+    n_phases = rng.randint(2, 3)
+    phases = []
+    any_kill = False
+    for _ in range(n_phases):
+        rounds = segment_rounds * rng.randint(1, 2)
+        kill_frac = rng.choice((0.0, 0.0, 0.15, 0.25))
+        if kill_frac:
+            any_kill = True
+        skew = rng.choice((0, 0, 1, 12))
+        phases.append(FaultPhase(
+            rounds=rounds,
+            write_frac=rng.choice((0.1, 0.2, 0.3)),
+            kill_frac=kill_frac,
+            revive_killed=any_kill and rng.random() < 0.3,
+            partition_groups=rng.choice((1, 1, 2, 3)),
+            drop_prob=rng.choice((0.0, 0.0, 0.02, 0.1)),
+            clock_skew_rounds=skew,
+            clock_skew_frac=0.3 if skew else 0.0,
+        ))
+    # the healed tail: revive every corpse, clean network, no writes —
+    # the settle budget settles data, it does not wait out churn
+    phases.append(FaultPhase(rounds=8, revive_killed=any_kill))
+    phases = tuple(phases)
+
+    mesh_devices = 0
+    fused = "auto"
+    injections = []
+    # cumulative committed segments at the END of each phase — the
+    # recoverability precondition for the crash/corruption draws
+    segs_through = []
+    acc = 0
+    for ph in phases:
+        acc += ph.rounds // segment_rounds
+        segs_through.append(acc)
+    recoverable = [i for i in range(len(phases)) if segs_through[i] >= 2]
+
+    crash_phases = set()
+    for kind in rng.sample(chaos.INJECTION_KINDS,
+                           k=rng.choice((0, 1, 1, 2))):
+        if kind in ("crash_slice", "crash_manifest"):
+            open_phases = [p for p in recoverable if p not in crash_phases]
+            if not open_phases:
+                continue
+            phase = rng.choice(open_phases)
+            crash_phases.add(phase)
+            injections.append(Injection(kind=kind, phase=phase))
+        elif kind == "corrupt_checkpoint":
+            if not recoverable:
+                continue
+            injections.append(Injection(
+                kind=kind, phase=rng.choice(recoverable)))
+        elif kind == "preempt":
+            injections.append(Injection(
+                kind=kind, phase=rng.choice(recoverable or [0])))
+        elif kind == "remesh":
+            mesh_devices, target = rng.choice(_REMESH_CHAINS)
+            injections.append(Injection(
+                kind=kind, phase=rng.randrange(len(phases) - 1),
+                mesh_devices=target))
+        elif kind == "fused_flip":
+            fused, target = rng.choice(_FUSED_FLIPS)
+            injections.append(Injection(
+                kind=kind, phase=rng.randrange(len(phases) - 1),
+                fused=target))
+    injections.sort(key=lambda i: (i.phase, i.kind))
+
+    return ScenarioScript(
+        name=f"fuzz-{int(seed):06d}",
+        phases=phases,
+        injections=tuple(injections),
+        n_nodes=n_nodes,
+        segment_rounds=segment_rounds,
+        mesh_devices=mesh_devices,
+        fused=fused,
+    ).validate()
+
+
+def run_fuzz(seeds, profile: str = "fast", keep_failures: bool = False):
+    """Sweep a fuzz-seed budget; -> the ``artifacts/fuzz_r18.json``
+    record: one verdict case per seed plus the ``per_seed`` map
+    (verdict + rounds-to-convergence/quiescence) that makes flaky-seed
+    regressions diffable, mirroring the chaos sweep artifact shape.
+
+    ``keep_failures=True`` additionally attaches the failing scripts'
+    JSON (``script_to_json``) so a CI failure carries its reproducer
+    inline before anyone re-runs the shrinker."""
+    import jax
+
+    seeds = [int(s) for s in seeds]
+    cases = []
+    for seed in seeds:
+        script = gen_script(seed, profile=profile)
+        rec = chaos.run_scenario(script, seed=seed)
+        case = {
+            "name": script.name,
+            "seed": seed,
+            "n_nodes": script.n_nodes,
+            "phases": len(script.phases),
+            "injections": [i.kind for i in script.injections],
+            "trace_digest": rec.get("trace_digest"),
+            "ok": bool(rec["ok"]),
+            "skipped": rec.get("skipped"),
+            "rounds_to_convergence": rec.get("rounds_to_convergence", -1),
+            "rounds_to_quiescence": rec.get("rounds_to_quiescence", -1),
+        }
+        if rec.get("problems"):
+            case["problems"] = rec["problems"]
+            if keep_failures:
+                case["script"] = script_to_json(script)
+        cases.append(case)
+        logger.info("corrofuzz seed %d (%s): %s", seed, script.name,
+                    "ok" if case["ok"] else "FAIL")
+    return {
+        "metric": "chaos_fuzz",
+        "profile": profile,
+        "platform": jax.devices()[0].platform,
+        "seeds": seeds,
+        "ladder": list(fuzz_ladder()),
+        "cases": cases,
+        "per_seed": {
+            str(c["seed"]): {
+                "ok": c["ok"],
+                "rounds_to_convergence": c["rounds_to_convergence"],
+                "rounds_to_quiescence": c["rounds_to_quiescence"],
+            }
+            for c in cases
+        },
+        "ok": all(c["ok"] for c in cases),
+    }
+
+
+# --- the shrinker ---------------------------------------------------------
+
+
+def _drop_phase(script: ScenarioScript, i: int) -> ScenarioScript:
+    """Drop phase ``i``; injections targeting it go with it, later
+    injections re-index down one."""
+    phases = script.phases[:i] + script.phases[i + 1:]
+    injections = tuple(
+        dataclasses.replace(inj, phase=inj.phase - (1 if inj.phase > i else 0))
+        for inj in script.injections if inj.phase != i
+    )
+    return dataclasses.replace(script, phases=phases, injections=injections)
+
+
+def grammar_valid(script: ScenarioScript) -> bool:
+    """The validity-by-construction rules the generator obeys, as a
+    predicate — the shrinker must stay inside the same grammar.
+    Structural validity is ``validate()``'s job; this checks the
+    SEMANTIC rules: crash/corruption only where at least two cumulative
+    committed segments exist to recover to, one crash seam per phase.
+    (Without this gate a shrink judged under the mutation fixture —
+    whose failure needs no recovery at all — happily reduces a
+    corruption script to a single committed segment, and the resulting
+    "reproducer" fails the HEALTHY engine too: corrupting the only
+    checkpoint leaves nothing to fall back to.)"""
+    segs = 0
+    segs_through = []
+    for ph in script.phases:
+        segs += ph.rounds // script.segment_rounds
+        segs_through.append(segs)
+    crash_phases = []
+    for inj in script.injections:
+        if inj.kind in ("crash_slice", "crash_manifest",
+                        "corrupt_checkpoint"):
+            if segs_through[inj.phase] < 2:
+                return False
+        if inj.kind in ("crash_slice", "crash_manifest"):
+            crash_phases.append(inj.phase)
+    return len(crash_phases) == len(set(crash_phases))
+
+
+def _shrink_candidates(script: ScenarioScript):
+    """Every single-step reduction of ``script``, simplest-first.
+    The shrink loop keeps only candidates that ``validate()`` AND stay
+    :func:`grammar_valid` — a reproducer outside the generator's
+    grammar is not a finding, it is a malformed script."""
+    # 1. drop a whole phase
+    if len(script.phases) > 1:
+        for i in range(len(script.phases)):
+            yield _drop_phase(script, i)
+    # 2. drop an injection
+    for i in range(len(script.injections)):
+        yield dataclasses.replace(
+            script,
+            injections=script.injections[:i] + script.injections[i + 1:],
+        )
+    # 3. halve a phase's rounds (floor: one segment)
+    for i, ph in enumerate(script.phases):
+        if ph.rounds > script.segment_rounds:
+            smaller = max(
+                script.segment_rounds,
+                (ph.rounds // 2) // script.segment_rounds
+                * script.segment_rounds,
+            )
+            yield dataclasses.replace(script, phases=(
+                script.phases[:i]
+                + (dataclasses.replace(ph, rounds=smaller),)
+                + script.phases[i + 1:]
+            ))
+    # 4. shrink N down the ladder
+    lower = [r for r in LADDER_RUNGS if r < script.n_nodes]
+    if lower:
+        yield dataclasses.replace(script, n_nodes=max(lower))
+    # 5. zero one fault knob of one phase
+    zeroed = dict(write_frac=0.0, kill_frac=0.0, revive_killed=False,
+                  partition_groups=1, drop_prob=0.0, clock_skew_rounds=0,
+                  clock_skew_frac=0.0)
+    for i, ph in enumerate(script.phases):
+        for field, z in zeroed.items():
+            if getattr(ph, field) != z:
+                yield dataclasses.replace(script, phases=(
+                    script.phases[:i]
+                    + (dataclasses.replace(ph, **{field: z}),)
+                    + script.phases[i + 1:]
+                ))
+    # 6. drop the mesh / pin the execution mode when no injection
+    #    still needs them
+    kinds = {i.kind for i in script.injections}
+    if script.mesh_devices and "remesh" not in kinds:
+        yield dataclasses.replace(script, mesh_devices=0)
+    if script.fused != "auto" and "fused_flip" not in kinds:
+        yield dataclasses.replace(script, fused="auto")
+
+
+def shrink(script: ScenarioScript, seed: int,
+           failing: Optional[Callable[[ScenarioScript], bool]] = None,
+           max_runs: int = 200) -> Tuple[ScenarioScript, int]:
+    """Delta-debug ``script`` to a 1-minimal failing reproducer.
+
+    ``failing(candidate) -> bool`` re-runs the oracles (default: the
+    full three-oracle :func:`chaos.run_scenario` verdict at ``seed``)
+    — every accepted reduction is *re-verified*, the shrinker never
+    assumes monotonicity. Greedy fixpoint: restart the candidate walk
+    from every smaller script that still fails; stop when no
+    single-step reduction reproduces (1-minimality) or the
+    ``max_runs`` oracle budget is spent.
+
+    -> ``(minimal_script, oracle_runs_spent)``. Raises ``ValueError``
+    if the input script does not fail its oracle (nothing to shrink —
+    a passing script must never enter the corpus)."""
+    if failing is None:
+        def failing(s: ScenarioScript) -> bool:
+            rec = chaos.run_scenario(s, seed=seed)
+            return not rec["ok"] and not rec.get("skipped")
+
+    runs = 1
+    if not failing(script):
+        raise ValueError(
+            f"script {script.name!r} passes its oracles at seed {seed}; "
+            "refusing to shrink a non-failure"
+        )
+    current = script
+    progress = True
+    while progress and runs < max_runs:
+        progress = False
+        for cand in _shrink_candidates(current):
+            try:
+                cand.validate()
+            except ValueError:
+                continue
+            if not grammar_valid(cand):
+                continue
+            runs += 1
+            if failing(cand):
+                logger.info(
+                    "corrofuzz shrink: %d phases/%d injections/%d rounds "
+                    "still fails",
+                    len(cand.phases), len(cand.injections),
+                    cand.total_rounds,
+                )
+                current = cand
+                progress = True
+                break
+            if runs >= max_runs:
+                break
+    return dataclasses.replace(
+        current, name=f"{script.name}-min"), runs
+
+
+# --- the corpus -----------------------------------------------------------
+
+
+def corpus_dir() -> str:
+    """The committed reproducer corpus: ``tests/chaos_corpus/`` at the
+    repo root (resolved relative to this file so replay works from any
+    CWD)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.join(os.path.dirname(os.path.dirname(here)),
+                        "tests", "chaos_corpus")
+
+
+def save_reproducer(script: ScenarioScript, seed: int, note: str = "",
+                    tier1: bool = False, path: Optional[str] = None) -> str:
+    """Serialize a shrunk reproducer into the corpus. -> the file path.
+
+    The envelope carries the replay seed and provenance note; the
+    ``script`` key is exactly :func:`script_to_json`, so
+    ``corrosion-tpu chaos --script FILE`` replays the file and the
+    round-trip preserves ``trace_digest``."""
+    if path is None:
+        path = os.path.join(corpus_dir(), f"{script.name}.json")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    payload = {
+        "schema": CORPUS_SCHEMA_VERSION,
+        "seed": int(seed),
+        "note": note,
+        "tier1": bool(tier1),
+        "script": script_to_json(script),
+    }
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def load_reproducer(path: str) -> Tuple[ScenarioScript, int, dict]:
+    """Load one corpus file. -> ``(script, seed, meta)`` where ``meta``
+    is the envelope minus the script. Refuses unknown envelope schemas
+    and malformed scripts loudly (``script_from_json``)."""
+    with open(path) as f:
+        payload = json.load(f)
+    schema = int(payload.get("schema", CORPUS_SCHEMA_VERSION))
+    if schema != CORPUS_SCHEMA_VERSION:
+        raise ValueError(
+            f"{path}: corpus schema {schema} != {CORPUS_SCHEMA_VERSION}"
+        )
+    script = script_from_json(payload["script"])
+    meta = {k: v for k, v in payload.items() if k != "script"}
+    return script, int(payload.get("seed", 0)), meta
+
+
+def iter_corpus(dirpath: Optional[str] = None):
+    """Sorted corpus file paths (deterministic replay order)."""
+    dirpath = dirpath or corpus_dir()
+    if not os.path.isdir(dirpath):
+        return []
+    return [os.path.join(dirpath, name)
+            for name in sorted(os.listdir(dirpath))
+            if name.endswith(".json")]
+
+
+# --- the mutation fixture -------------------------------------------------
+
+
+@contextlib.contextmanager
+def broken_corruption_oracle():
+    """Blind the corruption injector (the mutation fixture).
+
+    Inside the context, ``chaos.corrupt_checkpoint`` is a no-op: the
+    engine *believes* it corrupted the newest checkpoint, so its
+    post-corruption probe finds the load succeeding and the recovery
+    resuming from the "corrupted" file — any script carrying a
+    ``corrupt_checkpoint`` injection now FAILS its verdict
+    deterministically. This is how tests/test_fuzz.py proves the
+    fuzzer catches real oracle violations and the shrinker carves them
+    to a minimal corpus reproducer — a chaos pipeline that cannot fail
+    is not measuring anything."""
+    real = chaos.corrupt_checkpoint
+
+    def dark(path: str, *a, **k) -> None:
+        logger.info("corrofuzz mutation fixture: corruption of %s "
+                    "suppressed", path)
+
+    chaos.corrupt_checkpoint = dark
+    try:
+        yield
+    finally:
+        chaos.corrupt_checkpoint = real

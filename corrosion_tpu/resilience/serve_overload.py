@@ -71,16 +71,25 @@ def run_serve_overload(seed: int = 0, writers: int = 4, ops: int = 40,
                        keys: int = 12, n_nodes: int = 8,
                        slow_ms: float = 25.0, pad_bytes: int = 1024,
                        warm_rounds: int = 8, deadline_s: float = 240.0,
-                       workdir: Optional[str] = None) -> dict:
+                       workdir: Optional[str] = None,
+                       flight_path: Optional[str] = None) -> dict:
     """Run the scenario; -> a chaos-shaped verdict record (pure op plan
     in ``seed``; ``workdir`` is accepted for registry-signature parity
-    and unused — this scenario touches no disk)."""
+    and unused — this scenario touches no disk unless ``flight_path``
+    asks for the NDJSON flight record, whose header/end pair carries
+    the admission/shed snapshot so the replay shows the shed story —
+    docs/observability.md)."""
     from corrosion_tpu.agent import Agent
     from corrosion_tpu.api.admission import AdmissionController
     from corrosion_tpu.api.http import ApiServer
     from corrosion_tpu.client import ApiError, CorrosionApiClient
     from corrosion_tpu.config import ServeConfig
     from corrosion_tpu.db import Database
+    from corrosion_tpu.obs.flight import (
+        FLIGHT_SCHEMA_VERSION,
+        FlightRecorder,
+        serve_snapshot,
+    )
     from corrosion_tpu.testing import cluster_config
     from corrosion_tpu.utils.lifecycle import spawn_counted
     from corrosion_tpu.utils.metrics import parse_exposition
@@ -116,10 +125,18 @@ def run_serve_overload(seed: int = 0, writers: int = 4, ops: int = 40,
     flap = {"t0": None, "t1": None, "applied": False, "observed": 0}
     sub_out: List[Optional[dict]] = [None, None]  # fast, slow
 
+    flight = FlightRecorder(flight_path) if flight_path else None
     with Agent(cfg) as agent:
         agent.wait_rounds(warm_rounds, timeout=deadline_s)
         db = Database(agent)
         admission = AdmissionController(serve, registry=agent.metrics)
+        if flight is not None:
+            flight.record(
+                "header", schema=FLIGHT_SCHEMA_VERSION,
+                mode="serve-overload", n_nodes=int(n_nodes),
+                start_round=0, total_rounds=0, segment_rounds=0,
+                seed=int(seed), plan_digest=plan["digest"],
+            )
         with ApiServer(db, port=0, serve=serve,
                        admission=admission) as api:
             setup = CorrosionApiClient(api.addr, api.port)
@@ -361,6 +378,21 @@ def run_serve_overload(seed: int = 0, writers: int = 4, ops: int = 40,
                 s["dropped"] for s in sub_out if s)
             rec["ready_flap_applied"] = bool(flap["applied"])
             rec["ready_503_observed"] = flap["observed"]
+            if flight is not None:
+                # the shed story, replayable: corro.admission.* +
+                # corro.subs.shed_total ride the end record
+                flight.record(
+                    "end", completed_rounds=0, aborted=False,
+                    crashed=False, checkpoint=None,
+                    stats={
+                        "acked_writes": rec["acked_writes"],
+                        "rejected_writes": rec["rejected_writes"],
+                        "subs_shed_total": rec["subs_shed_total"],
+                    },
+                    serve=serve_snapshot(agent.metrics),
+                )
+    if flight is not None:
+        flight.close()
 
     leaked = sorted(
         t.name for t in threading.enumerate()

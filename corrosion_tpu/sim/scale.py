@@ -538,6 +538,21 @@ def scale_swim_step(
     known = occupied & not_self
     ann_slot, has_known = sample_one(known, k_annt)
     ann_tgt = jnp.clip(select_cols(mem_id, ann_slot[:, None])[:, 0], 0)
+    # bootstrap fallback: a node whose table holds nobody but itself
+    # (long-dead, fully purged by the cluster, its own view reset by the
+    # state-loss rejoin) announces to a random static seed instead — the
+    # restart-time bootstrap-host re-contact. Without it a forgotten
+    # node can never rejoin: it has no announce target, no probe target,
+    # and nobody probes it, so its queued changesets wedge undrained
+    # (the chaos quiescence oracle caught exactly this on
+    # rejoin-refutation).
+    seed_tgt = jr.randint(
+        jr.fold_in(k_annt, 1), (n,), 0, min(cfg.n_seeds, n),
+        dtype=jnp.int32,
+    )
+    lonely = alive & ~has_known & (seed_tgt != iarr)
+    ann_tgt = jnp.where(lonely, seed_tgt, ann_tgt)
+    has_known = has_known | lonely
     ann_card = card_at(card, ann_tgt)
     announcing = announcing & has_known
     ann_out = announcing & datagram_ok_c(net, k_ann1, card, ann_card)

@@ -616,21 +616,34 @@ def activity_masks(cfg: ScaleSimConfig, st: ScaleSimState) -> dict:
     - ``partials``: any buffered incomplete multi-cell version;
     - ``sync``: any outstanding version need (heard-of-but-unseen,
       ``ops.versions.needs_count``) that anti-entropy must pull;
-    - ``probes``: any running SWIM suspicion / down-purge timer
+    - ``probes``: any RUNNING SWIM suspicion / down-purge timer
       (membership churn in flight; steady-state probing of a healthy
-      quiet cluster keeps all timers at zero).
+      quiet cluster keeps all timers at zero). A timer only runs while
+      its entry is still Suspect or Down — the membership update
+      neither ticks nor clears ``mem_timer`` once an entry is refuted
+      back to Alive, so the raw plane legitimately carries stale
+      nonzero residue after recovered churn (the chaos quiescence
+      oracle found exactly this); counting residue as activity would
+      keep healed shards hot forever.
 
     The quiet-trace oracle rides on this: zero traffic (no writes, no
     kills) ⇒ every mask all-False ⇒ every ``active_*`` info count is
     zero. Each mask is one cheap reduce over an existing state plane —
     no new HBM tables, no extra gathers."""
+    from corrosion_tpu.ops.lww import STATE_DOWN, STATE_SUSPECT
     from corrosion_tpu.ops.partials import NO_SLOT
 
+    view = st.swim.mem_view
+    pending = (
+        (st.swim.mem_id >= 0)
+        & (view >= 0)
+        & (((view & 3) == STATE_SUSPECT) | ((view & 3) == STATE_DOWN))
+    )
     return {
         "bcast": jnp.any(st.crdt.q_origin != NO_Q, axis=1),
         "partials": jnp.any(st.crdt.partials.origin != NO_SLOT, axis=1),
         "sync": jnp.any(needs_count(st.crdt.book) > 0, axis=1),
-        "probes": jnp.any(st.swim.mem_timer > 0, axis=1),
+        "probes": jnp.any(pending & (st.swim.mem_timer > 0), axis=1),
     }
 
 

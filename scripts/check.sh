@@ -64,11 +64,12 @@ python -m corrosion_tpu.analysis \
     corrosion_tpu/resilience/chaos.py corrosion_tpu/sim/scenario.py \
     corrosion_tpu/sim/scale.py corrosion_tpu/sim/broadcast.py \
     corrosion_tpu/ops/versions.py corrosion_tpu/ops/partials.py \
+    corrosion_tpu/resilience/fuzz.py \
     --output-json /tmp/lint_fused_scope.json
 python - <<'PY'
 import json
 scoped = json.load(open("/tmp/lint_fused_scope.json"))
-if scoped["files_checked"] != 10 or not scoped["clean"]:
+if scoped["files_checked"] != 11 or not scoped["clean"]:
     raise SystemExit(f"fused/chaos-path lint scope regressed: {scoped}")
 full = json.load(open("artifacts/lint_r06.json"))
 assert "rule_counts" in full, "lint report lost rule_counts"
@@ -264,9 +265,11 @@ echo "== corrochaos fault-scenario sweep =="
 # the ISSUE 13 robustness gate (docs/chaos.md): every shipped seeded
 # fault scenario — partition-heal, clock-skew past the HLC drift gate,
 # rejoin refutation, mid-segment preemption (both crash windows),
-# checkpoint corruption, elastic 8->4 remesh, fused<->unfused flip —
-# through the REAL segmented pipeline under CORROSAN=1, double-oracle-
-# checked (convergence + no checkpoint restores diverged state).
+# checkpoint corruption, elastic 8->4 remesh, fused<->unfused flip,
+# plus the r18 composed scenarios (corrupt-remesh, skew-partition,
+# preempt-storm) — through the REAL segmented pipeline under
+# CORROSAN=1, triple-oracle-checked (convergence + no checkpoint
+# restores diverged state + the healed cluster quiesces).
 # Publishes per-scenario verdicts to artifacts/chaos_r13.json and the
 # rounds-to-convergence lineage record to CONVERGENCE_r13_cpu.json
 # (superseding the seed-era one-scenario artifact).
@@ -286,12 +289,50 @@ if not rec.get("corrosan"):
 scen = rec["scenarios"]
 if len(scen) < 6 or any(r.get("skipped") for r in scen):
     raise SystemExit(f"chaos sweep incomplete: {scen}")
+names = {r["name"] for r in scen}
+composed = {"corrupt-remesh", "skew-partition", "preempt-storm"}
+if not composed <= names:
+    raise SystemExit(f"composed scenarios missing: {composed - names}")
+if not all(r.get("quiesced") for r in scen):
+    bad = [r["name"] for r in scen if not r.get("quiesced")]
+    raise SystemExit(f"third oracle (quiescence) failed: {bad}")
 validated = sum(r["checkpoints_validated"] for r in scen)
 faults = sum(r["faults_injected"] for r in scen)
-print(f"chaos sweep: {len(scen)} scenarios ok, {validated} checkpoints "
-      f"validated, {faults} host-plane faults injected")
+print(f"chaos sweep: {len(scen)} scenarios ok (all quiesced), "
+      f"{validated} checkpoints validated, {faults} host-plane faults "
+      f"injected")
 PY
 echo "chaos sweep: ok (report: artifacts/chaos_r13.json)"
+
+echo "== corrofuzz generative sweep =="
+# the ISSUE 18 robustness gate (docs/chaos.md "Generative fuzzing"):
+# a fixed-seed budget of generated multi-fault scenarios — seeded
+# random-but-valid scripts over the whole fault grammar, N drawn from
+# the corrobudget-priced fast ladder — each judged by all three
+# oracles under the same CORROSAN window. A failing seed is a real
+# finding: shrink it (corrosion-tpu fuzz --shrink-failures) and commit
+# the reproducer to tests/chaos_corpus/.
+env CORROSAN=1 JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m corrosion_tpu fuzz --seeds 0:24 \
+    --output-json artifacts/fuzz_r18.json > /dev/null
+python - <<'PY'
+import json
+rec = json.load(open("artifacts/fuzz_r18.json"))
+if not rec.get("ok"):
+    bad = [c for c in rec["cases"] if not c.get("ok")]
+    raise SystemExit(f"corrofuzz sweep failed: {bad}")
+if not rec.get("corrosan"):
+    raise SystemExit("corrofuzz sweep did not run under the sanitizer")
+if len(rec["cases"]) < 25 or any(c.get("skipped") for c in rec["cases"]):
+    raise SystemExit(f"corrofuzz budget incomplete: {rec['cases']}")
+kinds = {k for c in rec["cases"] for k in c["injections"]}
+slow = [r for r in rec["ladder"] if r["slow"]]
+print(f"corrofuzz: {len(rec['cases'])} generated scenarios ok "
+      f"({sorted(kinds)} exercised; ladder to "
+      f"{rec['ladder'][-1]['n_nodes']} nodes, {len(slow)} slow rungs)")
+PY
+echo "corrofuzz: ok (report: artifacts/fuzz_r18.json)"
 
 echo "== sharded checkpoint probe =="
 # per-shard drain + elastic 8->4 resharded restore, published next to

@@ -38,7 +38,9 @@ def test_disabled_guard_admits_everything():
     """max_inflight <= 0 is the unguarded plane: every admit is free
     and the admission series are never minted."""
     reg = Registry()
-    c = ctl(reg)  # default ServeConfig: max_inflight=0
+    # defaults are non-zero (measured, docs/overload.md) since r18 —
+    # the naked plane is now an explicit opt-out
+    c = AdmissionController(ServeConfig.unlimited(), registry=reg)
     assert not c.enabled
     for cls in ROUTE_CLASSES:
         for _ in range(64):
@@ -145,7 +147,9 @@ def test_stream_capacity_separate_from_oneshot():
     for _ in range(5):
         assert c.admit("stream")
     assert not c.admit("stream")
-    assert ctl(max_inflight=3).capacity("stream") == 3
+    # max_streams=0 is the explicit fallback-to-max_inflight knob (the
+    # default is now a measured non-zero cap, see docs/overload.md)
+    assert ctl(max_inflight=3, max_streams=0).capacity("stream") == 3
 
 
 def test_route_class_mapping():

@@ -1,13 +1,15 @@
 """corrochaos: the deterministic seeded fault-scenario engine
 (docs/chaos.md, ``resilience/chaos.py``).
 
-Tier-1 replays the small tier-1 scripts end to end against BOTH
+Tier-1 replays the small tier-1 scripts end to end against all THREE
 oracles (convergence within budget; every surviving manifest replays
-to the uninterrupted fixpoint bitwise), pins verdict determinism in
-``(name, seed)``, and meta-tests the registry against the doc. The
-full sweep — every shipped scenario, including the 8->4 remesh and the
-fused flip — is slow-marked here and rides ``scripts/check.sh`` under
-``CORROSAN=1`` (publishing ``artifacts/chaos_r13.json``).
+to the uninterrupted fixpoint bitwise; the healed cluster quiesces —
+activity drains to zero), pins verdict determinism in ``(name,
+seed)``, and meta-tests the registry against the doc. The full sweep
+— every shipped scenario, including the 8->4 remesh, the fused flip
+and the ISSUE-18 composed scenarios — is slow-marked here and rides
+``scripts/check.sh`` under ``CORROSAN=1`` (publishing
+``artifacts/chaos_r13.json``).
 """
 
 import dataclasses
@@ -38,7 +40,7 @@ DOC = os.path.join(os.path.dirname(__file__), "..", "docs", "chaos.md")
 
 
 @pytest.mark.parametrize("name", TIER1_SCENARIOS)
-def test_tier1_scenario_passes_both_oracles(name, tmp_path):
+def test_tier1_scenario_passes_all_three_oracles(name, tmp_path):
     rec = run_scenario(SCENARIOS[name], seed=0, workdir=str(tmp_path))
     assert rec["ok"], rec.get("problems")
     # oracle 1: the chaos leg matches the uninterrupted run bitwise and
@@ -47,6 +49,9 @@ def test_tier1_scenario_passes_both_oracles(name, tmp_path):
     assert rec["rounds_to_convergence"] >= rec["rounds_scripted"]
     # oracle 2: the checkpoint lineage validated (no diverged restores)
     assert rec["checkpoints_validated"] >= 1
+    # oracle 3: the healed cluster went quiet within the same budget
+    assert rec["quiesced"]
+    assert rec["rounds_to_quiescence"] >= rec["rounds_scripted"]
     # every scripted host-plane fault actually fired
     assert rec["faults_injected"] == len(SCENARIOS[name].injections)
 
@@ -162,6 +167,20 @@ def test_registry_covers_the_required_fault_axes():
     assert any(ph.revive_killed for ph in phases)  # rejoin-refutation
     assert {"crash_slice", "crash_manifest", "corrupt_checkpoint",
             "remesh", "fused_flip"} <= kinds
+    # the ISSUE-18 composed scenarios are shipped and actually composed
+    # (two+ fault axes in one script)
+    assert {"corrupt-remesh", "skew-partition", "preempt-storm"} \
+        <= set(SCENARIOS)
+    cr = SCENARIOS["corrupt-remesh"]
+    assert {i.kind for i in cr.injections} == {"corrupt_checkpoint",
+                                               "remesh"}
+    sp = SCENARIOS["skew-partition"].phases[0]
+    assert sp.partition_groups > 1 and sp.clock_skew_rounds > \
+        HLC_MAX_DRIFT_ROUNDS
+    ps = SCENARIOS["preempt-storm"]
+    assert {i.kind for i in ps.injections} == {"crash_slice", "preempt",
+                                               "crash_manifest"}
+    assert any(ph.kill_frac > 0 for ph in ps.phases)
     # tier-1 subset is real and shipped
     assert set(TIER1_SCENARIOS) <= set(SCENARIOS)
     assert 2 <= len(TIER1_SCENARIOS) <= 3
@@ -211,7 +230,7 @@ def test_full_sweep_every_scenario_both_oracles():
     assert {r["name"] for r in out["scenarios"]} == set(SCENARIOS)
     # the 8-virtual-device conftest rig means nothing may skip here
     assert not any(r.get("skipped") for r in out["scenarios"])
-    assert all(r["converged"] and r["bitwise_match"]
+    assert all(r["converged"] and r["bitwise_match"] and r["quiesced"]
                for r in out["scenarios"])
 
 

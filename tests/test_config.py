@@ -62,3 +62,24 @@ def test_sim_config_bridges():
     cfg.sim.mode = "bogus"
     with pytest.raises(ValueError):
         cfg.sim_config()
+
+
+def test_serve_defaults_are_measured_and_opt_out_is_explicit():
+    """[serve] defaults are the BENCH_SERVE_r17-derived caps
+    (docs/overload.md "Default caps"); 0 stays the per-knob unlimited
+    opt-out and ServeConfig.unlimited() is the all-off policy."""
+    from corrosion_tpu.config import ServeConfig
+
+    s = ServeConfig()
+    assert (s.max_inflight, s.max_queue, s.max_streams, s.sub_queue) == (
+        8, 16, 64, 1024)
+    naked = ServeConfig.unlimited()
+    assert (naked.max_inflight, naked.max_queue, naked.max_streams,
+            naked.sub_queue) == (0, 0, 0, 0)
+    # the derivation doc and the committed bench record both exist
+    root = __file__.rsplit("/tests/", 1)[0]
+    import os
+    assert os.path.exists(os.path.join(root, "BENCH_SERVE_r17.json"))
+    with open(os.path.join(root, "docs", "overload.md")) as f:
+        doc = f.read()
+    assert "BENCH_SERVE_r17.json" in doc and "unlimited()" in doc

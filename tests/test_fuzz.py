@@ -1,0 +1,334 @@
+"""corrofuzz: generative multi-fault chaos (docs/chaos.md "Generative
+fuzzing", ``resilience/fuzz.py``).
+
+Tier-1 pins the generator (purity in the seed, validity by
+construction, the corrobudget-priced N ladder), the script<->JSON
+round-trip contract (``trace_digest`` preserved), the shrinker's
+1-minimal fixpoint (synthetic oracle — no engine runs), and the
+committed corpus: every ``tests/chaos_corpus/*.json`` parses, and the
+mutation-fixture reproducer REPLAYS — failing under the blinded
+corruption injector, passing (twice, bit-identically) with the healthy
+engine. The end-to-end live shrink and the seeded fuzz sweep are
+slow-marked and ride ``scripts/check.sh`` (``artifacts/fuzz_r18.json``).
+"""
+
+import dataclasses
+import json
+import os
+
+import pytest
+
+from corrosion_tpu.resilience import chaos, fuzz
+from corrosion_tpu.resilience.chaos import (
+    INJECTION_KINDS,
+    compile_scenario,
+    run_scenario,
+    script_from_json,
+    script_to_json,
+)
+from corrosion_tpu.resilience.fuzz import (
+    FAST_LADDER_BYTES,
+    LADDER_RUNGS,
+    broken_corruption_oracle,
+    fuzz_ladder,
+    gen_script,
+    iter_corpus,
+    load_reproducer,
+    run_fuzz,
+    save_reproducer,
+    shrink,
+)
+
+SEED_POOL = range(64)
+
+
+# --- the generator --------------------------------------------------------
+
+
+def test_gen_script_pure_in_seed_and_profile():
+    for seed in (0, 7, 24, 63):
+        assert gen_script(seed) == gen_script(seed)
+        assert gen_script(seed, profile="scale") == gen_script(
+            seed, profile="scale")
+    assert gen_script(0) != gen_script(1)
+    with pytest.raises(ValueError):
+        gen_script(0, profile="warp")
+
+
+def test_ladder_is_priced_and_fast_rungs_are_fast():
+    """Every rung carries a corrobudget price; the slow flag is exactly
+    the FAST_LADDER_BYTES threshold; prices grow with N."""
+    ladder = fuzz_ladder()
+    assert tuple(r["n_nodes"] for r in ladder) == LADDER_RUNGS
+    for r in ladder:
+        assert r["bytes"] > 0
+        assert r["slow"] == (r["bytes"] > FAST_LADDER_BYTES)
+    prices = [r["bytes"] for r in ladder]
+    assert prices == sorted(prices) and len(set(prices)) == len(prices)
+    fast = {r["n_nodes"] for r in ladder if not r["slow"]}
+    assert fast == {24, 64}  # the tier-1 / check.sh draw
+
+
+def test_generated_scripts_are_valid_by_construction():
+    """Over the seed pool: every script validates, obeys the grammar
+    constraints (segment-aligned rounds, recoverable crash/corruption
+    targets, one crash seam per phase, healed tail), and the fast
+    profile never draws a slow rung."""
+    fast_rungs = {r["n_nodes"] for r in fuzz_ladder() if not r["slow"]}
+    kinds_seen = set()
+    for seed in SEED_POOL:
+        s = gen_script(seed)
+        s.validate()
+        assert s.name == f"fuzz-{seed:06d}"
+        assert s.n_nodes in fast_rungs
+        assert all(ph.rounds % s.segment_rounds == 0 for ph in s.phases)
+        # healed tail: a kill-bearing script revives before settling
+        if any(ph.kill_frac > 0 for ph in s.phases):
+            assert s.phases[-1].revive_killed
+        assert s.phases[-1].write_frac == 0.0
+        # recoverability: crash/corruption only after 2 committed segs
+        segs = 0
+        segs_through = []
+        for ph in s.phases:
+            segs += ph.rounds // s.segment_rounds
+            segs_through.append(segs)
+        crash_phases = []
+        for inj in s.injections:
+            kinds_seen.add(inj.kind)
+            if inj.kind in ("crash_slice", "crash_manifest",
+                            "corrupt_checkpoint"):
+                assert segs_through[inj.phase] >= 2, (seed, inj)
+            if inj.kind in ("crash_slice", "crash_manifest"):
+                crash_phases.append(inj.phase)
+        assert len(crash_phases) == len(set(crash_phases))  # one seam/phase
+    # the pool exercises every host-plane injection kind
+    assert kinds_seen == set(INJECTION_KINDS)
+
+
+def test_script_json_round_trip_preserves_trace_digest():
+    """script_to_json -> script_from_json is the identity, and the
+    compiled trace digest — the replay contract — survives it."""
+    for seed in (0, 8, 24):
+        s = gen_script(seed)
+        back = script_from_json(json.loads(json.dumps(script_to_json(s))))
+        assert back == s
+        _, _, digest = compile_scenario(s, seed=seed)
+        _, _, digest2 = compile_scenario(back, seed=seed)
+        assert digest == digest2
+
+
+def test_fuzz_record_shape_and_keep_failures(monkeypatch):
+    """run_fuzz folds per-seed verdicts into the artifact record and
+    (keep_failures) attaches the failing script's JSON inline."""
+    def stub(script, seed=0, workdir=None):
+        ok = seed != 3
+        rec = {"name": script.name, "seed": seed, "ok": ok,
+               "trace_digest": f"d{seed}", "rounds_to_convergence": 5,
+               "rounds_to_quiescence": 4}
+        if not ok:
+            rec["problems"] = ["synthetic failure"]
+        return rec
+
+    monkeypatch.setattr(chaos, "run_scenario", stub)
+    out = run_fuzz([2, 3], keep_failures=True)
+    assert out["metric"] == "chaos_fuzz" and out["seeds"] == [2, 3]
+    assert not out["ok"]
+    assert set(out["per_seed"]) == {"2", "3"}
+    assert out["per_seed"]["2"] == {"ok": True, "rounds_to_convergence": 5,
+                                    "rounds_to_quiescence": 4}
+    by_seed = {c["seed"]: c for c in out["cases"]}
+    assert "script" not in by_seed[2]
+    assert by_seed[3]["problems"] == ["synthetic failure"]
+    assert script_from_json(by_seed[3]["script"]) == gen_script(3)
+
+
+# --- the shrinker (synthetic oracle: no engine runs) ----------------------
+
+
+def test_shrinker_carves_to_the_failing_injection():
+    """With a synthetic oracle that fails exactly when a
+    corrupt_checkpoint injection is present, the shrinker must strip
+    every other phase, injection, and fault knob — the 1-minimal form
+    the mutation fixture demands (<= 3 phases)."""
+    script = gen_script(24)  # carries a corrupt_checkpoint draw
+    assert any(i.kind == "corrupt_checkpoint" for i in script.injections)
+
+    runs_spent = []
+
+    def failing(s):
+        runs_spent.append(1)
+        return any(i.kind == "corrupt_checkpoint" for i in s.injections)
+
+    minimal, runs = shrink(script, seed=24, failing=failing)
+    assert runs == len(runs_spent) <= 200
+    assert minimal.name == script.name + "-min"
+    assert [i.kind for i in minimal.injections] == ["corrupt_checkpoint"]
+    assert len(minimal.phases) <= 3
+    assert minimal.n_nodes == min(LADDER_RUNGS)
+    # the shrinker never leaves the generator's grammar: the surviving
+    # corruption still has a committed segment to fall back to
+    assert fuzz.grammar_valid(minimal)
+    assert minimal.total_rounds >= 2 * minimal.segment_rounds
+    assert minimal.total_rounds <= script.total_rounds
+    # 1-minimality: no single-step in-grammar reduction still fails
+    for cand in fuzz._shrink_candidates(
+            dataclasses.replace(minimal, name=script.name)):
+        try:
+            cand.validate()
+        except ValueError:
+            continue
+        if not fuzz.grammar_valid(cand):
+            continue
+        assert not failing(cand), cand
+
+
+def test_shrink_refuses_a_passing_script():
+    with pytest.raises(ValueError, match="refusing to shrink"):
+        shrink(gen_script(0), seed=0, failing=lambda s: False)
+
+
+def test_grammar_valid_pins_the_recoverability_floor():
+    from corrosion_tpu.resilience.chaos import Injection, ScenarioScript
+    from corrosion_tpu.sim.scenario import FaultPhase
+
+    one_seg = ScenarioScript(
+        name="one-seg",
+        phases=(FaultPhase(rounds=4),),
+        injections=(Injection(kind="corrupt_checkpoint", phase=0),),
+    )
+    assert not fuzz.grammar_valid(one_seg)
+    two_seg = dataclasses.replace(
+        one_seg, name="two-seg", phases=(FaultPhase(rounds=8),))
+    assert fuzz.grammar_valid(two_seg)
+    # two crash seams on one phase are out of grammar
+    double = dataclasses.replace(
+        two_seg, name="double-seam",
+        injections=(Injection(kind="crash_slice", phase=0),
+                    Injection(kind="crash_manifest", phase=0)))
+    assert not fuzz.grammar_valid(double)
+    # every generated script is in grammar by construction
+    assert all(fuzz.grammar_valid(gen_script(s)) for s in SEED_POOL)
+
+
+def test_drop_phase_reindexes_injections():
+    script = gen_script(8)
+    assert len(script.phases) >= 3
+    kept = fuzz._drop_phase(script, 0)
+    assert len(kept.phases) == len(script.phases) - 1
+    for inj in kept.injections:
+        assert 0 <= inj.phase < len(kept.phases)
+
+
+def test_broken_oracle_swaps_and_restores_the_injector():
+    real = chaos.corrupt_checkpoint
+    with broken_corruption_oracle():
+        assert chaos.corrupt_checkpoint is not real
+    assert chaos.corrupt_checkpoint is real
+
+
+# --- the corpus -----------------------------------------------------------
+
+
+def test_corpus_every_file_parses_and_validates():
+    """Meta-test: the committed corpus is non-empty, every file loads
+    through the envelope contract, every script validates, and every
+    entry says where it came from."""
+    paths = iter_corpus()
+    assert paths, "tests/chaos_corpus/ must ship at least one reproducer"
+    for path in paths:
+        script, seed, meta = load_reproducer(path)
+        script.validate()
+        assert seed >= 0
+        assert meta["note"], f"{path}: a reproducer needs provenance"
+        assert isinstance(meta["tier1"], bool)
+        assert os.path.basename(path) == f"{script.name}.json"
+
+
+def test_corpus_envelope_refuses_unknown_schema(tmp_path):
+    script = gen_script(0)
+    path = save_reproducer(script, seed=0, note="schema probe",
+                           path=str(tmp_path / "probe.json"))
+    with open(path) as f:
+        payload = json.load(f)
+    payload["schema"] = 999
+    with open(path, "w") as f:
+        json.dump(payload, f)
+    with pytest.raises(ValueError, match="corpus schema"):
+        load_reproducer(path)
+
+
+def _tier1_corpus():
+    entries = [load_reproducer(p) for p in iter_corpus()]
+    return [(s, seed) for s, seed, meta in entries if meta["tier1"]]
+
+
+def test_corpus_mutation_reproducer_replays(tmp_path):
+    """The shrinker-is-live acceptance: the committed <=3-phase
+    reproducer FAILS under the blinded corruption injector and PASSES
+    with the healthy engine. (The run-twice determinism pin on the
+    same reproducer lives in the slow tier below — two engine runs
+    here keeps tier-1 inside its wall-clock budget.)"""
+    repros = [(s, seed) for s, seed in _tier1_corpus()
+              if any(i.kind == "corrupt_checkpoint" for i in s.injections)]
+    assert repros, "the mutation-fixture reproducer must be committed"
+    for script, seed in repros:
+        assert len(script.phases) <= 3
+        with broken_corruption_oracle():
+            rec = run_scenario(script, seed=seed,
+                               workdir=str(tmp_path / "dark"))
+        assert not rec["ok"]
+        assert any("NOT detected" in p for p in rec["problems"])
+        a = run_scenario(script, seed=seed, workdir=str(tmp_path / "a"))
+        assert a["ok"], a.get("problems")
+        assert a["quiesced"] and a["converged"] and a["bitwise_match"]
+
+
+@pytest.mark.slow
+def test_corpus_replay_is_deterministic(tmp_path):
+    """Replaying the same committed reproducer twice yields
+    field-for-field identical verdict records."""
+    for script, seed in _tier1_corpus():
+        a = run_scenario(script, seed=seed, workdir=str(tmp_path / "a"))
+        b = run_scenario(script, seed=seed, workdir=str(tmp_path / "b"))
+        assert a == b
+        assert a["ok"], a.get("problems")
+
+
+# --- end-to-end (slow; also rides check.sh) -------------------------------
+
+
+@pytest.mark.slow
+def test_fuzz_sweep_all_oracles_deterministic():
+    """>= 25 generated scenarios pass all three oracles, and the whole
+    sweep record is pure in the seed budget (run-twice pinning)."""
+    seeds = range(25)
+    out = run_fuzz(seeds)
+    bad = [c for c in out["cases"] if not c["ok"]]
+    assert out["ok"], bad
+    again = run_fuzz(seeds)
+    assert out["per_seed"] == again["per_seed"]
+    assert [c["trace_digest"] for c in out["cases"]] == \
+        [c["trace_digest"] for c in again["cases"]]
+
+
+@pytest.mark.slow
+def test_live_shrink_under_mutation_fixture(tmp_path):
+    """The full find->shrink->serialize->replay pipeline against the
+    real engine: blind the corruption injector, shrink the failing
+    script to <= 3 phases, and replay the saved reproducer from JSON."""
+    script = gen_script(24)
+
+    def failing(s):
+        with broken_corruption_oracle():
+            rec = run_scenario(s, seed=24)
+        return not rec["ok"] and not rec.get("skipped")
+
+    minimal, runs = shrink(script, seed=24, failing=failing, max_runs=60)
+    assert len(minimal.phases) <= 3
+    assert [i.kind for i in minimal.injections] == ["corrupt_checkpoint"]
+    path = save_reproducer(minimal, seed=24, note="live shrink probe",
+                           path=str(tmp_path / f"{minimal.name}.json"))
+    replayed, seed, _ = load_reproducer(path)
+    assert replayed == minimal
+    assert failing(replayed)
+    assert run_scenario(replayed, seed=seed)["ok"]

@@ -111,7 +111,23 @@ def test_request_metrics_per_route(rig):
     # monotonic >= rather than exact ==: the registry is shared, and
     # under full-suite load a background caller may land requests in
     # the same window — the gate is "this op was measured on this
-    # route", not a global count
+    # route", not a global count. Histograms land in the handler's
+    # finally AFTER the response reaches the client (same race the
+    # inflight-gauge poll below covers), so poll briefly here too.
+    def _settled():
+        return (_hist(metrics, "corro.http.request.seconds",
+                      route="/v1/transactions", method="POST",
+                      code="200") >= base_tx + 1
+                and _hist(metrics, "corro.http.request.seconds",
+                          route="/v1/queries", method="POST",
+                          code="200") >= 1
+                and _hist(metrics, "corro.http.request.seconds",
+                          route="/v1/queries", method="POST",
+                          code="400") >= base_bad + 1)
+
+    deadline = _time.monotonic() + 5.0
+    while not _settled() and _time.monotonic() < deadline:
+        _time.sleep(0.05)
     assert _hist(metrics, "corro.http.request.seconds",
                  route="/v1/transactions", method="POST",
                  code="200") >= base_tx + 1
@@ -237,6 +253,14 @@ def test_delivery_latency_and_queue_depth_series(rig):
     assert done.wait(30), "no change event received"
     wall = _time.perf_counter() - t0
     stream.close()
+    # the server thread records delivery.seconds AFTER the event is on
+    # the wire — the client can observe the change before the histogram
+    # lands, so poll briefly for it to settle (same race as the
+    # inflight-gauge poll in test_request_metrics_per_route)
+    deadline = _time.monotonic() + 5.0
+    while (_hist(metrics, "corro.subs.delivery.seconds") <= base
+           and _time.monotonic() < deadline):
+        _time.sleep(0.05)
     snap = metrics.snapshot()["histograms"]
     observed = [h for (n, _l), h in snap.items()
                 if n == "corro.subs.delivery.seconds"]

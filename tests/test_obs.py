@@ -127,6 +127,45 @@ def test_flight_recorder_thread_counted_and_joined(tmp_path):
                    for t in threading.enumerate())
 
 
+def test_flight_records_carry_serve_snapshot(tmp_path):
+    """An observer wired to a serve registry embeds the admission/shed
+    story (``corro.admission.*`` + ``corro.subs.shed_total``) into its
+    segment/end records, and replay surfaces the newest one — the
+    overloaded-soak forensics seam (docs/overload.md)."""
+    from corrosion_tpu.obs.flight import serve_snapshot
+    from corrosion_tpu.utils.metrics import Registry
+
+    reg = Registry()
+    reg.counter("corro.admission.admitted_total", 5,
+                labels={"class": "write"})
+    reg.counter("corro.admission.rejected_total", 2,
+                labels={"class": "write"})
+    reg.gauge("corro.admission.inflight", 3, labels={"class": "write"})
+    reg.counter("corro.subs.shed_total", 7)
+    reg.counter("corro.http.requests_total", 9)  # NOT a serve series
+    snap = serve_snapshot(reg)
+    assert snap["corro.admission.admitted_total{class=write}"] == 5
+    assert snap["corro.admission.rejected_total{class=write}"] == 2
+    assert snap["corro.admission.inflight{class=write}"] == 3
+    assert snap["corro.subs.shed_total"] == 7
+    assert not any(k.startswith("corro.http.") for k in snap)
+    assert serve_snapshot(None) == {}
+
+    path = str(tmp_path / "flight.ndjson")
+    flight = FlightRecorder(path)
+    flight.record("header", schema=1, mode="scale", n_nodes=N,
+                  start_round=0, total_rounds=2, segment_rounds=2)
+    obs = SoakObserver(flight=flight, serve_registry=reg)
+    obs.on_segment(seg_index=1, lo=0, hi=2, infos={},
+                   stats={"segments": 1}, state=None)
+    reg.counter("corro.subs.shed_total", 4)  # sheds between segment+end
+    obs.end_run(stats={"segments": 1}, completed_rounds=2, aborted=False)
+    obs.close()
+    summary = replay_flight_record(path)
+    # replay reports the NEWEST snapshot (the end record's)
+    assert summary["serve"]["corro.subs.shed_total"] == 11
+
+
 # --- the headline: crash-injected soak, replay vs resume ------------------
 
 
