@@ -41,14 +41,16 @@ RULE = "dtype-widen"
 #: plane lives as int8, so its boundaries must never receive a
 #: concretely-wider store — dynamic ``.astype(<plane>.dtype)`` casts
 #: stay the contract at every boundary, which is also why the int16
-#: default config needs no code change
+#: default config needs no code change. ``q_tx``/``q_seq``/``q_nseq``
+#: are 8 since ISSUE 19 (``narrow_q_int8``, the analogous queue-counter
+#: tier) for the same reason.
 NARROW_LEAVES: Dict[str, int] = {
     "mem_timer": 16,
     "mem_tx": 8,
     "q_cell": 16,
-    "q_seq": 16,
-    "q_nseq": 16,
-    "q_tx": 16,
+    "q_seq": 8,
+    "q_nseq": 8,
+    "q_tx": 8,
     "last_sync": 16,
 }
 
@@ -62,7 +64,7 @@ NARROW_LEAVES: Dict[str, int] = {
 #: carry's aval and retraces every consumer (ISSUE 10).
 NARROW_REFS: Dict[str, int] = {
     "o_timer": 16, "o_tx": 8, "m_timer": 16, "m_tx": 8,
-    "o_q_cell": 16, "o_q_tx": 16,
+    "o_q_cell": 16, "o_q_tx": 8,
 }
 NARROW_REFS.update(NARROW_LEAVES)
 

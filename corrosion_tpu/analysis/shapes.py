@@ -419,6 +419,7 @@ DEFAULT_EXTENTS: Dict[str, int] = {
 DEFAULT_FLAGS: Dict[str, bool] = {
     "narrow_dtypes": True,
     "narrow_int8": False,
+    "narrow_q_int8": False,
     "any_writer": True,
 }
 
@@ -483,12 +484,14 @@ class ConfigVal:
         sync_sym = "N" if type(cfg).__name__ == "SimConfig" else "M"
         flags.setdefault("narrow_dtypes", False)
         flags.setdefault("narrow_int8", False)
+        flags.setdefault("narrow_q_int8", False)
         return ConfigVal(bindings, flags, extras, sync_tracks_sym=sync_sym)
 
     def has(self, name: str) -> bool:
         return (name in SYMBOLS or name in PROPERTY_SYMBOLS
                 or name in self.flags or name in self.extras
-                or name in ("sync_tracks", "timer_dtype", "tx_dtype"))
+                or name in ("sync_tracks", "timer_dtype", "tx_dtype",
+                            "q_dtype"))
 
     def attr(self, name: str):
         if name in SYMBOLS:
@@ -505,6 +508,12 @@ class ConfigVal:
             # mirrors ScaleConfig/ScaleSimConfig.tx_dtype (ISSUE 12
             # int8 shrink): int8 budget planes under narrow_int8
             if self.flags.get("narrow_int8"):
+                return DtypeVal("int8")
+            return self.attr("timer_dtype")
+        if name == "q_dtype":
+            # mirrors ScaleSimConfig.q_dtype (ISSUE 19 int8 tier):
+            # int8 q_tx/q_seq/q_nseq counter planes under narrow_q_int8
+            if self.flags.get("narrow_q_int8"):
                 return DtypeVal("int8")
             return self.attr("timer_dtype")
         if name in self.flags:

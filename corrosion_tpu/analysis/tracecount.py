@@ -284,6 +284,39 @@ def _probe_fused_scale_run(repeats: int, rounds_per_segment: int = 2) -> int:
     return traces()
 
 
+def _probe_quiet_scale_run(repeats: int, rounds_per_segment: int = 2) -> int:
+    """The quiescence-gated path (ISSUE 19): ``scale_run_rounds_carry``
+    under ``quiet="on"`` with the carry donated and chained back in —
+    the shape a quiet-auto segment dispatch takes. The quiet step body
+    carries an extra ``lax.cond`` over the whole active round; a
+    retrace here means the quiet predicate or the fixpoint branch
+    destabilized the steady state."""
+    import dataclasses
+
+    from corrosion_tpu.resilience.segments import make_soak_inputs
+    from corrosion_tpu.sim.scale_step import (
+        ScaleSimState,
+        scale_run_rounds_carry,
+    )
+    from corrosion_tpu.sim.transport import NetModel
+
+    cfg = dataclasses.replace(_scale_cfg(), quiet="on").validate()
+    net = NetModel.create(cfg.n_nodes)
+    fn, traces = counting_jit(
+        lambda s, k, i: scale_run_rounds_carry(cfg, s, net, k, i),
+        donate_argnums=(0, 1),
+    )
+    st, key = ScaleSimState.create(cfg), jr.key(0)
+    for i in range(repeats):
+        seg = make_soak_inputs(cfg, jr.key(i), rounds_per_segment,
+                               write_frac=0.25)
+        (st, key), _infos = fn(st, key, seg)
+        if i == 0:
+            st = _host_roundtrip_owned(st)  # resume shape, donate-safe
+    jax.block_until_ready(st)
+    return traces()
+
+
 #: name -> probe(repeats) -> observed trace count
 HOT_ENTRY_POINTS: Dict[str, Callable[[int], int]] = {
     "full_sim_step": _probe_full_step,
@@ -292,6 +325,7 @@ HOT_ENTRY_POINTS: Dict[str, Callable[[int], int]] = {
     "sharded_scale_run": _probe_sharded_scale_run,
     "segmented_soak": _probe_segmented_soak,
     "fused_scale_run": _probe_fused_scale_run,
+    "quiet_scale_run": _probe_quiet_scale_run,
 }
 
 

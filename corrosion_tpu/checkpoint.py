@@ -60,7 +60,14 @@ class CheckpointIntegrityError(ValueError):
 #: under a different execution mode (e.g. a TPU soak's checkpoint
 #: restored under ``fused="interpret"`` on CPU), and so manifests
 #: written before the key existed keep restoring.
-EXECUTION_ONLY_CONFIG_KEYS = ("fused",)
+#: ``quiet*`` (ISSUE 19) joins ``fused``: the active-set round is pinned
+#: bitwise == dense, and the backstop/shard knobs only steer which
+#: rounds take the (result-identical) fixpoint branch and how occupancy
+#: is reported — a quiet soak's checkpoint restores under dense and
+#: vice versa.
+EXECUTION_ONLY_CONFIG_KEYS = (
+    "fused", "quiet", "quiet_backstop_interval", "quiet_shards",
+)
 
 #: semantic config keys added AFTER checkpoints already existed in the
 #: wild, with the default the older code behaved as: a manifest written
@@ -69,7 +76,8 @@ EXECUTION_ONLY_CONFIG_KEYS = ("fused",)
 #: a NON-default setting still refuses them loudly. ``narrow_int8``
 #: (ISSUE 12) changes the ``mem_tx`` aval when on, so unlike ``fused``
 #: it cannot be execution-only.
-COMPAT_DEFAULT_CONFIG_KEYS = {"narrow_int8": False}
+COMPAT_DEFAULT_CONFIG_KEYS = {"narrow_int8": False,
+                              "narrow_q_int8": False}
 
 
 def config_identity(cfg_or_dict) -> dict:

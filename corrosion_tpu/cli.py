@@ -313,6 +313,12 @@ def cmd_soak(args) -> int:
         import dataclasses
 
         cfg = dataclasses.replace(cfg, fused=args.fused).validate()
+    if getattr(args, "quiet_mode", None):
+        # same contract as --fused for the corroquiet active-set rounds:
+        # quiet == dense bitwise, checkpoint identity ignores the key
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, quiet=args.quiet_mode).validate()
     net = NetModel.create(
         cfg.n_nodes,
         drop_prob=cfg_file.gossip.drop_prob,
@@ -946,7 +952,7 @@ def build_parser() -> argparse.ArgumentParser:
     sk.add_argument("--mesh-hosts", type=int, default=0,
                     help="with --shard: fold the devices into a 2-D "
                          "(dcn, node) mesh over this many hosts")
-    from corrosion_tpu.sim.config import FUSED_MODES
+    from corrosion_tpu.sim.config import FUSED_MODES, QUIET_MODES
 
     sk.add_argument("--fused", choices=list(FUSED_MODES),
                     default=None,
@@ -955,6 +961,14 @@ def build_parser() -> argparse.ArgumentParser:
                          "'interpret' runs the pallas kernels "
                          "interpreted on any backend — the parity/"
                          "debug mode")
+    sk.add_argument("--quiet-mode", choices=list(QUIET_MODES),
+                    dest="quiet_mode", default=None,
+                    help="quiescence-aware active-set rounds override "
+                         "(default: the [perf] quiet config key; "
+                         "docs/fused.md). 'on' pins the quiet scan "
+                         "body, 'auto' lets the segment pipeline pick "
+                         "it for all-quiet segments — results are "
+                         "bitwise identical either way")
     sk.add_argument("--flight", default=None, metavar="PATH",
                     help="flight-recorder NDJSON path (overrides [obs] "
                          "flight_path): crash-safe per-segment records "

@@ -93,6 +93,13 @@ class PerfConfig:
     # tier-1 parity/testing mode). Threaded onto the sim config as
     # ``cfg.fused`` — execution only, results are bitwise identical
     fused: str = "auto"
+    # quiescence-aware active-set rounds (corroquiet, docs/fused.md):
+    # "auto" = the host plane picks the quiet step for all-quiet
+    # segments; "on" pins the active-set scan body; "off" pins dense.
+    # Threaded as ``cfg.quiet`` — execution only, quiet == dense bitwise
+    quiet: str = "auto"
+    # dense-round backstop cadence while quiet; 0 = sync_interval
+    quiet_backstop_interval: int = 0
 
 
 @dataclasses.dataclass
@@ -262,6 +269,8 @@ class Config:
             bcast_max_transmissions=self.perf.bcast_max_transmissions,
             announce_interval=self.gossip.idle_rounds,
             fused=self.perf.fused,
+            quiet=self.perf.quiet,
+            quiet_backstop_interval=self.perf.quiet_backstop_interval,
         )
 
     def to_full_config(self):

@@ -48,7 +48,7 @@ FLIGHT_SCHEMA_VERSION = 1
 _STATS_SUM_KEYS = (
     "segments", "donated_segments", "carry_reuploads", "ckpt_stall_s",
     "ckpt_io_s", "ckpt_written", "ckpt_overlapped_segments",
-    "ckpt_drain_bytes", "ckpt_serialize_s",
+    "ckpt_drain_bytes", "ckpt_serialize_s", "quiet_segments",
 )
 
 
@@ -309,6 +309,7 @@ class SoakObserver:
                 async_checkpoint=bool(stats.get("async_checkpoint")),
                 fused_mode=stats.get("fused_mode"),
                 pallas_fused=bool(stats.get("pallas_fused")),
+                quiet_mode=stats.get("quiet_mode"),
                 config_digest=config_digest(cfg),
                 hbm_bytes=hbm,
             )

@@ -270,7 +270,11 @@ def _width_ok_ingest(cfg, msgs: int, emit: bool = False) -> bool:
     # planes), so it must key the cache like the swim probe's `narrow`
     key = (backend, "ingest", blk, cfg.n_origins, cfg.n_cells,
            cfg.bcast_queue, seen_w, msgs, emit,
-           bool(getattr(cfg, "narrow_dtypes", False)))
+           bool(getattr(cfg, "narrow_dtypes", False)),
+           # the q-plane int8 tier changes the probed kernel's store
+           # widths the same way (ISSUE 19); the probe below builds its
+           # CrdtState from a replace(cfg, ...) so it carries the flag
+           bool(getattr(cfg, "narrow_q_int8", False)))
     if key not in _width_ok_cache:
         nb = _probe_n(blk)
         if nb == 0 or nb >= cfg.n_nodes:

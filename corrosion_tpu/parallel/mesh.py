@@ -180,6 +180,15 @@ def sharded_run(cfg: SimConfig, mesh: Mesh, st, net, key, inputs):
 # out-ref store), and the wrappers hoist the eager fused probes
 # (``megakernel.prime_fused``) so path selection never runs a probe
 # thread from inside a traced/sharded dispatch.
+#
+# The quiet round variant (ISSUE 19) needs no wiring here: the step is
+# chosen inside ``scale_run_rounds_carry`` from ``cfg.quiet`` (a static
+# argnum), and its gating ``lax.cond`` predicate is a scalar reduction
+# over the node-sharded planes — under SPMD the reduction all-gathers
+# to a REPLICATED scalar, so every device takes the same branch and the
+# cheap round skips work on all shards at once. The same donation
+# contract holds on the pass-through branch: the cond returns the
+# donated carry buffers unchanged.
 
 
 def _prime_fused(cfg) -> None:

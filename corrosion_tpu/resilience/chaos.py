@@ -96,6 +96,7 @@ INJECTION_KINDS = (
     "corrupt_checkpoint",
     "remesh",
     "fused_flip",
+    "quiet_flip",
 )
 
 
@@ -110,6 +111,7 @@ class Injection:
     phase: int
     mesh_devices: int = 0  # remesh target (0 = single device)
     fused: str = ""  # fused_flip target execution mode
+    quiet: str = ""  # quiet_flip target round variant (ISSUE 19)
 
     def validate(self) -> "Injection":
         if self.kind not in INJECTION_KINDS:
@@ -120,6 +122,8 @@ class Injection:
             raise ValueError(f"injection phase {self.phase} < 0")
         if self.kind == "fused_flip" and not self.fused:
             raise ValueError("fused_flip needs a target fused mode")
+        if self.kind == "quiet_flip" and not self.quiet:
+            raise ValueError("quiet_flip needs a target quiet mode")
         return self
 
 
@@ -138,6 +142,7 @@ class ScenarioScript:
     keep_last: int = 64  # retention wide enough for the lineage oracle
     mesh_devices: int = 0  # initial mesh (0 = single device)
     fused: str = "auto"  # initial execution mode
+    quiet: str = "auto"  # initial round variant (ISSUE 19)
     # minimum per-info-key sums the chaos leg must report (e.g. the
     # clock-skew script must actually trip the drift gate)
     expect_info: Tuple[Tuple[str, int], ...] = ()
@@ -215,7 +220,7 @@ def scenario_config(script: ScenarioScript):
 
     return scale_sim_config(
         script.n_nodes, m_slots=8, n_origins=4, n_rows=4, n_cols=2,
-        sync_interval=4, fused=script.fused,
+        sync_interval=4, fused=script.fused, quiet=script.quiet,
     )
 
 
@@ -527,6 +532,15 @@ def _run_chaos_leg(cfg, script, traces, key0, root, rec, problems):
                 st, key, pos, _ = _resume_point(run_cfg, root, mesh)
                 rec["resumes"] += 1
                 rec["fused_flips"].append(inj.fused)
+            elif inj.kind == "quiet_flip":
+                # quiet<->dense across a resume (ISSUE 19): replace
+                # from run_cfg so the flip composes with a prior
+                # fused_flip instead of silently reverting it
+                run_cfg = dataclasses.replace(
+                    run_cfg, quiet=inj.quiet).validate()
+                st, key, pos, _ = _resume_point(run_cfg, root, mesh)
+                rec["resumes"] += 1
+                rec["quiet_flips"].append(inj.quiet)
     rec["info_sums"] = {k: info_sums[k] for k in sorted(info_sums)}
     for inj in script.injections:
         if id(inj) not in applied:
@@ -651,6 +665,7 @@ def run_scenario(script: ScenarioScript, seed: int = 0,
         "resumes": 0,
         "remeshes": 0,
         "fused_flips": [],
+        "quiet_flips": [],
         "corrupted": [],
         "corruptions_detected": 0,
         "checkpoints_validated": 0,
@@ -671,6 +686,14 @@ def run_scenario(script: ScenarioScript, seed: int = 0,
             ref_st = _apply_skew(ref_st, tr.skew, None, cfg.n_nodes)
             ref_st, ref_key, _ = runner(ref_st, ref_key, tr.net, tr.inputs)
         _, ref_leaves = _host_state(ref_st)
+        # content digest of the fixpoint: two runs of the same (script,
+        # seed) under different EXECUTION-ONLY knobs (quiet, fused) must
+        # publish the same digest — the quiet-parity probe
+        # (scripts/quiet_probe.py) compares these across round variants
+        h = hashlib.sha256()
+        for a in ref_leaves:
+            h.update(np.asarray(a).tobytes())
+        rec["state_digest"] = h.hexdigest()
 
         # chaos leg: same trace through the segmented pipeline + faults
         st, key, skip = _run_chaos_leg(
@@ -851,6 +874,25 @@ SCENARIOS = {
                 Injection(kind="fused_flip", phase=0, fused="off"),
             ),
             fused="interpret",
+        ),
+        # quiet<->dense round-variant flip across resumes (ISSUE 19):
+        # the active-set round writes the checkpoints, the dense round
+        # resumes them mid-lineage, then flips back — both directions
+        # in one lineage, bitwise per config_identity (quiet is
+        # execution-only). The tail phase is write-free so the flipped-
+        # back leg actually exercises the cheap fixpoint path
+        ScenarioScript(
+            name="quiet-flip",
+            phases=(
+                FaultPhase(rounds=8, write_frac=0.3),
+                FaultPhase(rounds=8, write_frac=0.1),
+                FaultPhase(rounds=8),
+            ),
+            injections=(
+                Injection(kind="quiet_flip", phase=0, quiet="off"),
+                Injection(kind="quiet_flip", phase=1, quiet="on"),
+            ),
+            quiet="on",
         ),
         # --- composed multi-fault scenarios (ISSUE 18): the ROADMAP's
         # "multi-fault compositions" rungs, promoted from the fuzzer's

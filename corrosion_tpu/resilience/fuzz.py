@@ -130,6 +130,10 @@ def probe_script(n_nodes: int = 24) -> ScenarioScript:
 #: fused==unfused parity contract, both CPU-runnable (docs/fused.md)
 _FUSED_FLIPS = (("interpret", "off"), ("off", "interpret"))
 
+#: quiet_flip transition: start round variant -> flip target (ISSUE
+#: 19). Both directions of the quiet==dense bitwise contract
+_QUIET_FLIPS = (("on", "off"), ("off", "on"))
+
 #: remesh chains: (initial mesh, boundary target) — descending, per
 #: the elastic-restore surface (docs/elastic.md); 8 devices is the
 #: tier-1 host rig (tests/conftest.py forces 8 host devices)
@@ -192,7 +196,12 @@ def gen_script(seed: int, profile: str = "fast") -> ScenarioScript:
     recoverable = [i for i in range(len(phases)) if segs_through[i] >= 2]
 
     crash_phases = set()
-    for kind in rng.sample(chaos.INJECTION_KINDS,
+    # quiet_flip joins via its own tail draw below: sampling it here
+    # would reshuffle every pre-quiet seed's rng stream and invalidate
+    # the corpus
+    legacy_kinds = tuple(
+        k for k in chaos.INJECTION_KINDS if k != "quiet_flip")
+    for kind in rng.sample(legacy_kinds,
                            k=rng.choice((0, 1, 1, 2))):
         if kind in ("crash_slice", "crash_manifest"):
             open_phases = [p for p in recoverable if p not in crash_phases]
@@ -219,6 +228,18 @@ def gen_script(seed: int, profile: str = "fast") -> ScenarioScript:
             injections.append(Injection(
                 kind=kind, phase=rng.randrange(len(phases) - 1),
                 fused=target))
+    # the quiet axis (ISSUE 19), drawn at the END of the rng stream so
+    # every pre-quiet seed still generates its exact historical script:
+    # either a quiet_flip lineage (both directions) or a static
+    # non-default round variant for the whole scenario
+    quiet = "auto"
+    if rng.random() < 0.25:
+        quiet, target = rng.choice(_QUIET_FLIPS)
+        injections.append(Injection(
+            kind="quiet_flip", phase=rng.randrange(len(phases) - 1),
+            quiet=target))
+    elif rng.random() < 0.25:
+        quiet = rng.choice(("on", "off"))
     injections.sort(key=lambda i: (i.phase, i.kind))
 
     return ScenarioScript(
@@ -229,6 +250,7 @@ def gen_script(seed: int, profile: str = "fast") -> ScenarioScript:
         segment_rounds=segment_rounds,
         mesh_devices=mesh_devices,
         fused=fused,
+        quiet=quiet,
     ).validate()
 
 
@@ -378,6 +400,8 @@ def _shrink_candidates(script: ScenarioScript):
         yield dataclasses.replace(script, mesh_devices=0)
     if script.fused != "auto" and "fused_flip" not in kinds:
         yield dataclasses.replace(script, fused="auto")
+    if script.quiet != "auto" and "quiet_flip" not in kinds:
+        yield dataclasses.replace(script, quiet="auto")
 
 
 def shrink(script: ScenarioScript, seed: int,

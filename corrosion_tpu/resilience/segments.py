@@ -25,6 +25,7 @@ with the last committed checkpoint as the recovery point.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import time
 from typing import NamedTuple, Optional
 
@@ -118,6 +119,13 @@ def _pipeline_stats(donate: bool, async_checkpoint: bool,
         "fused_mode": fused.get("mode", "auto"),
         "pallas_fused": fused_engaged(fused),
         "fused_interpret": bool(fused.get("interpret")),
+        # corroquiet (ISSUE 19): which quiet knob the run carries, and
+        # how many segments the host fast path dispatched on the
+        # active-set program (quiet="auto" resolution; a pinned
+        # quiet="on" run dispatches every segment quiet but counts 0
+        # here — the counter is the AUTO resolver's decision record)
+        "quiet_mode": "off",
+        "quiet_segments": 0,
         "segments": 0,
         "donated_segments": 0,
         "carry_reuploads": 0,
@@ -201,10 +209,47 @@ def _slice_inputs(inputs, lo: int, hi: int):
 def _concat_infos(parts: list) -> dict:
     if not parts:
         return {}
+    # segments dispatched on different execution paths can emit
+    # different info-key sets (the quiet step adds ``quiet_*`` keys a
+    # dense segment doesn't compute) — union the keys and zero-fill the
+    # segments that lack one (a dense segment cheap-pathed 0 rounds)
+    keys: dict = {}
+    for p in parts:
+        for k in p:
+            keys.setdefault(k, np.asarray(p[k]).dtype)
+
+    def col(p: dict, k: str, dt):
+        if k in p:
+            return np.asarray(p[k])
+        n = len(np.asarray(next(iter(p.values()))))
+        return np.zeros(n, dt)
+
     return {
-        k: np.concatenate([np.asarray(p[k]) for p in parts])
-        for k in parts[0]
+        k: np.concatenate([col(p, k, dt) for p in parts])
+        for k, dt in keys.items()
     }
+
+
+def _inputs_quiet(seg) -> bool:
+    """Host-side occupancy check of one segment's stacked inputs: True
+    when the slice injects no kills/revives/writes/transactions (the
+    input half of the corroquiet predicate, decided per segment)."""
+    return not any(
+        bool(np.any(np.asarray(getattr(seg, f))))
+        for f in ("kill", "revive", "write_mask", "tx_mask")
+        if hasattr(seg, f)
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _quiet_carry_probe(cfg):
+    """One tiny jitted reduce per config: is the carry provably quiet
+    (no alive node owes work — ``scale_step._quiet_busy``)? Deliberately
+    NOT routed through ``_jit``: the trace-stability harness counts
+    segment dispatches through that seam, and this probe is not one."""
+    from corrosion_tpu.sim.scale_step import _quiet_busy
+
+    return jax.jit(lambda st: ~jnp.any(_quiet_busy(cfg, st)))
 
 
 def run_segmented(
@@ -279,15 +324,30 @@ def run_segmented(
     from corrosion_tpu.ops import megakernel
 
     fused_decisions = megakernel.prime_fused(cfg)
-    # one jitted program per distinct (segment length, donation) pair —
-    # at most K and the final partial segment, donated and not
+    # corroquiet host fast path: under quiet="auto", an ALL-QUIET
+    # segment (no input events over the slice + carry provably quiet at
+    # the boundary) dispatches the active-set program
+    # (``scale_sim_step_quiet`` scan body — bitwise == dense, every
+    # in-segment round short-circuits to the fixpoint branch except the
+    # backstop cadence); any doubt dispatches the historical dense
+    # program, so existing traces see the exact same programs as before
+    quiet_auto = (mode == "scale"
+                  and getattr(cfg, "quiet", None) == "auto"
+                  and getattr(cfg, "sync_cohort", False))
+    quiet_cfg = (dataclasses.replace(cfg, quiet="on").validate()
+                 if quiet_auto else cfg)
+    # one jitted program per distinct (segment length, donation,
+    # quiet-resolution) tuple — at most K and the final partial segment,
+    # donated and not, quiet and dense
     jitted: dict = {}
 
-    def dispatch(st, key, seg_inputs, donate_now: bool):
-        n = (_n_rounds(seg_inputs), donate_now)
+    def dispatch(st, key, seg_inputs, donate_now: bool,
+                 quiet_now: bool = False):
+        n = (_n_rounds(seg_inputs), donate_now, quiet_now)
+        seg_cfg = quiet_cfg if quiet_now else cfg
         if n not in jitted:
             jitted[n] = _jit(
-                lambda s, k, i: run_carry(cfg, s, net, k, i),
+                lambda s, k, i: run_carry(seg_cfg, s, net, k, i),
                 donate_argnums=((0, 1) if donate_now else ()),
             )
         (st2, key2), infos = jitted[n](st, key, seg_inputs)
@@ -299,6 +359,7 @@ def run_segmented(
     seg_box = {"index": 0}  # read by the async writer's overlap probe
     use_writer = bool(checkpoint_root and async_checkpoint)
     stats = _pipeline_stats(donate, use_writer, fused=fused_decisions)
+    stats["quiet_mode"] = str(getattr(cfg, "quiet", "off") or "off")
     from corrosion_tpu.obs.spans import pipeline_span
 
     jax_prof = bool(obs is not None and getattr(obs, "jax_profile", False))
@@ -334,6 +395,15 @@ def run_segmented(
                 and seg_box["index"] > 0
                 and (supervisor is None or host_carry is not None)
             )
+            # quiet resolution: the cheap input check first, the carry
+            # probe (one scalar D2H) only when the inputs already passed
+            quiet_now = (
+                quiet_auto
+                and _inputs_quiet(seg)
+                and bool(_quiet_carry_probe(cfg)(st))
+            )
+            if quiet_now:
+                stats["quiet_segments"] += 1
 
             def seg_dispatch():
                 nonlocal st, key
@@ -351,7 +421,7 @@ def run_segmented(
                         "snapshot for retry at round %d",
                         start_round + completed,
                     )
-                return dispatch(st, key, seg, donate_now)
+                return dispatch(st, key, seg, donate_now, quiet_now)
 
             try:
                 with pipeline_span(

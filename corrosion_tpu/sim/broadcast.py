@@ -143,6 +143,9 @@ class CrdtState(NamedTuple):
         # the scale round-step re-narrows on carry-out
         ndt = (jnp.int16 if getattr(cfg, "narrow_dtypes", False)
                else jnp.int32)
+        # the q counter planes' own tier (ISSUE 19): int8 under
+        # narrow_q_int8, else the narrow int16 default
+        qdt = (jnp.int8 if getattr(cfg, "narrow_q_int8", False) else ndt)
         return CrdtState(
             store=(z(n, c), z(n, c), z(n, c), z(n, c), z(n, c)),
             book=Book.create(n, cfg.n_origins, cfg.buf_slots),
@@ -154,10 +157,10 @@ class CrdtState(NamedTuple):
             q_val=z(n, q),
             q_site=z(n, q),
             q_clp=z(n, q),
-            q_seq=jnp.zeros((n, q), ndt),
-            q_nseq=jnp.ones((n, q), ndt),
+            q_seq=jnp.zeros((n, q), qdt),
+            q_nseq=jnp.ones((n, q), qdt),
             q_ts=z(n, q),
-            q_tx=jnp.zeros((n, q), ndt),
+            q_tx=jnp.zeros((n, q), qdt),
             hlc=z(n),
             now=jnp.int32(0),
             partials=Partials.create(

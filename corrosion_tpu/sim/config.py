@@ -23,6 +23,13 @@ import math
 #: so the CLI parser can use it without pulling in jax)
 FUSED_MODES = ("auto", "on", "off", "interpret")
 
+#: legal values of the ``quiet`` execution knob (corroquiet active-set
+#: rounds, docs/fused.md): "auto" lets the host plane
+#: (resilience/segments) pick the quiet step for all-quiet segments,
+#: "on" pins the active-set scan body, "off" pins the dense step.
+#: Same import-light contract as FUSED_MODES (the CLI parser uses it).
+QUIET_MODES = ("auto", "on", "off")
+
 
 @dataclasses.dataclass(frozen=True)
 class SimConfig:

@@ -378,8 +378,13 @@ class OracleCluster:
 
 def run_sim_script(script: WorkloadScript, seed: int = 0,
                    settle_rounds: int = 512, drop_prob: float = 0.0,
-                   sync_interval: int = 4):
+                   sync_interval: int = 4, quiet: str = "auto"):
     """Run the scale sim under the same script until converged.
+
+    ``quiet`` selects the round variant (ISSUE 19): "on" routes every
+    round through ``scale_sim_step_quiet`` — the battery runs the same
+    script under "on" and "off" and requires identical planes/alive/
+    rounds-taken (the masked==dense oracle at harness level).
 
     Returns (store planes [N, n_cells] x4, alive mask, rounds-taken or -1).
     """
@@ -393,6 +398,7 @@ def run_sim_script(script: WorkloadScript, seed: int = 0,
         scale_crdt_metrics,
         scale_sim_config,
         scale_sim_step,
+        scale_sim_step_quiet,
     )
     from corrosion_tpu.sim.transport import NetModel
 
@@ -401,7 +407,7 @@ def run_sim_script(script: WorkloadScript, seed: int = 0,
     cfg = scale_sim_config(
         script.n_nodes, n_origins=script.n_origins,
         n_rows=n_rows, n_cols=(script.n_cells + n_rows - 1) // n_rows,
-        sync_interval=sync_interval, tx_max_cells=tx_k,
+        sync_interval=sync_interval, tx_max_cells=tx_k, quiet=quiet,
     )
     # the configured grid must cover the script's cell space
     if cfg.n_cells < script.n_cells:
@@ -411,7 +417,8 @@ def run_sim_script(script: WorkloadScript, seed: int = 0,
         )
     st = ScaleSimState.create(cfg)
     net = NetModel.create(script.n_nodes, drop_prob=drop_prob)
-    step = jax.jit(lambda s, nt, k, i: scale_sim_step(cfg, s, nt, k, i))
+    step_fn = scale_sim_step_quiet if cfg.quiet == "on" else scale_sim_step
+    step = jax.jit(lambda s, nt, k, i: step_fn(cfg, s, nt, k, i))
     key = jr.key(seed)
     quiet = ScaleRoundInput.quiet(cfg)
 

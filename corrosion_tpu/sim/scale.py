@@ -447,15 +447,48 @@ def swim_tables_update(
     return mem_id, mem_view, timer, mem_tx, inc, refute
 
 
-def scale_swim_step(
+class _SwimFront(NamedTuple):
+    """First half of the SWIM round: everything up to (and excluding) the
+    cross-node row gathers — churn + self refresh, probe/announce legs,
+    per-receiver elections, the delivered-packet channel list with sender
+    incarnations, and the delivered-packet counts.
+
+    ``scale_swim_step`` always runs front + back (pure code motion, bitwise
+    the historical single-function round). The quiet round variant
+    (``sim/scale_step.scale_sim_step_quiet``) runs the front
+    unconditionally — its outputs decide whether this round's traffic could
+    change any membership table (:func:`swim_front_disturbed`) — and gates
+    the expensive back half behind one ``lax.cond``."""
+
+    alive: jax.Array        # bool  [N] post-churn liveness
+    inc: jax.Array          # int32 [N] post-churn incarnations
+    mem_id: jax.Array       # int32 [N, M] self-refreshed member ids
+    mem_view: jax.Array     # int32 [N, M] self-refreshed member views
+    self_slot: jax.Array    # int32 [N] own hash slot (i mod M)
+    sus_heard: jax.Array    # int32 [N] probe-notify suspicion only (the
+                            # announce-reply down-notice lands in the back)
+    sends: jax.Array        # int32 [N] attempted membership transmissions
+    probe_slot: jax.Array   # int32 [N] probed table slot
+    suspect_key: jax.Array  # int32 [N] suspect mark for a failed probe
+    failed: jax.Array       # bool  [N] failed probes
+    acked: jax.Array        # bool  [N] acked probes
+    ann_tgt: jax.Array      # int32 [N] announce target
+    ann_back: jax.Array     # bool  [N] announce reply delivered
+    channels: tuple         # 4 x (sender, valid) delivered-packet pairs
+    ch_snd_inc: tuple       # 4 x int32 [N] sender incarnations (off cards)
+    carried: jax.Array      # int32 [N] delivered packets per sender
+    k_upd: jax.Array        # PRNG key for the bounded-piggyback selection
+
+
+def _swim_front(
     cfg: ScaleConfig,
     st: ScaleSwimState,
     net: NetModel,
     key: jax.Array,
     kill=None,
     revive=None,
-):
-    """One SWIM probe period for the whole cluster, O(N*M) work."""
+) -> _SwimFront:
+    """Front half of the SWIM probe period (see :class:`_SwimFront`)."""
     n, m = cfg.n_nodes, cfg.m_slots
     iarr = jnp.arange(n, dtype=jnp.int32)
     (k_tgt, k_p1, k_p2, k_help, k_ind, k_ann, k_annt, k_ann1, k_ann2,
@@ -558,28 +591,12 @@ def scale_swim_step(
     ann_out = announcing & datagram_ok_c(net, k_ann1, card, ann_card)
     ann_back = ann_out & datagram_ok_c(net, k_ann2, ann_card, card)
 
-    # down-notice: the announce receiver's (possibly stale) belief about
-    # the announcer rides the reply; a non-alive belief at >= our
-    # incarnation triggers refutation below
-    # peer's view row = fast row gather; the self column picks densely
-    peer_view_rows = jax.lax.optimization_barrier(old_view[ann_tgt])
-    peer_id_rows = jax.lax.optimization_barrier(old_id[ann_tgt])
-    bel = select_cols(peer_view_rows, self_slot[:, None])[:, 0]
-    bel_is_me = select_cols(peer_id_rows, self_slot[:, None])[:, 0] == iarr
-    notice = jnp.where(ann_back & bel_is_me, bel, -1)
-    sus_heard = jnp.maximum(sus_heard, notice)
-
     # --- choose one prober / announcer per receiver ----------------------
     prober_of, has_prober = _one_sender_per_receiver(n, leg_out, tgt, k_cp)
     announcer_of, has_announcer = _one_sender_per_receiver(
         n, ann_out, ann_tgt, k_ca
     )
 
-    # --- row-local back half: merges, assertions, timers, refutation ----
-    # sender rows gathered here (barriered — see PERF.md on fused-gather
-    # scalarization); the table transforms run either as plain XLA or as
-    # one pallas kernel per node block (ops/megakernel.py)
-    sendable = st.mem_tx > 0
     sends = (
         has_tgt.astype(jnp.int32)  # probe we sent
         + announcing.astype(jnp.int32)  # announce we sent
@@ -605,54 +622,7 @@ def scale_swim_step(
         card_at(card, channels[2][0]),
         ann_card,
     ]
-    ch_in_id, ch_in_view, ch_in_send, ch_valid, ch_snd, ch_snd_inc = (
-        [], [], [], [], [], [],
-    )
-    pig_k = int(getattr(cfg, "pig_members", 0) or 0)
-    mem_tx_in = st.mem_tx
-    if pig_k > 0:
-        # bounded packets: every packet a node sends this round carries
-        # its pig_k freshest sendable entries (highest remaining budget
-        # first, random tiebreak — foca flushes its least-sent updates
-        # first); one [N, 2k] gather per channel replaces three [N, M]
-        # row gathers
-        occ_sendable = sendable & (old_id >= 0)
-        upd_slots, upd_ok = sample_k_biased(
-            occ_sendable, st.mem_tx.astype(jnp.float32), pig_k, k_upd
-        )
-        upd_id = jnp.where(
-            upd_ok, select_cols(old_id, upd_slots), jnp.int32(FREE)
-        )
-        upd_view = select_cols(old_view, upd_slots)
-        pig_pack = jnp.concatenate([upd_id, upd_view], axis=1)  # [N, 2k]
-        ones_k = jnp.ones((n, pig_k), bool)
-        for (src, valid), s_card in zip(channels, ch_cards):
-            got = jax.lax.optimization_barrier(pig_pack[src])
-            ch_in_id.append(got[:, :pig_k])
-            ch_in_view.append(got[:, pig_k:])
-            ch_in_send.append(ones_k)  # selection already applied it
-            ch_valid.append(valid)
-            ch_snd.append(src)
-            ch_snd_inc.append(s_card[:, CARD_INC])
-        # transmit-budget decrement for the SELECTED entries only (the
-        # table-update function skips its full-row decrement in this
-        # mode); refill-on-change still happens inside it
-        # accumulate in the plane's own dtype: the fused swim kernel is
-        # probed at the plane dtypes, so a promotion here would lower a
-        # DIFFERENT (unprobed) kernel under narrow_dtypes
-        dec = scatter_cols_add(
-            jnp.zeros((n, m), st.mem_tx.dtype), upd_slots,
-            jnp.broadcast_to(sends[:, None], upd_slots.shape), upd_ok,
-        )
-        mem_tx_in = jnp.maximum(st.mem_tx - dec, 0)
-    else:
-        for (src, valid), s_card in zip(channels, ch_cards):
-            ch_in_id.append(jax.lax.optimization_barrier(old_id[src]))
-            ch_in_view.append(jax.lax.optimization_barrier(old_view[src]))
-            ch_in_send.append(jax.lax.optimization_barrier(sendable[src]))
-            ch_valid.append(valid)
-            ch_snd.append(src)
-            ch_snd_inc.append(s_card[:, CARD_INC])
+    ch_snd_inc = tuple(c[:, CARD_INC] for c in ch_cards)
 
     # delivered-packet count per sender — the piggyback layer's budget
     # multiplicity. It must be delivery-coupled (a changeset's budget
@@ -682,14 +652,100 @@ def scale_swim_step(
         + ack_count
         + reply_count
     )
+    return _SwimFront(
+        alive=alive, inc=inc, mem_id=mem_id, mem_view=mem_view,
+        self_slot=self_slot, sus_heard=sus_heard, sends=sends,
+        probe_slot=probe_slot, suspect_key=suspect_key, failed=failed,
+        acked=acked, ann_tgt=ann_tgt, ann_back=ann_back,
+        channels=tuple(channels), ch_snd_inc=ch_snd_inc,
+        carried=carried, k_upd=k_upd,
+    )
+
+
+def _swim_back(cfg: ScaleConfig, st: ScaleSwimState, front: _SwimFront):
+    """Back half of the SWIM probe period: the cross-node row gathers
+    (down-notice, piggybacked member entries) plus the row-local table
+    transforms (``swim_tables_update`` / the fused kernel). Pure code
+    motion out of the historical ``scale_swim_step`` body — running
+    front + back is bit-for-bit the original round."""
+    n, m = cfg.n_nodes, cfg.m_slots
+    iarr = jnp.arange(n, dtype=jnp.int32)
+    old_id, old_view = st.mem_id, st.mem_view
+
+    # down-notice: the announce receiver's (possibly stale) belief about
+    # the announcer rides the reply; a non-alive belief at >= our
+    # incarnation triggers refutation inside the table update
+    # peer's view row = fast row gather; the self column picks densely
+    peer_view_rows = jax.lax.optimization_barrier(old_view[front.ann_tgt])
+    peer_id_rows = jax.lax.optimization_barrier(old_id[front.ann_tgt])
+    bel = select_cols(peer_view_rows, front.self_slot[:, None])[:, 0]
+    bel_is_me = (
+        select_cols(peer_id_rows, front.self_slot[:, None])[:, 0] == iarr
+    )
+    notice = jnp.where(front.ann_back & bel_is_me, bel, -1)
+    sus_heard = jnp.maximum(front.sus_heard, notice)
+
+    # --- row-local back half: merges, assertions, timers, refutation ----
+    # sender rows gathered here (barriered — see PERF.md on fused-gather
+    # scalarization); the table transforms run either as plain XLA or as
+    # one pallas kernel per node block (ops/megakernel.py)
+    sendable = st.mem_tx > 0
+    sends = front.sends
+    channels = list(front.channels)
+    ch_in_id, ch_in_view, ch_in_send, ch_valid, ch_snd = [], [], [], [], []
+    ch_snd_inc = list(front.ch_snd_inc)
+    pig_k = int(getattr(cfg, "pig_members", 0) or 0)
+    mem_tx_in = st.mem_tx
+    if pig_k > 0:
+        # bounded packets: every packet a node sends this round carries
+        # its pig_k freshest sendable entries (highest remaining budget
+        # first, random tiebreak — foca flushes its least-sent updates
+        # first); one [N, 2k] gather per channel replaces three [N, M]
+        # row gathers
+        occ_sendable = sendable & (old_id >= 0)
+        upd_slots, upd_ok = sample_k_biased(
+            occ_sendable, st.mem_tx.astype(jnp.float32), pig_k, front.k_upd
+        )
+        upd_id = jnp.where(
+            upd_ok, select_cols(old_id, upd_slots), jnp.int32(FREE)
+        )
+        upd_view = select_cols(old_view, upd_slots)
+        pig_pack = jnp.concatenate([upd_id, upd_view], axis=1)  # [N, 2k]
+        ones_k = jnp.ones((n, pig_k), bool)
+        for src, valid in channels:
+            got = jax.lax.optimization_barrier(pig_pack[src])
+            ch_in_id.append(got[:, :pig_k])
+            ch_in_view.append(got[:, pig_k:])
+            ch_in_send.append(ones_k)  # selection already applied it
+            ch_valid.append(valid)
+            ch_snd.append(src)
+        # transmit-budget decrement for the SELECTED entries only (the
+        # table-update function skips its full-row decrement in this
+        # mode); refill-on-change still happens inside it
+        # accumulate in the plane's own dtype: the fused swim kernel is
+        # probed at the plane dtypes, so a promotion here would lower a
+        # DIFFERENT (unprobed) kernel under narrow_dtypes
+        dec = scatter_cols_add(
+            jnp.zeros((n, m), st.mem_tx.dtype), upd_slots,
+            jnp.broadcast_to(sends[:, None], upd_slots.shape), upd_ok,
+        )
+        mem_tx_in = jnp.maximum(st.mem_tx - dec, 0)
+    else:
+        for src, valid in channels:
+            ch_in_id.append(jax.lax.optimization_barrier(old_id[src]))
+            ch_in_view.append(jax.lax.optimization_barrier(old_view[src]))
+            ch_in_send.append(jax.lax.optimization_barrier(sendable[src]))
+            ch_valid.append(valid)
+            ch_snd.append(src)
+
     consts = (
         m, int(cfg.suspicion_rounds), int(cfg.down_purge_rounds),
         int(cfg.max_transmissions), pig_k,
     )
     args = (
-        mem_id, mem_view, old_id, old_view, st.mem_timer, mem_tx_in,
-        alive, inc, iarr, self_slot, sus_heard, sends,
-        probe_slot, suspect_key, failed,
+        front.mem_id, front.mem_view, old_id, old_view, st.mem_timer,
+        mem_tx_in, front.alive, front.inc, iarr, front.self_slot,
+        sus_heard, sends, front.probe_slot, front.suspect_key, front.failed,
         ch_in_id, ch_in_view, ch_in_send, ch_valid, ch_snd, ch_snd_inc,
     )
     from corrosion_tpu.ops import megakernel
@@ -710,18 +766,64 @@ def scale_swim_step(
             consts, *args
         )
 
-    st2 = ScaleSwimState(alive, inc, mem_id, mem_view, timer, mem_tx)
+    st2 = ScaleSwimState(
+        front.alive, front.inc, mem_id, mem_view, timer, mem_tx
+    )
     info = {
-        "acked": jnp.sum(acked),
-        "failed_probes": jnp.sum(failed),
+        "acked": jnp.sum(front.acked),
+        "failed_probes": jnp.sum(front.failed),
         "refutes": jnp.sum(refute),
     }
-    # channels: the four delivered-packet (sender, valid) pairs built
-    # above — higher layers piggyback changesets on exactly these
+    return st2, info
+
+
+def swim_front_disturbed(cfg: ScaleConfig, front: _SwimFront):
+    """Would this round's delivered SWIM traffic change any membership
+    table? Scalar bool, computed from the front half alone.
+
+    Re-checks the back half's only input-driven mutation surfaces against
+    the front's (self-refreshed) planes: a failed probe plants a suspect
+    mark (``swim_tables_update`` suspect scatter), and a delivered
+    packet's sender-alive assertion inserts the sender into a free hash
+    slot or raises a stale incarnation (the two assertion scatters). The
+    merge sections need no term here: their masks require a sendable
+    (mem_tx > 0) entry at an alive sender, which the quiet predicate's
+    carry-occupancy bits (``scale_step._quiet_busy``) already exclude.
+
+    False ⇒ — given the carry-occupancy and input-quiet predicates of
+    ``scale_sim_step_quiet`` — the back half is a bitwise no-op on every
+    plane; any True sends the round down the dense branch."""
+    m = cfg.m_slots
+    disturbed = jnp.any(front.failed)
+    for (src, valid), s_inc in zip(front.channels, front.ch_snd_inc):
+        s_key = pack_inc_state(s_inc, jnp.int32(STATE_ALIVE))
+        slot = (src % m)[:, None]
+        cur_id = lookup_cols(front.mem_id, slot)[:, 0]
+        cur_view = lookup_cols(front.mem_view, slot, fill=-1)[:, 0]
+        would = valid & (
+            (cur_id < 0) | ((cur_id == src) & (s_key > cur_view))
+        )
+        disturbed = disturbed | jnp.any(would)
+    return disturbed
+
+
+def scale_swim_step(
+    cfg: ScaleConfig,
+    st: ScaleSwimState,
+    net: NetModel,
+    key: jax.Array,
+    kill=None,
+    revive=None,
+):
+    """One SWIM probe period for the whole cluster, O(N*M) work."""
+    front = _swim_front(cfg, st, net, key, kill=kill, revive=revive)
+    st2, info = _swim_back(cfg, st, front)
+    # channels: the four delivered-packet (sender, valid) pairs built by
+    # the front — higher layers piggyback changesets on exactly these
     # packets; ``carried`` is each sender's delivered-packet count, the
     # piggyback layer's budget multiplicity (one transmission per
     # delivered packet, like the reference's max_transmissions counter).
-    return st2, info, channels, carried
+    return st2, info, list(front.channels), front.carried
 
 
 def scale_swim_metrics(st: ScaleSwimState):

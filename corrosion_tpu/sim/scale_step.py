@@ -52,9 +52,12 @@ from corrosion_tpu.sim.broadcast import (
 )
 from corrosion_tpu.sim.scale import (
     ScaleSwimState,
+    _swim_back,
+    _swim_front,
     scale_config,
     scale_swim_metrics,
     scale_swim_step,
+    swim_front_disturbed,
 )
 from corrosion_tpu.sim.transport import (
     NetModel,
@@ -154,6 +157,12 @@ class ScaleSimConfig:
     # ScaleConfig.narrow_int8 and docs/memory-budget.md). Default OFF
     # pending a real-TPU width probe; BENCH_NARROW8=1 measures it
     narrow_int8: bool = False
+    # int8 tier for the broadcast queue's counter planes q_tx/q_seq/
+    # q_nseq (ISSUE 19): q_tx is bounded by bcast_max_transmissions and
+    # q_seq/q_nseq by tx_max_cells, all tiny. q_cell stays int16 (cell
+    # ids range over the grid) and last_sync stays int16 (cap 4095).
+    # Default OFF like narrow_int8, pending a real-TPU width probe
+    narrow_q_int8: bool = False
     # --- fused megakernel path (ops/megakernel.py, docs/fused.md) --------
     # the production execution knob, fed from ``config.perf.fused``:
     #   "auto"      — pallas kernels on non-CPU backends when the eager
@@ -168,6 +177,33 @@ class ScaleSimConfig:
     # written under one mode resume under another
     # (checkpoint.config_identity excludes this key).
     fused: str = "auto"
+    # --- quiescence-aware active-set rounds (docs/fused.md, PERF.md) -----
+    # corroquiet execution knob, fed from ``config.perf.quiet``:
+    #   "auto" — host-resolved: resilience/segments picks the quiet step
+    #            per segment when the segment's inputs are all-quiet (the
+    #            device step itself runs dense under "auto", so direct
+    #            callers see the historical program);
+    #   "on"   — the scan body is ``scale_sim_step_quiet``: rounds whose
+    #            carry + inputs are provably quiescent take a fixpoint
+    #            branch that skips the SWIM back half, the piggyback
+    #            layer and the sync phase (bitwise == the dense round);
+    #   "off"  — always the dense step.
+    # Execution only: quiet == dense bit for bit, so checkpoints written
+    # under one mode resume under another (checkpoint.config_identity
+    # excludes all three quiet keys).
+    quiet: str = "auto"
+    # dense-round backstop while quiet: rounds where (now % interval)==0
+    # never take the fixpoint branch, so anti-entropy and the probe layer
+    # still sweep every node. 0 = sync_interval (the sync-cohort rounds
+    # already forced dense by the schedule predicate).
+    quiet_backstop_interval: int = 0
+    # observability granularity of the per-shard occupancy series
+    # (``corro.quiet.shards.*``): the node axis folds into this many
+    # groups for reporting. Execution unaffected — the fixpoint gate is
+    # one cluster-wide scalar (a jit-sharded program replicates scalar
+    # branch predicates, so per-group divergence cannot exist in one
+    # SPMD program; see parallel/mesh.py).
+    quiet_shards: int = 1
 
     @property
     def n_cells(self) -> int:
@@ -222,12 +258,47 @@ class ScaleSimConfig:
                 "narrow_int8 stores mem_tx as int8; max_transmissions "
                 f"{self.max_transmissions} exceeds int8 range"
             )
-        from corrosion_tpu.sim.config import FUSED_MODES
+        if self.narrow_q_int8:
+            if not self.narrow_dtypes:
+                raise ValueError(
+                    "narrow_q_int8 is a tier of narrow_dtypes; "
+                    "enable both"
+                )
+            if max(self.bcast_max_transmissions,
+                   self.tx_max_cells) >= (1 << 7):
+                raise ValueError(
+                    "narrow_q_int8 stores q_tx/q_seq/q_nseq as int8; "
+                    f"bcast_max_transmissions "
+                    f"{self.bcast_max_transmissions} or tx_max_cells "
+                    f"{self.tx_max_cells} exceeds int8 range"
+                )
+        from corrosion_tpu.sim.config import FUSED_MODES, QUIET_MODES
 
         if self.fused not in FUSED_MODES:
             raise ValueError(
                 f"fused {self.fused!r} not one of {FUSED_MODES} "
                 f"(docs/fused.md)"
+            )
+        if self.quiet not in QUIET_MODES:
+            raise ValueError(
+                f"quiet {self.quiet!r} not one of {QUIET_MODES} "
+                f"(docs/fused.md)"
+            )
+        if self.quiet == "on" and not self.sync_cohort:
+            raise ValueError(
+                "quiet='on' requires sync_cohort: without the cohort "
+                "schedule the sync phase runs (and ages scoring state) "
+                "every round, so no round is ever a fixpoint"
+            )
+        if self.quiet_backstop_interval < 0:
+            raise ValueError(
+                f"quiet_backstop_interval {self.quiet_backstop_interval} "
+                f"must be >= 0 (0 = sync_interval)"
+            )
+        if self.quiet_shards < 1 or self.n_nodes % self.quiet_shards:
+            raise ValueError(
+                f"quiet_shards {self.quiet_shards} must be >= 1 and "
+                f"divide n_nodes ({self.n_nodes})"
             )
         return self
 
@@ -240,6 +311,12 @@ class ScaleSimConfig:
     def tx_dtype(self):
         """HBM dtype of ``mem_tx`` (see ``ScaleConfig.tx_dtype``)."""
         return jnp.int8 if self.narrow_int8 else self.timer_dtype
+
+    @property
+    def q_dtype(self):
+        """HBM dtype of the q_tx/q_seq/q_nseq counter planes (ISSUE 19
+        int8 tier; mirrored by ``analysis/shapes.py::ConfigVal``)."""
+        return jnp.int8 if self.narrow_q_int8 else self.timer_dtype
 
 
 def scale_sim_config(n_nodes: int, **overrides) -> ScaleSimConfig:
@@ -461,21 +538,15 @@ def piggyback_bcast_step(cfg: ScaleSimConfig, cst: CrdtState, channels, key,
     )
 
 
-def scale_sim_step(
-    cfg: ScaleSimConfig,
-    st: ScaleSimState,
-    net: NetModel,
-    key,
-    inp: ScaleRoundInput,
-):
-    """One full protocol round at scale. Returns (state, info)."""
+def _post_swim(cfg, st, net, swim, swim_info, channels, carried,
+               k_pig, k_sp, k_sync, inp):
+    """CRDT half of the round — everything after the SWIM step: local
+    writes, piggyback broadcast, staleness aging and the sync phase.
+    Shared verbatim by the dense step and the quiet step's active branch
+    (pure code motion out of the historical ``scale_sim_step`` body)."""
     from corrosion_tpu.sim.sync import choose_sync_peers, sync_step
 
     n, m = cfg.n_nodes, cfg.m_slots
-    k_swim, k_pig, k_sp, k_sync = jr.split(key, 4)
-    swim, swim_info, channels, carried = scale_swim_step(
-        cfg, st.swim, net, k_swim, kill=inp.kill, revive=inp.revive
-    )
 
     # tick the round counter — the HLC's physical time axis
     cst = st.crdt._replace(now=st.crdt.now + 1)
@@ -603,6 +674,165 @@ def scale_sim_step(
     return st_out, info
 
 
+def scale_sim_step(
+    cfg: ScaleSimConfig,
+    st: ScaleSimState,
+    net: NetModel,
+    key,
+    inp: ScaleRoundInput,
+):
+    """One full protocol round at scale. Returns (state, info)."""
+    k_swim, k_pig, k_sp, k_sync = jr.split(key, 4)
+    swim, swim_info, channels, carried = scale_swim_step(
+        cfg, st.swim, net, k_swim, kill=inp.kill, revive=inp.revive
+    )
+    return _post_swim(cfg, st, net, swim, swim_info, channels, carried,
+                      k_pig, k_sp, k_sync, inp)
+
+
+def _quiet_busy(cfg: ScaleSimConfig, st: ScaleSimState):
+    """bool [N]: alive nodes that still owe the cluster work — the
+    carry-occupancy half of the quiet-round predicate.
+
+    Strictly stronger than ``activity_masks`` on alive rows, by design:
+
+    - membership pendings count REGARDLESS of timer residue (the masks'
+      ``probes`` bit requires a running timer, but a Suspect/Down entry
+      with a stalled timer still mutates state the next time news about
+      it arrives, and a Down entry keeps purge eligibility);
+    - a nonzero membership transmission budget (``mem_tx``) counts: a
+      sendable entry would be piggybacked, decrementing budgets and
+      merging into receiver tables.
+
+    Dead rows are EXCLUDED on purpose — their queue/partials/table
+    residue is provably inert (every mutating path in the round is
+    gated on the row being alive or on a delivered packet from an alive
+    sender), and counting it would pin a post-churn cluster dense
+    forever. The quiet≡dense parity battery (tests/test_quiet.py) is
+    the oracle for that proof."""
+    from corrosion_tpu.ops.lww import STATE_DOWN, STATE_SUSPECT
+    from corrosion_tpu.ops.partials import NO_SLOT
+
+    view = st.swim.mem_view
+    pending = (
+        (st.swim.mem_id >= 0)
+        & (view >= 0)
+        & (((view & 3) == STATE_SUSPECT) | ((view & 3) == STATE_DOWN))
+    )
+    row_busy = (
+        jnp.any(pending, axis=1)
+        | jnp.any(st.swim.mem_tx > 0, axis=1)
+        | jnp.any(st.crdt.q_origin != NO_Q, axis=1)
+        | jnp.any(st.crdt.partials.origin != NO_SLOT, axis=1)
+        | jnp.any(needs_count(st.crdt.book) > 0, axis=1)
+    )
+    return st.swim.alive & row_busy
+
+
+def _quiet_info(cfg: ScaleSimConfig, busy, quiet_ok, settled, schedule_ok):
+    """The ``quiet_*`` round-info keys (``corro.quiet.*`` series) —
+    computed OUTSIDE the fixpoint cond so both branches share them."""
+    shards = max(1, int(getattr(cfg, "quiet_shards", 1)))
+    shard_busy = jnp.any(busy.reshape(shards, -1), axis=1)
+    return {
+        "quiet_round": quiet_ok.astype(jnp.int32),
+        "quiet_shards_quiet": jnp.sum(~shard_busy).astype(jnp.int32),
+        "quiet_shards_skipped": jnp.where(
+            quiet_ok, jnp.int32(shards), jnp.int32(0)
+        ),
+        "quiet_backstop": (settled & ~schedule_ok).astype(jnp.int32),
+        "quiet_nodes_active": jnp.sum(busy).astype(jnp.int32),
+    }
+
+
+def scale_sim_step_quiet(
+    cfg: ScaleSimConfig,
+    st: ScaleSimState,
+    net: NetModel,
+    key,
+    inp: ScaleRoundInput,
+):
+    """Quiescence-aware variant of :func:`scale_sim_step` — the
+    active-set round (``cfg.quiet == "on"``; corroquiet tentpole).
+
+    Always runs the cheap SWIM front half (churn, probe/announce legs,
+    elections — the round's RNG draws and delivered-packet channels),
+    then decides on device whether this round can change ANY state:
+
+    - ``carry quiet``  — no alive node owes work (:func:`_quiet_busy`);
+    - ``input quiet``  — this round injects no kills/revives/writes/txs;
+    - ``undisturbed``  — the delivered SWIM traffic would not touch any
+      membership table (:func:`sim.scale.swim_front_disturbed`);
+    - ``schedule ok``  — neither a sync-cohort round nor a
+      ``quiet_backstop_interval`` backstop round.
+
+    When all four hold the round is a proven fixpoint and one
+    ``lax.cond`` takes the cheap branch: carry the state through with
+    only the round counter tick + staleness aging (exactly what the
+    dense round computes on such a round — bit for bit, pinned by
+    tests/test_quiet.py and the check.sh quiet-parity stage). Any doubt
+    takes the dense branch, so correctness never leans on the predicate
+    being tight — only the speedup does."""
+    k_swim, k_pig, k_sp, k_sync = jr.split(key, 4)
+    front = _swim_front(
+        cfg, st.swim, net, k_swim, kill=inp.kill, revive=inp.revive
+    )
+
+    busy = _quiet_busy(cfg, st)
+    carry_quiet = ~jnp.any(busy)
+    input_quiet = ~(
+        jnp.any(inp.kill) | jnp.any(inp.revive)
+        | jnp.any(inp.write_mask) | jnp.any(inp.tx_mask)
+    )
+    # the dense round gates sync on (now % interval == 0) AFTER the tick
+    now1 = st.crdt.now + 1
+    si = max(1, cfg.sync_interval)
+    bs = max(1, cfg.quiet_backstop_interval or cfg.sync_interval)
+    schedule_ok = (now1 % si != 0) & (now1 % bs != 0)
+    settled = carry_quiet & input_quiet & ~swim_front_disturbed(cfg, front)
+    quiet_ok = settled & schedule_ok
+
+    def active(_):
+        swim, swim_info = _swim_back(cfg, st.swim, front)
+        return _post_swim(
+            cfg, st, net, swim, swim_info, list(front.channels),
+            front.carried, k_pig, k_sp, k_sync, inp,
+        )
+
+    def fixpoint(_):
+        # what the dense round computes on a proven-quiet round: the
+        # counter tick and the last_sync aging — nothing else moves
+        crdt = st.crdt._replace(
+            now=st.crdt.now + 1,
+            last_sync=jnp.minimum(st.crdt.last_sync + 1, LAST_SYNC_CAP),
+        )
+        st_out = _narrow_carry(cfg, ScaleSimState(st.swim, crdt))
+        zero = jnp.int32(0)
+        info = {
+            # swim_info: no refutations; acked/failed mirror the front
+            "acked": jnp.sum(front.acked),
+            "failed_probes": jnp.sum(front.failed),
+            "refutes": zero,
+            # b_info: nothing delivered; queued counts (dead-row) residue
+            "delivered": zero,
+            "fresh": zero,
+            "tx_completed": zero,
+            "clock_drift_rejects": zero,
+            "queued": jnp.sum(st.crdt.q_origin != NO_Q),
+            # s_info: the schedule predicate proves this is a skip round
+            "syncs": zero,
+            "cells_pulled": zero,
+            "versions_granted": zero,
+            "serve_rejects": zero,
+            **activity_info(cfg, st_out),
+        }
+        return st_out, info
+
+    st_out, info = jax.lax.cond(quiet_ok, fixpoint, active, None)
+    info = {**info, **_quiet_info(cfg, busy, quiet_ok, settled, schedule_ok)}
+    return st_out, info
+
+
 def activity_masks(cfg: ScaleSimConfig, st: ScaleSimState) -> dict:
     """Per-node activity masks, computed on device from the round's
     carry-out state (ISSUE 11 / ROADMAP quiescence item).
@@ -674,11 +904,15 @@ def _narrow_carry(cfg: ScaleSimConfig, st: ScaleSimState) -> ScaleSimState:
         # mem_tx has its own (possibly int8) HBM tier — ISSUE 12 shrink
         mem_tx=st.swim.mem_tx.astype(cfg.tx_dtype),
     )
+    # the counter planes have their own (possibly int8) HBM tier —
+    # ISSUE 19 shrink; q_cell/last_sync hold grid ids / the 4095 cap
+    # and stay at the int16 tier
+    qdt = cfg.q_dtype
     crdt = st.crdt._replace(
         q_cell=st.crdt.q_cell.astype(dt),
-        q_seq=st.crdt.q_seq.astype(dt),
-        q_nseq=st.crdt.q_nseq.astype(dt),
-        q_tx=st.crdt.q_tx.astype(dt),
+        q_seq=st.crdt.q_seq.astype(qdt),
+        q_nseq=st.crdt.q_nseq.astype(qdt),
+        q_tx=st.crdt.q_tx.astype(qdt),
         last_sync=st.crdt.last_sync.astype(dt),
     )
     return ScaleSimState(swim, crdt)
@@ -688,12 +922,19 @@ def scale_run_rounds_carry(cfg: ScaleSimConfig, st, net: NetModel, key,
                            inputs):
     """Scan returning the FULL carry ``((state, key), infos)`` — the
     segment entry point (see ``sim/step.run_rounds_carry``): chaining
-    segment carries reproduces the straight-through scan bit for bit."""
+    segment carries reproduces the straight-through scan bit for bit.
+
+    ``cfg.quiet == "on"`` swaps the scan body for the active-set round
+    (:func:`scale_sim_step_quiet` — quiet == dense bitwise); "auto" runs
+    dense here (the host plane resolves "auto" per segment,
+    ``resilience/segments.py``)."""
+    step = (scale_sim_step_quiet
+            if getattr(cfg, "quiet", "off") == "on" else scale_sim_step)
 
     def body(carry, inp):
         st, key = carry
         key, sub = jr.split(key)
-        st, info = scale_sim_step(cfg, st, net, sub, inp)
+        st, info = step(cfg, st, net, sub, inp)
         return (st, key), info
 
     return jax.lax.scan(body, (st, key), inputs)

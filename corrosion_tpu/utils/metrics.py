@@ -358,6 +358,14 @@ _INFO_MAP = {
     "active_partials": ("corro.activity.partials.nodes", "gauge"),
     "active_sync": ("corro.activity.sync.nodes", "gauge"),
     "active_probes": ("corro.activity.swim.nodes", "gauge"),
+    # corroquiet active-set rounds (ISSUE 19): emitted by the quiet
+    # step only (``scale_sim_step_quiet``); a dense round emits none of
+    # these, and the segmented runner zero-fills mixed soaks
+    "quiet_round": ("corro.quiet.rounds.cheap", "counter"),
+    "quiet_backstop": ("corro.quiet.backstop.fires", "counter"),
+    "quiet_shards_skipped": ("corro.quiet.shards.skipped", "counter"),
+    "quiet_shards_quiet": ("corro.quiet.shards.quiet", "gauge"),
+    "quiet_nodes_active": ("corro.quiet.nodes.active", "gauge"),
 }
 
 

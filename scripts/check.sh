@@ -340,6 +340,35 @@ echo "== sharded checkpoint probe =="
 python scripts/ckpt_probe.py --output artifacts/ckpt_r09.json
 echo "ckpt probe: ok (report: artifacts/ckpt_r09.json)"
 
+echo "== corroquiet parity gate =="
+# the ISSUE 19 quiescence gate (PERF.md "Quiescence"): every registry
+# chaos scenario run under BOTH round variants — quiet="on" and
+# quiet="off" — must pass all three oracles AND land on the identical
+# fixpoint state digest (masked == dense through kills, skew,
+# corruption, remesh, and mid-lineage quiet flips), plus the
+# steady-state speedup smoke (active-set rounds >= 3x dense on a
+# settled trace, bitwise equal). Under CORROSAN=1; published as
+# artifacts/quiet_r19.json.
+env CORROSAN=1 JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python scripts/quiet_probe.py --output artifacts/quiet_r19.json
+python - <<'PY'
+import json
+rec = json.load(open("artifacts/quiet_r19.json"))
+if not rec.get("ok"):
+    raise SystemExit(f"quiet parity gate failed: {rec.get('problems')}")
+if not rec.get("corrosan"):
+    raise SystemExit("quiet parity gate did not run under the sanitizer")
+scen = [r for r in rec["scenarios"] if not r.get("skipped")]
+if len(scen) < 6 or not all(r.get("digest_match") for r in scen):
+    raise SystemExit(f"quiet parity sweep incomplete: {rec['scenarios']}")
+smoke = rec["speedup_smoke"]
+print(f"quiet parity: {len(scen)} scenarios masked==dense, "
+      f"speedup {smoke['speedup']}x "
+      f"({smoke['cheap_rounds']}/{smoke['rounds']} rounds cheap)")
+PY
+echo "quiet parity: ok (report: artifacts/quiet_r19.json)"
+
 echo "== tier-1 tests =="
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider -p no:xdist \

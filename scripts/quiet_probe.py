@@ -1,0 +1,187 @@
+#!/usr/bin/env python
+"""corroquiet parity gate -> artifacts/quiet_r19.json (ISSUE 19).
+
+The CI face of the quiescence-aware round variant's ONE contract —
+``scale_sim_step_quiet`` is bitwise-indistinguishable from the dense
+round on any trace — swept where it is hardest to hold:
+
+- **masked == dense over the chaos registry**: every shipped scenario
+  runs twice, once under ``quiet="on"`` and once under
+  ``quiet="off"``. Both legs must pass all three oracles, and their
+  fixpoint ``state_digest`` (a content hash of every reference leaf)
+  must be IDENTICAL — the round variant is execution-only all the way
+  through kills, skew, corruption, remesh, and mid-lineage flips;
+- **quiescent-speedup smoke**: the trace the variant exists for — a
+  settled cluster — must actually be cheap: active-set rounds at
+  least 3x faster than dense at the bench smoke extents, bitwise
+  equal, with the cheap-path round count recorded.
+
+Run under ``CORROSAN=1`` from ``scripts/check.sh`` (the record notes
+whether the sanitizer was live). Exit 0 with ``"ok": true`` when every
+claim holds; exit 1 otherwise (the artifact is written either way).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# must be set before jax initializes; conftest does the same for tests
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+
+def _scenario_sweep(problems):
+    """Every registry scenario under both round variants: both green,
+    identical fixpoint digest, identical oracle-arrival rounds."""
+    import dataclasses
+
+    from corrosion_tpu.resilience.chaos import SCENARIOS, run_scenario
+
+    rows = []
+    for name in sorted(SCENARIOS):
+        script = SCENARIOS[name]
+        legs = {}
+        for mode in ("on", "off"):
+            legs[mode] = run_scenario(
+                dataclasses.replace(script, quiet=mode), seed=0)
+        on, off = legs["on"], legs["off"]
+        row = {
+            "scenario": name,
+            "ok_quiet": on["ok"],
+            "ok_dense": off["ok"],
+            "skipped": bool(on.get("skipped") or off.get("skipped")),
+        }
+        if not row["skipped"]:
+            row["digest_match"] = (
+                on["state_digest"] == off["state_digest"])
+            row["rounds_to_convergence"] = on["rounds_to_convergence"]
+            row["rounds_to_quiescence"] = on["rounds_to_quiescence"]
+            if not on["ok"]:
+                problems.append(
+                    f"{name}: quiet leg failed: {on.get('problems')}")
+            if not off["ok"]:
+                problems.append(
+                    f"{name}: dense leg failed: {off.get('problems')}")
+            if not row["digest_match"]:
+                problems.append(
+                    f"{name}: masked != dense (fixpoint digest differs)")
+            for k in ("rounds_to_convergence", "rounds_to_quiescence"):
+                if on[k] != off[k]:
+                    problems.append(
+                        f"{name}: {k} differs across round variants: "
+                        f"{on[k]} vs {off[k]}")
+        rows.append(row)
+    return rows
+
+
+def _speedup_smoke(problems):
+    """The steady-state claim at the bench smoke extents: quiet vs
+    dense on a fully settled trace, bitwise gate + >= 3x."""
+    import dataclasses
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import jax.random as jr
+    import numpy as np
+
+    from corrosion_tpu.sim.scale_step import (
+        ScaleSimState,
+        make_write_inputs,
+        scale_run_rounds,
+        scale_sim_config,
+    )
+    from corrosion_tpu.sim.transport import NetModel
+
+    n = int(os.environ.get("QUIET_PROBE_NODES", "512"))
+    rounds = int(os.environ.get("QUIET_PROBE_ROUNDS", "48"))
+    cfg = scale_sim_config(n)
+    net = NetModel.create(n)
+    inputs = make_write_inputs(cfg, jr.key(5), rounds,
+                               jnp.zeros((rounds, n), bool))
+    rps, final = {}, {}
+    cheap = 0
+    for label, mode in (("quiet", "on"), ("dense", "off")):
+        c = dataclasses.replace(cfg, quiet=mode).validate()
+        run = jax.jit(functools.partial(scale_run_rounds, c),
+                      donate_argnums=(0,))
+        s = jax.block_until_ready(
+            run(ScaleSimState.create(c), net, jr.key(6), inputs))[0]
+        t0 = time.perf_counter()
+        s, infos = run(s, net, jr.key(7), inputs)
+        jax.block_until_ready(s)
+        rps[label] = rounds / (time.perf_counter() - t0)
+        final[label] = s
+        if label == "quiet":
+            cheap = int(np.asarray(infos["quiet_round"]).sum())
+    parity = all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree.leaves(final["quiet"]),
+                        jax.tree.leaves(final["dense"]))
+    )
+    speedup = rps["quiet"] / max(rps["dense"], 1e-9)
+    if not parity:
+        problems.append("speedup smoke: quiet != dense bitwise")
+    if speedup < 3.0:
+        problems.append(
+            f"speedup smoke: {speedup:.2f}x < 3x "
+            f"({cheap}/{rounds} rounds cheap-pathed)")
+    return {
+        "n_nodes": n,
+        "rounds": rounds,
+        "cheap_rounds": cheap,
+        "rps_quiet": round(rps["quiet"], 2),
+        "rps_dense": round(rps["dense"], 2),
+        "speedup": round(speedup, 2),
+        "parity": parity,
+    }
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from corrosion_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+
+    problems = []
+    t0 = time.perf_counter()
+    scenarios = _scenario_sweep(problems)
+    smoke = _speedup_smoke(problems)
+
+    record = {
+        "probe": "quiet_r19",
+        "ok": not problems,
+        "corrosan": os.environ.get("CORROSAN", "") == "1",
+        "scenarios": scenarios,
+        "speedup_smoke": smoke,
+        "elapsed_s": round(time.perf_counter() - t0, 2),
+    }
+    if problems:
+        record["problems"] = problems
+    out = sys.argv[sys.argv.index("--output") + 1] if (
+        "--output" in sys.argv) else "artifacts/quiet_r19.json"
+    os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+    with open(out, "w") as f:
+        json.dump(record, f, indent=2)
+        f.write("\n")
+    print(json.dumps({
+        "probe": record["probe"], "ok": record["ok"],
+        "scenarios": len(scenarios),
+        "digest_matches": sum(
+            1 for r in scenarios if r.get("digest_match")),
+        "speedup": smoke["speedup"],
+    }))
+    return 0 if not problems else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

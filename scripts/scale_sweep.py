@@ -1,0 +1,228 @@
+#!/usr/bin/env python
+"""BENCH_SCALE_N sweep -> artifacts/scale_sweep_r19.json (ISSUE 19).
+
+The ROADMAP's carried scale-ladder item, stood up as a probe next to
+the ckpt/membudget artifacts: climb N through CPU-scaled rungs (the
+flagship extents shrunk to ``m_slots=8, tx_max_cells=1`` so a laptop
+can hold them) and record, per rung:
+
+- **rounds/s** for the dense round AND the quiet round variant on a
+  settled trace (the corroquiet steady-state claim, measured at scale
+  rather than at the bench smoke's N=512);
+- **measured vs projected HBM**: ``obs/memory.state_bytes`` of the
+  real state must equal corrobudget's static
+  ``obs/memory.projected_bytes`` at the same N — the same agreement
+  the bench records as ``hbm_bytes`` / ``hbm_bytes_projected_1m``,
+  here pinned EXACTLY at every rung actually built;
+- **checkpoint drain bytes per shard** from one segmented leg over the
+  8 virtual devices (the ISSUE 9 sharded drain, priced at rung scale).
+
+Rungs come from ``BENCH_SCALE_N`` (comma list, default
+``100000,300000``). The 1M rung is deliberately NOT in the default
+list: it is slow on CPU and belongs to a TPU tunnel session — set
+``BENCH_SCALE_1M=1`` (and optionally put ``1000000`` in
+``BENCH_SCALE_N``) to run it; otherwise the artifact records it as
+skipped with the reason.
+
+Exit 0 with ``"ok": true`` when every agreement holds; exit 1
+otherwise (the artifact is written either way).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# must be set before jax initializes; conftest does the same for tests
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+SLOW_RUNG = 1_000_000
+
+
+def _rung_cfg(n):
+    """The CPU-scaled flagship config: small M so the O(N*M) tables fit
+    a host at 300k, chunking off (tx_max_cells=1) so the rung prices
+    the steady-state round, not the ingest tail."""
+    from corrosion_tpu.sim.scale_step import scale_sim_config
+
+    return scale_sim_config(
+        n, m_slots=8, n_origins=4, n_rows=4, n_cols=2, tx_max_cells=1,
+    )
+
+
+def _run_rung(n, rounds, warm_runs, problems):
+    import dataclasses
+    import functools
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import jax.random as jr
+    import numpy as np
+
+    from corrosion_tpu.obs.memory import projected_bytes, state_bytes
+    from corrosion_tpu.parallel.mesh import make_mesh, shard_state
+    from corrosion_tpu.resilience.segments import run_segmented
+    from corrosion_tpu.sim.scale_step import (
+        ScaleSimState,
+        make_write_inputs,
+        scale_run_rounds,
+    )
+    from corrosion_tpu.sim.transport import NetModel
+
+    cfg = _rung_cfg(n)
+    st = ScaleSimState.create(cfg)
+
+    # --- measured vs projected HBM: must agree EXACTLY ------------------
+    measured = state_bytes(st)
+    projected = projected_bytes(cfg, n)
+    if measured != projected:
+        problems.append(
+            f"N={n}: measured HBM {measured} != projected {projected}"
+        )
+
+    # --- rounds/s, dense vs quiet round variant -------------------------
+    net = NetModel.create(n)
+    inputs = make_write_inputs(cfg, jr.key(11), rounds,
+                               jnp.zeros((rounds, n), bool))
+    rps = {}
+    quiet_cheap = 0
+    for label, mode in (("dense", "off"), ("quiet", "on")):
+        c = dataclasses.replace(cfg, quiet=mode).validate()
+        run = jax.jit(functools.partial(scale_run_rounds, c),
+                      donate_argnums=(0,))
+        s = ScaleSimState.create(c)
+        # warm runs settle the cold-start carry (SWIM membership churn)
+        # so the timed leg prices the steady state the variant targets
+        for i in range(warm_runs):
+            s, infos = run(s, net, jr.key(12 + i), inputs)
+        jax.block_until_ready(s)
+        t0 = time.perf_counter()
+        s, infos = run(s, net, jr.key(99), inputs)
+        jax.block_until_ready(s)
+        rps[label] = rounds / (time.perf_counter() - t0)
+        if label == "quiet":
+            quiet_cheap = int(np.asarray(infos["quiet_round"]).sum())
+
+    # --- checkpoint drain bytes per shard (segmented, 8-way) ------------
+    ckpt = {}
+    n_dev = len(jax.devices())
+    if n % n_dev == 0:
+        mesh = make_mesh(jax.devices())
+        seg_rounds = min(8, rounds)
+        seg_in = jax.tree.map(lambda a: a[:seg_rounds], inputs)
+        tmp = tempfile.mkdtemp(prefix="scale_sweep_")
+        try:
+            res = run_segmented(
+                cfg, shard_state(mesh, n, ScaleSimState.create(cfg)),
+                shard_state(mesh, n, net), jr.key(13),
+                shard_state(mesh, n, seg_in),
+                segment_rounds=max(seg_rounds // 2, 1), mode="scale",
+                checkpoint_root=tmp,
+            )
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+        s = res.stats
+        if s["ckpt_shards"] != n_dev:
+            problems.append(
+                f"N={n}: drained {s['ckpt_shards']} shards, "
+                f"expected {n_dev}"
+            )
+        ckpt = {
+            "shards": s["ckpt_shards"],
+            "drain_bytes": s["ckpt_drain_bytes"],
+            "bytes_per_shard": s["ckpt_drain_bytes"]
+            // max(s["ckpt_shards"], 1),
+            "shard_bytes_max": s["ckpt_shard_bytes_max"],
+            "quiet_mode": s.get("quiet_mode", "off"),
+            "quiet_segments": s.get("quiet_segments", 0),
+        }
+    else:
+        ckpt = {"skipped": f"N={n} not divisible by {n_dev} devices"}
+
+    return {
+        "n": n,
+        "rounds": rounds,
+        "hbm_bytes_measured": measured,
+        "hbm_bytes_projected": projected,
+        "hbm_agree": measured == projected,
+        "rounds_per_s": {k: round(v, 3) for k, v in rps.items()},
+        "quiet_speedup": round(rps["quiet"] / max(rps["dense"], 1e-9), 3),
+        "quiet_cheap_rounds": quiet_cheap,
+        "ckpt": ckpt,
+    }
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from corrosion_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+
+    rungs = [
+        int(x) for x in os.environ.get(
+            "BENCH_SCALE_N", "100000,300000").split(",") if x.strip()
+    ]
+    rounds = int(os.environ.get("BENCH_SCALE_ROUNDS", "16"))
+    warm_runs = int(os.environ.get("BENCH_SCALE_WARM_RUNS", "2"))
+    run_1m = os.environ.get("BENCH_SCALE_1M", "") == "1"
+
+    problems = []
+    records = []
+    for n in rungs:
+        if n >= SLOW_RUNG and not run_1m:
+            records.append({
+                "n": n,
+                "skipped": "slow rung: set BENCH_SCALE_1M=1 "
+                           "(TPU tunnel session; hours on CPU)",
+            })
+            continue
+        t0 = time.perf_counter()
+        rec = _run_rung(n, rounds, warm_runs, problems)
+        rec["elapsed_s"] = round(time.perf_counter() - t0, 2)
+        records.append(rec)
+    if not any(r["n"] >= SLOW_RUNG for r in records):
+        records.append({
+            "n": SLOW_RUNG,
+            "skipped": "slow rung: set BENCH_SCALE_1M=1 and add it to "
+                       "BENCH_SCALE_N (TPU tunnel session)",
+        })
+
+    record = {
+        "metric": "scale_sweep_r19",
+        "ok": not problems,
+        "devices": len(jax.devices()),
+        "rungs": records,
+    }
+    if problems:
+        record["problems"] = problems
+    out = sys.argv[sys.argv.index("--output") + 1] if (
+        "--output" in sys.argv) else "artifacts/scale_sweep_r19.json"
+    os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+    with open(out, "w") as f:
+        json.dump(record, f, indent=2)
+        f.write("\n")
+    print(json.dumps({
+        "metric": record["metric"], "ok": record["ok"],
+        "rungs": [
+            {k: r[k] for k in ("n", "rounds_per_s", "quiet_speedup",
+                               "hbm_agree") if k in r}
+            | ({"skipped": r["skipped"]} if "skipped" in r else {})
+            for r in records
+        ],
+    }))
+    return 0 if not problems else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -567,6 +567,7 @@ def test_hot_entry_points_compile_once():
     assert set(counts) == {
         "full_sim_step", "scale_sim_step", "segment_dispatch",
         "sharded_scale_run", "segmented_soak", "fused_scale_run",
+        "quiet_scale_run",
     }
 
 

@@ -698,6 +698,7 @@ def test_hot_entry_registry_matches_runtime():
     assert set(HOT_ENTRY_POINTS) == {
         "full_sim_step", "scale_sim_step", "segment_dispatch",
         "sharded_scale_run", "segmented_soak", "fused_scale_run",
+        "quiet_scale_run",
     }
     for fn in (sim_step, scale_sim_step):
         assert list(inspect.signature(fn).parameters)[:4] == [

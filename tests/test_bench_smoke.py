@@ -54,4 +54,15 @@ def test_bench_smoke_pipeline_facts():
     assert soak["ckpt_written"] == soak["segments"]
     # the overlapped drain: hot-loop stall well under the writer's IO
     assert soak["ckpt_stall_s"] < soak["ckpt_io_s"]
+    # quiescence arm (ISSUE 19): provenance recorded, the active-set
+    # round is bitwise dense-identical on the quiet trace, and the
+    # cheap fixpoint path actually pays for itself
+    assert rec["quiet_mode"] in ("auto", "on", "off")
+    assert rec["quiet"]["parity"] is True
+    assert rec["quiet"]["speedup"] >= 3.0
+    assert rec["quiet"]["cheap_rounds"] > 0
+    # scale-sweep wiring (ISSUE 19): the static projection priced at
+    # the run's own N must equal the measured carry bytes exactly
+    assert rec["hbm_projection_agrees"] is True
+    assert rec["hbm_bytes"] == rec["hbm_bytes_projected"] > 0
     assert rec["elapsed_s"] <= rec["deadline_s"]

@@ -1,0 +1,328 @@
+"""corroquiet (ISSUE 19): the quiescence-gated active-set round.
+
+The one contract: ``scale_sim_step_quiet`` is bitwise-indistinguishable
+from the dense round on ANY trace — quiet, seeded-write, kill/revive
+churn, every registry chaos scenario — while cheap-pathing provably
+settled rounds. Plus the execution-only checkpoint surface: a lineage
+written under one round variant resumes under the other, bit for bit
+(``checkpoint.EXECUTION_ONLY_CONFIG_KEYS``), and the segmented runner's
+host fast path (``segments.run_segmented`` under ``quiet="auto"``)
+short-circuits fully-quiet segments without perturbing a single leaf.
+"""
+
+import dataclasses
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+
+from corrosion_tpu.sim.scale_step import (
+    ScaleSimState,
+    make_write_inputs,
+    scale_run_rounds,
+    scale_sim_config,
+    scale_sim_step,
+    scale_sim_step_quiet,
+)
+from corrosion_tpu.sim.transport import NetModel
+
+N = 48
+ROUNDS = 48
+
+
+def _cfg(**overrides):
+    return scale_sim_config(
+        N, m_slots=8, n_origins=4, n_rows=4, n_cols=2, sync_interval=4,
+        **overrides,
+    )
+
+
+def _trace(cfg, kind, rounds=ROUNDS, seed=7):
+    """A stacked round-input trace: all-quiet, seeded writes, or writes
+    plus kill/revive churn."""
+    n = cfg.n_nodes
+    key = jr.key(seed)
+    w = jnp.zeros((rounds, n), bool)
+    if kind != "quiet":
+        w = ((jr.uniform(key, (rounds, n)) < 0.3)
+             & (jnp.arange(n) < cfg.n_origins)[None, :]
+             & (jnp.arange(rounds) < 10)[:, None])
+    inputs = make_write_inputs(cfg, jr.fold_in(key, 1), rounds, w)
+    if kind == "churn":
+        kill = jnp.zeros((rounds, n), bool).at[2, n - 1].set(True)
+        revive = jnp.zeros((rounds, n), bool).at[rounds // 2, n - 1].set(True)
+        inputs = inputs._replace(kill=kill, revive=revive)
+    return inputs
+
+
+def _run(cfg, inputs, seed=0):
+    run = jax.jit(functools.partial(scale_run_rounds, cfg))
+    st, infos = run(ScaleSimState.create(cfg), NetModel.create(cfg.n_nodes),
+                    jr.key(seed), inputs)
+    jax.block_until_ready(st)
+    return st, infos
+
+
+def _assert_bitwise(st_a, st_b, label):
+    for i, (a, b) in enumerate(zip(jax.tree.leaves(st_a),
+                                   jax.tree.leaves(st_b))):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), (
+            f"{label}: state leaf {i} diverged")
+
+
+# --- the device-plane oracle: masked == dense, bitwise --------------------
+
+
+@pytest.mark.parametrize("kind", ["quiet", "seeded", "churn"])
+def test_quiet_matches_dense_bitwise(kind):
+    """Every leaf of the carry AND every shared info key is identical
+    under quiet="on" and quiet="off" — on a settled trace, a seeded
+    write trace, and a kill/revive churn trace."""
+    cfg_q = _cfg(quiet="on")
+    cfg_d = _cfg(quiet="off")
+    inputs = _trace(cfg_q, kind)
+    st_q, infos_q = _run(cfg_q, inputs)
+    st_d, infos_d = _run(cfg_d, inputs)
+    _assert_bitwise(st_q, st_d, kind)
+    # dense info keys are a subset of the quiet step's (which adds the
+    # corro.quiet.* sources); the shared ones must match bitwise
+    for k in infos_d:
+        assert np.array_equal(np.asarray(infos_q[k]),
+                              np.asarray(infos_d[k])), (
+            f"{kind}: info {k!r} diverged")
+    if kind == "quiet":
+        # the trace this variant exists for: the cold-start carry takes
+        # ~18 rounds to settle (SWIM membership churn from an all-fresh
+        # state), after which every round off the sync/backstop schedule
+        # is cheap — assert on the steady-state half
+        qr = np.asarray(infos_q["quiet_round"]).astype(int)
+        assert int(qr[ROUNDS // 2:].sum()) > ROUNDS // 4
+
+
+def test_quiet_series_and_backstop_accounting():
+    """The quiet step emits the corro.quiet.* sources — cheap rounds,
+    skipped shards, backstop fires — and the dense step emits none."""
+    cfg_q = _cfg(quiet="on")
+    inputs = _trace(cfg_q, "quiet")
+    _, infos_q = _run(cfg_q, inputs)
+    cheap = int(np.asarray(infos_q["quiet_round"]).sum())
+    backstop = int(np.asarray(infos_q["quiet_backstop"]).sum())
+    assert cheap > 0
+    # sync_interval=4 forces a dense round every 4th tick on a settled
+    # trace: each one is a backstop fire by definition
+    assert backstop > 0
+    assert cheap + backstop <= ROUNDS
+    skipped = int(np.asarray(infos_q["quiet_shards_skipped"]).sum())
+    assert skipped == cheap * cfg_q.quiet_shards
+    _, infos_d = _run(_cfg(quiet="off"), inputs)
+    assert "quiet_round" not in infos_d
+
+
+def test_quiet_backstop_interval_overrides_sync():
+    """quiet_backstop_interval decouples the backstop from the sync
+    cadence — a tighter backstop forces more dense rounds, bitwise
+    equal to dense all the same."""
+    cfg_q = _cfg(quiet="on", quiet_backstop_interval=2)
+    inputs = _trace(cfg_q, "quiet")
+    st_q, infos_q = _run(cfg_q, inputs)
+    st_d, _ = _run(_cfg(quiet="off"), inputs)
+    _assert_bitwise(st_q, st_d, "backstop=2")
+    # every other round is blocked by the backstop, on top of the sync
+    # schedule: cheap rounds can be at most half the trace
+    assert 0 < int(np.asarray(infos_q["quiet_round"]).sum()) <= ROUNDS // 2
+
+
+def test_quiet_auto_is_dense_at_device_level():
+    """quiet="auto" resolves at the HOST (segments.run_segmented); the
+    device-level scan under "auto" is the dense program."""
+    cfg = _cfg()  # quiet defaults to "auto"
+    assert cfg.quiet == "auto"
+    _, infos = _run(cfg, _trace(cfg, "quiet", rounds=8))
+    assert "quiet_round" not in infos
+
+
+def test_quiet_step_signature_parity():
+    """Both step variants share the registry signature (cfg, st, net,
+    key, inp) and one round of each matches bitwise on a busy input."""
+    import inspect
+
+    for fn in (scale_sim_step, scale_sim_step_quiet):
+        assert list(inspect.signature(fn).parameters)[:4] == [
+            "cfg", "st", "net", "key"]
+    cfg_q = _cfg(quiet="on")
+    inputs = _trace(cfg_q, "seeded", rounds=1)
+    one = jax.tree.map(lambda a: a[0], inputs)
+    st0 = ScaleSimState.create(cfg_q)
+    net = NetModel.create(cfg_q.n_nodes)
+    st_q, _ = scale_sim_step_quiet(cfg_q, st0, net, jr.key(3), one)
+    st_d, _ = scale_sim_step(_cfg(quiet="off"), st0, net, jr.key(3), one)
+    _assert_bitwise(st_q, st_d, "single step")
+
+
+# --- config + checkpoint surface ------------------------------------------
+
+
+def test_quiet_config_validation():
+    with pytest.raises(ValueError, match="quiet"):
+        _cfg(quiet="sometimes")
+    with pytest.raises(ValueError, match="sync_cohort"):
+        _cfg(quiet="on", sync_cohort=False)
+    with pytest.raises(ValueError, match="backstop"):
+        _cfg(quiet_backstop_interval=-1)
+    with pytest.raises(ValueError, match="quiet_shards"):
+        _cfg(quiet_shards=7)  # does not divide 48
+    _cfg(quiet="on", quiet_shards=4)  # divides: fine
+
+
+def test_quiet_is_execution_only_identity():
+    """The quiet knobs never change checkpoint identity — a lineage
+    written under one variant restores under any other."""
+    from corrosion_tpu.checkpoint import (
+        EXECUTION_ONLY_CONFIG_KEYS,
+        config_identity,
+    )
+
+    assert {"quiet", "quiet_backstop_interval",
+            "quiet_shards"} <= set(EXECUTION_ONLY_CONFIG_KEYS)
+    base = _cfg()
+    flipped = dataclasses.replace(
+        base, quiet="on", quiet_backstop_interval=2, quiet_shards=4
+    ).validate()
+    assert config_identity(base) == config_identity(flipped)
+
+
+@pytest.mark.parametrize("first,second", [("on", "off"), ("off", "on")])
+def test_quiet_checkpoint_resume_cross_mode(first, second, tmp_path):
+    """A segmented soak checkpointed under one round variant resumes
+    under the other mid-lineage and lands bitwise on the dense straight
+    run's final state."""
+    from corrosion_tpu.resilience.segments import (
+        resume_segmented,
+        run_segmented,
+    )
+
+    cfg_a = _cfg(quiet=first)
+    cfg_b = _cfg(quiet=second)
+    rounds = 16
+    inputs = _trace(cfg_a, "seeded", rounds=rounds)
+    net = NetModel.create(cfg_a.n_nodes)
+    ref, _ = _run(_cfg(quiet="off"), inputs, seed=0)
+
+    half = jax.tree.map(lambda a: a[: rounds // 2], inputs)
+    run_segmented(cfg_a, ScaleSimState.create(cfg_a), net, jr.key(0),
+                  half, segment_rounds=4, checkpoint_root=str(tmp_path))
+    res = resume_segmented(cfg_b, net, inputs, segment_rounds=4,
+                           checkpoint_root=str(tmp_path))
+    assert res.completed_rounds == rounds
+    _assert_bitwise(res.state, ref, f"{first}->{second} resume")
+
+
+# --- the segmented host fast path -----------------------------------------
+
+
+def test_segments_quiet_auto_fast_path(tmp_path):
+    """Under quiet="auto" the segmented runner short-circuits segments
+    whose inputs AND carry are provably quiet — dispatching the quiet
+    program for them and the EXACT historical dense program for the
+    rest — with every leaf and every shared info row bitwise equal to
+    the dense straight scan."""
+    from corrosion_tpu.resilience.segments import run_segmented
+
+    cfg = _cfg()  # quiet="auto"
+    rounds = ROUNDS
+    inputs = _trace(cfg, "seeded", rounds=rounds)
+    net = NetModel.create(cfg.n_nodes)
+    ref, infos_ref = _run(_cfg(quiet="off"), inputs, seed=0)
+
+    res = run_segmented(cfg, ScaleSimState.create(cfg), net, jr.key(0),
+                        inputs, segment_rounds=8,
+                        checkpoint_root=str(tmp_path))
+    assert res.completed_rounds == rounds
+    _assert_bitwise(res.state, ref, "quiet-auto soak")
+    assert res.stats["quiet_mode"] == "auto"
+    # writes stop at round 10: the later segments are input-quiet and,
+    # once the carry settles, host-skipped onto the quiet program
+    assert res.stats["quiet_segments"] >= 1
+    for k in infos_ref:
+        assert np.array_equal(np.asarray(res.infos[k]),
+                              np.asarray(infos_ref[k])), (
+            f"soak info {k!r} diverged")
+    # mixed segments: dense parts zero-fill the quiet-only keys
+    assert int(np.asarray(res.infos["quiet_round"]).sum()) > 0
+
+
+def test_segments_quiet_off_never_fast_paths(tmp_path):
+    from corrosion_tpu.resilience.segments import run_segmented
+
+    cfg = _cfg(quiet="off")
+    inputs = _trace(cfg, "quiet", rounds=16)
+    res = run_segmented(cfg, ScaleSimState.create(cfg),
+                        NetModel.create(cfg.n_nodes), jr.key(0), inputs,
+                        segment_rounds=4, checkpoint_root=str(tmp_path))
+    assert res.stats["quiet_mode"] == "off"
+    assert res.stats["quiet_segments"] == 0
+    assert "quiet_round" not in res.infos
+
+
+# --- the parity harness + chaos registry ----------------------------------
+
+
+def test_quiet_parity_harness_workload():
+    """sim/parity.py battery rung: the same workload script under both
+    round variants — identical planes, alive set, rounds-to-converge."""
+    from corrosion_tpu.sim.parity import WorkloadScript, run_sim_script
+
+    script = WorkloadScript.random_full_mix(
+        n_nodes=24, n_origins=4, n_cells=8, rounds=16, seed=5)
+    on = run_sim_script(script, seed=2, settle_rounds=256, quiet="on")
+    off = run_sim_script(script, seed=2, settle_rounds=256, quiet="off")
+    for p_on, p_off in zip(on[0], off[0]):
+        assert np.array_equal(p_on, p_off)
+    assert np.array_equal(on[1], off[1])
+    assert on[2] == off[2]  # identical rounds-to-convergence
+
+
+def test_quiet_flip_scenario_registered():
+    from corrosion_tpu.resilience.chaos import INJECTION_KINDS, SCENARIOS
+
+    assert "quiet_flip" in INJECTION_KINDS
+    script = SCENARIOS["quiet-flip"]
+    assert script.quiet == "on"
+    flips = [i.quiet for i in script.injections if i.kind == "quiet_flip"]
+    assert flips == ["off", "on"]  # both directions in one lineage
+
+
+def test_quiet_chaos_scenario_tier1():
+    """One registry scenario under quiet="on": both oracles plus the
+    quiescence drain stay green and the chaos leg stays bitwise."""
+    from corrosion_tpu.resilience.chaos import SCENARIOS, run_scenario
+
+    script = dataclasses.replace(SCENARIOS["preempt-mid-segment"],
+                                 quiet="on")
+    rec = run_scenario(script, seed=0)
+    assert rec["ok"], rec.get("problems")
+    assert rec["bitwise_match"] and rec["converged"] and rec["quiesced"]
+
+
+def _scenario_names():
+    from corrosion_tpu.resilience.chaos import SCENARIOS
+
+    return sorted(SCENARIOS)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", _scenario_names())
+def test_quiet_chaos_registry_full(name):
+    """The whole registry under quiet="on" (the check.sh quiet-parity
+    stage runs this sweep as artifacts/quiet_r19.json)."""
+    from corrosion_tpu.resilience.chaos import SCENARIOS, run_scenario
+
+    rec = run_scenario(dataclasses.replace(SCENARIOS[name], quiet="on"),
+                       seed=0)
+    assert rec["ok"], rec.get("problems")
+    if not rec.get("skipped"):
+        assert rec["bitwise_match"] and rec["converged"] and rec["quiesced"]

@@ -682,3 +682,121 @@ def test_narrow_int8_sharded_matches_single_device():
         assert jnp.array_equal(a, b), "sharded int8 state diverged"
     for k in ref_infos:
         assert jnp.array_equal(ref_infos[k], infos[k]), k
+
+
+# --- ISSUE 19: the int8 queue-counter tier (q_tx/q_seq/q_nseq) ----------
+
+def _q_int8_rig(n_nodes=48, rounds=40, tx_cells=3):
+    """Churny written trace with chunked transactions, so q_seq/q_nseq
+    actually count past their initializers."""
+    import dataclasses
+
+    base = scale_sim_config(
+        n_nodes, m_slots=16, n_origins=4, n_rows=4, n_cols=2,
+        sync_interval=4, pig_members=4, narrow_dtypes=True,
+        tx_max_cells=tx_cells,
+    )
+    q8 = dataclasses.replace(base, narrow_q_int8=True).validate()
+    net = NetModel.create(base.n_nodes, drop_prob=0.02)
+    inp = quiet_inputs(base, rounds)
+    n = base.n_nodes
+    k1, k2, k3, k4 = jr.split(jr.key(50), 4)
+    w = jr.uniform(k1, (rounds, n)) < 0.25
+    t = (jr.uniform(k4, (rounds, n)) < 0.15) & ~w
+    start = jr.randint(k2, (rounds, n), 0, base.n_cells, dtype=jnp.int32)
+    tx_cell = (start[..., None] + jnp.arange(tx_cells)) % base.n_cells
+    inp = inp._replace(
+        write_mask=w,
+        write_cell=start,
+        write_val=jr.randint(k3, (rounds, n), 1, 1 << 15, dtype=jnp.int32),
+        tx_mask=t,
+        tx_len=jnp.full((rounds, n), tx_cells, jnp.int32),
+        tx_cell=tx_cell,
+        tx_val=jr.randint(k3, (rounds, n, tx_cells), 1, 1 << 15,
+                          dtype=jnp.int32),
+        kill=jnp.zeros((rounds, n), bool).at[8, 3].set(True),
+        revive=jnp.zeros((rounds, n), bool).at[25, 3].set(True),
+    )
+    return base, q8, net, inp
+
+
+def test_narrow_q_int8_matches_int16_exactly():
+    """The ISSUE-19 queue shrink must be a pure layout change: the int8
+    q_tx/q_seq/q_nseq arm equals the int16 arm bit-for-bit (widened for
+    comparison) on a churny chunked-transaction trace, and only the
+    counter planes narrowed."""
+    base, q8, net, inp = _q_int8_rig()
+    assert q8.q_dtype == jnp.int8 and base.q_dtype == jnp.int16
+
+    st16, info16 = run(base, ScaleSimState.create(base), net, jr.key(51),
+                       inp)
+    st8, info8 = run(q8, ScaleSimState.create(q8), net, jr.key(51), inp)
+    for plane in ("q_tx", "q_seq", "q_nseq"):
+        assert getattr(st8.crdt, plane).dtype == jnp.int8, plane
+    assert st8.crdt.q_cell.dtype == jnp.int16  # grid ids stay 16
+    assert st8.crdt.last_sync.dtype == jnp.int16  # 4095 cap stays 16
+    # the chunked txs must have actually exercised the counters
+    assert int(jnp.max(st16.crdt.q_nseq)) > 1
+    for a, b in zip(jax.tree.leaves(st16), jax.tree.leaves(st8)):
+        wa = a if a.dtype == bool else jnp.asarray(a, jnp.int32)
+        wb = b if b.dtype == bool else jnp.asarray(b, jnp.int32)
+        assert jnp.array_equal(wa, wb), "int8 q state diverged from int16"
+    for k in info16:
+        assert jnp.array_equal(info16[k], info8[k]), f"info {k} diverged"
+
+
+def test_narrow_q_int8_fused_matches_unfused():
+    """The fused ingest kernel under the int8 queue planes — the probe
+    cache keys the q dtype set separately, so the probed kernel is the
+    dispatched kernel."""
+    import dataclasses
+
+    _, q8, net, inp = _q_int8_rig(n_nodes=32, rounds=24)
+    fused = dataclasses.replace(q8, fused="interpret").validate()
+    unfused = dataclasses.replace(q8, fused="off").validate()
+    st_f, info_f = run(fused, ScaleSimState.create(fused), net,
+                       jr.key(52), inp)
+    st_u, info_u = run(unfused, ScaleSimState.create(unfused), net,
+                       jr.key(52), inp)
+    assert st_f.crdt.q_tx.dtype == jnp.int8
+    for a, b in zip(jax.tree.leaves(st_f), jax.tree.leaves(st_u)):
+        assert jnp.array_equal(a, b), "fused int8 q state diverged"
+    for k in info_f:
+        assert jnp.array_equal(info_f[k], info_u[k]), f"info {k} diverged"
+
+
+def test_narrow_q_int8_quiet_composes():
+    """int8 queue planes under the quiet round variant: both perf tiers
+    stacked still equal the plain dense int16 arm bit-for-bit."""
+    import dataclasses
+
+    base, q8, net, inp = _q_int8_rig(n_nodes=32, rounds=24)
+    quiet8 = dataclasses.replace(q8, quiet="on").validate()
+    st_ref, _ = run(base, ScaleSimState.create(base), net, jr.key(53), inp)
+    st_q8, _ = run(quiet8, ScaleSimState.create(quiet8), net, jr.key(53),
+                   inp)
+    assert st_q8.crdt.q_tx.dtype == jnp.int8
+    for a, b in zip(jax.tree.leaves(st_ref), jax.tree.leaves(st_q8)):
+        wa = a if a.dtype == bool else jnp.asarray(a, jnp.int32)
+        wb = b if b.dtype == bool else jnp.asarray(b, jnp.int32)
+        assert jnp.array_equal(wa, wb), "quiet int8 q state diverged"
+
+
+def test_narrow_q_int8_validation():
+    import dataclasses
+
+    base = scale_sim_config(32, m_slots=8)
+    with pytest.raises(ValueError, match="tier of narrow_dtypes"):
+        dataclasses.replace(base, narrow_dtypes=False,
+                            narrow_q_int8=True).validate()
+    with pytest.raises(ValueError, match="int8 range"):
+        dataclasses.replace(base, narrow_dtypes=True, narrow_q_int8=True,
+                            bcast_max_transmissions=200).validate()
+    # the dtype-flow registry guards the shrunk leaves at 8 bits, and a
+    # pre-ISSUE-19 checkpoint restores as the default-off tier
+    from corrosion_tpu.analysis.dtypes import NARROW_LEAVES, NARROW_REFS
+    from corrosion_tpu.checkpoint import COMPAT_DEFAULT_CONFIG_KEYS
+
+    assert all(NARROW_LEAVES[p] == 8 for p in ("q_tx", "q_seq", "q_nseq"))
+    assert NARROW_REFS["o_q_tx"] == 8
+    assert COMPAT_DEFAULT_CONFIG_KEYS["narrow_q_int8"] is False

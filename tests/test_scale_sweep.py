@@ -1,0 +1,72 @@
+"""The ``BENCH_SCALE_N`` sweep (``scripts/scale_sweep.py``) as a tier
+test (ISSUE 19).
+
+One subprocess run at a tiny rung pins the three facts the committed
+``artifacts/scale_sweep_r19.json`` claims at 100k/300k: measured HBM
+equals corrobudget's static projection EXACTLY, the segmented leg
+drains one checkpoint slice per device, and both round variants report
+a rounds/s figure. The 1M rung stays out of tier-1: slow-marked and
+gated on ``BENCH_SCALE_1M=1`` (a TPU tunnel session — hours on CPU).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+SWEEP = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts", "scale_sweep.py")
+
+
+@pytest.mark.slow
+def test_scale_sweep_tiny_rung(tmp_path):
+    out = tmp_path / "sweep.json"
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        BENCH_SCALE_N="2048",
+        BENCH_SCALE_ROUNDS="6",
+        BENCH_SCALE_WARM_RUNS="1",
+    )
+    proc = subprocess.run(
+        [sys.executable, SWEEP, "--output", str(out)],
+        capture_output=True, text=True, timeout=400, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(out.read_text())
+
+    assert rec["ok"], rec.get("problems")
+    rung = rec["rungs"][0]
+    assert rung["n"] == 2048
+    assert rung["hbm_agree"] is True
+    assert rung["hbm_bytes_measured"] == rung["hbm_bytes_projected"] > 0
+    assert rung["rounds_per_s"]["dense"] > 0
+    assert rung["rounds_per_s"]["quiet"] > 0
+    assert rung["ckpt"]["shards"] == rec["devices"]
+    assert rung["ckpt"]["bytes_per_shard"] > 0
+    # the 1M rung is always present in the artifact — run or skipped
+    # with the tunnel-session pointer, never silently absent
+    slow = [r for r in rec["rungs"] if r["n"] >= 1_000_000]
+    assert slow and "skipped" in slow[0]
+
+
+@pytest.mark.slow
+def test_scale_sweep_1m_rung(tmp_path):
+    """The flagship rung — tunnel-gated on top of the slow mark: it
+    prices a 1M-node state and belongs to a TPU session."""
+    if os.environ.get("BENCH_SCALE_1M") != "1":
+        pytest.skip("1M rung needs BENCH_SCALE_1M=1 (TPU tunnel session)")
+    out = tmp_path / "sweep_1m.json"
+    env = dict(os.environ)
+    env.update(BENCH_SCALE_N="1000000", BENCH_SCALE_ROUNDS="4",
+               BENCH_SCALE_WARM_RUNS="1")
+    proc = subprocess.run(
+        [sys.executable, SWEEP, "--output", str(out)],
+        capture_output=True, text=True, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(out.read_text())
+    assert rec["ok"], rec.get("problems")
+    assert rec["rungs"][0]["hbm_agree"] is True
