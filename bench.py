@@ -305,6 +305,43 @@ def _worker() -> None:
     jax.block_until_ready(st)
     dt = time.perf_counter() - t0
 
+    # corrocost static provenance (ISSUE 20, after the timed loop so the
+    # abstract traces never pollute the measurement): the per-round flop
+    # price of this SAME config family — fitted over the extents,
+    # checked against the direct jaxpr count at the run's own N, and
+    # projected to the flagship 1M point — plus the compiled sharded
+    # program's cross-shard bytes. BENCH_COST=0 skips all of it (tight
+    # TPU capture windows); failures degrade to None, never kill a
+    # finished measurement.
+    flops_per_round = flops_projected_1m = None
+    flops_projection_agrees = None
+    collective_bytes_per_round = None
+    if os.environ.get("BENCH_COST", "1") != "0":
+        from corrosion_tpu.analysis import collectives as _coll
+        from corrosion_tpu.analysis import cost as _cost
+
+        try:
+            env = {"N": n_nodes, "M": cfg.m_slots}
+            fit = _cost.fit_for_config(cfg)["flops"]
+            direct = _cost.price_per_round(
+                "sharded_scale_run", env, template=cfg)
+            flops_per_round = direct.flops
+            flops_projected_1m = fit.at(
+                {"N": 1_000_000, "M": cfg.m_slots})
+            pred = fit.at(env)
+            if cfg.fused in ("on", "interpret"):
+                # pallas grids are ceil-divisions: piecewise fit, so
+                # the agreement gate is a tolerance, not bit-equality
+                flops_projection_agrees = (
+                    abs(pred - direct.flops) <= direct.flops // 1000)
+            else:
+                flops_projection_agrees = pred == direct.flops
+        except Exception:  # noqa: BLE001 — provenance, not the payload
+            pass
+        if mesh is not None:
+            collective_bytes_per_round = _coll.projected_collective_bytes(
+                cfg, mesh)
+
     rps = reps * rounds / dt
     rec = {
                 "metric": (
@@ -333,6 +370,16 @@ def _worker() -> None:
                 "hbm_bytes_projected": hbm_bytes_projected,
                 "hbm_projection_agrees": hbm_bytes == hbm_bytes_projected,
                 "hbm_bytes_projected_1m": hbm_bytes_projected_1m,
+                # corrocost provenance (ISSUE 20, docs/corrolint.md):
+                # static per-round flop price at this run's own (N, M)
+                # (must agree with the fitted polynomial — the smoke
+                # gate), its flagship 1M projection, and the compiled
+                # sharded program's cross-shard bytes for one round
+                # (None off-mesh or under BENCH_COST=0)
+                "flops_per_round": flops_per_round,
+                "flops_projected_1m": flops_projected_1m,
+                "flops_projection_agrees": flops_projection_agrees,
+                "collective_bytes_per_round": collective_bytes_per_round,
                 # loud fused-path visibility (VERDICT r2 weak #2): a TPU
                 # record measured on the XLA fallback is flagged, not
                 # silently reported as if it were the pallas path —
@@ -567,6 +614,42 @@ def _smoke() -> None:
             obs.close()
         flight = replay_flight_record(obs.flight.path)
     stats = res.stats
+
+    # --- (c) corrocost provenance + agreement gate (ISSUE 20) ------------
+    # the static fit of THIS config family must reproduce the direct
+    # jaxpr count at the smoke's own shape exactly — the cheapest
+    # end-to-end proof that the committed 1M projections price the
+    # program the smoke just ran. The sharded collective manifest is
+    # skipped when the deadline is already crowded (compile-cache-cold
+    # first runs); BENCH_COST=0 skips the whole leg.
+    flops_per_round = flops_projected_1m = None
+    flops_projection_agrees = None
+    collective_bytes_per_round = None
+    if os.environ.get("BENCH_COST", "1") != "0":
+        from corrosion_tpu.analysis import collectives as _coll
+        from corrosion_tpu.analysis import cost as _cost
+
+        try:
+            env = {"N": n_nodes, "M": cfg.m_slots}
+            fit = _cost.fit_for_config(cfg)["flops"]
+            direct = _cost.price_per_round(
+                "sharded_scale_run", env, template=cfg)
+            flops_per_round = direct.flops
+            flops_projected_1m = fit.at(
+                {"N": 1_000_000, "M": cfg.m_slots})
+            pred = fit.at(env)
+            if cfg.fused in ("on", "interpret"):
+                flops_projection_agrees = (
+                    abs(pred - direct.flops) <= direct.flops // 1000)
+            else:
+                flops_projection_agrees = pred == direct.flops
+        except Exception:  # noqa: BLE001 — provenance, not the payload
+            pass
+        if (n_devices > 1
+                and time.perf_counter() - t_start < 0.7 * deadline_s):
+            collective_bytes_per_round = _coll.projected_collective_bytes(
+                cfg, mesh)
+
     elapsed = time.perf_counter() - t_start
     problems = []
     if not donated:
@@ -629,6 +712,13 @@ def _smoke() -> None:
             f"measured HBM {hbm_bytes} != static projection "
             f"{hbm_bytes_projected} at N={n_nodes} (scale-sweep gate)"
         )
+    if flops_projection_agrees is False:
+        # the corrocost smoke gate (ISSUE 20): the committed fit must
+        # price the program the smoke actually dispatched
+        problems.append(
+            f"static flop projection disagrees with the jaxpr count "
+            f"at N={n_nodes} (corrocost gate)"
+        )
     if elapsed > deadline_s:
         problems.append(f"deadline exceeded: {elapsed:.0f}s > {deadline_s:.0f}s")
     rec = {
@@ -664,6 +754,14 @@ def _smoke() -> None:
         "hbm_bytes_projected": hbm_bytes_projected,
         "hbm_projection_agrees": hbm_bytes == hbm_bytes_projected,
         "hbm_bytes_projected_1m": hbm_bytes_projected_1m,
+        # corrocost provenance (ISSUE 20): static per-round flop price
+        # at the smoke shape (gated == the direct jaxpr count above),
+        # its flagship 1M projection, and the sharded program's
+        # cross-shard bytes per round (None single-device / skipped)
+        "flops_per_round": flops_per_round,
+        "flops_projected_1m": flops_projected_1m,
+        "flops_projection_agrees": flops_projection_agrees,
+        "collective_bytes_per_round": collective_bytes_per_round,
         # flight-record replay facts (ISSUE 11): proves the soak leg
         # left a parseable NDJSON whose summary matches the live stats
         "flight": {

@@ -90,6 +90,18 @@ RULES: Dict[str, str] = {
         "trace-time intermediate whose N-degree exceeds every input's "
         "(an N x N pairwise broadcast: fits at 100k, OOMs at 1M)"
     ),
+    # --- v4 corrocost rules (jaxpr/HLO cost & collective auditor) ---
+    "collective-budget": (
+        "explicit cross-shard collective (lax.psum/all_gather/"
+        "with_sharding_constraint/...) in the runtime surface with no "
+        "reasoned DECLARED_COLLECTIVE_SITES entry — cross-shard bytes "
+        "must be argued into the budget, never smuggled"
+    ),
+    "cost-drift": (
+        "state constructor's symbolic shape degree no longer matches "
+        "the degree corrocost's committed cost fits were priced at — "
+        "the static roofline and 1M flop projection are stale"
+    ),
 }
 
 

@@ -1,0 +1,655 @@
+"""corrocost collective auditor (v4, ISSUE 20): every byte that will
+cross a shard boundary is declared, priced, and pinned BEFORE the
+tunnel opens.
+
+The sharded entry points (``parallel/mesh.py`` —
+``SHARDED_ENTRY_POINTS``) contain no explicit collectives: GSPMD infers
+every all-gather/all-reduce/collective-permute while partitioning the
+donated jit. That inference is invisible at the jaxpr tier — the ONLY
+place the real cross-shard traffic exists is the compiled, optimized
+per-device HLO. So this module audits exactly that: it lowers the real
+registered jits (static config, donation intact) on the virtual 8-way
+mesh with abstract ``ShapeDtypeStruct`` arguments carrying
+``NamedSharding``s — no arrays, no execution — and extracts a
+**collective manifest** (op kind -> definition count, operand bytes)
+from ``compiled.as_text()``.
+
+Manifests are gated two ways:
+
+- **kind gate** — every kind that appears must carry a reasoned entry
+  in :data:`COLLECTIVE_BUDGET`; a NEW collective kind fails lint until
+  argued in;
+- **pin gate** — per knob combo, the manifest must match the committed
+  pin **bit for bit** (definition counts AND bytes). GSPMD is
+  deterministic for a fixed program: any drift means the partitioner
+  started moving different bytes, which is exactly the regression this
+  tier exists to catch. ``tests/test_cost.py`` proves the gate fires by
+  smuggling an accidental full-table gather
+  (:func:`smuggled_gather_entry`) past the same audit.
+
+Two mesh layouts are audited: the flat 1-D ``("node",)`` mesh and the
+2-D ``("dcn", "node")`` multihost mesh with the joint
+``P(("dcn", "node"))`` spec. The repo's sharding contract says these
+must compile to the SAME program — the audit asserts the manifests are
+identical (``dcn_matches_flat``), turning a latent invariant into a
+pinned one.
+
+The static half (:func:`check_project`, the ``collective-budget`` lint
+rule) runs with **no jax import**: an AST scan of the runtime surface
+(``sim/``, ``ops/``, ``parallel/``, ``resilience/``) for EXPLICIT
+collective spellings (``lax.psum``, ``all_gather``,
+``with_sharding_constraint``, ...). Today the registry of declared
+sites is EMPTY by design — all cross-shard traffic is GSPMD-inferred —
+so any hand-written collective anywhere in the runtime surface fails
+lint until it is declared with a reason.
+
+CI face: ``scripts/cost_probe.py`` -> ``artifacts/cost_r20.json``
+(full 16-combo knob matrix x both entries); tier-1 runs a reduced
+combo set. Regenerate pins after an intentional change with::
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python -m corrosion_tpu.analysis.collectives --regen
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import functools
+import math
+import os
+import re
+from fractions import Fraction
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from corrosion_tpu.analysis.base import Finding, dotted_name
+from corrosion_tpu.analysis.callgraph import Project
+
+RULE = "collective-budget"
+
+# --------------------------------------------------------------------------
+# static half: explicit collective call sites (no jax — lint engine safe)
+# --------------------------------------------------------------------------
+
+#: qualname -> reason. EMPTY BY DESIGN: the runtime surface contains no
+#: hand-written collectives — GSPMD infers all cross-shard traffic from
+#: shardings, and the pinned manifests below audit what it inferred.
+#: Adding an explicit ``lax.psum``/``all_gather``/
+#: ``with_sharding_constraint`` site means arguing it in HERE with the
+#: reason, and re-pinning the manifests it changes in the same PR.
+DECLARED_COLLECTIVE_SITES: Dict[str, str] = {}
+
+#: call spellings (last dotted component) that move or place bytes
+#: across shards when traced under a mesh
+COLLECTIVE_CALLS = frozenset({
+    "all_gather", "psum", "pmean", "pmax", "pmin", "ppermute",
+    "all_to_all", "psum_scatter", "pshuffle", "pdot", "pbroadcast",
+    "axis_index_groups", "with_sharding_constraint", "reshard",
+})
+
+_SCOPES = ("/sim/", "/ops/", "/parallel/", "/resilience/")
+
+
+def in_scope(path: str) -> bool:
+    """The runtime surface the rule polices. Mirrors
+    ``dtypes.in_scope``: nonexistent paths (lint fixtures) are always
+    in scope so tests can probe the rule with blobs."""
+    ap = os.path.abspath(path)
+    if not os.path.exists(ap):
+        return True
+    return any(s in ap for s in _SCOPES)
+
+
+def _call_sites(tree: ast.AST) -> List[Tuple[int, str]]:
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        if not name:
+            continue
+        last = name.rsplit(".", 1)[-1]
+        if last in COLLECTIVE_CALLS:
+            out.append((node.lineno, last))
+    return out
+
+
+def check_project(project: Project) -> List[Finding]:
+    """``collective-budget`` (static half): every explicit collective
+    spelling in the runtime surface must be a declared, reasoned site.
+    """
+    findings: List[Finding] = []
+    seen_funcs = set()
+    for fn in project.functions.values():
+        if not in_scope(fn.path):
+            continue
+        seen_funcs.add(id(fn.node))
+        for line, call in _call_sites(fn.node):
+            if fn.qualname in DECLARED_COLLECTIVE_SITES:
+                continue
+            findings.append(Finding(
+                path=fn.path, line=line, rule=RULE,
+                message=(
+                    f"explicit collective `{call}` in {fn.qualname} has "
+                    "no DECLARED_COLLECTIVE_SITES entry — cross-shard "
+                    "traffic must be argued into the collective budget, "
+                    "not smuggled"),
+                hint=("declare the site with a reason in "
+                      "analysis/collectives.py and re-pin the manifests "
+                      "it changes (scripts/cost_probe.py)"),
+            ))
+    for mod in project.modules:
+        if not in_scope(mod.path):
+            continue
+        for top in mod.tree.body:
+            if isinstance(top, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                continue  # function/method bodies handled above
+            for line, call in _call_sites(top):
+                findings.append(Finding(
+                    path=mod.path, line=line, rule=RULE,
+                    message=(
+                        f"module-level collective `{call}` in "
+                        f"{mod.name} — import-time cross-shard traffic "
+                        "can never be budgeted"),
+                    hint="move it under a declared entry point",
+                ))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# manifest extraction from compiled HLO
+# --------------------------------------------------------------------------
+
+#: HLO op kinds that move bytes across shards
+COLLECTIVE_HLO_KINDS = (
+    "all-gather", "all-reduce", "reduce-scatter", "collective-permute",
+    "all-to-all", "collective-broadcast",
+)
+
+_KIND_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?\S+ = (\w+)\[([\d,]*)\][^ ]* ("
+    + "|".join(COLLECTIVE_HLO_KINDS) + r")(-start)?\(")
+
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+
+_DTSIZE = {"pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "s32": 4,
+           "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8, "bf16": 2,
+           "f16": 2, "f8e4m3fn": 1, "f8e5m2": 1}
+
+_PAIRS_RE = re.compile(r"source_target_pairs=\{([^}]*(?:\},\{[^}]*)*)\}\}")
+_GROUPS_RE = re.compile(r"replica_groups=\{(\{[^=]*?\})\}")
+_IOTA_RE = re.compile(r"replica_groups=\[(\d+),(\d+)\]<=\[(\d+)\]")
+
+
+def _shape_bytes(dt: str, shape: str) -> int:
+    n = 1
+    for d in shape.split(","):
+        if d:
+            n *= int(d)
+    return n * _DTSIZE.get(dt, 4)
+
+
+def _line_groups(line: str) -> Optional[List[List[int]]]:
+    """Replica groups / permute pairs on an HLO op line, or None when
+    the line carries neither (or a form we do not parse)."""
+    m = _IOTA_RE.search(line)
+    if m:
+        g, k, total = (int(x) for x in m.groups())
+        if g * k != total or "T(" in line[m.end():m.end() + 8]:
+            return None
+        ids = list(range(total))
+        return [ids[i * k:(i + 1) * k] for i in range(g)]
+    m = _GROUPS_RE.search(line)
+    if m:
+        try:
+            return [[int(x) for x in grp.split(",") if x.strip()]
+                    for grp in m.group(1).strip("{}").split("},{")]
+        except ValueError:
+            return None
+    m = _PAIRS_RE.search(line)
+    if m:
+        try:
+            return [[int(x) for x in pair.split(",")]
+                    for pair in m.group(1).strip("{}").split("},{")]
+        except ValueError:
+            return None
+    return None
+
+
+def manifest_from_text(txt: str, dcn_row: int = 0) -> Dict[str, List[int]]:
+    """``{kind: [definition_count, operand_bytes]}`` over an optimized
+    HLO module. ``-start`` halves count once; ``-done`` never counts.
+    With ``dcn_row`` > 0 (devices per dcn row), a third slot counts the
+    bytes whose replica groups SPAN rows — traffic the 2-D mesh would
+    put on the slow axis (unparseable groups count as spanning)."""
+    out: Dict[str, List[int]] = {}
+    for line in txt.splitlines():
+        m = _KIND_RE.match(line)
+        if m is None:
+            if "-done(" in line:
+                continue
+            # -start forms output tuples: `(f32[..], f32[..]) kind-start(`
+            hit = next(
+                (k for k in COLLECTIVE_HLO_KINDS if k + "-start(" in line),
+                None)
+            if hit is None:
+                continue
+            shapes = _SHAPE_RE.findall(line.split("=", 1)[0])
+            b = sum(_shape_bytes(dt, sh) for dt, sh in shapes[:1])
+            kind = hit
+        else:
+            dt, shape, kind, _ = m.groups()
+            b = _shape_bytes(dt, shape)
+        entry = out.setdefault(kind, [0, 0] + ([0] if dcn_row else []))
+        entry[0] += 1
+        entry[1] += b
+        if dcn_row:
+            groups = _line_groups(line)
+            spans = (groups is None or any(
+                len({i // dcn_row for i in g}) > 1 for g in groups))
+            if spans:
+                entry[2] += b
+    return out
+
+
+# --------------------------------------------------------------------------
+# the audited entry points, knob matrix, and pinned budget
+# --------------------------------------------------------------------------
+
+#: the audit shape — ``tracecount``'s canonical small config family
+AUDIT_N = 24
+AUDIT_ROUNDS = 2
+#: N sweep for the per-round traffic fit (single-round programs).
+#: Starts at 48, NOT the audit's 24: at 3 nodes/shard the compiler
+#: emits a structurally different program (even the permute
+#: instruction count differs), so N=24 sits below the asymptotic
+#: traffic line; for N >= 48 every kind is exactly affine (verified
+#: by hand through N=384).
+FIT_NS = (48, 96)
+FIT_HOLDOUT_N = 192
+MESH_DEVICES = 8
+
+
+def audit_config(n: int = AUDIT_N, **knobs):
+    from corrosion_tpu.sim.scale_step import scale_sim_config
+
+    cfg = scale_sim_config(n, m_slots=8, n_origins=4, n_rows=4,
+                           n_cols=2, sync_interval=4)
+    if knobs:
+        cfg = dataclasses.replace(cfg, **knobs).validate()
+    return cfg
+
+
+def knob_matrix() -> List[Tuple[str, Dict[str, object]]]:
+    """The full 16-combo label -> knob dict sweep:
+    quiet x fused(interpret) x narrow_int8 x narrow_q_int8."""
+    out = []
+    for quiet in ("off", "on"):
+        for fused in ("off", "interpret"):
+            for i8 in (False, True):
+                for q8 in (False, True):
+                    label = "-".join(
+                        ["quiet" if quiet == "on" else "dense"]
+                        + (["fused"] if fused == "interpret" else [])
+                        + (["i8"] if i8 else [])
+                        + (["q8"] if q8 else []))
+                    out.append((label, dict(
+                        quiet=quiet, fused=fused, narrow_int8=i8,
+                        narrow_q_int8=q8)))
+    return out
+
+
+#: tier-1's reduced sweep (the probe runs the full matrix)
+TIER1_LABELS = ("dense", "quiet-fused-i8-q8")
+
+
+def have_mesh_devices() -> bool:
+    import jax
+
+    return len(jax.devices()) >= MESH_DEVICES
+
+
+def _mesh(kind: str):
+    import jax
+
+    from corrosion_tpu.parallel import mesh as pmesh
+
+    devs = jax.devices()[:MESH_DEVICES]
+    if kind == "node":
+        return pmesh.make_mesh(devs)
+    if kind == "dcn,node":
+        return pmesh.make_multihost_mesh(2, devs)
+    raise ValueError(f"unknown mesh kind {kind!r}")
+
+
+def sharded_specs(cfg, mesh, rounds: int):
+    """Abstract sharded arguments: ``ShapeDtypeStruct``s carrying the
+    real ``node_sharding`` specs — lowering sees exactly what
+    ``device_put_shards`` would place, with zero bytes allocated."""
+    import jax
+    import jax.random as jr
+
+    from corrosion_tpu.parallel.mesh import node_sharding
+    from corrosion_tpu.sim.scale_step import (
+        ScaleSimState,
+        make_write_inputs,
+    )
+    from corrosion_tpu.sim.transport import NetModel
+
+    sp = node_sharding(mesh, cfg.n_nodes)
+
+    def shard(tree):
+        return jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                           sharding=sp(a)), tree)
+
+    st = shard(jax.eval_shape(lambda: ScaleSimState.create(cfg)))
+    net = shard(jax.eval_shape(
+        lambda: NetModel.create(cfg.n_nodes, drop_prob=0.05)))
+    key = shard(jax.eval_shape(lambda: jr.key(0)))
+    mask = jax.ShapeDtypeStruct((rounds, cfg.n_nodes), bool)
+    inputs = shard(jax.eval_shape(
+        lambda m: make_write_inputs(cfg, jr.key(8), rounds, m), mask))
+    return st, net, key, inputs
+
+
+def lower_entry(name: str, cfg, mesh, rounds: int = AUDIT_ROUNDS,
+                fn: Optional[Callable] = None):
+    """Compile one registered sharded entry (or an override ``fn`` with
+    the ``scale_run_rounds`` signature — the mutation fixtures) against
+    abstract sharded arguments. Donation and the static config travel
+    exactly as the production dispatch sends them."""
+    import jax
+
+    from corrosion_tpu.parallel import mesh as pmesh
+
+    if cfg.fused in ("on", "interpret"):
+        from corrosion_tpu.ops import megakernel
+
+        megakernel.prime_fused(cfg)  # eager probes BEFORE lowering
+    st, net, key, inputs = sharded_specs(cfg, mesh, rounds)
+    if fn is not None:
+        jitted = jax.jit(functools.partial(fn, cfg), donate_argnums=(0,))
+        return jitted.lower(st, net, key, inputs).compile()
+    jitted = pmesh.SHARDED_ENTRY_POINTS[name]
+    if name == "sharded_scale_run_carry":
+        return jitted.lower(cfg, st, key, net, inputs).compile()
+    return jitted.lower(cfg, st, net, key, inputs).compile()
+
+
+def collective_manifest(name: str, label: str = "dense",
+                        mesh_kind: str = "node", n: int = AUDIT_N,
+                        rounds: int = AUDIT_ROUNDS,
+                        fn: Optional[Callable] = None,
+                        dcn_row: int = 0) -> Dict[str, List[int]]:
+    knobs = dict(knob_matrix()).get(label)
+    if knobs is None:
+        raise KeyError(f"unknown knob combo {label!r}")
+    cfg = audit_config(n, **knobs)
+    comp = lower_entry(name, cfg, _mesh(mesh_kind), rounds, fn=fn)
+    return manifest_from_text(comp.as_text(), dcn_row=dcn_row)
+
+
+def smuggled_gather_entry(cfg, st, net, key, inputs):
+    """Mutation fixture: the dense run plus an ACCIDENTAL full-table
+    gather — a replicate constraint on the sharded CRDT store, the
+    classic "small debug read of the whole table" mistake. The audit
+    must fail its pin gate on this (tests/test_cost.py,
+    scripts/cost_probe.py assert it does)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from corrosion_tpu.sim.scale_step import scale_run_rounds
+
+    st2, infos = scale_run_rounds(cfg, st, net, key, inputs)
+    mesh = _mesh("node")
+    gathered = jax.tree.map(
+        lambda a: jax.lax.with_sharding_constraint(
+            a, NamedSharding(mesh, P())), st2.crdt.store)
+    return st2._replace(crdt=st2.crdt._replace(store=gathered)), infos
+
+
+# --------------------------------------------------------------------------
+# the budget registry: reasoned kinds + bit-for-bit pins
+# --------------------------------------------------------------------------
+
+#: why each collective kind is allowed to exist in the lowered modules.
+#: A kind absent here failing the gate is the POINT: new cross-shard
+#: traffic gets argued in with a reason, or it does not ship.
+COLLECTIVE_KIND_REASONS: Dict[str, str] = {
+    "all-gather": (
+        "GSPMD materializes row views for the cross-node reads the "
+        "round genuinely needs (sync peer sampling, membership views): "
+        "bounded per-lane gathers, never the CRDT store"),
+    "all-reduce": (
+        "node-axis reductions for round infos and convergence metrics "
+        "(alive counts, needs totals) — scalar-per-round traffic"),
+    "collective-permute": (
+        "neighbor rotations GSPMD inserts for peer-indexed lane "
+        "shuffles (ring reads of per-node lanes)"),
+    "reduce-scatter": (
+        "fused reduce+shard GSPMD may emit instead of "
+        "all-reduce+slice for node-sharded reduction outputs"),
+}
+
+#: {entry: {label: {kind: [defs, bytes]}}} at the audit shape
+#: (N=24, m_slots=8, rounds=2, 8-way mesh). Machine-generated — run
+#: ``python -m corrosion_tpu.analysis.collectives --regen`` after an
+#: intentional change and paste, with the PR arguing the delta.
+COLLECTIVE_PINS: Dict[str, Dict[str, Dict[str, List[int]]]] = {
+    "sharded_scale_run": {
+        "dense": {"all-gather": [115, 75440], "all-reduce": [63, 15399], "collective-permute": [135, 3298]},
+        "dense-q8": {"all-gather": [115, 74576], "all-reduce": [63, 15399], "collective-permute": [135, 3298]},
+        "dense-i8": {"all-gather": [115, 75440], "all-reduce": [63, 15399], "collective-permute": [135, 3298]},
+        "dense-i8-q8": {"all-gather": [115, 74576], "all-reduce": [63, 15399], "collective-permute": [135, 3298]},
+        "dense-fused": {"all-gather": [50, 24608], "all-reduce": [53, 10391], "collective-permute": [131, 3228]},
+        "dense-fused-q8": {"all-gather": [50, 24512], "all-reduce": [53, 10391], "collective-permute": [131, 3228]},
+        "dense-fused-i8": {"all-gather": [50, 24608], "all-reduce": [53, 10391], "collective-permute": [131, 3228]},
+        "dense-fused-i8-q8": {"all-gather": [50, 24512], "all-reduce": [53, 10391], "collective-permute": [131, 3228]},
+        "quiet": {"all-gather": [115, 75440], "all-reduce": [85, 15778], "collective-permute": [153, 3418]},
+        "quiet-q8": {"all-gather": [115, 74576], "all-reduce": [85, 15778], "collective-permute": [153, 3418]},
+        "quiet-i8": {"all-gather": [115, 75440], "all-reduce": [85, 15778], "collective-permute": [153, 3418]},
+        "quiet-i8-q8": {"all-gather": [115, 74576], "all-reduce": [85, 15778], "collective-permute": [153, 3418]},
+        "quiet-fused": {"all-gather": [50, 24608], "all-reduce": [75, 10770], "collective-permute": [149, 3348]},
+        "quiet-fused-q8": {"all-gather": [50, 24512], "all-reduce": [75, 10770], "collective-permute": [149, 3348]},
+        "quiet-fused-i8": {"all-gather": [50, 24608], "all-reduce": [75, 10770], "collective-permute": [149, 3348]},
+        "quiet-fused-i8-q8": {"all-gather": [50, 24512], "all-reduce": [75, 10770], "collective-permute": [149, 3348]},
+    },
+    "sharded_scale_run_carry": {
+        "dense": {"all-gather": [115, 75440], "all-reduce": [63, 15399], "collective-permute": [135, 3298]},
+        "dense-q8": {"all-gather": [115, 74576], "all-reduce": [63, 15399], "collective-permute": [135, 3298]},
+        "dense-i8": {"all-gather": [115, 75440], "all-reduce": [63, 15399], "collective-permute": [135, 3298]},
+        "dense-i8-q8": {"all-gather": [115, 74576], "all-reduce": [63, 15399], "collective-permute": [135, 3298]},
+        "dense-fused": {"all-gather": [50, 24608], "all-reduce": [53, 10391], "collective-permute": [131, 3228]},
+        "dense-fused-q8": {"all-gather": [50, 24512], "all-reduce": [53, 10391], "collective-permute": [131, 3228]},
+        "dense-fused-i8": {"all-gather": [50, 24608], "all-reduce": [53, 10391], "collective-permute": [131, 3228]},
+        "dense-fused-i8-q8": {"all-gather": [50, 24512], "all-reduce": [53, 10391], "collective-permute": [131, 3228]},
+        "quiet": {"all-gather": [115, 75440], "all-reduce": [85, 15778], "collective-permute": [153, 3418]},
+        "quiet-q8": {"all-gather": [115, 74576], "all-reduce": [85, 15778], "collective-permute": [153, 3418]},
+        "quiet-i8": {"all-gather": [115, 75440], "all-reduce": [85, 15778], "collective-permute": [153, 3418]},
+        "quiet-i8-q8": {"all-gather": [115, 74576], "all-reduce": [85, 15778], "collective-permute": [153, 3418]},
+        "quiet-fused": {"all-gather": [50, 24608], "all-reduce": [75, 10770], "collective-permute": [149, 3348]},
+        "quiet-fused-q8": {"all-gather": [50, 24512], "all-reduce": [75, 10770], "collective-permute": [149, 3348]},
+        "quiet-fused-i8": {"all-gather": [50, 24608], "all-reduce": [75, 10770], "collective-permute": [149, 3348]},
+        "quiet-fused-i8-q8": {"all-gather": [50, 24512], "all-reduce": [75, 10770], "collective-permute": [149, 3348]},
+    },
+}
+
+COLLECTIVE_BUDGET = {
+    "sharded_scale_run": {
+        "kinds": COLLECTIVE_KIND_REASONS,
+        "pins": COLLECTIVE_PINS.get("sharded_scale_run", {}),
+    },
+    "sharded_scale_run_carry": {
+        "kinds": COLLECTIVE_KIND_REASONS,
+        "pins": COLLECTIVE_PINS.get("sharded_scale_run_carry", {}),
+    },
+}
+
+
+def check_manifest(entry: str, label: str,
+                   man: Dict[str, List[int]]) -> List[str]:
+    """Kind gate + bit-for-bit pin gate; returns problem strings."""
+    problems: List[str] = []
+    budget = COLLECTIVE_BUDGET[entry]
+    for kind in sorted(man):
+        if kind not in budget["kinds"]:
+            problems.append(
+                f"{entry}/{label}: collective kind `{kind}` has no "
+                "reasoned COLLECTIVE_KIND_REASONS entry")
+    pin = budget["pins"].get(label)
+    if pin is None:
+        problems.append(f"{entry}/{label}: no committed pin")
+        return problems
+    got = {k: list(v[:2]) for k, v in man.items()}
+    want = {k: list(v[:2]) for k, v in pin.items()}
+    if got != want:
+        problems.append(
+            f"{entry}/{label}: manifest drifted — got {got}, "
+            f"pinned {want}")
+    return problems
+
+
+def audit_entry(entry: str,
+                labels: Optional[Sequence[str]] = None,
+                mesh_kinds: Sequence[str] = ("node", "dcn,node")) -> dict:
+    """Audit one entry across combos and mesh layouts. The flat and 2-D
+    manifests must be identical (same program — the sharding contract);
+    pins are stored once and gate both."""
+    labels = list(labels or [lb for lb, _ in knob_matrix()])
+    rec = {"entry": entry, "labels": {}, "problems": []}
+    for label in labels:
+        flat = collective_manifest(entry, label, "node")
+        lrec = {"manifest": {k: list(v) for k, v in sorted(flat.items())}}
+        if "dcn,node" in mesh_kinds:
+            dcn = collective_manifest(entry, label, "dcn,node",
+                                      dcn_row=MESH_DEVICES // 2)
+            lrec["dcn_matches_flat"] = (
+                {k: v[:2] for k, v in dcn.items()}
+                == {k: list(v) for k, v in flat.items()})
+            lrec["dcn_cross_row_bytes"] = {
+                k: v[2] for k, v in sorted(dcn.items())}
+            if not lrec["dcn_matches_flat"]:
+                rec["problems"].append(
+                    f"{entry}/{label}: 2-D (dcn,node) mesh compiled a "
+                    "DIFFERENT collective manifest than the flat mesh")
+        probs = check_manifest(entry, label, flat)
+        lrec["pin_ok"] = not probs
+        rec["problems"].extend(probs)
+        rec["labels"][label] = lrec
+    return rec
+
+
+# --------------------------------------------------------------------------
+# per-round traffic fit and 1M projection
+# --------------------------------------------------------------------------
+
+
+def per_round_manifest(entry: str = "sharded_scale_run",
+                       label: str = "dense",
+                       n: int = AUDIT_N) -> Dict[str, List[int]]:
+    """The SINGLE-round program's manifest: a static per-round upper
+    bound on cross-shard traffic (loop-body collectives execute once
+    per round; boundary collectives are amortized upper-bounded)."""
+    return collective_manifest(entry, label, "node", n=n, rounds=1)
+
+
+def collective_fit(entry: str = "sharded_scale_run",
+                   label: str = "dense") -> dict:
+    """Per-kind polynomial fit of single-round collective BYTES in N
+    over :data:`FIT_NS`, holdout-verified at :data:`FIT_HOLDOUT_N`,
+    projected to the 1M point. Affine first (exact holdout required);
+    quadratic fallback through all three N when traffic is genuinely
+    superlinear (recorded — the roofline then says so)."""
+    ns = list(FIT_NS) + [FIT_HOLDOUT_N]
+    mans = {n: per_round_manifest(entry, label, n) for n in ns}
+    kinds = sorted({k for m in mans.values() for k in m})
+    out = {"entry": entry, "label": label, "ns": ns, "kinds": {},
+           "projected_1m_bytes": 0}
+    for kind in kinds:
+        ys = {n: mans[n].get(kind, [0, 0])[1] for n in ns}
+        n1, n2 = FIT_NS
+        b = Fraction(ys[n2] - ys[n1], n2 - n1)
+        a = Fraction(ys[n1]) - b * n1
+        exact = a + b * FIT_HOLDOUT_N == ys[FIT_HOLDOUT_N]
+        if exact:
+            proj = a + b * 1_000_000
+            rec = {"poly": f"{a} + {b}*N", "degree": 1, "exact": True}
+        else:
+            # quadratic through all three points — no holdout left, so
+            # the projection is flagged as unverified extrapolation
+            x1, x2, x3 = ns
+            d = Fraction(
+                (ys[x3] - ys[x1]) * (x2 - x1)
+                - (ys[x2] - ys[x1]) * (x3 - x1),
+                (x3 - x1) * (x3 - x2) * (x2 - x1))
+            b2 = Fraction(ys[x2] - ys[x1], x2 - x1) - d * (x1 + x2)
+            a2 = Fraction(ys[x1]) - b2 * x1 - d * x1 * x1
+            proj = a2 + b2 * 1_000_000 + d * 1_000_000 ** 2
+            rec = {"poly": f"{a2} + {b2}*N + {d}*N^2", "degree": 2,
+                   "exact": False}
+        rec["bytes_at"] = {str(n): ys[n] for n in ns}
+        rec["projected_1m"] = int(proj)
+        out["kinds"][kind] = rec
+        out["projected_1m_bytes"] += int(proj)
+    out["all_exact"] = all(r["exact"] for r in out["kinds"].values())
+    return out
+
+
+def projected_collective_bytes(cfg, mesh, entry_fn=None,
+                               rounds: int = 1) -> Optional[int]:
+    """Per-round cross-shard bytes of a LIVE run's program (the bench
+    ``collective_bytes_per_round`` field): lower the measured config on
+    the measured mesh for one round and sum the manifest. Returns None
+    when lowering fails (e.g. exotic backends) — provenance degrades,
+    benches never crash."""
+    import jax
+
+    from corrosion_tpu.sim.scale_step import scale_run_rounds
+
+    try:
+        if cfg.fused in ("on", "interpret"):
+            from corrosion_tpu.ops import megakernel
+
+            megakernel.prime_fused(cfg)
+        st, net, key, inputs = sharded_specs(cfg, mesh, rounds)
+        fn = entry_fn or scale_run_rounds
+        comp = jax.jit(functools.partial(fn, cfg),
+                       donate_argnums=(0,)).lower(
+            st, net, key, inputs).compile()
+        man = manifest_from_text(comp.as_text())
+        return sum(v[1] for v in man.values())
+    except Exception:
+        return None
+
+
+def _regen(entries=("sharded_scale_run", "sharded_scale_run_carry"),
+           labels: Optional[Sequence[str]] = None) -> str:
+    """Print the COLLECTIVE_PINS literal for the current tree."""
+    labels = list(labels or [lb for lb, _ in knob_matrix()])
+    lines = ["COLLECTIVE_PINS: Dict[str, Dict[str, Dict[str, "
+             "List[int]]]] = {"]
+    for entry in entries:
+        lines.append(f'    "{entry}": {{')
+        for label in labels:
+            man = collective_manifest(entry, label, "node")
+            body = ", ".join(
+                f'"{k}": {list(v)}' for k, v in sorted(man.items()))
+            lines.append(f'        "{label}": {{{body}}},')
+        lines.append("    },")
+    lines.append("}")
+    return "\n".join(lines)
+
+
+if __name__ == "__main__":  # pragma: no cover - maintenance CLI
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--regen", action="store_true")
+    ap.add_argument("--labels", default=None,
+                    help="comma-separated combo labels (default: all)")
+    args = ap.parse_args()
+    if args.regen:
+        labels = args.labels.split(",") if args.labels else None
+        print(_regen(labels=labels))

@@ -1,0 +1,709 @@
+"""corrocost cost model (v4, ISSUE 20): price every hot entry point's
+jaxpr in flops and HBM-model bytes, fit the counts to polynomials in
+the config extents, and project the declared 1M point as a static
+roofline — before any hardware sees the program.
+
+corrobudget (``shapes.py``) prices what the state *is*; this tier
+prices what one round *does*. The two must agree on growth: a table the
+inventory prices at degree N must not be touched by compute of a higher
+degree, and compute must never outgrow the inventory (an N×N pairwise
+intermediate shows up here as a fitted N²-term long before it OOMs).
+
+Methodology — deliberately simple, so the counts stay *exactly*
+polynomial in the extents and the fits are interpolations, not
+regressions:
+
+- every primitive's flop cost is ``weight × element count`` with a
+  small per-primitive weight table (``dot_general`` gets the real
+  ``2·m·n·k``); weights are constants, never ``log`` terms, so a fit
+  that reproduces held-out points proves the cost *function* is the
+  fitted polynomial, not approximately near it;
+- HBM-model bytes are the unfused upper bound: every equation reads
+  its inputs and writes its outputs once. XLA fuses most of that away —
+  the ``lowered.compile().cost_analysis()`` cross-check (see
+  ``xla_agreement``) bounds the constant-factor slack;
+- control flow: ``scan`` multiplies its body by the static trip count,
+  ``cond`` takes the most expensive branch (the roofline branch),
+  ``pallas_call`` multiplies the kernel body by the grid,
+  ``while`` bodies count once (trip count is dynamic — recorded).
+
+The module imports jax ONLY inside tracing helpers: the lint engine
+(``runner.py``) registers :func:`check_project` (the ``cost-drift``
+rule), which is pure AST + symbolic arithmetic and must work with no
+backend, no devices, and no jax import — exactly like ``mem-budget``.
+
+Tier-1 gates live in ``tests/test_cost.py``; the CI face is
+``scripts/cost_probe.py`` -> ``artifacts/cost_r20.json``
+(docs/corrolint.md, "corrocost").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from fractions import Fraction
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from corrosion_tpu.analysis import shapes
+from corrosion_tpu.analysis.base import Finding
+from corrosion_tpu.analysis.callgraph import Project
+from corrosion_tpu.analysis.shapes import index_classes
+
+RULE = "cost-drift"
+
+#: state root -> the extent degrees corrocost's fitted polynomials have
+#: (and therefore the degrees the SYMBOLIC inventory must have: compute
+#: scales with the tables it touches, nothing superlinear hides). A PR
+#: that changes a constructor's growth must re-price the fits
+#: (``scripts/cost_probe.py``) and update this registry in the same
+#: change — the ``cost-drift`` lint rule holds the two together.
+COST_DEGREES: Dict[str, Dict[str, int]] = {
+    # scale state: every plane is [N], [N, M] or smaller — one round is
+    # bilinear in (N, M)
+    "ScaleSimState": {"N": 1, "M": 1},
+    # full-view state: the [N, N] membership plane is the design
+    # (sim/swim.py) — one round is quadratic in N. Slot planes keep the
+    # inventory degree-1 in M; the full tier's fit sweeps N only and
+    # holds M at the template (the scale tier owns the M axis).
+    "SimState": {"N": 2, "M": 1},
+}
+
+#: the declared flagship projection point (shared with corrobudget —
+#: kept equal to ``shapes.HBM_BUDGET["point"]`` by tests/test_cost.py)
+ROOFLINE_POINT: Dict[str, int] = {"N": 1_000_000, "M": 64}
+
+
+# --------------------------------------------------------------------------
+# the per-primitive cost counter
+# --------------------------------------------------------------------------
+
+#: pure data-movement primitives: 0 flops (bytes still counted)
+_ZERO_FLOP = frozenset({
+    "broadcast_in_dim", "reshape", "squeeze", "transpose", "rev",
+    "slice", "dynamic_slice", "concatenate", "pad", "iota", "copy",
+    "gather", "bitcast_convert_type", "stop_gradient",
+    "optimization_barrier", "expand_dims", "device_put",
+})
+
+#: flop weight per OUTPUT element for primitives that are not 1/element.
+#: Constants by design (no log terms): see the module docstring.
+_FLOP_WEIGHTS = {
+    "sort": 8,          # stand-in for the comparator network depth
+    "top_k": 4,
+    "random_bits": 16,  # threefry rounds per emitted word
+    "random_fold_in": 16,
+    "random_split": 16,
+    "random_wrap": 0,
+    "random_unwrap": 0,
+    "population_count": 1,
+    "clz": 1,
+}
+
+#: reductions price at the INPUT size (one combine per input element)
+_INPUT_SIZED = frozenset({
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_and", "reduce_or",
+    "reduce_prod", "argmax", "argmin", "cumsum", "cummax", "cummin",
+    "cumprod", "reduce_precision",
+})
+
+
+def _size(aval) -> int:
+    shape = getattr(aval, "shape", None)
+    if shape is None:
+        return 1
+    return math.prod(shape) if shape else 1
+
+
+def _nbytes(aval) -> int:
+    dtype = getattr(aval, "dtype", None)
+    if dtype is None:
+        return 0
+    return _size(aval) * dtype.itemsize
+
+
+@dataclasses.dataclass(frozen=True)
+class CostCount:
+    """One jaxpr's priced totals (the unit every fit interpolates)."""
+
+    flops: int
+    hbm_bytes: int
+    eqns: int
+    while_loops: int = 0  # bodies counted once — dynamic trip counts
+
+    def minus(self, other: "CostCount") -> "CostCount":
+        return CostCount(self.flops - other.flops,
+                         self.hbm_bytes - other.hbm_bytes,
+                         self.eqns - other.eqns,
+                         max(self.while_loops, other.while_loops))
+
+
+def _dot_flops(eqn) -> int:
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    lhs = eqn.invars[0].aval.shape
+    batch = math.prod(lhs[d] for d in lb) if lb else 1
+    k = math.prod(lhs[d] for d in lc) if lc else 1
+    out = _size(eqn.outvars[0].aval)
+    return 2 * out * k * (1 if batch else 1)
+
+
+def _branch_cost(closed, mult: int) -> CostCount:
+    acc = {"flops": 0, "hbm_bytes": 0, "eqns": 0, "while_loops": 0}
+    _walk(closed.jaxpr if hasattr(closed, "jaxpr") else closed, acc, mult)
+    return CostCount(**acc)
+
+
+def _walk(jaxpr, acc: Dict[str, int], mult: int) -> None:
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name == "scan":
+            _walk(eqn.params["jaxpr"].jaxpr, acc,
+                  mult * int(eqn.params["length"]))
+            continue
+        if name == "while":
+            acc["while_loops"] += 1
+            _walk(eqn.params["cond_jaxpr"].jaxpr, acc, mult)
+            _walk(eqn.params["body_jaxpr"].jaxpr, acc, mult)
+            continue
+        if name == "cond":
+            # the roofline branch: whichever arm prices highest
+            costs = [_branch_cost(br, mult)
+                     for br in eqn.params["branches"]]
+            worst = max(costs, key=lambda c: (c.flops, c.hbm_bytes))
+            acc["flops"] += worst.flops
+            acc["hbm_bytes"] += worst.hbm_bytes
+            acc["eqns"] += worst.eqns
+            acc["while_loops"] += worst.while_loops
+            continue
+        if name == "pallas_call":
+            grid = eqn.params["grid_mapping"].grid
+            cells = math.prod(int(g) for g in grid) if grid else 1
+            inner = eqn.params["jaxpr"]
+            _walk(inner.jaxpr if hasattr(inner, "jaxpr") else inner,
+                  acc, mult * cells)
+            continue
+        recursed = False
+        for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+            inner = eqn.params.get(key)
+            if inner is not None:
+                _walk(inner.jaxpr if hasattr(inner, "jaxpr") else inner,
+                      acc, mult)
+                recursed = True
+                break
+        if recursed:
+            continue
+        out_elems = sum(_size(v.aval) for v in eqn.outvars)
+        if name == "dot_general":
+            flops = _dot_flops(eqn)
+        elif name in _ZERO_FLOP:
+            flops = 0
+        elif name in _INPUT_SIZED:
+            flops = sum(_size(v.aval) for v in eqn.invars
+                        if hasattr(v, "aval"))
+        elif name.startswith("scatter"):
+            # work scales with the UPDATES, not the operand being
+            # scattered into (operand, indices, updates)
+            flops = (_size(eqn.invars[2].aval)
+                     if len(eqn.invars) >= 3 else out_elems)
+        else:
+            flops = _FLOP_WEIGHTS.get(name, 1) * out_elems
+        io = sum(_nbytes(v.aval) for v in eqn.invars
+                 if hasattr(v, "aval"))
+        io += sum(_nbytes(v.aval) for v in eqn.outvars)
+        acc["flops"] += mult * flops
+        acc["hbm_bytes"] += mult * io
+        acc["eqns"] += 1
+
+
+def count_jaxpr(closed) -> CostCount:
+    """Price a closed jaxpr with the corrocost model."""
+    acc = {"flops": 0, "hbm_bytes": 0, "eqns": 0, "while_loops": 0}
+    _walk(closed.jaxpr, acc, 1)
+    return CostCount(**acc)
+
+
+# --------------------------------------------------------------------------
+# the priced entry-point registry
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class PricedEntry:
+    """One hot entry point's pricing recipe.
+
+    ``build(cfg, rounds)`` -> closed jaxpr (abstract trace — no arrays,
+    no devices: a 1M-node trace costs ~2s and a few MB of constants).
+    ``scan`` entries take a per-dispatch round count; step entries
+    ignore it. ``template`` builds the config family the fit sweeps —
+    replace only the extents, keep every knob."""
+
+    name: str
+    root: str                       # COST_DEGREES key it is gated against
+    extents: Tuple[str, ...]        # fit symbols
+    scanned: bool                   # True: per-round = marginal round
+    template: Callable[[], object]
+    build: Callable[[object, int], object]
+    #: False for entries whose cost is only PIECEWISE polynomial (the
+    #: fused path: pallas grids are ceil-divisions of N, so tail
+    #: masking wobbles the count ~1e-4 between grid-aligned points).
+    #: Their roofline uses a DIRECT 1M abstract trace as truth and
+    #: reports the fit's relative error instead of demanding bit-equal
+    #: extrapolation.
+    exact_fit: bool = True
+
+
+def _flagship_cfg():
+    from corrosion_tpu.sim.scale_step import scale_sim_config
+
+    return scale_sim_config(100_000)
+
+
+def _full_cfg():
+    from corrosion_tpu.sim.config import SimConfig
+
+    # the tracecount harness's full-view shape (tracecount._full_cfg)
+    return SimConfig(n_nodes=12, n_origins=4, n_rows=4, n_cols=2,
+                     tx_max_cells=2)
+
+
+def config_at(template, env: Dict[str, int]):
+    """The template config with its extents rebound (validated)."""
+    kw = {}
+    if "N" in env:
+        kw["n_nodes"] = int(env["N"])
+    if "M" in env and hasattr(template, "m_slots"):
+        kw["m_slots"] = int(env["M"])
+    return dataclasses.replace(template, **kw).validate()
+
+
+def _scale_specs(cfg, rounds: int):
+    import jax
+    import jax.random as jr
+
+    from corrosion_tpu.sim.scale_step import (
+        ScaleSimState,
+        make_write_inputs,
+    )
+    from corrosion_tpu.sim.transport import NetModel
+
+    st = jax.eval_shape(lambda: ScaleSimState.create(cfg))
+    net = jax.eval_shape(
+        lambda: NetModel.create(cfg.n_nodes, drop_prob=0.05))
+    key = jax.eval_shape(lambda: jr.key(0))
+    mask = jax.ShapeDtypeStruct((rounds, cfg.n_nodes), bool)
+    inputs = jax.eval_shape(
+        lambda m: make_write_inputs(cfg, jr.key(8), rounds, m), mask)
+    return st, net, key, inputs
+
+
+def _trace_scale_step(cfg, rounds: int):
+    import functools
+
+    import jax
+
+    from corrosion_tpu.sim.scale_step import ScaleRoundInput, scale_sim_step
+
+    st, net, key, _ = _scale_specs(cfg, 1)
+    inp = jax.eval_shape(lambda: ScaleRoundInput.quiet(cfg))
+    return jax.make_jaxpr(functools.partial(scale_sim_step, cfg))(
+        st, net, key, inp)
+
+
+def _trace_scale_run(cfg, rounds: int):
+    import functools
+
+    import jax
+
+    from corrosion_tpu.sim.scale_step import scale_run_rounds
+
+    if cfg.fused in ("on", "interpret"):
+        from corrosion_tpu.ops import megakernel
+
+        megakernel.prime_fused(cfg)  # eager probes BEFORE the trace
+    st, net, key, inputs = _scale_specs(cfg, rounds)
+    return jax.make_jaxpr(functools.partial(scale_run_rounds, cfg))(
+        st, net, key, inputs)
+
+
+def _trace_scale_run_carry(cfg, rounds: int):
+    import functools
+
+    import jax
+
+    from corrosion_tpu.sim.scale_step import scale_run_rounds_carry
+
+    if cfg.fused in ("on", "interpret"):
+        from corrosion_tpu.ops import megakernel
+
+        megakernel.prime_fused(cfg)
+    st, net, key, inputs = _scale_specs(cfg, rounds)
+    return jax.make_jaxpr(functools.partial(scale_run_rounds_carry, cfg))(
+        st, net, key, inputs)
+
+
+def _with(factory, **knobs):
+    def template():
+        return dataclasses.replace(factory(), **knobs).validate()
+
+    return template
+
+
+def _trace_full_step(cfg, rounds: int):
+    import functools
+
+    import jax
+    import jax.random as jr
+
+    from corrosion_tpu.sim.step import RoundInput, SimState, sim_step
+    from corrosion_tpu.sim.transport import NetModel
+
+    st = jax.eval_shape(lambda: SimState.create(cfg))
+    net = jax.eval_shape(lambda: NetModel.create(cfg.n_nodes))
+    key = jax.eval_shape(lambda: jr.key(0))
+    inp = jax.eval_shape(lambda: RoundInput.quiet(cfg))
+    return jax.make_jaxpr(functools.partial(sim_step, cfg))(
+        st, net, key, inp)
+
+
+#: every entry point the bench/tracecount registries care about, priced.
+#: ``tracecount.HOT_ENTRY_POINTS`` must stay a SUBSET of this dict
+#: (tests/test_cost.py coverage gate): registering a new hot entry
+#: without pricing it fails tier-1. ``sharded_scale_run``'s jaxpr is
+#: placement-independent (sharding changes collectives, not the traced
+#: program) — its cross-shard traffic is priced by
+#: ``analysis/collectives.py`` on the real lowered modules.
+PRICED_ENTRY_POINTS: Dict[str, PricedEntry] = {
+    "full_sim_step": PricedEntry(
+        "full_sim_step", "SimState", ("N",), False,
+        _full_cfg, _trace_full_step),
+    "scale_sim_step": PricedEntry(
+        "scale_sim_step", "ScaleSimState", ("N", "M"), False,
+        _flagship_cfg, _trace_scale_step),
+    "segment_dispatch": PricedEntry(
+        "segment_dispatch", "ScaleSimState", ("N", "M"), True,
+        _flagship_cfg, _trace_scale_run_carry),
+    "segmented_soak": PricedEntry(
+        # the soak runner dispatches the SAME donated carry program as
+        # segment_dispatch — priced under its registered name so the
+        # coverage gate stays a set relation, not a special case
+        "segmented_soak", "ScaleSimState", ("N", "M"), True,
+        _flagship_cfg, _trace_scale_run_carry),
+    "sharded_scale_run": PricedEntry(
+        "sharded_scale_run", "ScaleSimState", ("N", "M"), True,
+        _flagship_cfg, _trace_scale_run),
+    "fused_scale_run": PricedEntry(
+        "fused_scale_run", "ScaleSimState", ("N", "M"), True,
+        _with(_flagship_cfg, fused="interpret"), _trace_scale_run,
+        exact_fit=False),
+    "quiet_scale_run": PricedEntry(
+        "quiet_scale_run", "ScaleSimState", ("N", "M"), True,
+        _with(_flagship_cfg, quiet="on"), _trace_scale_run_carry),
+}
+
+#: per-dispatch round count the scan fits trace at (marginal = r2 - r1)
+_FIT_ROUNDS = 2
+
+
+def price_entry(name: str, env: Dict[str, int],
+                rounds: Optional[int] = None,
+                template=None) -> CostCount:
+    """Price one entry at concrete extents (one abstract trace)."""
+    entry = PRICED_ENTRY_POINTS[name]
+    cfg = config_at(template if template is not None else entry.template(),
+                    env)
+    return count_jaxpr(entry.build(cfg, rounds or _FIT_ROUNDS))
+
+
+def price_per_round(name: str, env: Dict[str, int],
+                    template=None) -> CostCount:
+    """The marginal cost of ONE round: scan entries price at 2 rounds
+    and 1 round and difference (exactly the scan body's contribution);
+    step entries price the step itself."""
+    entry = PRICED_ENTRY_POINTS[name]
+    if not entry.scanned:
+        return price_entry(name, env, template=template)
+    two = price_entry(name, env, rounds=2, template=template)
+    one = price_entry(name, env, rounds=1, template=template)
+    return two.minus(one)
+
+
+# --------------------------------------------------------------------------
+# exact polynomial fits over the extents
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class CostFit:
+    """An exact interpolating polynomial for one entry × metric.
+
+    ``basis`` holds monomial exponent tuples aligned with ``extents``
+    (e.g. ``((0, 0), (1, 0), (0, 1), (1, 1))`` = 1, N, M, NM).
+    ``exact`` is True when every HELD-OUT point reproduced bit-for-bit —
+    the proof that the cost function IS this polynomial on the swept
+    family, which is what licenses the 1M extrapolation."""
+
+    entry: str
+    metric: str
+    extents: Tuple[str, ...]
+    basis: Tuple[Tuple[int, ...], ...]
+    coeffs: Tuple[Fraction, ...]
+    points: Tuple[Tuple[int, ...], ...]
+    holdouts: Tuple[Tuple[int, ...], ...]
+    exact: bool
+
+    def at(self, env: Dict[str, int]) -> int:
+        total = Fraction(0)
+        for expts, c in zip(self.basis, self.coeffs):
+            term = c
+            for sym, e in zip(self.extents, expts):
+                term *= Fraction(env[sym]) ** e
+            total += term
+        if total.denominator != 1:
+            raise ValueError(f"non-integer cost at {env}: {total}")
+        return int(total)
+
+    def degree(self, sym: str) -> int:
+        if sym not in self.extents:
+            return 0
+        i = self.extents.index(sym)
+        return max((e[i] for e, c in zip(self.basis, self.coeffs)
+                    if c != 0), default=0)
+
+    def render(self) -> str:
+        parts = []
+        for expts, c in zip(self.basis, self.coeffs):
+            if c == 0:
+                continue
+            mono = "*".join(
+                sym if e == 1 else f"{sym}^{e}"
+                for sym, e in zip(self.extents, expts) if e)
+            parts.append(f"{c}{'*' + mono if mono else ''}")
+        return " + ".join(parts) or "0"
+
+
+def _solve(rows: List[List[Fraction]],
+           ys: List[Fraction]) -> List[Fraction]:
+    """Exact Gaussian elimination (the systems are 3x3 / 4x4)."""
+    n = len(rows)
+    aug = [list(r) + [y] for r, y in zip(rows, ys)]
+    for i in range(n):
+        piv = next((r for r in range(i, n) if aug[r][i] != 0), None)
+        if piv is None:
+            raise ValueError("singular fit system — degenerate points")
+        aug[i], aug[piv] = aug[piv], aug[i]
+        inv = aug[i][i]
+        aug[i] = [x / inv for x in aug[i]]
+        for r in range(n):
+            if r != i and aug[r][i] != 0:
+                f = aug[r][i]
+                aug[r] = [a - f * b for a, b in zip(aug[r], aug[i])]
+    return [aug[r][n] for r in range(n)]
+
+
+def _fit_points(entry: PricedEntry, template) -> Tuple[
+        Tuple[Tuple[int, ...], ...], Tuple[Tuple[int, ...], ...],
+        Tuple[Tuple[int, ...], ...]]:
+    """(basis, fit points, holdout points) for the entry's extents,
+    scaled so every point validates against the template config."""
+    if entry.extents == ("N",):
+        # full view: quadratic in N. Points respect n_origins <= N.
+        n0 = max(8, getattr(template, "n_origins", 4) * 2)
+        basis = ((0,), (1,), (2,))
+        pts = ((n0,), (2 * n0,), (4 * n0,))
+        hold = ((3 * n0,),)
+        return basis, pts, hold
+    n0 = 64
+    n_origins = getattr(template, "n_origins", 16)
+    while n0 < max(n_origins, 2 * getattr(template, "sync_peers", 0)):
+        n0 *= 2
+    m0 = getattr(template, "m_slots", 64)
+    basis = ((0, 0), (1, 0), (0, 1), (1, 1))
+    pts = ((n0, m0), (2 * n0, m0), (n0, 2 * m0), (2 * n0, 2 * m0))
+    hold = ((3 * n0, m0), (n0, 3 * m0))
+    return basis, pts, hold
+
+
+def fit_entry(name: str, template=None) -> Dict[str, CostFit]:
+    """Exact per-round fits for one entry: ``{"flops": CostFit,
+    "hbm_bytes": CostFit}``. Every fit interpolates the fit points and
+    verifies the holdouts; ``exact`` records whether the holdouts
+    reproduced (the probe and tier-1 gate on it)."""
+    entry = PRICED_ENTRY_POINTS[name]
+    template = template if template is not None else entry.template()
+    basis, pts, hold = _fit_points(entry, template)
+    counts = {p: price_per_round(name, dict(zip(entry.extents, p)),
+                                 template=template) for p in pts + hold}
+    fits: Dict[str, CostFit] = {}
+    for metric in ("flops", "hbm_bytes"):
+        rows = [[Fraction(math.prod(int(x) ** e
+                                    for x, e in zip(p, expts)))
+                 for expts in basis] for p in pts]
+        ys = [Fraction(getattr(counts[p], metric)) for p in pts]
+        coeffs = _solve(rows, ys)
+        fit = CostFit(name, metric, entry.extents, basis, tuple(coeffs),
+                      pts, hold, exact=True)
+        exact = all(
+            fit.at(dict(zip(entry.extents, h)))
+            == getattr(counts[h], metric) for h in hold)
+        fits[metric] = dataclasses.replace(fit, exact=exact)
+    return fits
+
+
+_FIT_CACHE: Dict[object, Dict[str, CostFit]] = {}
+
+
+def fit_for_config(cfg, entry: str = "sharded_scale_run") -> Dict[
+        str, CostFit]:
+    """Fits for a LIVE config family (the bench provenance hook): the
+    swept points keep every knob of ``cfg`` and rebind only the
+    extents, so the projection prices the run that was measured."""
+    key = (entry, cfg)
+    if key not in _FIT_CACHE:
+        _FIT_CACHE[key] = fit_entry(entry, template=cfg)
+    return _FIT_CACHE[key]
+
+
+def projected_flops(cfg, n_nodes: int,
+                    entry: str = "sharded_scale_run") -> int:
+    """Per-round flops of ``cfg``'s family at N=n_nodes (the
+    ``flops_projected_1m`` bench field when n_nodes=1M)."""
+    fit = fit_for_config(cfg, entry)["flops"]
+    return fit.at({"N": n_nodes, "M": cfg.m_slots})
+
+
+def xla_agreement(name: str = "scale_sim_step",
+                  env: Optional[Dict[str, int]] = None) -> dict:
+    """Compile one entry (single device) and compare the model against
+    ``compiled.cost_analysis()`` where the backend reports it. The
+    model is unfused and constant-weighted, XLA is fused and DCE'd —
+    agreement means the RATIO stays inside a declared band, recorded
+    either way. Returns ``{"reported": bool, ...}``."""
+    import functools
+
+    import jax
+
+    entry = PRICED_ENTRY_POINTS[name]
+    env = env or {"N": 64, "M": 64}
+    cfg = config_at(entry.template(), env)
+    closed = entry.build(cfg, 1)
+    model = count_jaxpr(closed)
+
+    from corrosion_tpu.sim.scale_step import ScaleRoundInput, scale_sim_step
+
+    st, net, key, _ = _scale_specs(cfg, 1)
+    inp = jax.eval_shape(lambda: ScaleRoundInput.quiet(cfg))
+    comp = jax.jit(functools.partial(scale_sim_step, cfg)).lower(
+        st, net, key, inp).compile()
+    ca = comp.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    rec = {"entry": name, "env": dict(env),
+           "model_flops": model.flops,
+           "model_hbm_bytes": model.hbm_bytes,
+           "band": XLA_AGREEMENT_BAND, "reported": False}
+    if not ca or "flops" not in ca:
+        return rec
+    xla_flops = float(ca["flops"])
+    xla_bytes = float(ca.get("bytes accessed", 0.0))
+    rec.update({
+        "reported": True,
+        "xla_flops": xla_flops,
+        "xla_bytes_accessed": xla_bytes,
+        "flops_ratio": model.flops / max(1.0, xla_flops),
+        "bytes_ratio": model.hbm_bytes / max(1.0, xla_bytes),
+    })
+    lo, hi = XLA_AGREEMENT_BAND
+    rec["agrees"] = (lo <= rec["flops_ratio"] <= hi
+                     and (xla_bytes == 0.0
+                          or lo <= rec["bytes_ratio"] <= hi))
+    return rec
+
+
+#: model/XLA ratio band: the model is deliberately unfused (bytes read
+#: high) and constant-weighted (flops read low vs XLA's per-op counts);
+#: measured ratios sit near 0.4x (flops) and 2.8x (bytes). A drift past
+#: 8x either way means the model lost a subsystem, not a constant.
+XLA_AGREEMENT_BAND: Tuple[float, float] = (1 / 8, 8.0)
+
+
+def roofline(entries: Sequence[str] = ("sharded_scale_run",
+                                       "fused_scale_run",
+                                       "quiet_scale_run")) -> dict:
+    """The static 1M roofline (PERF.md "Static roofline"): per-round
+    flops and HBM-model bytes projected to :data:`ROOFLINE_POINT`,
+    cross-checked against a DIRECT abstract trace at N=1M — the
+    extrapolation must reproduce the real jaxpr count bit-for-bit."""
+    out = {"point": dict(ROOFLINE_POINT), "entries": {}}
+    for name in entries:
+        entry = PRICED_ENTRY_POINTS[name]
+        fits = fit_entry(name)
+        direct = price_per_round(name, dict(ROOFLINE_POINT))
+        rec = {"exact_fit_expected": entry.exact_fit}
+        for metric, fit in fits.items():
+            proj = fit.at(ROOFLINE_POINT)
+            truth = getattr(direct, metric)
+            rec[metric + "_per_round"] = truth if not entry.exact_fit \
+                else proj
+            rec[metric + "_poly"] = fit.render()
+            rec[metric + "_fit_exact"] = fit.exact
+            if entry.exact_fit:
+                rec[metric + "_direct_1m_matches"] = proj == truth
+            else:
+                rec[metric + "_fit_rel_err"] = (
+                    abs(proj - truth) / max(1, truth))
+        out["entries"][name] = rec
+    return out
+
+
+# --------------------------------------------------------------------------
+# the static lint rule (no jax — runs in the no-backend lint engine)
+# --------------------------------------------------------------------------
+
+
+def inventory_degrees(inv) -> Dict[str, int]:
+    """Max per-symbol shape degree over an inventory's resolved leaves
+    (a leaf's degree = the sum over its dims — an [N, N] plane is
+    degree 2). Unresolved leaves are mem-budget's finding, not ours."""
+    degs: Dict[str, int] = {"N": 0, "M": 0}
+    for leaf in inv.leaves.values():
+        if leaf.dims is None:
+            continue
+        for sym in degs:
+            d = sum(dim.degree(sym) if hasattr(dim, "degree") else 0
+                    for dim in leaf.dims)
+            degs[sym] = max(degs[sym], d)
+    return degs
+
+
+def check_project(project: Project) -> List[Finding]:
+    """``cost-drift``: the walked tree's own state constructors must
+    grow at exactly the degrees the committed cost fits were priced at.
+    A new [N, N] plane (or a vanished [N, M] one) flips the symbolic
+    inventory's degree and fails lint until the fits are re-run and
+    :data:`COST_DEGREES` is updated in the same PR."""
+    findings: List[Finding] = []
+    classes = index_classes(project)
+    for root, declared in COST_DEGREES.items():
+        info = classes.get(root)
+        if info is None:
+            continue  # walked subset does not define this state
+        inv = shapes.build_inventory(project, root,
+                                     shapes.ConfigVal.default())
+        if inv is None:
+            continue
+        got = inventory_degrees(inv)
+        for sym, want in declared.items():
+            have = got.get(sym, 0)
+            if have == want:
+                continue
+            findings.append(Finding(
+                path=info.module.path, line=info.node.lineno, rule=RULE,
+                message=(
+                    f"{root}'s symbolic inventory is degree {have} in "
+                    f"{sym} but corrocost's committed fits price degree "
+                    f"{want} — the static roofline and the 1M flop "
+                    "projection are stale"),
+                hint=("re-run scripts/cost_probe.py and update "
+                      "analysis/cost.py COST_DEGREES with the PR that "
+                      "changes the state's growth"),
+            ))
+    return findings

@@ -43,7 +43,12 @@ RULE = "dtype-widen"
 #: stay the contract at every boundary, which is also why the int16
 #: default config needs no code change. ``q_tx``/``q_seq``/``q_nseq``
 #: are 8 since ISSUE 19 (``narrow_q_int8``, the analogous queue-counter
-#: tier) for the same reason.
+#: tier) for the same reason. Since ISSUE 20 this registry is also
+#: cross-checked against the REAL traced entry outputs:
+#: ``tests/test_cost.py`` abstract-traces the scan entry under the
+#: narrow knobs and asserts every name here exists in the carry at
+#: exactly its declared width — the static rule and the runtime dtype
+#: flow cannot drift apart silently.
 NARROW_LEAVES: Dict[str, int] = {
     "mem_timer": 16,
     "mem_tx": 8,

@@ -36,6 +36,8 @@ from typing import Callable, Dict, Iterable, Iterator, List, Optional, Tuple
 
 from corrosion_tpu.analysis import (
     asserts,
+    collectives,
+    cost,
     donation,
     dtypes,
     locks,
@@ -68,6 +70,12 @@ PROJECT_CHECKERS: Dict[str, Callable] = {
     # corrobudget (v3, ISSUE 12): symbolic shape/memory interpreter
     "mem-budget": shapes.check_budget,
     "densify": shapes.check_densify,
+    # corrocost (v4, ISSUE 20): cost & collective auditor — the static
+    # halves only (AST + symbolic degrees; the trace/compile gates live
+    # in tests/test_cost.py and scripts/cost_probe.py, keeping `--lint`
+    # jax-free)
+    "collective-budget": collectives.check_project,
+    "cost-drift": cost.check_project,
 }
 
 _SKIP_DIRS = {"__pycache__", ".git", ".jax_cache", "node_modules"}

@@ -318,6 +318,9 @@ def _probe_quiet_scale_run(repeats: int, rounds_per_segment: int = 2) -> int:
 
 
 #: name -> probe(repeats) -> observed trace count
+#: every name here must ALSO be priced by corrocost
+#: (cost.PRICED_ENTRY_POINTS — the tests/test_cost.py coverage gate):
+#: trace-stable AND costed, or not a hot entry point
 HOT_ENTRY_POINTS: Dict[str, Callable[[int], int]] = {
     "full_sim_step": _probe_full_step,
     "scale_sim_step": _probe_scale_step,

@@ -231,6 +231,19 @@ def sharded_scale_run_carry(cfg, mesh, st, net, key, inputs):
     return _scale_run_carry(cfg, st, key, net, inputs)
 
 
+#: corrocost's audit surface (ISSUE 20): public sharded entry name ->
+#: the underlying donated jit it dispatches. ``analysis/collectives.py``
+#: lowers EXACTLY these objects (static config, donation intact) to
+#: extract the GSPMD collective manifests it pins — auditing a copy of
+#: the function would let the real dispatch drift unpriced. Adding a
+#: sharded entry point means registering it here; the coverage gate in
+#: ``tests/test_cost.py`` pins this dict against the audited set.
+SHARDED_ENTRY_POINTS = {
+    "sharded_scale_run": _scale_run,
+    "sharded_scale_run_carry": _scale_run_carry,
+}
+
+
 # --- per-shard host drain + elastic re-placement ---------------------------
 #
 # The checkpoint pipeline's device<->host boundary (docs/checkpoints.md).

@@ -65,11 +65,12 @@ python -m corrosion_tpu.analysis \
     corrosion_tpu/sim/scale.py corrosion_tpu/sim/broadcast.py \
     corrosion_tpu/ops/versions.py corrosion_tpu/ops/partials.py \
     corrosion_tpu/resilience/fuzz.py \
+    corrosion_tpu/analysis/collectives.py corrosion_tpu/analysis/cost.py \
     --output-json /tmp/lint_fused_scope.json
 python - <<'PY'
 import json
 scoped = json.load(open("/tmp/lint_fused_scope.json"))
-if scoped["files_checked"] != 11 or not scoped["clean"]:
+if scoped["files_checked"] != 13 or not scoped["clean"]:
     raise SystemExit(f"fused/chaos-path lint scope regressed: {scoped}")
 full = json.load(open("artifacts/lint_r06.json"))
 assert "rule_counts" in full, "lint report lost rule_counts"
@@ -106,6 +107,44 @@ echo "corrobudget: under budget (report: artifacts/membudget_r12.json)"
 if [[ "${1:-}" == "--lint" ]]; then
     exit 0
 fi
+
+echo "== corrocost: cost & collective audit =="
+# the ISSUE 20 jaxpr/HLO pricing gate (docs/corrolint.md "corrocost",
+# PERF.md "Static roofline"): exact per-round cost fits for every hot
+# entry point (degrees gated against the corrobudget inventory), the 1M
+# roofline cross-checked against a direct 1M abstract trace, the XLA
+# cost_analysis band, and the GSPMD collective manifests of BOTH
+# registered sharded entries across the full 16-combo knob matrix on
+# flat and 2-D meshes — pinned bit for bit, with the smuggled-gather
+# mutation fixture required to FAIL the gate. Published as
+# artifacts/cost_r20.json (written even on failure). Compiles the
+# matrix: cold ~10 min, compile-cache-warm reruns are cheap.
+env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python scripts/cost_probe.py --output artifacts/cost_r20.json
+python - <<'PY'
+import json
+rec = json.load(open("artifacts/cost_r20.json"))
+if not rec.get("ok"):
+    raise SystemExit(f"corrocost gate failed: {rec.get('problems')}")
+if not rec.get("mutation_gate_fired"):
+    raise SystemExit("smuggled-gather mutation fixture did not fire")
+roof = rec["roofline"]["entries"]["sharded_scale_run"]
+for metric in ("flops", "hbm_bytes"):
+    if not roof[f"{metric}_fit_exact"] or not roof[f"{metric}_direct_1m_matches"]:
+        raise SystemExit(f"1M {metric} roofline not exact: {roof}")
+audited = set(rec["collective_audit"])
+if audited != {"sharded_scale_run", "sharded_scale_run_carry"}:
+    raise SystemExit(f"collective audit lost an entry: {audited}")
+for entry, arec in rec["collective_audit"].items():
+    if len(arec["labels"]) != 16:
+        raise SystemExit(f"{entry}: knob matrix incomplete: "
+                         f"{sorted(arec['labels'])}")
+print(f"corrocost: {roof['flops_per_round'] / 1e9:.1f} Gflop/round and "
+      f"{rec['collective_fit']['projected_1m_bytes'] / 1e6:.1f} MB "
+      f"cross-shard/round at 1M; 32 manifests pinned, mutation fired")
+PY
+echo "corrocost: ok (report: artifacts/cost_r20.json)"
 
 echo "== corrosan: seeded-fixture replay =="
 env JAX_PLATFORMS=cpu python -m corrosion_tpu.analysis.sanitizer \

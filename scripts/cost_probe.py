@@ -1,0 +1,196 @@
+#!/usr/bin/env python
+"""corrocost gate probe -> artifacts/cost_r20.json (ISSUE 20).
+
+The CI face of the jaxpr/HLO cost & collective audit (docs/corrolint.md
+"corrocost", PERF.md "Static roofline"):
+
+- **cost fits**: every priced hot entry point traced abstractly at the
+  fit points, interpolated exactly (Fraction arithmetic), holdouts
+  verified, degrees gated against ``COST_DEGREES`` AND against the
+  corrobudget symbolic inventory's own degrees — compute must grow
+  exactly as fast as the state it touches, no faster;
+- **1M roofline**: per-round flops / HBM-model bytes projected to the
+  declared 1M point, cross-checked against a DIRECT abstract trace at
+  N=1M (bit-equal for exact entries; recorded relative error for the
+  piecewise fused path);
+- **XLA cross-check**: the model vs ``compiled.cost_analysis()`` ratio
+  must stay inside the declared band where the backend reports it;
+- **collective audit**: both registered sharded entries lowered on the
+  8-way virtual mesh across the FULL 16-combo knob matrix
+  (quiet x fused x narrow_int8 x narrow_q_int8); manifests must match
+  the committed ``COLLECTIVE_PINS`` bit for bit, the 2-D (dcn, node)
+  mesh must compile the identical manifest, and the per-round traffic
+  fit must hold at its holdout N;
+- **mutation gate**: the smuggled-gather fixture MUST fail the pin
+  gate — a gate that cannot fire is decoration;
+- **lint face**: the ``collective-budget`` / ``cost-drift`` rules must
+  be clean over the repo walk (rule counts recorded).
+
+Exit 0 with ``"ok": true`` when every claim holds; exit 1 otherwise
+(the artifact is written either way). First cold run compiles the full
+matrix (~10 min); the persistent compile cache makes reruns cheap.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# must be set before jax initializes; conftest does the same for tests
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def main() -> int:
+    problems = []
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from corrosion_tpu.analysis import collectives, cost, shapes
+    from corrosion_tpu.analysis.runner import lint_report
+
+    # --- cost fits + degree gates ----------------------------------------
+    fits_rec = {}
+    for name, entry in cost.PRICED_ENTRY_POINTS.items():
+        fits = cost.fit_entry(name)
+        rec = {}
+        for metric, fit in fits.items():
+            rec[metric] = {
+                "poly": fit.render(),
+                "exact": fit.exact,
+                "degrees": {s: fit.degree(s) for s in fit.extents},
+            }
+            if entry.exact_fit and not fit.exact:
+                problems.append(
+                    f"{name}/{metric}: fit failed its holdouts — cost "
+                    "is no longer polynomial in the extents")
+        declared = cost.COST_DEGREES[entry.root]
+        for sym in entry.extents:
+            got = fits["flops"].degree(sym)
+            want = declared.get(sym, 0)
+            if got > want:
+                problems.append(
+                    f"{name}: flop degree {got} in {sym} exceeds the "
+                    f"{entry.root} inventory degree {want} — compute "
+                    "outgrew the state it touches")
+        fits_rec[name] = rec
+
+    # the inventory's OWN degrees must equal the declaration the lint
+    # rule gates on (three-way: fits <= declared == inventory)
+    inv_degrees = {}
+    for root, declared in cost.COST_DEGREES.items():
+        mode = "scale" if root == "ScaleSimState" else "full"
+        # symbolic default (cfg=None) — the lint rule's own view; a
+        # concrete config collapses bounded dims to constants
+        inv = shapes.static_inventory(None, mode=mode)
+        degs = cost.inventory_degrees(inv)
+        inv_degrees[root] = degs
+        for sym, want in declared.items():
+            if degs.get(sym, 0) != want:
+                problems.append(
+                    f"{root}: inventory degree {degs.get(sym, 0)} in "
+                    f"{sym} != declared COST_DEGREES {want}")
+
+    # --- 1M roofline ------------------------------------------------------
+    roof = cost.roofline()
+    for name, rec in roof["entries"].items():
+        for metric in ("flops", "hbm_bytes"):
+            if rec["exact_fit_expected"]:
+                if not rec[f"{metric}_direct_1m_matches"]:
+                    problems.append(
+                        f"{name}/{metric}: 1M extrapolation does not "
+                        "reproduce the direct 1M trace")
+            elif rec[f"{metric}_fit_rel_err"] > 1e-3:
+                problems.append(
+                    f"{name}/{metric}: fused fit drifted "
+                    f"{rec[f'{metric}_fit_rel_err']:.2e} from the "
+                    "direct 1M trace")
+
+    # --- XLA cost_analysis cross-check -----------------------------------
+    xla = cost.xla_agreement()
+    if xla["reported"] and not xla["agrees"]:
+        problems.append(
+            f"model/XLA ratio left the band {xla['band']}: "
+            f"flops {xla['flops_ratio']:.3f}, "
+            f"bytes {xla['bytes_ratio']:.3f}")
+
+    # --- collective audit: full knob matrix, both entries, both meshes ---
+    audits = {}
+    for entry in collectives.COLLECTIVE_BUDGET:
+        rec = collectives.audit_entry(entry)
+        problems.extend(rec.pop("problems"))
+        audits[entry] = rec
+
+    # --- per-round traffic fit + 1M projection ---------------------------
+    traffic = collectives.collective_fit()
+    for kind, rec in traffic["kinds"].items():
+        if not rec["exact"]:
+            problems.append(
+                f"collective {kind} bytes are not affine in N "
+                f"(holdout N={collectives.FIT_HOLDOUT_N} missed) — "
+                "projection downgraded to unverified quadratic")
+
+    # --- mutation gate: the smuggled gather MUST fire ---------------------
+    mutated = collectives.collective_manifest(
+        "sharded_scale_run", "dense",
+        fn=collectives.smuggled_gather_entry)
+    mut_problems = collectives.check_manifest(
+        "sharded_scale_run", "dense", mutated)
+    if not mut_problems:
+        problems.append(
+            "mutation fixture (smuggled all-gather) passed the pin "
+            "gate — the gate cannot fire")
+
+    # --- rule counts over the repo walk ----------------------------------
+    root_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    findings, n_files = lint_report(
+        [os.path.join(root_dir, "corrosion_tpu"),
+         os.path.join(root_dir, "bench.py")],
+        checkers=["collective-budget", "cost-drift"])
+    rule_counts = {"collective-budget": 0, "cost-drift": 0}
+    for f in findings:
+        rule_counts[f.rule] = rule_counts.get(f.rule, 0) + 1
+        problems.append(f.render())
+
+    record = {
+        "probe": "cost_r20",
+        "ok": not problems,
+        "roofline": roof,
+        "fits": fits_rec,
+        "cost_degrees": cost.COST_DEGREES,
+        "inventory_degrees": inv_degrees,
+        "xla_agreement": xla,
+        "collective_audit": audits,
+        "collective_fit": traffic,
+        "mutation_gate_fired": bool(mut_problems),
+        "mutation_problems": mut_problems,
+        "rule_counts": rule_counts,
+        "files_checked": n_files,
+    }
+    if problems:
+        record["problems"] = problems
+    out = sys.argv[sys.argv.index("--output") + 1] if (
+        "--output" in sys.argv) else "artifacts/cost_r20.json"
+    os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+    with open(out, "w") as f:
+        json.dump(record, f, indent=2)
+        f.write("\n")
+    print(json.dumps({
+        "probe": "cost_r20", "ok": record["ok"],
+        "mutation_gate_fired": record["mutation_gate_fired"],
+        "flops_per_round_1m": roof["entries"].get(
+            "sharded_scale_run", {}).get("flops_per_round"),
+        "collective_bytes_per_round_1m": traffic["projected_1m_bytes"],
+        "rule_counts": rule_counts,
+    }))
+    return 0 if not problems else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

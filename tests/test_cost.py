@@ -1,0 +1,367 @@
+"""corrocost (ISSUE 20): jaxpr/HLO cost & collective auditor gates.
+
+Four test tiers:
+
+- **rule fixtures**: ``collective-budget`` fires on undeclared explicit
+  collectives (and honors declared sites + reasoned suppressions);
+  ``cost-drift`` fires when a constructor's symbolic degree leaves the
+  declared fit degrees;
+- **coverage + registry sync**: every ``HOT_ENTRY_POINTS`` name is
+  priced, every registered sharded entry is audited, the declared
+  degrees equal the corrobudget inventory's own degrees, and the
+  roofline point matches corrobudget's;
+- **fit regressions**: exact interpolation with verified holdouts,
+  degrees, and the 1M-projection == direct-1M-trace identity;
+- **dtype-flow runtime cross-check**: the NARROW_LEAVES registry against
+  the REAL traced entry outputs under the narrow knobs — no leaf
+  widens through the jaxpr, and every registry name exists in the
+  state (both directions);
+- **collective manifests** (8 virtual devices): lowered manifests match
+  the committed pins bit for bit, the 2-D mesh compiles the identical
+  program, and the smuggled-gather mutation fixture FAILS the gate.
+"""
+
+import dataclasses
+
+import jax
+import pytest
+
+from corrosion_tpu.analysis import collectives, cost, dtypes, shapes
+from corrosion_tpu.analysis.runner import check_source
+
+# --- rule fixtures --------------------------------------------------------
+
+SMUGGLED = '''
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def drain_views(st, mesh):
+    gathered = lax.all_gather(st.store, "node")
+    return jnp.sum(gathered)
+'''
+
+
+def _collective(src, path="corrosion_tpu/sim/fixture_coll.py"):
+    return check_source(
+        src, path, {"collective-budget": collectives.check_project})
+
+
+def test_collective_budget_fires_on_undeclared_site():
+    findings = _collective(SMUGGLED)
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "collective-budget"
+    assert "all_gather" in f.message and "drain_views" in f.message
+    assert f.line == 8
+
+
+def test_collective_budget_fires_on_sharding_constraint():
+    src = SMUGGLED.replace(
+        'lax.all_gather(st.store, "node")',
+        "jax.lax.with_sharding_constraint(st.store, spec)")
+    findings = _collective(src)
+    assert len(findings) == 1
+    assert "with_sharding_constraint" in findings[0].message
+
+
+def test_collective_budget_declared_site_is_clean(monkeypatch):
+    monkeypatch.setitem(
+        collectives.DECLARED_COLLECTIVE_SITES,
+        "corrosion_tpu.sim.fixture_coll.drain_views",
+        "test fixture: deliberate gather")
+    assert _collective(SMUGGLED) == []
+
+
+def test_collective_budget_reasoned_suppression():
+    src = SMUGGLED.replace(
+        'lax.all_gather(st.store, "node")',
+        'lax.all_gather(st.store, "node")  '
+        "# corrolint: disable=collective-budget -- fixture gather")
+    assert _collective(src) == []
+
+
+def test_collective_budget_out_of_scope_is_clean():
+    # must be a path that EXISTS — nonexistent paths are deliberately
+    # in scope so fixture blobs can probe the rule
+    assert _collective(
+        SMUGGLED, path="corrosion_tpu/analysis/runner.py") == []
+
+
+def test_collective_budget_module_level_fires():
+    src = "from jax import lax\nTOTAL = lax.psum(1, 'node')\n"
+    findings = _collective(src)
+    assert len(findings) == 1
+    assert "module-level" in findings[0].message
+
+
+def test_collective_registry_empty_by_design():
+    # the whole point of the static rule today: the runtime surface has
+    # NO hand-written collectives — GSPMD owns cross-shard traffic and
+    # the pinned manifests audit it. Adding one means declaring it.
+    assert collectives.DECLARED_COLLECTIVE_SITES == {}
+
+
+WRONG_DEGREE = '''
+from typing import NamedTuple
+import jax
+import jax.numpy as jnp
+
+
+class ScaleSimState(NamedTuple):
+    pair: jax.Array
+
+    @staticmethod
+    def create(cfg):
+        n = cfg.n_nodes
+        return ScaleSimState(pair=jnp.zeros((n, n), jnp.int8))
+'''
+
+
+def test_cost_drift_fires_on_degree_change():
+    findings = check_source(
+        WRONG_DEGREE, "fixture_cost.py",
+        {"cost-drift": cost.check_project})
+    assert any(f.rule == "cost-drift" and "degree 2" in f.message
+               for f in findings)
+
+
+def test_cost_drift_silent_without_state_root():
+    assert check_source(
+        "def f():\n    return 1\n", "fixture_cost.py",
+        {"cost-drift": cost.check_project}) == []
+
+
+# --- coverage + registry sync ---------------------------------------------
+
+
+def test_every_hot_entry_point_is_priced():
+    from corrosion_tpu.analysis.tracecount import HOT_ENTRY_POINTS
+
+    missing = set(HOT_ENTRY_POINTS) - set(cost.PRICED_ENTRY_POINTS)
+    assert not missing, (
+        f"hot entry points registered but not priced by corrocost: "
+        f"{sorted(missing)} — add a PricedEntry in analysis/cost.py")
+
+
+def test_every_sharded_entry_is_audited():
+    from corrosion_tpu.parallel.mesh import SHARDED_ENTRY_POINTS
+
+    assert set(SHARDED_ENTRY_POINTS) == set(collectives.COLLECTIVE_BUDGET)
+    for entry, budget in collectives.COLLECTIVE_BUDGET.items():
+        assert budget["pins"], f"{entry} has no committed pins"
+        assert set(budget["pins"]) == {
+            lb for lb, _ in collectives.knob_matrix()}, (
+            f"{entry} pins do not cover the full knob matrix")
+
+
+def test_declared_degrees_match_inventory():
+    # three-way sync: COST_DEGREES == the corrobudget inventory's own
+    # max degrees, for both state roots (the lint rule gates the same
+    # equality over the walked tree)
+    for root, declared in cost.COST_DEGREES.items():
+        mode = "scale" if root == "ScaleSimState" else "full"
+        # symbolic default config (cfg=None): a concrete config would
+        # collapse bounded dims to constants and erase their degree —
+        # exactly the lint rule's ConfigVal.default() view
+        inv = shapes.static_inventory(None, mode=mode)
+        assert cost.inventory_degrees(inv) == declared, root
+
+
+def test_roofline_point_matches_corrobudget():
+    assert cost.ROOFLINE_POINT == shapes.HBM_BUDGET["point"]
+
+
+def test_repo_walk_is_clean_for_v4_rules():
+    from corrosion_tpu.analysis.runner import lint_report
+
+    findings, n_files = lint_report(
+        ["corrosion_tpu", "bench.py"],
+        checkers=["collective-budget", "cost-drift"])
+    assert findings == []
+    assert n_files > 20
+
+
+# --- fit regressions ------------------------------------------------------
+
+
+def test_scale_step_fit_exact_and_bilinear():
+    fits = cost.fit_entry("scale_sim_step")
+    for metric, fit in fits.items():
+        assert fit.exact, (metric, fit.render())
+        assert fit.degree("N") == 1 and fit.degree("M") == 1, fit.render()
+
+
+def test_full_step_fit_exact_and_quadratic():
+    fits = cost.fit_entry("full_sim_step")
+    assert fits["flops"].exact
+    assert fits["flops"].degree("N") == 2, fits["flops"].render()
+
+
+def test_fit_degrees_never_exceed_inventory():
+    for name, entry in cost.PRICED_ENTRY_POINTS.items():
+        if name not in ("scale_sim_step", "full_sim_step"):
+            continue  # one scan entry is covered by the 1M test below
+        fits = cost.fit_entry(name)
+        declared = cost.COST_DEGREES[entry.root]
+        for sym in entry.extents:
+            assert fits["flops"].degree(sym) <= declared.get(sym, 0), (
+                f"{name}: compute outgrew the {entry.root} inventory "
+                f"in {sym}")
+
+
+def test_1m_projection_reproduces_direct_trace():
+    # the extrapolation license: the fitted per-round polynomial at
+    # N=1M must equal a DIRECT abstract trace of the 1M-node program,
+    # bit for bit, for flops AND model bytes
+    fits = cost.fit_entry("sharded_scale_run")
+    direct = cost.price_per_round("sharded_scale_run",
+                                  dict(cost.ROOFLINE_POINT))
+    for metric, fit in fits.items():
+        assert fit.exact, fit.render()
+        assert fit.at(cost.ROOFLINE_POINT) == getattr(direct, metric)
+
+
+def test_fused_entry_declared_piecewise():
+    # the pallas grid's ceil-division makes the fused cost only
+    # piecewise polynomial — the registry must say so (roofline then
+    # uses the direct 1M trace as truth, not the extrapolation)
+    assert not cost.PRICED_ENTRY_POINTS["fused_scale_run"].exact_fit
+    assert cost.PRICED_ENTRY_POINTS["sharded_scale_run"].exact_fit
+
+
+def test_xla_cost_analysis_agreement():
+    rec = cost.xla_agreement()
+    if not rec["reported"]:
+        pytest.skip("backend reports no cost_analysis")
+    assert rec["agrees"], rec
+
+
+# --- dtype-flow runtime cross-check (satellite 1) -------------------------
+
+
+def _narrow_cfg(**knobs):
+    from corrosion_tpu.sim.scale_step import scale_sim_config
+
+    return scale_sim_config(
+        24, m_slots=8, n_origins=4, n_rows=4, n_cols=2, sync_interval=4,
+        **knobs)
+
+
+def _leaf_widths(cfg):
+    """name -> set of observed bit widths over the REAL traced output
+    state of the scan entry (path leaf name == registry key)."""
+    import functools
+
+    from corrosion_tpu.sim.scale_step import scale_run_rounds
+
+    entry = cost.PRICED_ENTRY_POINTS["sharded_scale_run"]
+    st_out = jax.eval_shape(
+        functools.partial(scale_run_rounds, cfg),
+        *cost._scale_specs(cfg, 2))[0]
+    widths = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(st_out)[0]:
+        name = None
+        for part in reversed(path):
+            if hasattr(part, "name"):
+                name = part.name
+                break
+        if name is None:
+            continue
+        widths.setdefault(name, set()).add(leaf.dtype.itemsize * 8)
+    del entry
+    return widths
+
+
+@pytest.mark.parametrize("knobs", [
+    {"narrow_int8": True, "narrow_q_int8": True},
+    {"narrow_int8": True, "narrow_q_int8": False},
+    {"narrow_int8": False, "narrow_q_int8": True},
+])
+def test_narrow_leaves_never_widen_through_the_jaxpr(knobs):
+    # the registry widths are the fully-narrow contract; a knob left
+    # off legitimately keeps its own planes wider, so the gate is
+    # "never wider than the knob's contract": with the knob on, the
+    # traced output must sit at the declared width exactly
+    widths = _leaf_widths(_narrow_cfg(**knobs))
+    i8_planes = {"mem_tx"}
+    q8_planes = {"q_seq", "q_nseq", "q_tx"}
+    for name, declared in dtypes.NARROW_LEAVES.items():
+        assert name in widths, (
+            f"registry leaf {name} not found in the traced state — "
+            "NARROW_LEAVES out of sync with the real carry")
+        got = widths[name]
+        assert len(got) == 1, (name, got)
+        (bits,) = got
+        if name in i8_planes and not knobs["narrow_int8"]:
+            assert bits >= declared, (name, bits)
+        elif name in q8_planes and not knobs["narrow_q_int8"]:
+            assert bits >= declared, (name, bits)
+        else:
+            assert bits == declared, (
+                f"{name}: traced width {bits} != declared {declared} — "
+                "a leaf widened (or over-narrowed) through the jaxpr")
+
+
+def test_narrow_registry_names_all_exist_in_state():
+    # registry-sync, the other direction: every NARROW_LEAVES key must
+    # name a real leaf of the default-config carry too
+    widths = _leaf_widths(_narrow_cfg())
+    assert set(dtypes.NARROW_LEAVES) <= set(widths)
+
+
+# --- collective manifests (mesh tier) -------------------------------------
+
+needs_mesh = pytest.mark.skipif(
+    len(jax.devices()) < collectives.MESH_DEVICES,
+    reason=f"needs {collectives.MESH_DEVICES} devices")
+
+
+@needs_mesh
+@pytest.mark.parametrize("label", collectives.TIER1_LABELS)
+def test_manifest_matches_pins(label):
+    man = collectives.collective_manifest("sharded_scale_run", label)
+    assert collectives.check_manifest(
+        "sharded_scale_run", label, man) == []
+
+
+@needs_mesh
+def test_carry_entry_manifest_and_2d_mesh_identical():
+    flat = collectives.collective_manifest(
+        "sharded_scale_run_carry", "dense")
+    assert collectives.check_manifest(
+        "sharded_scale_run_carry", "dense", flat) == []
+    dcn = collectives.collective_manifest(
+        "sharded_scale_run_carry", "dense", mesh_kind="dcn,node")
+    assert {k: list(v) for k, v in dcn.items()} == \
+        {k: list(v) for k, v in flat.items()}, (
+        "2-D (dcn,node) mesh compiled a different collective manifest")
+
+
+@needs_mesh
+def test_smuggled_gather_fails_the_gate():
+    mutated = collectives.collective_manifest(
+        "sharded_scale_run", "dense",
+        fn=collectives.smuggled_gather_entry)
+    problems = collectives.check_manifest(
+        "sharded_scale_run", "dense", mutated)
+    assert problems, (
+        "the smuggled all-gather passed the pin gate — the gate "
+        "cannot fire")
+    assert any("drifted" in p for p in problems)
+    # the smuggle specifically inflates the gather traffic
+    pins = collectives.COLLECTIVE_BUDGET["sharded_scale_run"]["pins"]
+    assert mutated["all-gather"][1] > pins["dense"]["all-gather"][1]
+
+
+@needs_mesh
+def test_manifest_parser_on_live_hlo():
+    # the regex tier never goes stale silently: the parser must find
+    # at least one collective in the real compiled sharded program,
+    # and every kind it finds must be a known HLO kind
+    man = collectives.collective_manifest("sharded_scale_run", "dense")
+    assert man, "no collectives parsed from a sharded program"
+    assert set(man) <= set(collectives.COLLECTIVE_HLO_KINDS)
+    for kind, (defs, nbytes) in man.items():
+        assert defs > 0 and nbytes > 0, (kind, defs, nbytes)
